@@ -1,0 +1,63 @@
+"""paddle_tpu: a TPU-native deep-learning framework with the capabilities of
+PaddlePaddle Fluid (reference at /root/reference, see SURVEY.md).
+
+Usage mirrors the reference's `import paddle.fluid as fluid`:
+
+    import paddle_tpu as fluid
+    x = fluid.layers.data(name='x', shape=[13])
+    y_pred = fluid.layers.fc(input=x, size=1)
+    ...
+    exe = fluid.Executor(fluid.TPUPlace())
+    exe.run(fluid.default_startup_program())
+    loss_val, = exe.run(feed={...}, fetch_list=[loss])
+
+Architecture: a deferred Program/Block/Operator IR (framework.py) built by
+layers, differentiated by backward.py, and compiled *whole-block* to XLA by
+executor.py -- one jitted computation per training step, not per-op kernel
+dispatch. Data parallelism is GSPMD sharding over a jax Mesh
+(parallel_executor.py), not threaded op handles + NCCL.
+"""
+from . import ops            # registers all operators (import side effect)
+from . import framework
+from .framework import (Program, Block, Operator, Variable, Parameter,
+                        default_main_program, default_startup_program,
+                        program_guard, name_scope, grad_var_name)
+from . import layers
+from . import initializer
+from . import unique_name
+from . import backward
+from .backward import append_backward, calc_gradient  # noqa: F401
+from . import optimizer
+from . import regularizer
+from . import clip
+from .param_attr import ParamAttr, WeightNormParamAttr
+from . import executor
+from .executor import (Executor, Scope, global_scope, scope_guard,
+                       CPUPlace, TPUPlace, XLAPlace, CUDAPlace, fetch_var)
+from . import lod_tensor
+from .lod_tensor import LoDTensor, create_lod_tensor, \
+    create_random_int_lodtensor
+from . import io
+from . import nets
+from . import metrics
+from . import profiler
+from .data_feeder import DataFeeder
+from . import parallel_executor
+from .parallel_executor import (ParallelExecutor, ExecutionStrategy,
+                                BuildStrategy)
+from . import core
+
+__version__ = '0.1.0'
+
+__all__ = [
+    'Program', 'Block', 'Operator', 'Variable', 'Parameter',
+    'default_main_program', 'default_startup_program', 'program_guard',
+    'name_scope', 'grad_var_name', 'layers', 'initializer', 'unique_name',
+    'backward', 'append_backward', 'optimizer', 'regularizer', 'clip',
+    'ParamAttr', 'WeightNormParamAttr', 'Executor', 'Scope', 'global_scope',
+    'scope_guard', 'CPUPlace', 'TPUPlace', 'XLAPlace', 'CUDAPlace',
+    'fetch_var', 'LoDTensor', 'create_lod_tensor',
+    'create_random_int_lodtensor', 'io', 'nets', 'metrics', 'profiler',
+    'DataFeeder', 'ParallelExecutor', 'ExecutionStrategy', 'BuildStrategy',
+    'core',
+]

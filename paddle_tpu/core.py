@@ -1,0 +1,30 @@
+"""Compatibility shim for `fluid.core` (reference paddle/fluid/pybind/
+pybind.cc): the reference exposes its C++ runtime here; our runtime is
+JAX/XLA, so this module surfaces the equivalent introspection symbols that
+user scripts and tests commonly touch."""
+from __future__ import annotations
+
+import jax
+
+from .executor import CPUPlace, TPUPlace, XLAPlace, CUDAPlace, Scope  # noqa
+from .lod_tensor import LoDTensor  # noqa: F401
+
+
+def is_compiled_with_cuda():
+    return False
+
+
+def is_compiled_with_tpu():
+    return any(d.platform == 'tpu' for d in jax.devices())
+
+
+def get_tpu_device_count():
+    return len([d for d in jax.devices() if d.platform != 'cpu']) \
+        or len(jax.devices())
+
+
+get_cuda_device_count = get_tpu_device_count
+
+
+def get_device_count():
+    return len(jax.devices())

@@ -1,0 +1,489 @@
+"""Executor: jit-compiles whole program blocks to XLA.
+
+TPU-native re-design of the reference C++ Executor
+(paddle/fluid/framework/executor.cc: Prepare :294, hot loop :332-339). The
+reference interprets a block op-by-op, dispatching each op to a per-device
+kernel -- per-op host overhead the TPU cannot tolerate. Here `Prepare`
+partitions a block into maximal *device segments* separated by host ops
+(save/load/print/feed/fetch), composes each segment's op emitters into one
+Python function over traced JAX values, and `jax.jit`s it with persistable
+state donated -- so a whole training step (forward + backward + optimizer
+update) is ONE XLA executable with in-place parameter buffers in HBM. This is
+exactly the BASELINE.json north star: "Executor jit-compiles ProgramDesc
+blocks to XLA HLO instead of dispatching per-op CUDA kernels".
+
+Compile cache: keyed on (program identity, mutation version, block, feed
+shape/dtype signature, fetch names) -- the analog of the reference Python
+Executor's program cache (executor.py:374) plus XLA's own executable cache.
+"""
+from __future__ import annotations
+
+import contextlib
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from . import registry
+from .framework import default_main_program, Program, Variable
+
+__all__ = ['Executor', 'Scope', 'global_scope', 'scope_guard',
+           'CPUPlace', 'TPUPlace', 'XLAPlace', 'CUDAPlace', 'fetch_var']
+
+
+# ---------------------------------------------------------------------------
+# Places (reference paddle/fluid/platform/place.h:78 boost::variant<...>)
+# ---------------------------------------------------------------------------
+
+class Place(object):
+    platform = None
+
+    def __init__(self, device_id=0):
+        self.device_id = device_id
+
+    def jax_device(self):
+        devs = (jax.devices(self.platform) if self.platform
+                else jax.devices())
+        return devs[self.device_id % len(devs)]
+
+    def __repr__(self):
+        return '%s(%d)' % (type(self).__name__, self.device_id)
+
+    def __eq__(self, other):
+        return (type(self) is type(other)
+                and self.device_id == other.device_id)
+
+    def __hash__(self):
+        return hash((type(self).__name__, self.device_id))
+
+
+class CPUPlace(Place):
+    platform = 'cpu'
+
+
+class TPUPlace(Place):
+    """The default-accelerator place: whatever JAX's default backend is
+    (TPU on hardware, CPU elsewhere) -- the analog of fluid.CUDAPlace and
+    the north star's fluid.XLAPlace."""
+    platform = None
+
+
+# reference-compatible aliases: scripts say fluid.CUDAPlace(0) / XLAPlace(0)
+XLAPlace = TPUPlace
+CUDAPlace = TPUPlace
+
+
+# ---------------------------------------------------------------------------
+# Scope (reference paddle/fluid/framework/scope.h:39): name -> runtime value.
+# Values are jax.Arrays (device-resident) or host numpy for host-only vars.
+# ---------------------------------------------------------------------------
+
+class Scope(object):
+    def __init__(self, parent=None):
+        self._vars = {}
+        self.parent = parent
+        self._kids = []
+
+    def var(self, name):
+        """Find-or-create (reference Scope::Var)."""
+        if name not in self._vars:
+            self._vars[name] = None
+        return self._vars.get(name)
+
+    def find_var(self, name):
+        s = self
+        while s is not None:
+            if name in s._vars:
+                return s._vars[name]
+            s = s.parent
+        return None
+
+    def has_var(self, name):
+        s = self
+        while s is not None:
+            if name in s._vars:
+                return True
+            s = s.parent
+        return False
+
+    def set_var(self, name, value):
+        self._vars[name] = value
+
+    def erase(self, name):
+        self._vars.pop(name, None)
+
+    def new_scope(self):
+        kid = Scope(parent=self)
+        self._kids.append(kid)
+        return kid
+
+    def drop_kids(self):
+        self._kids = []
+
+    def local_var_names(self):
+        return list(self._vars)
+
+
+_global_scope = Scope()
+
+
+def global_scope():
+    return _global_scope
+
+
+@contextlib.contextmanager
+def scope_guard(scope):
+    global _global_scope
+    prev, _global_scope = _global_scope, scope
+    try:
+        yield
+    finally:
+        _global_scope = prev
+
+
+def fetch_var(name, scope=None, return_numpy=True):
+    scope = scope or global_scope()
+    val = scope.find_var(name)
+    if val is None:
+        raise KeyError('var %r not found in scope' % name)
+    return np.asarray(val) if return_numpy else val
+
+
+# ---------------------------------------------------------------------------
+# Emit contexts
+# ---------------------------------------------------------------------------
+
+class EmitContext(object):
+    """Traced-value environment handed to op emitters during lowering."""
+
+    __slots__ = ('env', 'block', 'rng_key', 'is_test', '_op_index')
+
+    def __init__(self, env, block, rng_key, is_test):
+        self.env = env
+        self.block = block
+        self.rng_key = rng_key
+        self.is_test = is_test
+        self._op_index = 0
+
+    def get(self, name):
+        try:
+            return self.env[name]
+        except KeyError:
+            raise KeyError(
+                'var %r is not available on device; produced ops must come '
+                'before consumers in the block' % name)
+
+    def set(self, name, value):
+        self.env[name] = value
+
+    def var(self, name):
+        return self.block.var_recursive(name)
+
+    def rng(self, op):
+        if self.rng_key is None:
+            raise RuntimeError('op %s needs RNG but none was threaded'
+                               % op.type)
+        return jax.random.fold_in(self.rng_key, self._op_index)
+
+
+class HostContext(object):
+    """Host-side environment for host ops (print/save/load/...)."""
+
+    def __init__(self, scope, block):
+        self.scope = scope
+        self.block = block
+        self.is_test = False
+
+    def get(self, name):
+        val = self.scope.find_var(name)
+        if val is None:
+            raise KeyError('host op input %r not found in scope' % name)
+        return np.asarray(val)
+
+    def set(self, name, value):
+        self.scope.set_var(name, np.asarray(value))
+
+    def delete(self, name):
+        self.scope.erase(name)
+
+    def var(self, name):
+        return self.block.var_recursive(name)
+
+    def rng(self, op):
+        raise RuntimeError('host ops have no device RNG')
+
+
+# ---------------------------------------------------------------------------
+# Prepared program: segments + metadata
+# ---------------------------------------------------------------------------
+
+class _DeviceSegment(object):
+    __slots__ = ('ops', 'op_offsets', 'in_names', 'out_names', 'jitted',
+                 'needs_rng')
+
+    def __init__(self, ops, op_offsets):
+        self.ops = ops
+        self.op_offsets = op_offsets  # global op indices (stable rng folding)
+        self.in_names = []
+        self.out_names = []
+        self.jitted = None
+        self.needs_rng = False
+
+
+class _HostStep(object):
+    __slots__ = ('op',)
+
+    def __init__(self, op):
+        self.op = op
+
+
+class PreparedProgram(object):
+    """Analog of reference ExecutorPrepareContext (executor.h:28)."""
+
+    def __init__(self, program, block_id, feed_names, fetch_names):
+        self.program = program
+        self.block = program.blocks[block_id]
+        self.feed_names = list(feed_names)
+        self.fetch_names = list(fetch_names)
+        self.steps = []          # list of _DeviceSegment | _HostStep
+        self._build_segments()
+        self._analyze_dataflow()
+
+    def _build_segments(self):
+        cur_ops, cur_offsets = [], []
+        for idx, op in enumerate(self.block.ops):
+            if op.type in ('feed', 'fetch'):
+                continue
+            opdef = registry._REGISTRY.get(op.type)
+            if opdef is None or opdef.emit is None:
+                raise KeyError('op %r has no emitter registered' % op.type)
+            if opdef.host:
+                if cur_ops:
+                    self.steps.append(_DeviceSegment(cur_ops, cur_offsets))
+                    cur_ops, cur_offsets = [], []
+                self.steps.append(_HostStep(op))
+            else:
+                cur_ops.append(op)
+                cur_offsets.append(idx)
+        if cur_ops:
+            self.steps.append(_DeviceSegment(cur_ops, cur_offsets))
+
+    def _analyze_dataflow(self):
+        """Per-segment inputs (read-before-write) and live outputs (written
+        and needed by later steps / fetches / persistable state)."""
+        persistable = {name for name, var in self.block.vars.items()
+                       if var.persistable}
+        # also persistables from the global block (sub-block case)
+        b = self.block
+        while b.parent_block is not None:
+            b = b.parent_block
+            persistable |= {n for n, v in b.vars.items() if v.persistable}
+
+        step_reads, step_writes = [], []
+        for step in self.steps:
+            if isinstance(step, _DeviceSegment):
+                reads, writes = set(), set()
+                for op in step.ops:
+                    for n in op.input_arg_names():
+                        if n not in writes:
+                            reads.add(n)
+                    writes.update(op.output_arg_names())
+                step_reads.append(reads)
+                step_writes.append(writes)
+            else:
+                step_reads.append(set(step.op.input_arg_names()))
+                step_writes.append(set(step.op.output_arg_names()))
+
+        fetch_set = set(self.fetch_names)
+        for i, step in enumerate(self.steps):
+            if not isinstance(step, _DeviceSegment):
+                continue
+            later_reads = set()
+            for j in range(i + 1, len(self.steps)):
+                later_reads |= step_reads[j]
+            writes = step_writes[i]
+            step.in_names = sorted(step_reads[i])
+            step.out_names = sorted(
+                (writes & (later_reads | fetch_set | persistable)))
+            step.needs_rng = any(
+                registry._REGISTRY[op.type].stateful for op in step.ops)
+
+
+# ---------------------------------------------------------------------------
+# Executor
+# ---------------------------------------------------------------------------
+
+class Executor(object):
+    def __init__(self, place=None):
+        self.place = place if place is not None else TPUPlace()
+        self.device = self.place.jax_device()
+        self._prepared_cache = {}
+        self._step = 0
+        self._base_key = None
+
+    # -- rng ---------------------------------------------------------------
+    def _rng_key(self, program):
+        seed = program.random_seed
+        if self._base_key is None or seed != getattr(self, '_seed_used', None):
+            if seed == 0:
+                seed = np.random.randint(0, 2**31 - 1)
+            self._base_key = jax.random.PRNGKey(seed)
+            self._seed_used = program.random_seed
+        return jax.random.fold_in(self._base_key, self._step)
+
+    # -- public API (reference python executor.py:374 Executor.run) --------
+    def run(self, program=None, feed=None, fetch_list=None,
+            feed_var_name='feed', fetch_var_name='fetch', scope=None,
+            return_numpy=True, use_program_cache=True):
+        program = program or default_main_program()
+        if not isinstance(program, Program):
+            raise TypeError('Executor.run expects a Program')
+        feed = feed or {}
+        fetch_list = fetch_list or []
+        scope = scope or global_scope()
+
+        fetch_names = [v.name if isinstance(v, Variable) else str(v)
+                       for v in fetch_list]
+
+        feed_arrays = {}
+        for name, value in feed.items():
+            from .lod_tensor import LoDTensor
+            if isinstance(value, LoDTensor):
+                value = value.numpy()
+            arr = np.asarray(value)
+            var = program.global_block().vars.get(name)
+            if var is not None and var.dtype is not None and \
+                    arr.dtype != np.dtype(var.dtype) and \
+                    var.dtype != 'bfloat16':
+                arr = arr.astype(var.dtype)
+            feed_arrays[name] = self._put_feed(name, arr)
+
+        feed_sig = tuple(sorted(
+            (n, a.shape, str(a.dtype)) for n, a in feed_arrays.items()))
+        cache_key = (id(program), program._version, 0, feed_sig,
+                     tuple(fetch_names))
+        prepared = self._prepared_cache.get(cache_key) \
+            if use_program_cache else None
+        if prepared is None:
+            prepared = PreparedProgram(program, 0, feed_arrays.keys(),
+                                       fetch_names)
+            if use_program_cache:
+                self._prepared_cache[cache_key] = prepared
+
+        result = self._run_prepared(prepared, feed_arrays, fetch_names,
+                                    scope, program)
+        self._step += 1
+        if return_numpy:
+            return [np.asarray(r) for r in result]
+        return result
+
+    # -- internals ---------------------------------------------------------
+    def _run_prepared(self, prepared, feed_arrays, fetch_names, scope,
+                      program):
+        block = prepared.block
+        rng_key = None
+        temp_names = set()
+        # run-local view: feeds + scope
+        local = dict(feed_arrays)
+
+        def read_var(name):
+            if name in local:
+                return local[name]
+            val = scope.find_var(name)
+            if val is None:
+                raise RuntimeError(
+                    'var %r used before initialization -- did you run the '
+                    'startup program?' % name)
+            return val
+
+        for step in prepared.steps:
+            if isinstance(step, _HostStep):
+                # sync host-visible values then run on host
+                hctx = _RunHostContext(scope, local, block)
+                registry._REGISTRY[step.op.type].emit(hctx, step.op)
+                continue
+
+            if step.jitted is None:
+                step.jitted = self._compile_segment(
+                    step, block, program,
+                    feed_names=tuple(feed_arrays.keys()))
+            donated = {}
+            const = {}
+            out_set = set(step.out_names)
+            for name in step.in_names:
+                val = read_var(name)
+                if name in out_set and name not in feed_arrays:
+                    donated[name] = val
+                else:
+                    const[name] = val
+            if step.needs_rng and rng_key is None:
+                rng_key = self._rng_key(program)
+            key_arg = rng_key if step.needs_rng \
+                else jnp.zeros((2,), dtype=jnp.uint32)
+            outs = step.jitted(donated, const, key_arg)
+            for name, val in zip(step.out_names, outs):
+                local[name] = val
+                var = block.vars.get(name)
+                if var is not None and var.persistable:
+                    scope.set_var(name, val)
+                else:
+                    temp_names.add(name)
+
+        results = []
+        for name in fetch_names:
+            if name in local:
+                results.append(local[name])
+            else:
+                val = scope.find_var(name)
+                if val is None:
+                    raise KeyError('fetch var %r was not produced' % name)
+                results.append(val)
+        return results
+
+    def _put_feed(self, name, arr):
+        """Hook: place one feed array; ParallelExecutor overrides this to
+        shard the global batch across the mesh."""
+        return jax.device_put(arr, self.device)
+
+    def _jit_options(self, segment, feed_names):
+        """Hook: extra jax.jit kwargs (in_shardings for the SPMD path)."""
+        return {}
+
+    def _compile_segment(self, segment, block, program, feed_names=()):
+        is_test = program._is_test
+        ops = segment.ops
+        offsets = segment.op_offsets
+        out_names = segment.out_names
+
+        def seg_fn(donated, const, rng_key):
+            env = {}
+            env.update(const)
+            env.update(donated)
+            ctx = EmitContext(env, block, rng_key, is_test)
+            for op, off in zip(ops, offsets):
+                ctx._op_index = off
+                registry._REGISTRY[op.type].emit(ctx, op)
+            return tuple(env[n] for n in out_names)
+
+        return jax.jit(seg_fn, donate_argnums=(0,),
+                       **self._jit_options(segment, feed_names))
+
+
+class _RunHostContext(HostContext):
+    """Host context that also sees the run-local (non-persistable) values."""
+
+    def __init__(self, scope, local, block):
+        super(_RunHostContext, self).__init__(scope, block)
+        self.local = local
+
+    def get(self, name):
+        if name in self.local:
+            return np.asarray(self.local[name])
+        return super(_RunHostContext, self).get(name)
+
+    def set(self, name, value):
+        self.local[name] = np.asarray(value)
+        if self.scope.has_var(name) or \
+                (name in self.block.vars and self.block.vars[name].persistable):
+            self.scope.set_var(name, np.asarray(value))

@@ -1,0 +1,148 @@
+"""Model persistence: save/load vars + inference model packaging
+(reference python/paddle/fluid/io.py: save_vars:89, save_persistables:252,
+load_vars:295, save_inference_model:561, load_inference_model:677).
+
+Like the reference, persistence is expressed as save/load *ops* executed by
+the Executor (host ops here), so distributed/sharded variants can rewrite
+them; the tensor file format lives in ops/io_ops.py.
+"""
+from __future__ import annotations
+
+import os
+
+from .framework import (Program, Parameter, Variable, default_main_program,
+                        program_guard)
+
+__all__ = ['save_vars', 'save_params', 'save_persistables', 'load_vars',
+           'load_params', 'load_persistables', 'save_inference_model',
+           'load_inference_model', 'get_inference_program']
+
+_MODEL_FILENAME = '__model__'
+
+
+def is_persistable(var):
+    return var.persistable
+
+
+def is_parameter(var):
+    return isinstance(var, Parameter)
+
+
+def _build_io_program(main_program, vars, dirname, filename, op_type):
+    prog = Program()
+    block = prog.global_block()
+    names = []
+    for var in vars:
+        v = block.create_var(name=var.name, shape=var.shape, dtype=var.dtype,
+                             persistable=True)
+        names.append(v.name)
+        if filename is None:
+            block.append_op(
+                type=op_type,
+                inputs={'X': [v.name]} if op_type == 'save' else {},
+                outputs={} if op_type == 'save' else {'Out': [v.name]},
+                attrs={'file_path': os.path.join(dirname, v.name)})
+    if filename is not None:
+        block.append_op(
+            type=op_type + '_combine',
+            inputs={'X': names} if op_type == 'save' else {},
+            outputs={} if op_type == 'save' else {'Out': names},
+            attrs={'file_path': os.path.join(dirname, filename)})
+    return prog
+
+
+def save_vars(executor, dirname, main_program=None, vars=None,
+              predicate=None, filename=None):
+    main_program = main_program or default_main_program()
+    if vars is None:
+        vars = [v for v in main_program.list_vars()
+                if predicate is None or predicate(v)]
+    else:
+        vars = [main_program.global_block().var(v) if isinstance(v, str)
+                else v for v in vars]
+    prog = _build_io_program(main_program, vars, dirname, filename, 'save')
+    executor.run(prog)
+
+
+def save_params(executor, dirname, main_program=None, filename=None):
+    save_vars(executor, dirname, main_program, predicate=is_parameter,
+              filename=filename)
+
+
+def save_persistables(executor, dirname, main_program=None, filename=None):
+    save_vars(executor, dirname, main_program, predicate=is_persistable,
+              filename=filename)
+
+
+def load_vars(executor, dirname, main_program=None, vars=None,
+              predicate=None, filename=None):
+    main_program = main_program or default_main_program()
+    if vars is None:
+        vars = [v for v in main_program.list_vars()
+                if predicate is None or predicate(v)]
+    else:
+        vars = [main_program.global_block().var(v) if isinstance(v, str)
+                else v for v in vars]
+    prog = _build_io_program(main_program, vars, dirname, filename, 'load')
+    executor.run(prog)
+
+
+def load_params(executor, dirname, main_program=None, filename=None):
+    load_vars(executor, dirname, main_program, predicate=is_parameter,
+              filename=filename)
+
+
+def load_persistables(executor, dirname, main_program=None, filename=None):
+    load_vars(executor, dirname, main_program, predicate=is_persistable,
+              filename=filename)
+
+
+def save_inference_model(dirname, feeded_var_names, target_vars, executor,
+                         main_program=None, model_filename=None,
+                         params_filename=None,
+                         export_for_deployment=True):
+    """Prune to the inference subgraph + save params (reference io.py:561)."""
+    main_program = main_program or default_main_program()
+    if isinstance(feeded_var_names, str):
+        feeded_var_names = [feeded_var_names]
+    if isinstance(target_vars, Variable):
+        target_vars = [target_vars]
+    os.makedirs(dirname, exist_ok=True)
+
+    pruned = main_program.clone(for_test=True)
+    pruned = pruned._prune(target_vars)
+    pruned._fetch_targets = [v.name for v in target_vars]
+    pruned._feed_names = list(feeded_var_names)
+
+    model_path = os.path.join(dirname,
+                              model_filename or _MODEL_FILENAME)
+    with open(model_path, 'w') as f:
+        import json
+        f.write(json.dumps({
+            'program': pruned.to_json(),
+            'feed_names': list(feeded_var_names),
+            'fetch_names': [v.name for v in target_vars],
+        }))
+    save_persistables(executor, dirname, pruned, params_filename)
+    return [v.name for v in target_vars]
+
+
+def load_inference_model(dirname, executor, model_filename=None,
+                         params_filename=None):
+    """Returns (program, feed_names, fetch_vars) (reference io.py:677)."""
+    import json
+    model_path = os.path.join(dirname, model_filename or _MODEL_FILENAME)
+    with open(model_path) as f:
+        d = json.loads(f.read())
+    program = Program.from_json(d['program'])
+    load_persistables(executor, dirname, program, params_filename)
+    fetch_vars = [program.global_block().var(n) for n in d['fetch_names']]
+    return program, d['feed_names'], fetch_vars
+
+
+def get_inference_program(target_vars, main_program=None):
+    main_program = main_program or default_main_program()
+    pruned = main_program.clone(for_test=True)
+    if isinstance(target_vars, Variable):
+        target_vars = [target_vars]
+    return pruned._prune(target_vars)

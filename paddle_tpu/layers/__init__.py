@@ -1,0 +1,15 @@
+"""fluid.layers namespace (reference python/paddle/fluid/layers/__init__.py)."""
+from . import io
+from .io import *          # noqa: F401,F403
+from . import nn
+from .nn import *          # noqa: F401,F403
+from . import tensor
+from .tensor import *      # noqa: F401,F403
+from . import ops
+from .ops import *         # noqa: F401,F403
+from . import math_op_patch  # noqa: F401  (side effect: Variable operators)
+from . import learning_rate_scheduler
+from .learning_rate_scheduler import *  # noqa: F401,F403
+
+__all__ = (io.__all__ + nn.__all__ + tensor.__all__ + ops.__all__
+           + learning_rate_scheduler.__all__)

@@ -1,0 +1,30 @@
+"""Data-input layers (reference python/paddle/fluid/layers/io.py:38 data)."""
+from __future__ import annotations
+
+from ..framework import default_main_program, default_startup_program
+from ..layer_helper import LayerHelper
+
+__all__ = ['data']
+
+
+def data(name, shape, append_batch_size=True, dtype='float32', lod_level=0,
+         type=None, stop_gradient=True):
+    """Declare a feed variable (reference layers/io.py:38).
+
+    With append_batch_size=True a leading -1 batch dim is added. On TPU the
+    batch dim is still dynamic at the Python level; the executor's compile
+    cache keys on the concrete fed shape, so use fixed batch sizes (or a
+    small set of bucketed sizes) to avoid recompilation.
+    """
+    helper = LayerHelper('data', name=name)
+    shape = list(shape)
+    if append_batch_size:
+        shape = [-1] + shape
+    # the var must exist in the global block of both programs like the
+    # reference (layers/io.py:102 creates it in main & startup)
+    main_block = default_main_program().global_block()
+    if main_block.has_var(name):
+        return main_block.var(name)
+    return main_block.create_var(
+        name=name, shape=shape, dtype=dtype, lod_level=lod_level,
+        is_data=True, stop_gradient=stop_gradient)

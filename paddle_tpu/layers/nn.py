@@ -1,0 +1,722 @@
+"""NN layer functions (reference python/paddle/fluid/layers/nn.py, 5772 LoC:
+fc:114, embedding:226, conv2d:1369, batch_norm:2004, ...).
+
+Each layer appends ops to the current block; nothing executes here. The ops
+are later compiled whole-block to XLA by the Executor.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..framework import Variable
+from ..layer_helper import LayerHelper
+from ..initializer import Constant, Normal
+from ..param_attr import ParamAttr
+
+__all__ = [
+    'fc', 'embedding', 'conv2d', 'pool2d', 'batch_norm', 'layer_norm',
+    'dropout', 'cross_entropy', 'square_error_cost', 'accuracy', 'softmax',
+    'softmax_with_cross_entropy', 'sigmoid_cross_entropy_with_logits',
+    'mean', 'mul', 'elementwise_add', 'elementwise_sub', 'elementwise_mul',
+    'elementwise_div', 'elementwise_max', 'elementwise_min', 'elementwise_pow',
+    'reduce_sum', 'reduce_mean', 'reduce_max', 'reduce_min', 'reduce_prod',
+    'reshape', 'transpose', 'split', 'topk', 'matmul', 'scale', 'clip',
+    'clip_by_norm', 'one_hot', 'lookup_table', 'conv2d_transpose', 'relu',
+    'log', 'l2_normalize', 'smooth_l1', 'huber_loss', 'prelu', 'lrn',
+    'pad', 'label_smooth', 'flatten', 'stack', 'expand', 'squeeze',
+    'unsqueeze', 'gather', 'scatter', 'slice', 'shape', 'autoincreased_step_counter',
+]
+
+
+def fc(input, size, num_flatten_dims=1, param_attr=None, bias_attr=None,
+       act=None, is_test=False, name=None):
+    """Fully-connected layer (reference layers/nn.py:114). Multiple inputs
+    each get their own weight; results are summed, then bias + activation."""
+    helper = LayerHelper('fc', input=input, param_attr=param_attr,
+                         bias_attr=bias_attr, act=act, name=name)
+    dtype = helper.input_dtype()
+    mul_results = []
+    for input_var, p_attr in helper.iter_inputs_and_params():
+        input_shape = input_var.shape
+        param_shape = [
+            int(np.prod(input_shape[num_flatten_dims:]))
+        ] + [size]
+        w = helper.create_parameter(attr=p_attr, shape=param_shape,
+                                    dtype=dtype)
+        tmp = helper.create_variable_for_type_inference(dtype)
+        helper.append_op(
+            type='mul', inputs={'X': [input_var], 'Y': [w]},
+            outputs={'Out': [tmp]},
+            attrs={'x_num_col_dims': num_flatten_dims, 'y_num_col_dims': 1})
+        mul_results.append(tmp)
+    if len(mul_results) == 1:
+        pre_bias = mul_results[0]
+    else:
+        pre_bias = helper.create_variable_for_type_inference(dtype)
+        helper.append_op(type='sum', inputs={'X': mul_results},
+                         outputs={'Out': [pre_bias]})
+    pre_act = helper.append_bias_op(pre_bias, dim_start=num_flatten_dims)
+    return helper.append_activation(pre_act)
+
+
+def embedding(input, size, is_sparse=False, is_distributed=False,
+              padding_idx=None, param_attr=None, dtype='float32'):
+    """Embedding lookup (reference layers/nn.py:226). is_sparse selects the
+    SelectedRows grad path in the reference; on TPU the scatter-add gradient
+    XLA derives is already sparse-update shaped, so the flag is accepted and
+    ignored for the dense path."""
+    helper = LayerHelper('embedding', param_attr=param_attr)
+    w = helper.create_parameter(attr=helper.param_attr, shape=size,
+                                dtype=dtype)
+    tmp = helper.create_variable_for_type_inference(dtype)
+    padding_idx = -1 if padding_idx is None else (
+        padding_idx if padding_idx >= 0 else size[0] + padding_idx)
+    helper.append_op(
+        type='lookup_table', inputs={'Ids': [input], 'W': [w]},
+        outputs={'Out': [tmp]},
+        attrs={'is_sparse': is_sparse, 'is_distributed': is_distributed,
+               'padding_idx': padding_idx})
+    return tmp
+
+
+lookup_table = embedding
+
+
+def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
+           groups=None, param_attr=None, bias_attr=None, use_cudnn=True,
+           act=None, name=None):
+    """2-D convolution, NCHW (reference layers/nn.py:1369). use_cudnn is
+    accepted for API parity and ignored -- XLA picks the conv algorithm."""
+    helper = LayerHelper('conv2d', param_attr=param_attr,
+                         bias_attr=bias_attr, act=act, name=name)
+    dtype = input.dtype
+    num_channels = input.shape[1]
+    groups = groups or 1
+    if num_channels % groups != 0:
+        raise ValueError('num_channels must be divisible by groups')
+
+    def _pair(x):
+        return [x, x] if isinstance(x, int) else list(x)
+
+    filter_size = _pair(filter_size)
+    stride = _pair(stride)
+    padding = _pair(padding)
+    dilation = _pair(dilation)
+
+    filter_shape = [num_filters, num_channels // groups] + filter_size
+    std = (2.0 / (filter_size[0] * filter_size[1] * num_channels)) ** 0.5
+    w = helper.create_parameter(
+        attr=helper.param_attr, shape=filter_shape, dtype=dtype,
+        default_initializer=Normal(0.0, std))
+    pre_bias = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        type='conv2d',
+        inputs={'Input': [input], 'Filter': [w]},
+        outputs={'Output': [pre_bias]},
+        attrs={'strides': stride, 'paddings': padding, 'dilations': dilation,
+               'groups': groups})
+    pre_act = _append_channel_bias(helper, pre_bias)
+    return helper.append_activation(pre_act)
+
+
+def _append_channel_bias(helper, pre_bias):
+    bias_attr = helper.bias_attr
+    if not bias_attr:
+        return pre_bias
+    num_channels = pre_bias.shape[1]
+    b = helper.create_parameter(attr=bias_attr, shape=[num_channels],
+                                dtype=pre_bias.dtype, is_bias=True)
+    tmp = helper.create_variable_for_type_inference(dtype=pre_bias.dtype)
+    helper.append_op(type='elementwise_add',
+                     inputs={'X': [pre_bias], 'Y': [b]},
+                     outputs={'Out': [tmp]}, attrs={'axis': 1})
+    return tmp
+
+
+def conv2d_transpose(input, num_filters, output_size=None, filter_size=None,
+                     padding=0, stride=1, dilation=1, groups=None,
+                     param_attr=None, bias_attr=None, use_cudnn=True,
+                     act=None, name=None):
+    helper = LayerHelper('conv2d_transpose', param_attr=param_attr,
+                         bias_attr=bias_attr, act=act, name=name)
+    dtype = input.dtype
+    num_channels = input.shape[1]
+    groups = groups or 1
+
+    def _pair(x):
+        return [x, x] if isinstance(x, int) else list(x)
+
+    stride = _pair(stride)
+    padding = _pair(padding)
+    dilation = _pair(dilation)
+    if filter_size is None:
+        if output_size is None:
+            raise ValueError('output_size or filter_size must be set')
+        output_size = _pair(output_size)
+        h, w_ = input.shape[2], input.shape[3]
+        filter_size = [
+            (output_size[0] - (h - 1) * stride[0] + 2 * padding[0]
+             - 1) // dilation[0] + 1,
+            (output_size[1] - (w_ - 1) * stride[1] + 2 * padding[1]
+             - 1) // dilation[1] + 1]
+    else:
+        filter_size = _pair(filter_size)
+    filter_shape = [num_channels, num_filters // groups] + filter_size
+    w = helper.create_parameter(attr=helper.param_attr, shape=filter_shape,
+                                dtype=dtype)
+    pre_bias = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        type='conv2d_transpose',
+        inputs={'Input': [input], 'Filter': [w]},
+        outputs={'Output': [pre_bias]},
+        attrs={'strides': stride, 'paddings': padding, 'dilations': dilation,
+               'groups': groups})
+    pre_act = _append_channel_bias(helper, pre_bias)
+    return helper.append_activation(pre_act)
+
+
+def pool2d(input, pool_size=-1, pool_type='max', pool_stride=1,
+           pool_padding=0, global_pooling=False, use_cudnn=True,
+           ceil_mode=False, exclusive=True, name=None):
+    """2-D pooling (reference layers/nn.py pool2d)."""
+    if pool_type not in ('max', 'avg'):
+        raise ValueError("pool_type must be 'max' or 'avg'")
+    helper = LayerHelper('pool2d', name=name)
+    dtype = input.dtype
+    out = helper.create_variable_for_type_inference(dtype)
+
+    def _pair(x):
+        return [x, x] if isinstance(x, int) else list(x)
+
+    helper.append_op(
+        type='pool2d', inputs={'X': [input]}, outputs={'Out': [out]},
+        attrs={'pooling_type': pool_type, 'ksize': _pair(pool_size),
+               'global_pooling': global_pooling, 'strides': _pair(pool_stride),
+               'paddings': _pair(pool_padding), 'ceil_mode': ceil_mode,
+               'exclusive': exclusive})
+    return out
+
+
+def batch_norm(input, act=None, is_test=False, momentum=0.9, epsilon=1e-5,
+               param_attr=None, bias_attr=None, data_layout='NCHW',
+               in_place=False, name=None, moving_mean_name=None,
+               moving_variance_name=None, do_model_average_for_mean_and_var=False,
+               use_global_stats=False):
+    """Batch normalization (reference layers/nn.py:2004)."""
+    helper = LayerHelper('batch_norm', param_attr=param_attr,
+                         bias_attr=bias_attr, act=act, name=name)
+    dtype = input.dtype
+    input_shape = input.shape
+    channel_num = input_shape[1] if data_layout == 'NCHW' else input_shape[-1]
+    param_shape = [channel_num]
+
+    scale = helper.create_parameter(
+        attr=helper.param_attr, shape=param_shape, dtype=dtype,
+        default_initializer=Constant(1.0))
+    bias = helper.create_parameter(
+        attr=helper.bias_attr, shape=param_shape, dtype=dtype, is_bias=True)
+
+    mean = helper.create_or_get_global_variable(
+        name=moving_mean_name or helper.name + '.mean',
+        dtype='float32', shape=param_shape, persistable=True)
+    helper.set_variable_initializer(mean, Constant(0.0))
+    variance = helper.create_or_get_global_variable(
+        name=moving_variance_name or helper.name + '.variance',
+        dtype='float32', shape=param_shape, persistable=True)
+    helper.set_variable_initializer(variance, Constant(1.0))
+
+    saved_mean = helper.create_variable_for_type_inference(
+        dtype='float32', stop_gradient=True)
+    saved_variance = helper.create_variable_for_type_inference(
+        dtype='float32', stop_gradient=True)
+    out = helper.create_variable_for_type_inference(dtype)
+
+    helper.append_op(
+        type='batch_norm',
+        inputs={'X': [input], 'Scale': [scale], 'Bias': [bias],
+                'Mean': [mean], 'Variance': [variance]},
+        outputs={'Y': [out], 'MeanOut': [mean], 'VarianceOut': [variance],
+                 'SavedMean': [saved_mean], 'SavedVariance': [saved_variance]},
+        attrs={'momentum': momentum, 'epsilon': epsilon, 'is_test': is_test,
+               'data_layout': data_layout,
+               'use_global_stats': use_global_stats})
+    return helper.append_activation(out)
+
+
+def layer_norm(input, scale=True, shift=True, begin_norm_axis=1,
+               epsilon=1e-5, param_attr=None, bias_attr=None, act=None,
+               name=None):
+    helper = LayerHelper('layer_norm', param_attr=param_attr,
+                         bias_attr=bias_attr, act=act, name=name)
+    dtype = input.dtype
+    input_shape = input.shape
+    param_shape = [int(np.prod(input_shape[begin_norm_axis:]))]
+    inputs = {'X': [input]}
+    if scale:
+        s = helper.create_parameter(attr=helper.param_attr, shape=param_shape,
+                                    dtype=dtype,
+                                    default_initializer=Constant(1.0))
+        inputs['Scale'] = [s]
+    if shift:
+        b = helper.create_parameter(attr=helper.bias_attr, shape=param_shape,
+                                    dtype=dtype, is_bias=True)
+        inputs['Bias'] = [b]
+    mean_out = helper.create_variable_for_type_inference(
+        dtype='float32', stop_gradient=True)
+    variance_out = helper.create_variable_for_type_inference(
+        dtype='float32', stop_gradient=True)
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        type='layer_norm', inputs=inputs,
+        outputs={'Y': [out], 'Mean': [mean_out], 'Variance': [variance_out]},
+        attrs={'epsilon': epsilon, 'begin_norm_axis': begin_norm_axis})
+    return helper.append_activation(out)
+
+
+def dropout(x, dropout_prob, is_test=False, seed=None, name=None,
+            dropout_implementation='downgrade_in_infer'):
+    helper = LayerHelper('dropout', name=name)
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    mask = helper.create_variable_for_type_inference(
+        dtype=x.dtype, stop_gradient=True)
+    helper.append_op(
+        type='dropout', inputs={'X': [x]},
+        outputs={'Out': [out], 'Mask': [mask]},
+        attrs={'dropout_prob': dropout_prob, 'is_test': is_test,
+               'seed': seed if seed is not None else 0,
+               'dropout_implementation': dropout_implementation})
+    return out
+
+
+def softmax(input, use_cudnn=True, name=None):
+    helper = LayerHelper('softmax', name=name)
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    helper.append_op(type='softmax', inputs={'X': [input]},
+                     outputs={'Out': [out]})
+    return out
+
+
+def cross_entropy(input, label, soft_label=False, ignore_index=-100):
+    helper = LayerHelper('cross_entropy')
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    helper.append_op(type='cross_entropy',
+                     inputs={'X': [input], 'Label': [label]},
+                     outputs={'Y': [out]},
+                     attrs={'soft_label': soft_label,
+                            'ignore_index': ignore_index})
+    return out
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False,
+                               ignore_index=-100, return_softmax=False):
+    helper = LayerHelper('softmax_with_cross_entropy')
+    softmax_out = helper.create_variable_for_type_inference(
+        dtype=logits.dtype)
+    loss = helper.create_variable_for_type_inference(dtype=logits.dtype)
+    helper.append_op(type='softmax_with_cross_entropy',
+                     inputs={'Logits': [logits], 'Label': [label]},
+                     outputs={'Softmax': [softmax_out], 'Loss': [loss]},
+                     attrs={'soft_label': soft_label,
+                            'ignore_index': ignore_index})
+    if return_softmax:
+        return loss, softmax_out
+    return loss
+
+
+def sigmoid_cross_entropy_with_logits(x, label, ignore_index=-100, name=None):
+    helper = LayerHelper('sigmoid_cross_entropy_with_logits', name=name)
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    helper.append_op(type='sigmoid_cross_entropy_with_logits',
+                     inputs={'X': [x], 'Label': [label]},
+                     outputs={'Out': [out]},
+                     attrs={'ignore_index': ignore_index})
+    return out
+
+
+def square_error_cost(input, label):
+    helper = LayerHelper('square_error_cost')
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    helper.append_op(type='square_error_cost',
+                     inputs={'X': [input], 'Y': [label]},
+                     outputs={'Out': [out]})
+    return out
+
+
+def accuracy(input, label, k=1, correct=None, total=None):
+    """top-k accuracy (reference layers/metric_op.py accuracy)."""
+    helper = LayerHelper('accuracy')
+    topk_out, topk_indices = topk(input, k=k)
+    acc_out = helper.create_variable_for_type_inference(dtype='float32')
+    if correct is None:
+        correct = helper.create_variable_for_type_inference(dtype='int32')
+    if total is None:
+        total = helper.create_variable_for_type_inference(dtype='int32')
+    helper.append_op(
+        type='accuracy',
+        inputs={'Out': [topk_out], 'Indices': [topk_indices],
+                'Label': [label]},
+        outputs={'Accuracy': [acc_out], 'Correct': [correct],
+                 'Total': [total]})
+    return acc_out
+
+
+def mean(x, name=None):
+    helper = LayerHelper('mean', name=name)
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    helper.append_op(type='mean', inputs={'X': [x]}, outputs={'Out': [out]})
+    return out
+
+
+def mul(x, y, x_num_col_dims=1, y_num_col_dims=1, name=None):
+    helper = LayerHelper('mul', name=name)
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    helper.append_op(type='mul', inputs={'X': [x], 'Y': [y]},
+                     outputs={'Out': [out]},
+                     attrs={'x_num_col_dims': x_num_col_dims,
+                            'y_num_col_dims': y_num_col_dims})
+    return out
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, alpha=1.0, name=None):
+    helper = LayerHelper('matmul', name=name)
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    helper.append_op(type='matmul', inputs={'X': [x], 'Y': [y]},
+                     outputs={'Out': [out]},
+                     attrs={'transpose_X': transpose_x,
+                            'transpose_Y': transpose_y,
+                            'alpha': float(alpha)})
+    return out
+
+
+def _elementwise(op_type):
+    def layer(x, y, axis=-1, act=None, name=None):
+        helper = LayerHelper(op_type, act=act, name=name)
+        out = helper.create_variable_for_type_inference(dtype=x.dtype)
+        helper.append_op(type=op_type, inputs={'X': [x], 'Y': [y]},
+                         outputs={'Out': [out]}, attrs={'axis': axis})
+        return helper.append_activation(out)
+    layer.__name__ = op_type
+    return layer
+
+
+elementwise_add = _elementwise('elementwise_add')
+elementwise_sub = _elementwise('elementwise_sub')
+elementwise_mul = _elementwise('elementwise_mul')
+elementwise_div = _elementwise('elementwise_div')
+elementwise_max = _elementwise('elementwise_max')
+elementwise_min = _elementwise('elementwise_min')
+elementwise_pow = _elementwise('elementwise_pow')
+
+
+def _reduce(op_type):
+    def layer(input, dim=None, keep_dim=False, name=None):
+        helper = LayerHelper(op_type, name=name)
+        out = helper.create_variable_for_type_inference(dtype=input.dtype)
+        if dim is not None and not isinstance(dim, (list, tuple)):
+            dim = [dim]
+        helper.append_op(
+            type=op_type, inputs={'X': [input]}, outputs={'Out': [out]},
+            attrs={'dim': dim if dim is not None else [0],
+                   'keep_dim': keep_dim, 'reduce_all': dim is None})
+        return out
+    layer.__name__ = op_type
+    return layer
+
+
+reduce_sum = _reduce('reduce_sum')
+reduce_mean = _reduce('reduce_mean')
+reduce_max = _reduce('reduce_max')
+reduce_min = _reduce('reduce_min')
+reduce_prod = _reduce('reduce_prod')
+
+
+def reshape(x, shape, actual_shape=None, act=None, inplace=False, name=None):
+    helper = LayerHelper('reshape2', act=act, name=name)
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    x_shape = helper.create_variable_for_type_inference(dtype=x.dtype)
+    helper.append_op(type='reshape2', inputs={'X': [x]},
+                     outputs={'Out': [out], 'XShape': [x_shape]},
+                     attrs={'shape': list(shape)})
+    return helper.append_activation(out)
+
+
+def transpose(x, perm, name=None):
+    helper = LayerHelper('transpose2', name=name)
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    x_shape = helper.create_variable_for_type_inference(dtype=x.dtype)
+    helper.append_op(type='transpose2', inputs={'X': [x]},
+                     outputs={'Out': [out], 'XShape': [x_shape]},
+                     attrs={'axis': list(perm)})
+    return out
+
+
+def split(input, num_or_sections, dim=-1, name=None):
+    helper = LayerHelper('split', name=name)
+    input_shape = input.shape
+    dim = dim if dim >= 0 else dim + len(input_shape)
+    if isinstance(num_or_sections, int):
+        num = num_or_sections
+        sections = []
+    else:
+        num = len(num_or_sections)
+        sections = list(num_or_sections)
+    outs = [helper.create_variable_for_type_inference(dtype=input.dtype)
+            for _ in range(num)]
+    helper.append_op(type='split', inputs={'X': [input]},
+                     outputs={'Out': outs},
+                     attrs={'num': num if not sections else 0,
+                            'sections': sections, 'axis': dim})
+    return outs
+
+
+def topk(input, k, name=None):
+    helper = LayerHelper('top_k', name=name)
+    values = helper.create_variable_for_type_inference(dtype=input.dtype)
+    indices = helper.create_variable_for_type_inference(dtype='int64')
+    helper.append_op(type='top_k', inputs={'X': [input]},
+                     outputs={'Out': [values], 'Indices': [indices]},
+                     attrs={'k': k})
+    values.stop_gradient = True
+    indices.stop_gradient = True
+    return values, indices
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    helper = LayerHelper('scale', act=act, name=name)
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    helper.append_op(type='scale', inputs={'X': [x]}, outputs={'Out': [out]},
+                     attrs={'scale': float(scale), 'bias': float(bias),
+                            'bias_after_scale': bias_after_scale})
+    return helper.append_activation(out)
+
+
+def clip(x, min, max, name=None):
+    helper = LayerHelper('clip', name=name)
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    helper.append_op(type='clip', inputs={'X': [x]}, outputs={'Out': [out]},
+                     attrs={'min': float(min), 'max': float(max)})
+    return out
+
+
+def clip_by_norm(x, max_norm, name=None):
+    helper = LayerHelper('clip_by_norm', name=name)
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    helper.append_op(type='clip_by_norm', inputs={'X': [x]},
+                     outputs={'Out': [out]},
+                     attrs={'max_norm': float(max_norm)})
+    return out
+
+
+def one_hot(input, depth):
+    helper = LayerHelper('one_hot')
+    out = helper.create_variable_for_type_inference(dtype='float32')
+    helper.append_op(type='one_hot', inputs={'X': [input]},
+                     outputs={'Out': [out]}, attrs={'depth': depth})
+    return out
+
+
+def relu(x, name=None):
+    helper = LayerHelper('relu', name=name)
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    helper.append_op(type='relu', inputs={'X': [x]}, outputs={'Out': [out]})
+    return out
+
+
+def log(x, name=None):
+    helper = LayerHelper('log', name=name)
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    helper.append_op(type='log', inputs={'X': [x]}, outputs={'Out': [out]})
+    return out
+
+
+def l2_normalize(x, axis, epsilon=1e-12, name=None):
+    """x / sqrt(sum(x^2, axis) + eps), composed from primitive ops
+    (reference layers/nn.py l2_normalize uses norm op)."""
+    sq = elementwise_mul(x, x)
+    summed = reduce_sum(sq, dim=axis, keep_dim=True)
+    from .ops import sqrt as _sqrt
+    norm = _sqrt(elementwise_add(summed, fill_const_like(summed, epsilon)))
+    return elementwise_div(x, norm, axis=0 if axis != 0 else 0)
+
+
+def fill_const_like(x, value):
+    from .tensor import fill_constant
+    return fill_constant(shape=list(x.shape), dtype=x.dtype, value=value)
+
+
+def smooth_l1(x, y, inside_weight=None, outside_weight=None, sigma=None):
+    helper = LayerHelper('smooth_l1_loss')
+    diff = helper.create_variable_for_type_inference(dtype=x.dtype)
+    loss = helper.create_variable_for_type_inference(dtype=x.dtype)
+    inputs = {'X': [x], 'Y': [y]}
+    if inside_weight is not None:
+        inputs['InsideWeight'] = [inside_weight]
+    if outside_weight is not None:
+        inputs['OutsideWeight'] = [outside_weight]
+    helper.append_op(type='smooth_l1_loss', inputs=inputs,
+                     outputs={'Diff': [diff], 'Out': [loss]},
+                     attrs={'sigma': sigma if sigma is not None else 1.0})
+    return loss
+
+
+def huber_loss(input, label, delta):
+    helper = LayerHelper('huber_loss')
+    residual = helper.create_variable_for_type_inference(dtype=input.dtype)
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    helper.append_op(type='huber_loss',
+                     inputs={'X': [input], 'Y': [label]},
+                     outputs={'Out': [out], 'Residual': [residual]},
+                     attrs={'delta': delta})
+    return out
+
+
+def prelu(x, mode, param_attr=None, name=None):
+    helper = LayerHelper('prelu', param_attr=param_attr, name=name)
+    if mode not in ('all', 'channel', 'element'):
+        raise ValueError("mode must be one of all|channel|element")
+    alpha_shape = [1]
+    if mode == 'channel':
+        alpha_shape = [1, x.shape[1], 1, 1]
+    elif mode == 'element':
+        alpha_shape = list(x.shape)
+    alpha = helper.create_parameter(
+        attr=helper.param_attr, shape=alpha_shape, dtype='float32',
+        is_bias=False, default_initializer=Constant(0.25))
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    helper.append_op(type='prelu', inputs={'X': [x], 'Alpha': [alpha]},
+                     outputs={'Out': [out]}, attrs={'mode': mode})
+    return out
+
+
+def lrn(input, n=5, k=1.0, alpha=1e-4, beta=0.75, name=None):
+    helper = LayerHelper('lrn', name=name)
+    mid_out = helper.create_variable_for_type_inference(
+        dtype=input.dtype, stop_gradient=True)
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    helper.append_op(type='lrn', inputs={'X': [input]},
+                     outputs={'Out': [out], 'MidOut': [mid_out]},
+                     attrs={'n': n, 'k': k, 'alpha': alpha, 'beta': beta})
+    return out
+
+
+def pad(x, paddings, pad_value=0.0, name=None):
+    helper = LayerHelper('pad', name=name)
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    helper.append_op(type='pad', inputs={'X': [x]}, outputs={'Out': [out]},
+                     attrs={'paddings': list(paddings),
+                            'pad_value': float(pad_value)})
+    return out
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, dtype='float32',
+                 name=None):
+    helper = LayerHelper('label_smooth', name=name)
+    out = helper.create_variable_for_type_inference(dtype)
+    inputs = {'X': [label]}
+    if prior_dist is not None:
+        inputs['PriorDist'] = [prior_dist]
+    helper.append_op(type='label_smooth', inputs=inputs,
+                     outputs={'Out': [out]}, attrs={'epsilon': float(epsilon)})
+    return out
+
+
+def flatten(x, axis=1, name=None):
+    helper = LayerHelper('flatten', name=name)
+    lead = int(np.prod(x.shape[:axis])) if axis > 0 else 1
+    rest = int(np.prod(x.shape[axis:]))
+    return reshape(x, [-1 if any(s < 0 for s in x.shape[:axis]) else lead,
+                       rest])
+
+
+def stack(x, axis=0):
+    helper = LayerHelper('stack')
+    if isinstance(x, Variable):
+        x = [x]
+    out = helper.create_variable_for_type_inference(dtype=x[0].dtype)
+    helper.append_op(type='stack', inputs={'X': x}, outputs={'Y': [out]},
+                     attrs={'axis': axis})
+    return out
+
+
+def expand(x, expand_times, name=None):
+    helper = LayerHelper('expand', name=name)
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    helper.append_op(type='expand', inputs={'X': [x]},
+                     outputs={'Out': [out]},
+                     attrs={'expand_times': list(expand_times)})
+    return out
+
+
+def squeeze(input, axes, name=None):
+    helper = LayerHelper('squeeze2', name=name)
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    x_shape = helper.create_variable_for_type_inference(dtype=input.dtype)
+    helper.append_op(type='squeeze2', inputs={'X': [input]},
+                     outputs={'Out': [out], 'XShape': [x_shape]},
+                     attrs={'axes': list(axes)})
+    return out
+
+
+def unsqueeze(input, axes, name=None):
+    helper = LayerHelper('unsqueeze2', name=name)
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    x_shape = helper.create_variable_for_type_inference(dtype=input.dtype)
+    helper.append_op(type='unsqueeze2', inputs={'X': [input]},
+                     outputs={'Out': [out], 'XShape': [x_shape]},
+                     attrs={'axes': list(axes)})
+    return out
+
+
+def gather(input, index):
+    helper = LayerHelper('gather')
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    helper.append_op(type='gather', inputs={'X': [input], 'Index': [index]},
+                     outputs={'Out': [out]})
+    return out
+
+
+def scatter(input, index, updates, name=None, overwrite=True):
+    helper = LayerHelper('scatter', name=name)
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    helper.append_op(type='scatter',
+                     inputs={'X': [input], 'Ids': [index],
+                             'Updates': [updates]},
+                     outputs={'Out': [out]}, attrs={'overwrite': overwrite})
+    return out
+
+
+def slice(input, axes, starts, ends):
+    helper = LayerHelper('slice')
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    helper.append_op(type='slice', inputs={'Input': [input]},
+                     outputs={'Out': [out]},
+                     attrs={'axes': list(axes), 'starts': list(starts),
+                            'ends': list(ends)})
+    return out
+
+
+def shape(input):
+    helper = LayerHelper('shape')
+    out = helper.create_variable_for_type_inference(dtype='int64')
+    helper.append_op(type='shape', inputs={'Input': [input]},
+                     outputs={'Out': [out]})
+    return out
+
+
+def autoincreased_step_counter(counter_name=None, begin=1, step=1):
+    """Global step counter var incremented every run (reference
+    layers/nn.py autoincreased_step_counter) -- used by lr schedulers."""
+    helper = LayerHelper('global_step_counter')
+    counter_name = counter_name or '@STEP_COUNTER@'
+    counter = helper.create_or_get_global_variable(
+        name=counter_name, dtype='int64', shape=[1], persistable=True)
+    if not any(op.type == 'increment' and
+               op.output('Out') == [counter_name]
+               for op in helper.main_program.global_block().ops):
+        helper.set_variable_initializer(
+            counter, Constant(value=float(begin - 1)))
+        helper.main_program.global_block()._prepend_op(
+            type='increment', inputs={'X': [counter]},
+            outputs={'Out': [counter]}, attrs={'step': float(step)})
+        counter.stop_gradient = True
+    return counter

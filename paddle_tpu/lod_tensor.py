@@ -1,0 +1,106 @@
+"""LoDTensor: host-side ragged-sequence tensor
+(reference paddle/fluid/framework/lod_tensor.h:110, python lod_tensor.py).
+
+LoD ("level of detail") is a list of offset vectors indexing nested sequence
+levels over the rows of a dense tensor -- the reference's mechanism for
+batching variable-length sequences WITHOUT padding. On TPU (XLA static
+shapes) the device lowering uses padded/bucketed batches with masks; the
+LoDTensor object itself lives host-side in the feed/fetch path and for
+sequence ops' metadata, preserving the reference API contract
+(set_lod/lod/recursive_sequence_lengths).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ['LoDTensor', 'create_lod_tensor', 'create_random_int_lodtensor']
+
+
+class LoDTensor(object):
+    def __init__(self, data=None, lod=None):
+        self._data = np.asarray(data) if data is not None else None
+        self._lod = [list(l) for l in lod] if lod else []
+
+    # -- reference-compatible API ------------------------------------------
+    def set(self, data, place=None):
+        self._data = np.asarray(data)
+
+    def set_lod(self, lod):
+        self._lod = [list(l) for l in lod]
+
+    def lod(self):
+        return [list(l) for l in self._lod]
+
+    def set_recursive_sequence_lengths(self, lengths):
+        """lengths-per-sequence form -> offset form (reference
+        lod_tensor.h LoD semantics)."""
+        lod = []
+        for level in lengths:
+            offsets = [0]
+            for ln in level:
+                offsets.append(offsets[-1] + ln)
+            lod.append(offsets)
+        self._lod = lod
+
+    def recursive_sequence_lengths(self):
+        out = []
+        for level in self._lod:
+            out.append([level[i + 1] - level[i]
+                        for i in range(len(level) - 1)])
+        return out
+
+    def has_valid_recursive_sequence_lengths(self):
+        if not self._lod:
+            return True
+        for i, level in enumerate(self._lod):
+            if not level or level[0] != 0:
+                return False
+            if any(level[j] > level[j + 1] for j in range(len(level) - 1)):
+                return False
+        if self._data is not None and self._lod:
+            return self._lod[-1][-1] == self._data.shape[0]
+        return True
+
+    def numpy(self):
+        return self._data
+
+    def __array__(self, dtype=None):
+        return self._data if dtype is None else self._data.astype(dtype)
+
+    def shape(self):
+        return list(self._data.shape) if self._data is not None else []
+
+    def __repr__(self):
+        return 'LoDTensor(shape=%s, lod=%s)' % (self.shape(), self._lod)
+
+
+def create_lod_tensor(data, recursive_seq_lens, place=None):
+    """(reference python/paddle/fluid/lod_tensor.py create_lod_tensor)"""
+    if isinstance(data, LoDTensor):
+        return create_lod_tensor(data.numpy(), recursive_seq_lens, place)
+    if isinstance(data, list):
+        # list of sequences -> flattened [N, 1] + lod
+        flat = []
+        seq_lens = []
+        for seq in data:
+            seq = np.asarray(seq)
+            seq_lens.append(len(seq))
+            flat.append(seq.reshape(len(seq), -1))
+        data = np.concatenate(flat, axis=0)
+        recursive_seq_lens = [seq_lens]
+    t = LoDTensor(np.asarray(data))
+    t.set_recursive_sequence_lengths(recursive_seq_lens)
+    assert t.has_valid_recursive_sequence_lengths()
+    return t
+
+
+def create_random_int_lodtensor(recursive_seq_lens, base_shape, place, low,
+                                high):
+    assert isinstance(base_shape, list)
+    converted_lod = []
+    for level in recursive_seq_lens:
+        converted_lod.append(level)
+    total = sum(recursive_seq_lens[-1])
+    shape = [total] + base_shape
+    data = np.random.randint(low, high + 1, shape).astype('int64')
+    return create_lod_tensor(data, recursive_seq_lens, place)

@@ -1,0 +1,8 @@
+"""Op library: importing this package registers every op's shape inference,
+JAX emitter, and grad maker with paddle_tpu.registry (the analog of the
+reference's static REGISTER_OPERATOR initializers in paddle/fluid/operators/)."""
+from . import math_ops      # noqa: F401
+from . import tensor_ops    # noqa: F401
+from . import nn_ops        # noqa: F401
+from . import optimizer_ops  # noqa: F401
+from . import io_ops        # noqa: F401

@@ -1,0 +1,144 @@
+"""Host-side IO ops: feed / fetch / print / save / load / save_combine /
+load_combine / assign-from-host (reference paddle/fluid/operators/{feed_op.cc,
+fetch_op.cc, print_op.cc, save_op.cc:66, load_op.cc, save_combine_op.cc,
+load_combine_op.cc}).
+
+These run on the host between jitted device segments -- the executor
+partitions each block into maximal device segments separated by host ops
+(executor.py), the TPU-native equivalent of the reference's per-op host
+dispatch for these op types.
+
+Tensor file format: a 4-byte magic + JSON header (dtype/shape) + raw
+little-endian bytes, one tensor per entry; `save_combine` packs many entries
+into one file. This replaces the reference's version+proto header binary
+format (save_op.cc SerializeToStream) with the same capability.
+"""
+from __future__ import annotations
+
+import json
+import os
+import struct
+
+import numpy as np
+
+from ..registry import register_op
+
+_MAGIC = b'PTT1'   # paddle-tpu tensor v1
+
+
+def write_tensor(f, arr):
+    arr = np.ascontiguousarray(arr)
+    header = json.dumps({'dtype': arr.dtype.name,
+                         'shape': list(arr.shape)}).encode('utf-8')
+    f.write(_MAGIC)
+    f.write(struct.pack('<I', len(header)))
+    f.write(header)
+    f.write(arr.tobytes())
+
+
+def read_tensor(f):
+    magic = f.read(4)
+    if magic != _MAGIC:
+        raise ValueError('bad tensor file magic: %r' % magic)
+    (hlen,) = struct.unpack('<I', f.read(4))
+    header = json.loads(f.read(hlen).decode('utf-8'))
+    dtype = np.dtype(header['dtype'])
+    shape = tuple(header['shape'])
+    n = int(np.prod(shape)) * dtype.itemsize
+    return np.frombuffer(f.read(n), dtype=dtype).reshape(shape)
+
+
+# -- feed/fetch are pure markers; the executor consumes them directly -------
+register_op('feed', host=True, no_grad=True)
+register_op('fetch', host=True, no_grad=True)
+
+
+def _print_emit(ctx, op):
+    import sys
+    x = np.asarray(ctx.get(op.single_input('In')))
+    msg = op.attr('message', '')
+    first_n = op.attr('first_n', -1)
+    count = op.attrs.setdefault('__print_count__', 0)
+    op.attrs['__print_count__'] = count + 1
+    if first_n > 0 and count >= first_n:
+        pass
+    else:
+        parts = [msg] if msg else []
+        if op.attr('print_tensor_name', True):
+            parts.append('Variable: %s' % op.single_input('In'))
+        if op.attr('print_tensor_shape', True):
+            parts.append('shape: %s' % (list(x.shape),))
+        if op.attr('print_tensor_dtype', True):
+            parts.append('dtype: %s' % x.dtype)
+        parts.append('data: %s' % np.array2string(x, threshold=20))
+        out = ('\n'.join(parts)) + '\n'
+        (sys.stderr if op.attr('print_phase', 'both') else sys.stdout).write(out)
+    if op.output('Out'):
+        ctx.set(op.single_output('Out'), x)
+
+
+register_op('print', emit=_print_emit, host=True, no_grad=True)
+
+
+def _save_emit(ctx, op):
+    path = op.attr('file_path')
+    overwrite = op.attr('overwrite', True)
+    if os.path.exists(path) and not overwrite:
+        raise RuntimeError('%s exists and overwrite=False' % path)
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    arr = np.asarray(ctx.get(op.single_input('X')))
+    if op.attr('save_as_fp16', False):
+        arr = arr.astype(np.float16)
+    with open(path, 'wb') as f:
+        write_tensor(f, arr)
+
+
+register_op('save', emit=_save_emit, host=True, no_grad=True)
+
+
+def _load_emit(ctx, op):
+    path = op.attr('file_path')
+    with open(path, 'rb') as f:
+        arr = read_tensor(f)
+    if op.attr('load_as_fp16', False):
+        arr = arr.astype(np.float16)
+    ctx.set(op.single_output('Out'), arr)
+
+
+register_op('load', emit=_load_emit, host=True, no_grad=True)
+
+
+def _save_combine_emit(ctx, op):
+    path = op.attr('file_path')
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, 'wb') as f:
+        for name in op.input('X'):
+            arr = np.asarray(ctx.get(name))
+            if op.attr('save_as_fp16', False):
+                arr = arr.astype(np.float16)
+            write_tensor(f, arr)
+
+
+register_op('save_combine', emit=_save_combine_emit, host=True, no_grad=True)
+
+
+def _load_combine_emit(ctx, op):
+    path = op.attr('file_path')
+    with open(path, 'rb') as f:
+        for name in op.output('Out'):
+            ctx.set(name, read_tensor(f))
+
+
+register_op('load_combine', emit=_load_combine_emit, host=True, no_grad=True)
+
+
+def _delete_var_emit(ctx, op):
+    for name in op.input('X'):
+        ctx.delete(name)
+
+
+register_op('delete_var', emit=_delete_var_emit, host=True, no_grad=True)

@@ -1,0 +1,227 @@
+"""Optimizer update ops (reference paddle/fluid/operators/{sgd_op.cc,
+momentum_op.cc, adam_op.cc, adagrad_op.cc, adamax_op.cc, adadelta_op.cc,
+rmsprop_op.cc, ftrl_op.cc, decayed_adagrad_op.cc}).
+
+The reference mutates Param in place on-device; here each op is pure --
+ParamOut is a fresh value and the executor writes it back to the Param var in
+the Scope, with XLA buffer donation making the update in-place in HBM (the
+TPU equivalent of the reference's in-place CUDA kernels).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..registry import register_op
+
+
+def _passthrough_infer(pairs):
+    """infer_shape copying shape/dtype from input slot to output slot."""
+    def fn(op, block):
+        for in_slot, out_slot in pairs:
+            if not op.output(out_slot):
+                continue
+            src = block.var_recursive(op.single_input(in_slot))
+            dst = block.var_recursive(op.single_output(out_slot))
+            dst.shape = src.shape
+            dst.dtype = src.dtype
+    return fn
+
+
+def _sgd_emit(ctx, op):
+    p = ctx.get(op.single_input('Param'))
+    g = ctx.get(op.single_input('Grad'))
+    lr = ctx.get(op.single_input('LearningRate'))
+    ctx.set(op.single_output('ParamOut'), p - lr * g.astype(p.dtype))
+
+
+register_op('sgd', emit=_sgd_emit, no_grad=True,
+            infer_shape=_passthrough_infer([('Param', 'ParamOut')]))
+
+
+def _momentum_emit(ctx, op):
+    p = ctx.get(op.single_input('Param'))
+    g = ctx.get(op.single_input('Grad'))
+    v = ctx.get(op.single_input('Velocity'))
+    lr = ctx.get(op.single_input('LearningRate'))
+    mu = op.attr('mu')
+    v_new = mu * v + g
+    if op.attr('use_nesterov', False):
+        p_new = p - (g + mu * v_new) * lr
+    else:
+        p_new = p - lr * v_new
+    ctx.set(op.single_output('ParamOut'), p_new)
+    ctx.set(op.single_output('VelocityOut'), v_new)
+
+
+register_op('momentum', emit=_momentum_emit, no_grad=True,
+            infer_shape=_passthrough_infer(
+                [('Param', 'ParamOut'), ('Velocity', 'VelocityOut')]))
+
+
+def _adam_emit(ctx, op):
+    p = ctx.get(op.single_input('Param'))
+    g = ctx.get(op.single_input('Grad'))
+    m1 = ctx.get(op.single_input('Moment1'))
+    m2 = ctx.get(op.single_input('Moment2'))
+    lr = ctx.get(op.single_input('LearningRate'))
+    b1p = ctx.get(op.single_input('Beta1Pow'))
+    b2p = ctx.get(op.single_input('Beta2Pow'))
+    b1 = op.attr('beta1', 0.9)
+    b2 = op.attr('beta2', 0.999)
+    eps = op.attr('epsilon', 1e-8)
+    m1_new = b1 * m1 + (1 - b1) * g
+    m2_new = b2 * m2 + (1 - b2) * jnp.square(g)
+    lr_t = lr * jnp.sqrt(1 - b2p) / (1 - b1p)
+    p_new = p - lr_t * m1_new / (jnp.sqrt(m2_new) + eps)
+    ctx.set(op.single_output('ParamOut'), p_new)
+    ctx.set(op.single_output('Moment1Out'), m1_new)
+    ctx.set(op.single_output('Moment2Out'), m2_new)
+    if op.output('Beta1PowOut'):
+        ctx.set(op.single_output('Beta1PowOut'), b1p * b1)
+    if op.output('Beta2PowOut'):
+        ctx.set(op.single_output('Beta2PowOut'), b2p * b2)
+
+
+register_op('adam', emit=_adam_emit, no_grad=True,
+            infer_shape=_passthrough_infer(
+                [('Param', 'ParamOut'), ('Moment1', 'Moment1Out'),
+                 ('Moment2', 'Moment2Out'), ('Beta1Pow', 'Beta1PowOut'),
+                 ('Beta2Pow', 'Beta2PowOut')]))
+
+
+def _adagrad_emit(ctx, op):
+    p = ctx.get(op.single_input('Param'))
+    g = ctx.get(op.single_input('Grad'))
+    m = ctx.get(op.single_input('Moment'))
+    lr = ctx.get(op.single_input('LearningRate'))
+    eps = op.attr('epsilon', 1e-6)
+    m_new = m + jnp.square(g)
+    p_new = p - lr * g / (jnp.sqrt(m_new) + eps)
+    ctx.set(op.single_output('ParamOut'), p_new)
+    ctx.set(op.single_output('MomentOut'), m_new)
+
+
+register_op('adagrad', emit=_adagrad_emit, no_grad=True,
+            infer_shape=_passthrough_infer(
+                [('Param', 'ParamOut'), ('Moment', 'MomentOut')]))
+
+
+def _decayed_adagrad_emit(ctx, op):
+    p = ctx.get(op.single_input('Param'))
+    g = ctx.get(op.single_input('Grad'))
+    m = ctx.get(op.single_input('Moment'))
+    lr = ctx.get(op.single_input('LearningRate'))
+    decay = op.attr('decay', 0.95)
+    eps = op.attr('epsilon', 1e-6)
+    m_new = decay * m + (1 - decay) * jnp.square(g)
+    p_new = p - lr * g / (jnp.sqrt(m_new) + eps)
+    ctx.set(op.single_output('ParamOut'), p_new)
+    ctx.set(op.single_output('MomentOut'), m_new)
+
+
+register_op('decayed_adagrad', emit=_decayed_adagrad_emit, no_grad=True,
+            infer_shape=_passthrough_infer(
+                [('Param', 'ParamOut'), ('Moment', 'MomentOut')]))
+
+
+def _adamax_emit(ctx, op):
+    p = ctx.get(op.single_input('Param'))
+    g = ctx.get(op.single_input('Grad'))
+    m = ctx.get(op.single_input('Moment'))
+    inf_norm = ctx.get(op.single_input('InfNorm'))
+    lr = ctx.get(op.single_input('LearningRate'))
+    b1p = ctx.get(op.single_input('Beta1Pow'))
+    b1 = op.attr('beta1', 0.9)
+    b2 = op.attr('beta2', 0.999)
+    eps = op.attr('epsilon', 1e-8)
+    m_new = b1 * m + (1 - b1) * g
+    inf_new = jnp.maximum(b2 * inf_norm, jnp.abs(g) + eps)
+    lr_t = lr / (1 - b1p)
+    p_new = p - lr_t * m_new / inf_new
+    ctx.set(op.single_output('ParamOut'), p_new)
+    ctx.set(op.single_output('MomentOut'), m_new)
+    ctx.set(op.single_output('InfNormOut'), inf_new)
+
+
+register_op('adamax', emit=_adamax_emit, no_grad=True,
+            infer_shape=_passthrough_infer(
+                [('Param', 'ParamOut'), ('Moment', 'MomentOut'),
+                 ('InfNorm', 'InfNormOut')]))
+
+
+def _adadelta_emit(ctx, op):
+    p = ctx.get(op.single_input('Param'))
+    g = ctx.get(op.single_input('Grad'))
+    avg_sq_grad = ctx.get(op.single_input('AvgSquaredGrad'))
+    avg_sq_upd = ctx.get(op.single_input('AvgSquaredUpdate'))
+    rho = op.attr('rho', 0.95)
+    eps = op.attr('epsilon', 1e-6)
+    asg_new = rho * avg_sq_grad + (1 - rho) * jnp.square(g)
+    update = -jnp.sqrt((avg_sq_upd + eps) / (asg_new + eps)) * g
+    asu_new = rho * avg_sq_upd + (1 - rho) * jnp.square(update)
+    ctx.set(op.single_output('ParamOut'), p + update)
+    ctx.set(op.single_output('AvgSquaredGradOut'), asg_new)
+    ctx.set(op.single_output('AvgSquaredUpdateOut'), asu_new)
+
+
+register_op('adadelta', emit=_adadelta_emit, no_grad=True,
+            infer_shape=_passthrough_infer(
+                [('Param', 'ParamOut'),
+                 ('AvgSquaredGrad', 'AvgSquaredGradOut'),
+                 ('AvgSquaredUpdate', 'AvgSquaredUpdateOut')]))
+
+
+def _rmsprop_emit(ctx, op):
+    p = ctx.get(op.single_input('Param'))
+    g = ctx.get(op.single_input('Grad'))
+    ms = ctx.get(op.single_input('MeanSquare'))
+    mom = ctx.get(op.single_input('Moment'))
+    lr = ctx.get(op.single_input('LearningRate'))
+    rho = op.attr('decay', 0.95)
+    eps = op.attr('epsilon', 1e-6)
+    momentum = op.attr('momentum', 0.0)
+    ms_new = rho * ms + (1 - rho) * jnp.square(g)
+    mom_new = momentum * mom + lr * g / jnp.sqrt(ms_new + eps)
+    ctx.set(op.single_output('ParamOut'), p - mom_new)
+    ctx.set(op.single_output('MeanSquareOut'), ms_new)
+    ctx.set(op.single_output('MomentOut'), mom_new)
+
+
+register_op('rmsprop', emit=_rmsprop_emit, no_grad=True,
+            infer_shape=_passthrough_infer(
+                [('Param', 'ParamOut'), ('MeanSquare', 'MeanSquareOut'),
+                 ('Moment', 'MomentOut')]))
+
+
+def _ftrl_emit(ctx, op):
+    p = ctx.get(op.single_input('Param'))
+    g = ctx.get(op.single_input('Grad'))
+    sq_accum = ctx.get(op.single_input('SquaredAccumulator'))
+    lin_accum = ctx.get(op.single_input('LinearAccumulator'))
+    lr = ctx.get(op.single_input('LearningRate'))
+    l1 = op.attr('l1', 0.0)
+    l2 = op.attr('l2', 0.0)
+    lr_power = op.attr('lr_power', -0.5)
+    new_accum = sq_accum + jnp.square(g)
+    if lr_power == -0.5:
+        sigma = (jnp.sqrt(new_accum) - jnp.sqrt(sq_accum)) / lr
+    else:
+        sigma = (jnp.power(new_accum, -lr_power)
+                 - jnp.power(sq_accum, -lr_power)) / lr
+    lin_new = lin_accum + g - sigma * p
+    if lr_power == -0.5:
+        x = l2 + jnp.sqrt(new_accum) / lr
+    else:
+        x = l2 + jnp.power(new_accum, -lr_power) / lr
+    pre_shrink = (jnp.sign(lin_new) * l1 - lin_new) / x
+    p_new = jnp.where(jnp.abs(lin_new) > l1, pre_shrink, 0.0)
+    ctx.set(op.single_output('ParamOut'), p_new)
+    ctx.set(op.single_output('SquaredAccumOut'), new_accum)
+    ctx.set(op.single_output('LinearAccumOut'), lin_new)
+
+
+register_op('ftrl', emit=_ftrl_emit, no_grad=True,
+            infer_shape=_passthrough_infer(
+                [('Param', 'ParamOut'),
+                 ('SquaredAccumulator', 'SquaredAccumOut'),
+                 ('LinearAccumulator', 'LinearAccumOut')]))

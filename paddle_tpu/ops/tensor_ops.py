@@ -1,0 +1,578 @@
+"""Tensor creation / manipulation ops.
+
+TPU-native re-design of reference paddle/fluid/operators/{fill_constant_op.cc,
+fill_zeros_like_op.cc, assign_op.cc, cast_op.cc, shape_op.cc, concat_op.cc,
+split_op.cc, reshape_op.cc, transpose_op.cc, slice_op.cc, expand_op.cc,
+stack_op.cc, squeeze_op.cc, unsqueeze_op.cc, gather_op.cc, one_hot_op.cc,
+uniform_random_op.cc, gaussian_random_op.cc}.
+
+Random ops take their key from ctx.rng(op): the executor threads a per-step
+PRNG key and folds in the op's position, so a jitted block is deterministic
+given (seed, step) -- the functional answer to the reference's per-op
+curand/std::mt19937 seed attrs.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..registry import register_op, op_emitter, same_shape_infer, register_vjp_grad
+
+
+@op_emitter('fill_constant')
+def _fill_constant_emit(ctx, op):
+    shape = op.attr('shape', [])
+    dtype = op.attr('dtype', 'float32')
+    value = op.attr('value', 0.0)
+    ctx.set(op.single_output('Out'), jnp.full(shape, value, dtype=dtype))
+
+
+def _fill_constant_infer(op, block):
+    out = block.var_recursive(op.single_output('Out'))
+    out.shape = tuple(op.attr('shape', []))
+    out.dtype = op.attr('dtype', 'float32')
+
+
+register_op('fill_constant', infer_shape=_fill_constant_infer, no_grad=True)
+
+
+@op_emitter('fill_zeros_like')
+def _fill_zeros_like_emit(ctx, op):
+    x = ctx.get(op.single_input('X'))
+    ctx.set(op.single_output('Out'), jnp.zeros_like(x))
+
+
+register_op('fill_zeros_like', infer_shape=same_shape_infer(), no_grad=True)
+
+
+@op_emitter('assign')
+def _assign_emit(ctx, op):
+    ctx.set(op.single_output('Out'), ctx.get(op.single_input('X')))
+
+
+register_op('assign', infer_shape=same_shape_infer())
+register_vjp_grad('assign')
+
+
+@op_emitter('assign_value')
+def _assign_value_emit(ctx, op):
+    values = np.asarray(op.attr('values'), dtype=op.attr('dtype', 'float32'))
+    ctx.set(op.single_output('Out'),
+            jnp.asarray(values).reshape(op.attr('shape')))
+
+
+def _assign_value_infer(op, block):
+    out = block.var_recursive(op.single_output('Out'))
+    out.shape = tuple(op.attr('shape'))
+    out.dtype = op.attr('dtype', 'float32')
+
+
+register_op('assign_value', infer_shape=_assign_value_infer, no_grad=True)
+
+
+@op_emitter('cast')
+def _cast_emit(ctx, op):
+    x = ctx.get(op.single_input('X'))
+    out_dtype = op.attr('out_dtype') or op.attr('dtype')
+    ctx.set(op.single_output('Out'), x.astype(out_dtype))
+
+
+def _cast_infer(op, block):
+    x = block.var_recursive(op.single_input('X'))
+    out = block.var_recursive(op.single_output('Out'))
+    out.shape = x.shape
+    out.dtype = op.attr('out_dtype') or op.attr('dtype')
+    out.lod_level = x.lod_level
+
+
+register_op('cast', infer_shape=_cast_infer)
+register_vjp_grad('cast')
+
+
+@op_emitter('shape')
+def _shape_emit(ctx, op):
+    x = ctx.get(op.single_input('Input'))
+    ctx.set(op.single_output('Out'), jnp.array(x.shape, dtype=jnp.int64))
+
+
+def _shape_infer(op, block):
+    x = block.var_recursive(op.single_input('Input'))
+    out = block.var_recursive(op.single_output('Out'))
+    out.shape = (len(x.shape),) if x.shape is not None else None
+    out.dtype = 'int64'
+
+
+register_op('shape', infer_shape=_shape_infer, no_grad=True)
+
+
+@op_emitter('concat')
+def _concat_emit(ctx, op):
+    xs = [ctx.get(n) for n in op.input('X')]
+    ctx.set(op.single_output('Out'), jnp.concatenate(xs, axis=op.attr('axis', 0)))
+
+
+def _concat_infer(op, block):
+    xs = [block.var_recursive(n) for n in op.input('X')]
+    axis = op.attr('axis', 0)
+    shape = list(xs[0].shape)
+    axis = axis % len(shape)
+    total = 0
+    for x in xs:
+        if x.shape[axis] < 0:
+            total = -1
+            break
+        total += x.shape[axis]
+    shape[axis] = total
+    out = block.var_recursive(op.single_output('Out'))
+    out.shape = tuple(shape)
+    out.dtype = xs[0].dtype
+
+
+register_op('concat', infer_shape=_concat_infer)
+register_vjp_grad('concat', in_slots=('X',))
+
+
+@op_emitter('split')
+def _split_emit(ctx, op):
+    x = ctx.get(op.single_input('X'))
+    axis = op.attr('axis', 0)
+    sections = op.attr('sections', [])
+    num = op.attr('num', 0)
+    if sections:
+        idx = np.cumsum(sections[:-1]).tolist()
+        parts = jnp.split(x, idx, axis=axis)
+    else:
+        parts = jnp.split(x, num, axis=axis)
+    for name, part in zip(op.output('Out'), parts):
+        ctx.set(name, part)
+
+
+def _split_infer(op, block):
+    x = block.var_recursive(op.single_input('X'))
+    axis = op.attr('axis', 0) % len(x.shape)
+    sections = op.attr('sections', [])
+    num = op.attr('num', 0)
+    outs = [block.var_recursive(n) for n in op.output('Out')]
+    if not sections:
+        sections = [x.shape[axis] // num] * num if x.shape[axis] >= 0 else [-1] * num
+    for v, s in zip(outs, sections):
+        shape = list(x.shape)
+        shape[axis] = s
+        v.shape = tuple(shape)
+        v.dtype = x.dtype
+
+
+def _split_grad(op, block):
+    from ..framework import grad_var_name
+    return [dict(type='concat',
+                 inputs={'X': [grad_var_name(n) for n in op.output('Out')]},
+                 outputs={'Out': [grad_var_name(op.single_input('X'))]},
+                 attrs={'axis': op.attr('axis', 0)})]
+
+
+register_op('split', infer_shape=_split_infer, grad=_split_grad)
+
+
+@op_emitter('reshape2')
+def _reshape_emit(ctx, op):
+    x = ctx.get(op.single_input('X'))
+    shape = list(op.attr('shape'))
+    # paddle semantics: 0 means copy input dim, -1 means infer
+    for i, s in enumerate(shape):
+        if s == 0:
+            shape[i] = x.shape[i]
+    ctx.set(op.single_output('Out'), x.reshape(shape))
+    if op.output('XShape'):
+        ctx.set(op.single_output('XShape'), jnp.zeros((0,) + x.shape))
+
+
+def _reshape_infer(op, block):
+    x = block.var_recursive(op.single_input('X'))
+    shape = list(op.attr('shape'))
+    for i, s in enumerate(shape):
+        if s == 0:
+            shape[i] = x.shape[i]
+    known = [s for s in shape if s >= 0]
+    if -1 in shape and x.shape is not None and all(d >= 0 for d in x.shape):
+        numel = int(np.prod(x.shape))
+        rest = int(np.prod(known)) if known else 1
+        shape[shape.index(-1)] = numel // rest if rest else -1
+    out = block.var_recursive(op.single_output('Out'))
+    out.shape = tuple(shape)
+    out.dtype = x.dtype
+    if op.output('XShape'):
+        xs = block.var_recursive(op.single_output('XShape'))
+        xs.shape = (0,) + tuple(x.shape or ())
+        xs.dtype = x.dtype
+
+
+def _reshape_grad(op, block):
+    from ..framework import grad_var_name
+    x = block.var_recursive(op.single_input('X'))
+    return [dict(type='reshape_grad_helper',
+                 inputs={'Out@GRAD': [grad_var_name(op.single_output('Out'))]},
+                 outputs={'X@GRAD': [grad_var_name(op.single_input('X'))]},
+                 attrs={'x_shape': list(x.shape)})]
+
+
+@op_emitter('reshape_grad_helper')
+def _reshape_grad_emit(ctx, op):
+    g = ctx.get(op.single_input('Out@GRAD'))
+    shape = list(op.attr('x_shape'))
+    if any(s < 0 for s in shape):
+        # runtime batch dim: take it from the grad's total size
+        known = int(np.prod([s for s in shape if s >= 0]))
+        shape[shape.index(-1)] = int(np.prod(g.shape)) // max(known, 1)
+    ctx.set(op.single_output('X@GRAD'), g.reshape(shape))
+
+
+register_op('reshape2', infer_shape=_reshape_infer, grad=_reshape_grad)
+register_op('reshape', infer_shape=_reshape_infer, grad=_reshape_grad,
+            emit=_reshape_emit)
+
+
+@op_emitter('transpose2')
+def _transpose_emit(ctx, op):
+    x = ctx.get(op.single_input('X'))
+    ctx.set(op.single_output('Out'), jnp.transpose(x, op.attr('axis')))
+    if op.output('XShape'):
+        ctx.set(op.single_output('XShape'), jnp.zeros((0,) + x.shape))
+
+
+def _transpose_infer(op, block):
+    x = block.var_recursive(op.single_input('X'))
+    axis = op.attr('axis')
+    out = block.var_recursive(op.single_output('Out'))
+    out.shape = tuple(x.shape[a] for a in axis) if x.shape is not None else None
+    out.dtype = x.dtype
+    if op.output('XShape'):
+        xs = block.var_recursive(op.single_output('XShape'))
+        xs.shape = (0,) + tuple(x.shape or ())
+        xs.dtype = x.dtype
+
+
+def _transpose_grad(op, block):
+    from ..framework import grad_var_name
+    axis = op.attr('axis')
+    inv = [0] * len(axis)
+    for i, a in enumerate(axis):
+        inv[a] = i
+    return [dict(type=op.type,
+                 inputs={'X': [grad_var_name(op.single_output('Out'))]},
+                 outputs={'Out': [grad_var_name(op.single_input('X'))],
+                          'XShape': []},
+                 attrs={'axis': inv})]
+
+
+register_op('transpose2', infer_shape=_transpose_infer, grad=_transpose_grad)
+register_op('transpose', infer_shape=_transpose_infer, grad=_transpose_grad,
+            emit=_transpose_emit)
+
+
+@op_emitter('slice')
+def _slice_emit(ctx, op):
+    x = ctx.get(op.single_input('Input'))
+    axes = op.attr('axes')
+    starts = op.attr('starts')
+    ends = op.attr('ends')
+    idx = [slice(None)] * x.ndim
+    for a, s, e in zip(axes, starts, ends):
+        dim = x.shape[a]
+        s = max(s + dim, 0) if s < 0 else min(s, dim)
+        e = max(e + dim, 0) if e < 0 else min(e, dim)
+        idx[a] = slice(s, e)
+    ctx.set(op.single_output('Out'), x[tuple(idx)])
+
+
+def _slice_infer(op, block):
+    x = block.var_recursive(op.single_input('Input'))
+    if x.shape is None:
+        return
+    shape = list(x.shape)
+    for a, s, e in zip(op.attr('axes'), op.attr('starts'), op.attr('ends')):
+        dim = shape[a]
+        if dim < 0:
+            continue
+        s2 = max(s + dim, 0) if s < 0 else min(s, dim)
+        e2 = max(e + dim, 0) if e < 0 else min(e, dim)
+        shape[a] = max(e2 - s2, 0)
+    out = block.var_recursive(op.single_output('Out'))
+    out.shape = tuple(shape)
+    out.dtype = x.dtype
+
+
+register_op('slice', infer_shape=_slice_infer)
+register_vjp_grad('slice', in_slots=('Input',))
+
+
+@op_emitter('expand')
+def _expand_emit(ctx, op):
+    x = ctx.get(op.single_input('X'))
+    times = op.attr('expand_times')
+    ctx.set(op.single_output('Out'), jnp.tile(x, times))
+
+
+def _expand_infer(op, block):
+    x = block.var_recursive(op.single_input('X'))
+    times = op.attr('expand_times')
+    out = block.var_recursive(op.single_output('Out'))
+    if x.shape is not None:
+        out.shape = tuple(s * t if s >= 0 else -1
+                          for s, t in zip(x.shape, times))
+    out.dtype = x.dtype
+
+
+register_op('expand', infer_shape=_expand_infer)
+register_vjp_grad('expand')
+
+
+@op_emitter('stack')
+def _stack_emit(ctx, op):
+    xs = [ctx.get(n) for n in op.input('X')]
+    ctx.set(op.single_output('Y'), jnp.stack(xs, axis=op.attr('axis', 0)))
+
+
+def _stack_infer(op, block):
+    x = block.var_recursive(op.input('X')[0])
+    n = len(op.input('X'))
+    axis = op.attr('axis', 0)
+    shape = list(x.shape)
+    axis = axis % (len(shape) + 1)
+    shape.insert(axis, n)
+    out = block.var_recursive(op.single_output('Y'))
+    out.shape = tuple(shape)
+    out.dtype = x.dtype
+
+
+register_op('stack', infer_shape=_stack_infer)
+register_vjp_grad('stack', in_slots=('X',), out_slots=('Y',))
+
+
+def _register_squeeze(op_type):
+    def emit(ctx, op):
+        x = ctx.get(op.single_input('X'))
+        axes = op.attr('axes', [])
+        if op_type.startswith('squeeze'):
+            if axes:
+                out = jnp.squeeze(x, axis=tuple(a % x.ndim for a in axes))
+            else:
+                out = jnp.squeeze(x)
+        else:
+            out = x
+            for a in sorted(axes):
+                out = jnp.expand_dims(out, a)
+        ctx.set(op.single_output('Out'), out)
+        if op.output('XShape'):
+            ctx.set(op.single_output('XShape'), jnp.zeros((0,) + x.shape))
+
+    def infer(op, block):
+        x = block.var_recursive(op.single_input('X'))
+        axes = op.attr('axes', [])
+        if x.shape is None:
+            return
+        shape = list(x.shape)
+        if op_type.startswith('squeeze'):
+            nd = len(shape)
+            if axes:
+                drop = set(a % nd for a in axes)
+            else:
+                drop = set(i for i, s in enumerate(shape) if s == 1)
+            shape = [s for i, s in enumerate(shape) if i not in drop]
+        else:
+            for a in sorted(axes):
+                shape.insert(a, 1)
+        out = block.var_recursive(op.single_output('Out'))
+        out.shape = tuple(shape)
+        out.dtype = x.dtype
+        if op.output('XShape'):
+            xs = block.var_recursive(op.single_output('XShape'))
+            xs.shape = (0,) + tuple(x.shape)
+            xs.dtype = x.dtype
+
+    register_op(op_type, emit=emit, infer_shape=infer)
+    register_vjp_grad(op_type)
+
+
+for _t in ('squeeze', 'squeeze2', 'unsqueeze', 'unsqueeze2'):
+    _register_squeeze(_t)
+
+
+@op_emitter('gather')
+def _gather_emit(ctx, op):
+    x = ctx.get(op.single_input('X'))
+    idx = ctx.get(op.single_input('Index'))
+    ctx.set(op.single_output('Out'), jnp.take(x, idx.reshape(-1), axis=0))
+
+
+def _gather_infer(op, block):
+    x = block.var_recursive(op.single_input('X'))
+    idx = block.var_recursive(op.single_input('Index'))
+    out = block.var_recursive(op.single_output('Out'))
+    out.shape = (idx.shape[0],) + tuple(x.shape[1:])
+    out.dtype = x.dtype
+
+
+register_op('gather', infer_shape=_gather_infer)
+register_vjp_grad('gather', in_slots=('X',), nondiff_slots=('Index',))
+
+
+@op_emitter('scatter')
+def _scatter_emit(ctx, op):
+    x = ctx.get(op.single_input('X'))
+    idx = ctx.get(op.single_input('Ids'))
+    upd = ctx.get(op.single_input('Updates'))
+    if op.attr('overwrite', True):
+        out = x.at[idx.reshape(-1)].set(upd)
+    else:
+        out = x.at[idx.reshape(-1)].add(upd)
+    ctx.set(op.single_output('Out'), out)
+
+
+register_op('scatter', infer_shape=same_shape_infer())
+register_vjp_grad('scatter', in_slots=('X', 'Updates'), nondiff_slots=('Ids',))
+
+
+@op_emitter('one_hot')
+def _one_hot_emit(ctx, op):
+    x = ctx.get(op.single_input('X'))
+    depth = op.attr('depth')
+    flat = x.reshape(x.shape[:-1]) if x.shape and x.shape[-1] == 1 else x
+    ctx.set(op.single_output('Out'),
+            jax.nn.one_hot(flat, depth, dtype=op.attr('dtype', 'float32')))
+
+
+def _one_hot_infer(op, block):
+    x = block.var_recursive(op.single_input('X'))
+    depth = op.attr('depth')
+    shape = tuple(x.shape)
+    if shape and shape[-1] == 1:
+        shape = shape[:-1]
+    out = block.var_recursive(op.single_output('Out'))
+    out.shape = shape + (depth,)
+    out.dtype = op.attr('dtype', 'float32')
+
+
+register_op('one_hot', infer_shape=_one_hot_infer, no_grad=True)
+
+
+# ---------------------------------------------------------------------------
+# random ops
+# ---------------------------------------------------------------------------
+
+@op_emitter('uniform_random', stateful=True)
+def _uniform_random_emit(ctx, op):
+    shape = op.attr('shape')
+    dtype = op.attr('dtype', 'float32')
+    key = ctx.rng(op)
+    ctx.set(op.single_output('Out'),
+            jax.random.uniform(key, tuple(shape), dtype=jnp.float32,
+                               minval=op.attr('min', -1.0),
+                               maxval=op.attr('max', 1.0)).astype(dtype))
+
+
+def _random_infer(op, block):
+    out = block.var_recursive(op.single_output('Out'))
+    out.shape = tuple(op.attr('shape'))
+    out.dtype = op.attr('dtype', 'float32')
+
+
+register_op('uniform_random', infer_shape=_random_infer, no_grad=True)
+
+
+@op_emitter('gaussian_random', stateful=True)
+def _gaussian_random_emit(ctx, op):
+    shape = op.attr('shape')
+    dtype = op.attr('dtype', 'float32')
+    key = ctx.rng(op)
+    val = (jax.random.normal(key, tuple(shape), dtype=jnp.float32)
+           * op.attr('std', 1.0) + op.attr('mean', 0.0))
+    ctx.set(op.single_output('Out'), val.astype(dtype))
+
+
+register_op('gaussian_random', infer_shape=_random_infer, no_grad=True)
+
+
+@op_emitter('truncated_gaussian_random', stateful=True)
+def _truncated_gaussian_random_emit(ctx, op):
+    shape = op.attr('shape')
+    dtype = op.attr('dtype', 'float32')
+    key = ctx.rng(op)
+    val = jax.random.truncated_normal(key, -2.0, 2.0, tuple(shape),
+                                      dtype=jnp.float32)
+    val = val * op.attr('std', 1.0) + op.attr('mean', 0.0)
+    ctx.set(op.single_output('Out'), val.astype(dtype))
+
+
+register_op('truncated_gaussian_random', infer_shape=_random_infer,
+            no_grad=True)
+
+
+@op_emitter('range')
+def _range_emit(ctx, op):
+    ctx.set(op.single_output('Out'),
+            jnp.arange(op.attr('start'), op.attr('end'), op.attr('step'),
+                       dtype=op.attr('dtype', 'int64')))
+
+
+def _range_infer(op, block):
+    out = block.var_recursive(op.single_output('Out'))
+    n = int(np.ceil((op.attr('end') - op.attr('start')) / op.attr('step')))
+    out.shape = (n,)
+    out.dtype = op.attr('dtype', 'int64')
+
+
+register_op('range', infer_shape=_range_infer, no_grad=True)
+
+
+@op_emitter('reverse')
+def _reverse_emit(ctx, op):
+    x = ctx.get(op.single_input('X'))
+    out = x
+    for a in op.attr('axis'):
+        out = jnp.flip(out, a)
+    ctx.set(op.single_output('Out'), out)
+
+
+register_op('reverse', infer_shape=same_shape_infer())
+register_vjp_grad('reverse')
+
+
+@op_emitter('pad')
+def _pad_emit(ctx, op):
+    x = ctx.get(op.single_input('X'))
+    p = op.attr('paddings')
+    pads = [(p[2 * i], p[2 * i + 1]) for i in range(x.ndim)]
+    ctx.set(op.single_output('Out'),
+            jnp.pad(x, pads, constant_values=op.attr('pad_value', 0.0)))
+
+
+def _pad_infer(op, block):
+    x = block.var_recursive(op.single_input('X'))
+    p = op.attr('paddings')
+    out = block.var_recursive(op.single_output('Out'))
+    if x.shape is not None:
+        out.shape = tuple(
+            (s + p[2 * i] + p[2 * i + 1]) if s >= 0 else -1
+            for i, s in enumerate(x.shape))
+    out.dtype = x.dtype
+
+
+register_op('pad', infer_shape=_pad_infer)
+register_vjp_grad('pad')
+
+
+@op_emitter('label_smooth')
+def _label_smooth_emit(ctx, op):
+    x = ctx.get(op.single_input('X'))
+    eps = op.attr('epsilon', 0.1)
+    if op.input('PriorDist'):
+        prior = ctx.get(op.single_input('PriorDist'))
+        out = (1 - eps) * x + eps * prior
+    else:
+        out = (1 - eps) * x + eps / x.shape[-1]
+    ctx.set(op.single_output('Out'), out)
+
+
+register_op('label_smooth', infer_shape=same_shape_infer())
+register_vjp_grad('label_smooth')

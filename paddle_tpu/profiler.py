@@ -1,0 +1,158 @@
+"""Profiler: host RecordEvent scopes + device trace via jax.profiler, with a
+chrome://tracing JSON export (reference paddle/fluid/platform/profiler.cc,
+device_tracer.cc, tools/timeline.py, python/paddle/fluid/profiler.py:221).
+
+The reference correlates CUPTI kernel records with per-op annotations; here
+device-side timing comes from XLA/jax.profiler (xplane) and the host-side
+RecordEvent table covers the executor segments, preserving the
+profiler("All", "total", path) user contract.
+"""
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import threading
+import time
+
+__all__ = ['RecordEvent', 'record_event', 'profiler', 'start_profiler',
+           'stop_profiler', 'reset_profiler', 'cuda_profiler']
+
+_lock = threading.Lock()
+_enabled = False
+_events = []     # (name, thread_id, start_s, end_s)
+
+
+class RecordEvent(object):
+    """RAII timing scope (reference platform/profiler.h RecordEvent)."""
+
+    def __init__(self, name):
+        self.name = name
+        self.start = None
+
+    def __enter__(self):
+        if _enabled:
+            self.start = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        if _enabled and self.start is not None:
+            end = time.perf_counter()
+            with _lock:
+                _events.append((self.name, threading.get_ident(),
+                                self.start, end))
+        return False
+
+
+record_event = RecordEvent
+
+
+def reset_profiler():
+    global _events
+    with _lock:
+        _events = []
+
+
+def start_profiler(state='All'):
+    """state in {CPU, GPU, All} kept for API parity; device tracing is
+    delegated to jax.profiler when a trace dir is given at stop time."""
+    global _enabled
+    if state not in ('CPU', 'GPU', 'All'):
+        raise ValueError("state must be 'CPU', 'GPU' or 'All'")
+    reset_profiler()
+    _enabled = True
+
+
+def stop_profiler(sorted_key=None, profile_path='/tmp/profile'):
+    global _enabled
+    _enabled = False
+    _print_summary(sorted_key)
+    if profile_path:
+        _write_chrome_trace(profile_path)
+
+
+def _aggregate():
+    agg = {}
+    with _lock:
+        for name, tid, start, end in _events:
+            total, calls, mn, mx = agg.get(name, (0.0, 0, float('inf'), 0.0))
+            dur = end - start
+            agg[name] = (total + dur, calls + 1, min(mn, dur), max(mx, dur))
+    return agg
+
+
+def _print_summary(sorted_key=None):
+    agg = _aggregate()
+    if not agg:
+        return
+    rows = [(name, calls, total * 1e3, total / calls * 1e3, mn * 1e3,
+             mx * 1e3)
+            for name, (total, calls, mn, mx) in agg.items()]
+    keyfun = {None: lambda r: 0, 'default': lambda r: 0,
+              'calls': lambda r: -r[1], 'total': lambda r: -r[2],
+              'ave': lambda r: -r[3], 'min': lambda r: -r[4],
+              'max': lambda r: -r[5]}[sorted_key]
+    rows.sort(key=keyfun)
+    print('------------------------->  Profiling Report  '
+          '<-------------------------')
+    print('%-40s %8s %12s %12s %12s %12s'
+          % ('Event', 'Calls', 'Total(ms)', 'Avg(ms)', 'Min(ms)', 'Max(ms)'))
+    for r in rows:
+        print('%-40s %8d %12.4f %12.4f %12.4f %12.4f' % r)
+
+
+def _write_chrome_trace(path):
+    """chrome://tracing JSON (the reference emits this via tools/timeline.py
+    from profiler.proto; we emit it directly)."""
+    agg_events = []
+    with _lock:
+        for name, tid, start, end in _events:
+            agg_events.append({
+                'name': name, 'cat': 'host', 'ph': 'X',
+                'ts': start * 1e6, 'dur': (end - start) * 1e6,
+                'pid': 0, 'tid': tid,
+            })
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, 'w') as f:
+        json.dump({'traceEvents': agg_events}, f)
+
+
+@contextlib.contextmanager
+def profiler(state='All', sorted_key=None, profile_path='/tmp/profile'):
+    """(reference python profiler.py:221) Optionally also captures an XLA
+    device trace to <profile_path>.xplane/ when state includes the device."""
+    start_profiler(state)
+    jax_trace = None
+    if state in ('GPU', 'All'):
+        try:
+            import jax
+            trace_dir = profile_path + '.xplane'
+            jax.profiler.start_trace(trace_dir)
+            jax_trace = trace_dir
+        except Exception:
+            jax_trace = None
+    try:
+        yield
+    finally:
+        if jax_trace is not None:
+            try:
+                import jax
+                jax.profiler.stop_trace()
+            except Exception:
+                pass
+        stop_profiler(sorted_key, profile_path)
+
+
+@contextlib.contextmanager
+def cuda_profiler(output_file, output_mode=None, config=None):
+    """API-parity shim for fluid.profiler.cuda_profiler (nvprof control);
+    on TPU it degrades to a jax.profiler trace."""
+    import jax
+    trace_dir = output_file + '.xplane'
+    try:
+        jax.profiler.start_trace(trace_dir)
+        yield
+    finally:
+        jax.profiler.stop_trace()

@@ -1,0 +1,257 @@
+"""Op registry: shape/dtype inference, JAX emitters, grad makers.
+
+TPU-native replacement for the reference's OpRegistry + OpInfoMap
+(paddle/fluid/framework/op_registry.h:64, op_info.h) and GradOpDescMakerBase
+(framework/grad_op_desc_maker.h:34). Instead of per-device kernels keyed by
+OpKernelType, every op registers a single *emitter*: a function from traced JAX
+values to traced JAX values. The Executor composes the emitters of a whole block
+into one function and `jax.jit`s it -- XLA then does the fusion/layout work the
+reference's per-op CUDA kernels and hand-written fusion passes did.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .framework import grad_var_name
+
+__all__ = [
+    'OpDef', 'register_op', 'get_op', 'has_op', 'infer_shape',
+    'op_emitter', 'op_infer_shape', 'op_grad_maker',
+    'same_shape_infer', 'elementwise_unary_grad', 'register_vjp_grad',
+]
+
+
+class OpDef(object):
+    __slots__ = ('type', 'infer_shape', 'emit', 'grad', 'host', 'stateful',
+                 'no_grad')
+
+    def __init__(self, type):
+        self.type = type
+        self.infer_shape = None   # fn(op, block) -> None (fills output vars)
+        self.emit = None          # fn(ctx, op) -> None (reads/writes ctx env)
+        self.grad = None          # fn(op, block) -> list[op-spec dict]
+        self.host = False         # True: runs host-side (print/save/load/feed)
+        self.stateful = False     # True: uses RNG (dropout, *_random)
+        self.no_grad = False      # True: terminal for backward
+
+
+_REGISTRY = {}
+
+
+def register_op(type, infer_shape=None, emit=None, grad=None, host=False,
+                stateful=False, no_grad=False):
+    opdef = _REGISTRY.get(type)
+    if opdef is None:
+        opdef = _REGISTRY[type] = OpDef(type)
+    if infer_shape is not None:
+        opdef.infer_shape = infer_shape
+    if emit is not None:
+        opdef.emit = emit
+    if grad is not None:
+        opdef.grad = grad
+    opdef.host = opdef.host or host
+    opdef.stateful = opdef.stateful or stateful
+    opdef.no_grad = opdef.no_grad or no_grad
+    return opdef
+
+
+def get_op(type):
+    opdef = _REGISTRY.get(type)
+    if opdef is None:
+        raise KeyError('op %r is not registered' % type)
+    return opdef
+
+
+def has_op(type):
+    return type in _REGISTRY
+
+
+def registered_ops():
+    return sorted(_REGISTRY)
+
+
+# -- decorator-style registration ------------------------------------------
+
+def op_emitter(type, stateful=False, host=False):
+    def deco(fn):
+        register_op(type, emit=fn, stateful=stateful, host=host)
+        return fn
+    return deco
+
+
+def op_infer_shape(type):
+    def deco(fn):
+        register_op(type, infer_shape=fn)
+        return fn
+    return deco
+
+
+def op_grad_maker(type):
+    def deco(fn):
+        register_op(type, grad=fn)
+        return fn
+    return deco
+
+
+def infer_shape(op, block):
+    """Run shape/dtype inference for one op, if registered. Grad ops and
+    host ops may have no inference; their vars get shapes from backward.py."""
+    opdef = _REGISTRY.get(op.type)
+    if opdef is not None and opdef.infer_shape is not None:
+        opdef.infer_shape(op, block)
+
+
+# -- common shape-inference helpers ----------------------------------------
+
+def same_shape_infer(in_slot='X', out_slot='Out'):
+    """Output has same shape/dtype as input (the elementwise-unary default)."""
+    def fn(op, block):
+        x = block.var_recursive(op.single_input(in_slot))
+        out = block.var_recursive(op.single_output(out_slot))
+        out.shape = x.shape
+        if out.dtype is None:
+            out.dtype = x.dtype
+        out.lod_level = x.lod_level
+    return fn
+
+
+def simple_grad_maker(grad_type, in_slots=('X',), fwd_in=True, fwd_out=False,
+                      out_slots=('Out',), extra_attrs=None):
+    """Build a standard grad maker: grad op consumes (optionally) forward
+    inputs/outputs plus Out@GRAD, produces X@GRAD (reference
+    grad_op_desc_maker.h:145 DefaultGradOpDescMaker semantics)."""
+    def maker(op, block):
+        inputs = {}
+        if fwd_in:
+            for s in in_slots:
+                inputs[s] = list(op.input(s))
+        for s in out_slots:
+            if fwd_out:
+                inputs[s] = list(op.output(s))
+            inputs[s + '@GRAD'] = [grad_var_name(n) for n in op.output(s)]
+        outputs = {s + '@GRAD': [grad_var_name(n) for n in op.input(s)]
+                   for s in in_slots}
+        attrs = dict(op.attrs)
+        if extra_attrs:
+            attrs.update(extra_attrs)
+        return [dict(type=grad_type, inputs=inputs, outputs=outputs,
+                     attrs=attrs)]
+    return maker
+
+
+def elementwise_unary_grad(fwd_type, needs=('X',)):
+    """Grad maker for unary elementwise ops: Out@GRAD (+X and/or Out) -> X@GRAD."""
+    fwd_in = 'X' in needs
+    fwd_out = 'Out' in needs
+    return simple_grad_maker(fwd_type + '_grad', in_slots=('X',),
+                             fwd_in=fwd_in, fwd_out=fwd_out)
+
+
+# -- vjp-based grad emitters ------------------------------------------------
+
+class _SandboxCtx(object):
+    """Minimal emit context over a plain dict, used to re-trace a forward
+    emitter inside a grad emitter (for jax.vjp-derived gradients)."""
+
+    def __init__(self, env, parent):
+        self.env = env
+        self.parent = parent          # real ctx (for var descs / rng / is_test)
+
+    def get(self, name):
+        return self.env[name]
+
+    def set(self, name, value):
+        self.env[name] = value
+
+    def var(self, name):
+        return self.parent.var(name)
+
+    def rng(self, op):
+        return self.parent.rng(op)
+
+    @property
+    def is_test(self):
+        return self.parent.is_test
+
+
+def register_vjp_grad(fwd_type, in_slots=('X',), out_slots=('Out',),
+                      nondiff_slots=()):
+    """Register `<fwd_type>_grad` with an emitter that differentiates the
+    forward emitter via jax.vjp. This is the TPU-native answer to hand-written
+    CUDA grad kernels: XLA CSEs the recomputed forward against the live one,
+    and the transposed HLO it derives is as good as (usually identical to) a
+    hand-derived gradient. Used for ops whose manual gradient is error-prone
+    (conv, pool, softmax, layer_norm, ...).
+
+    nondiff_slots: input slots treated as constants (e.g. integer indices).
+    """
+    import jax
+
+    grad_type = fwd_type + '_grad'
+
+    def maker(op, block):
+        inputs = {}
+        for s in list(in_slots) + list(nondiff_slots):
+            if op.input(s):
+                inputs[s] = list(op.input(s))
+        for s in out_slots:
+            inputs[s + '@GRAD'] = [grad_var_name(n) for n in op.output(s)]
+        outputs = {s + '@GRAD': [grad_var_name(n) for n in op.input(s)]
+                   for s in in_slots if op.input(s)}
+        attrs = dict(op.attrs)
+        # remember the forward wiring so the grad emitter can re-trace it
+        attrs['__fwd_inputs__'] = {k: list(v) for k, v in op.inputs.items()}
+        attrs['__fwd_outputs__'] = {k: list(v) for k, v in op.outputs.items()}
+        return [dict(type=grad_type, inputs=inputs, outputs=outputs,
+                     attrs=attrs)]
+
+    def emit(ctx, op):
+        from .framework import Operator
+        fwd_inputs = op.attr('__fwd_inputs__')
+        fwd_outputs = op.attr('__fwd_outputs__')
+        fwd_attrs = {k: v for k, v in op.attrs.items()
+                     if not k.startswith('__fwd_')}
+        fwd_emit = get_op(fwd_type).emit
+
+        diff_names = []
+        for s in in_slots:
+            diff_names.extend(fwd_inputs.get(s, []))
+        const_env = {}
+        for s, names in fwd_inputs.items():
+            for n in names:
+                if n not in diff_names:
+                    const_env[n] = ctx.get(n)
+
+        fwd_op = Operator.__new__(Operator)
+        fwd_op.block = op.block
+        fwd_op.type = fwd_type
+        fwd_op.inputs = fwd_inputs
+        fwd_op.outputs = fwd_outputs
+        fwd_op.attrs = fwd_attrs
+
+        out_names = []
+        for s in out_slots:
+            out_names.extend(fwd_outputs.get(s, []))
+
+        def f(*xs):
+            env = dict(const_env)
+            env.update(zip(diff_names, xs))
+            sandbox = _SandboxCtx(env, ctx)
+            fwd_emit(sandbox, fwd_op)
+            return tuple(env[n] for n in out_names)
+
+        primals = tuple(ctx.get(n) for n in diff_names)
+        _, vjp_fn = jax.vjp(f, *primals)
+        cots = tuple(ctx.get(grad_var_name(n)) for n in out_names)
+        grads = vjp_fn(cots)
+        for n, g in zip(diff_names, grads):
+            ctx.set(grad_var_name(n), g)
+
+    register_op(fwd_type, grad=maker)
+    register_op(grad_type, emit=emit)
+
+
+# -- numpy helpers shared by infer_shape fns -------------------------------
+
+def broadcast_shape(s1, s2):
+    return tuple(np.broadcast_shapes(tuple(s1), tuple(s2)))
