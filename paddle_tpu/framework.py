@@ -202,10 +202,12 @@ class Operator(object):
         return names[0]
 
     def input_arg_names(self):
-        return [n for ns in self.inputs.values() for n in ns]
+        return [n for ns in self.inputs.values() for n in ns if n]
 
     def output_arg_names(self):
-        return [n for ns in self.outputs.values() for n in ns]
+        # '' entries are blanked (not-needed) grad outputs -- positional
+        # placeholders kept for emitters, invisible to dataflow
+        return [n for ns in self.outputs.values() for n in ns if n]
 
     def attr(self, name, default=None):
         return self.attrs.get(name, default)

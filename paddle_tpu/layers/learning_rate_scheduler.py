@@ -93,21 +93,16 @@ def _pow_scalar_var(base_var, power):
 
 
 def piecewise_decay(boundaries, values):
-    """Piecewise-constant lr: chosen with arithmetic masking so it stays
-    jittable (the reference builds less_than + conditional assigns)."""
+    """Piecewise-constant lr via arithmetic masking so it stays inside the
+    jitted block (the reference builds less_than + conditional_block ops,
+    layers/learning_rate_scheduler.py piecewise_decay): step >= boundary[i]
+    switches to values[i+1]."""
     assert len(boundaries) + 1 == len(values)
     step = _global_step()
     lr = tensor.fill_constant([1], 'float32', float(values[0]))
-    prev_bound = None
     for i, b in enumerate(boundaries):
-        # mask = step >= b
-        ge = tensor.cast(
-            nn.elementwise_max(
-                nn.scale(step, scale=1.0, bias=-float(b) + 0.5),
-                tensor.fill_constant([1], 'float32', 0.0)),
-            'float32')
-        mask = tensor.cast(ge > tensor.fill_constant([1], 'float32', 0.0),
-                           'float32')
+        bound = tensor.fill_constant([1], 'float32', float(b))
+        mask = tensor.cast(step >= bound, 'float32')   # 1.0 when past bound
         delta = float(values[i + 1] - values[i])
         lr = nn.elementwise_add(lr, nn.scale(mask, scale=delta))
     return lr

@@ -196,8 +196,23 @@ def register_vjp_grad(fwd_type, in_slots=('X',), out_slots=('Out',),
                 inputs[s] = list(op.input(s))
         for s in out_slots:
             inputs[s + '@GRAD'] = [grad_var_name(n) for n in op.output(s)]
-        outputs = {s + '@GRAD': [grad_var_name(n) for n in op.input(s)]
-                   for s in in_slots if op.input(s)}
+        # one grad output per DISTINCT forward input: jax.vjp returns the
+        # total d/dx when a var feeds several slots (e.g. mul(x, x)), so
+        # repeat occurrences get blank placeholders -- emitting the total
+        # once prevents the fan-out sum from double-counting it
+        outputs = {}
+        seen = set()
+        for s in in_slots:
+            if not op.input(s):
+                continue
+            names = []
+            for n in op.input(s):
+                if n in seen:
+                    names.append('')
+                else:
+                    seen.add(n)
+                    names.append(grad_var_name(n))
+            outputs[s + '@GRAD'] = names
         attrs = dict(op.attrs)
         # remember the forward wiring so the grad emitter can re-trace it
         attrs['__fwd_inputs__'] = {k: list(v) for k, v in op.inputs.items()}
@@ -215,7 +230,9 @@ def register_vjp_grad(fwd_type, in_slots=('X',), out_slots=('Out',),
 
         diff_names = []
         for s in in_slots:
-            diff_names.extend(fwd_inputs.get(s, []))
+            for n in fwd_inputs.get(s, []):
+                if n not in diff_names:      # a var in two slots is ONE input
+                    diff_names.append(n)
         const_env = {}
         for s, names in fwd_inputs.items():
             for n in names:
@@ -244,8 +261,15 @@ def register_vjp_grad(fwd_type, in_slots=('X',), out_slots=('Out',),
         _, vjp_fn = jax.vjp(f, *primals)
         cots = tuple(ctx.get(grad_var_name(n)) for n in out_names)
         grads = vjp_fn(cots)
-        for n, g in zip(diff_names, grads):
-            ctx.set(grad_var_name(n), g)
+        grad_by_input = dict(zip(diff_names, grads))
+        # write to the op's ACTUAL output names -- backward.py may have
+        # renamed them (fan-out dedup) or blanked them (no_grad inputs)
+        for s in in_slots:
+            fwd_names = fwd_inputs.get(s, [])
+            out_grad_names = op.output(s + '@GRAD')
+            for fwd_n, out_n in zip(fwd_names, out_grad_names):
+                if out_n:
+                    ctx.set(out_n, grad_by_input[fwd_n])
 
     register_op(fwd_type, grad=maker)
     register_op(grad_type, emit=emit)

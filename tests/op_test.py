@@ -1,0 +1,148 @@
+"""OpTest: declarative per-op test harness with numeric gradient checking
+(re-design of reference python/paddle/fluid/tests/unittests/op_test.py:131).
+
+Subclasses set:
+    self.op_type  - registered op type
+    self.inputs   - {slot: np.ndarray | [(name, np.ndarray), ...]}
+    self.outputs  - {slot: expected np.ndarray | [(name, expected), ...]}
+    self.attrs    - op attrs (optional)
+
+check_output() builds a one-op Program, runs it through the real Executor
+(whole-block XLA compile, same path as training), and compares.
+check_grad() compares the registered grad path against central-difference
+numeric gradients (reference op_test.py:43 get_numeric_gradient).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.framework import Program, program_guard
+
+
+def _as_pairs(slot, value):
+    """Normalise an input/output spec to [(var_name, array), ...]."""
+    if isinstance(value, (list, tuple)) and value and \
+            isinstance(value[0], (list, tuple)):
+        return [(name, np.asarray(arr)) for name, arr in value]
+    return [(slot, np.asarray(value))]
+
+
+class OpTest(object):
+    atol = 1e-5
+    rtol = 1e-5
+
+    def _build(self):
+        prog, startup = Program(), Program()
+        feed = {}
+        with program_guard(prog, startup):
+            block = prog.global_block()
+            op_inputs, op_outputs = {}, {}
+            for slot, value in getattr(self, 'inputs', {}).items():
+                names = []
+                for name, arr in _as_pairs(slot, value):
+                    block.create_var(name=name, shape=arr.shape,
+                                     dtype=str(arr.dtype), is_data=True)
+                    feed[name] = arr
+                    names.append(name)
+                op_inputs[slot] = names
+            for slot, value in getattr(self, 'outputs', {}).items():
+                names = []
+                for name, _arr in _as_pairs(slot, value):
+                    block.create_var(name=name, dtype=None)
+                    names.append(name)
+                op_outputs[slot] = names
+            block.append_op(type=self.op_type, inputs=op_inputs,
+                            outputs=op_outputs,
+                            attrs=getattr(self, 'attrs', {}))
+        return prog, startup, feed, op_inputs, op_outputs
+
+    def check_output(self, atol=None, no_check_set=()):
+        atol = atol if atol is not None else self.atol
+        prog, startup, feed, _op_in, op_outputs = self._build()
+        exe = fluid.Executor(fluid.CPUPlace())
+        with fluid.scope_guard(fluid.Scope()):
+            exe.run(startup)
+            fetch_names, expects = [], []
+            for slot, value in self.outputs.items():
+                for name, arr in _as_pairs(slot, value):
+                    if name in no_check_set or slot in no_check_set:
+                        continue
+                    fetch_names.append(name)
+                    expects.append(np.asarray(arr))
+            results = exe.run(prog, feed=feed, fetch_list=fetch_names)
+            for name, got, want in zip(fetch_names, results, expects):
+                np.testing.assert_allclose(
+                    got.astype(np.float64) if got.dtype != np.bool_ else got,
+                    want.astype(np.float64) if want.dtype != np.bool_ else want,
+                    atol=atol, rtol=self.rtol,
+                    err_msg='output %r of op %s mismatch'
+                            % (name, self.op_type))
+
+    def check_grad(self, inputs_to_check, output_names=None,
+                   max_relative_error=0.005, numeric_delta=5e-3,
+                   no_grad_set=None):
+        """Analytic grads (via backward ops) vs central finite differences of
+        a scalar objective sum(outputs)."""
+        if output_names is None:
+            output_names = []
+            for slot, value in self.outputs.items():
+                output_names.extend(n for n, _ in _as_pairs(slot, value))
+        elif isinstance(output_names, str):
+            output_names = [output_names]
+
+        prog, startup, feed, op_in, _op_out = self._build()
+        with program_guard(prog, startup):
+            block = prog.global_block()
+            # scalar objective: sum over every checked output
+            partials = []
+            for n in output_names:
+                s = block.create_var(name=n + '@SUM', dtype='float32')
+                block.append_op(type='reduce_sum', inputs={'X': [n]},
+                                outputs={'Out': [n + '@SUM']},
+                                attrs={'reduce_all': True, 'dim': [0],
+                                       'keep_dim': False})
+                partials.append(n + '@SUM')
+            obj = block.create_var(name='grad_objective', dtype='float32')
+            block.append_op(type='sum', inputs={'X': partials},
+                            outputs={'Out': ['grad_objective']})
+            obj_var = block.var('grad_objective')
+            in_vars = [block.var(n) for n in inputs_to_check]
+            grads = fluid.calc_gradient(obj_var, in_vars,
+                                        no_grad_set=no_grad_set)
+
+        exe = fluid.Executor(fluid.CPUPlace())
+        with fluid.scope_guard(fluid.Scope()):
+            exe.run(startup)
+            analytic = exe.run(prog, feed=feed,
+                               fetch_list=[g for g in grads])
+
+            # numeric: central differences through the same executor
+            for name, got in zip(inputs_to_check, analytic):
+                base = feed[name].astype(np.float64)
+                num = np.zeros_like(base, dtype=np.float64)
+                flat = base.reshape(-1)
+                for i in range(flat.size):
+                    orig = flat[i]
+                    for sign in (+1, -1):
+                        flat[i] = orig + sign * numeric_delta
+                        feed[name] = base.reshape(feed[name].shape) \
+                            .astype(feed[name].dtype)
+                        val, = exe.run(prog, feed=feed,
+                                       fetch_list=['grad_objective'])
+                        num.reshape(-1)[i] += sign * float(val)
+                    flat[i] = orig
+                feed[name] = base.reshape(feed[name].shape) \
+                    .astype(feed[name].dtype)
+                num /= (2.0 * numeric_delta)
+                got = np.asarray(got, dtype=np.float64)
+                denom = np.maximum(np.maximum(np.abs(num), np.abs(got)), 1e-3)
+                diff = np.abs(num - got)
+                rel = diff / denom
+                # differences below fp32 finite-difference noise are a match
+                rel = np.where(diff < 1e-4, 0.0, rel)
+                assert rel.max() <= max_relative_error, (
+                    'grad of %r for op %s: max rel err %.5f > %.5f\n'
+                    'numeric=%s\nanalytic=%s'
+                    % (name, self.op_type, rel.max(), max_relative_error,
+                       num.reshape(-1)[:8], got.reshape(-1)[:8]))

@@ -1,0 +1,92 @@
+"""Autodiff tests: fan-out dedup, stop_gradient, calc_gradient
+(pattern of reference test_backward.py + append_backward behaviors)."""
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.framework import Program, program_guard, grad_var_name
+
+
+def test_fanout_grad_sum():
+    """x feeds two consumers; dx must be the sum of both contributions."""
+    prog, startup = Program(), Program()
+    with program_guard(prog, startup):
+        x = fluid.layers.data(name='x', shape=[3], dtype='float32')
+        x.stop_gradient = False
+        a = fluid.layers.scale(x, scale=2.0)
+        b = fluid.layers.scale(x, scale=3.0)
+        s = fluid.layers.elementwise_add(a, b)
+        loss = fluid.layers.mean(s)
+        grads = fluid.calc_gradient(loss, [x])
+    # a sum op must have been inserted for the two dx contributions
+    types = [op.type for op in prog.global_block().ops]
+    assert 'sum' in types
+    exe = fluid.Executor(fluid.CPUPlace())
+    xv = np.ones((2, 3), dtype='float32')
+    g, = exe.run(prog, feed={'x': xv}, fetch_list=grads)
+    np.testing.assert_allclose(g, np.full((2, 3), 5.0 / 6.0), rtol=1e-6)
+
+
+def test_stop_gradient_blocks_path():
+    prog, startup = Program(), Program()
+    with program_guard(prog, startup):
+        x = fluid.layers.data(name='x', shape=[3], dtype='float32')
+        y = fluid.layers.fc(input=x, size=2)
+        y2 = fluid.layers.fc(input=x, size=2)
+        y2.stop_gradient = True
+        loss = fluid.layers.mean(fluid.layers.elementwise_add(y, y2))
+        params_grads = fluid.append_backward(loss)
+    got = {p.name for p, g in params_grads}
+    # only the first fc's params get grads
+    assert any('fc_0' in n for n in got)
+    assert not any('fc_1' in n for n in got)
+
+
+def test_append_backward_creates_grad_vars():
+    prog, startup = Program(), Program()
+    with program_guard(prog, startup):
+        x = fluid.layers.data(name='x', shape=[4], dtype='float32')
+        y = fluid.layers.fc(input=x, size=2)
+        loss = fluid.layers.mean(y)
+        params_grads = fluid.append_backward(loss)
+        assert len(params_grads) == 2   # w and b
+        for p, g in params_grads:
+            assert g.name == grad_var_name(p.name)
+            assert g.shape == p.shape
+
+
+def test_matches_numeric_gradient():
+    """End-to-end grad vs finite differences through a 2-layer net."""
+    prog, startup = Program(), Program()
+    with program_guard(prog, startup):
+        x = fluid.layers.data(name='x', shape=[4], dtype='float32')
+        h = fluid.layers.fc(input=x, size=5, act='tanh',
+                            param_attr=fluid.ParamAttr(name='w1'),
+                            bias_attr=fluid.ParamAttr(name='b1'))
+        y = fluid.layers.fc(input=h, size=1,
+                            param_attr=fluid.ParamAttr(name='w2'),
+                            bias_attr=fluid.ParamAttr(name='b2'))
+        loss = fluid.layers.mean(fluid.layers.square(y))
+        params_grads = fluid.append_backward(loss)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    xv = np.random.RandomState(0).rand(3, 4).astype('float32')
+    g_w1 = dict((p.name, g) for p, g in params_grads)['w1']
+    analytic, = exe.run(prog, feed={'x': xv}, fetch_list=[g_w1])
+
+    w1 = fluid.fetch_var('w1').copy()
+    eps = 1e-3
+    num = np.zeros_like(w1)
+    scope = fluid.global_scope()
+    for i in range(w1.shape[0]):
+        for j in range(w1.shape[1]):
+            vals = []
+            for sign in (+1, -1):
+                w1p = w1.copy()
+                w1p[i, j] += sign * eps
+                scope.set_var('w1', w1p)
+                l, = exe.run(prog, feed={'x': xv}, fetch_list=['mean_0.tmp_0'])
+                vals.append(float(l))
+            num[i, j] = (vals[0] - vals[1]) / (2 * eps)
+    scope.set_var('w1', w1)
+    np.testing.assert_allclose(analytic, num, atol=2e-3)

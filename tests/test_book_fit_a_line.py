@@ -1,0 +1,48 @@
+"""Book chapter 1: fit_a_line end-to-end train + save/load inference
+(re-design of reference tests/book/test_fit_a_line.py:40-55 with synthetic
+data instead of the uci_housing download)."""
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.framework import Program, program_guard
+
+
+def test_fit_a_line_trains_and_infers(tmp_path):
+    prog, startup = Program(), Program()
+    with program_guard(prog, startup):
+        x = fluid.layers.data(name='x', shape=[13], dtype='float32')
+        y = fluid.layers.data(name='y', shape=[1], dtype='float32')
+        y_predict = fluid.layers.fc(input=x, size=1, act=None)
+        cost = fluid.layers.square_error_cost(input=y_predict, label=y)
+        avg_cost = fluid.layers.mean(cost)
+        fluid.optimizer.SGD(learning_rate=0.01).minimize(avg_cost)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+
+    rng = np.random.RandomState(0)
+    w_true = rng.randn(13, 1).astype('float32')
+    first = last = None
+    for i in range(150):
+        xb = rng.randn(20, 13).astype('float32')
+        yb = xb @ w_true + 0.01 * rng.randn(20, 1).astype('float32')
+        loss, = exe.run(prog, feed={'x': xb, 'y': yb},
+                        fetch_list=[avg_cost])
+        if first is None:
+            first = float(loss)
+        last = float(loss)
+    assert last < 0.1 * first, (first, last)
+
+    # save + load inference model, check prediction consistency
+    fluid.io.save_inference_model(str(tmp_path), ['x'], [y_predict], exe,
+                                  main_program=prog)
+    infer_prog, feed_names, fetch_vars = \
+        fluid.io.load_inference_model(str(tmp_path), exe)
+    xt = rng.randn(4, 13).astype('float32')
+    test_prog = prog.clone(for_test=True)
+    direct, = exe.run(test_prog, feed={'x': xt,
+                                       'y': np.zeros((4, 1), 'float32')},
+                      fetch_list=[y_predict])
+    loaded, = exe.run(infer_prog, feed={feed_names[0]: xt},
+                      fetch_list=fetch_vars)
+    np.testing.assert_allclose(direct, loaded, rtol=1e-5)

@@ -1,0 +1,52 @@
+"""Book chapter 2: recognize_digits -- LeNet-style conv net end-to-end
+(re-design of reference tests/book/test_recognize_digits.py with a small
+synthetic separable dataset)."""
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.framework import Program, program_guard
+
+
+def _digit_batch(rng, bs):
+    """Tiny synthetic 'digits': class k has a bright kxk top-left block."""
+    x = rng.rand(bs, 1, 12, 12).astype('float32') * 0.1
+    y = rng.randint(0, 4, (bs, 1)).astype('int64')
+    for i in range(bs):
+        k = int(y[i, 0]) + 2
+        x[i, 0, :k, :k] += 1.0
+    return x, y
+
+
+def test_recognize_digits_conv():
+    prog, startup = Program(), Program()
+    startup.random_seed = 1
+    with program_guard(prog, startup):
+        img = fluid.layers.data(name='img', shape=[1, 12, 12],
+                                dtype='float32')
+        label = fluid.layers.data(name='label', shape=[1], dtype='int64')
+        conv = fluid.nets.simple_img_conv_pool(
+            input=img, filter_size=3, num_filters=8, pool_size=2,
+            pool_stride=2, act='relu')
+        prediction = fluid.layers.fc(input=conv, size=4, act='softmax')
+        cost = fluid.layers.cross_entropy(input=prediction, label=label)
+        avg_cost = fluid.layers.mean(cost)
+        acc = fluid.layers.accuracy(input=prediction, label=label)
+        fluid.optimizer.Adam(learning_rate=0.01).minimize(avg_cost)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    rng = np.random.RandomState(0)
+    accs = []
+    for i in range(60):
+        xb, yb = _digit_batch(rng, 32)
+        _, a = exe.run(prog, feed={'img': xb, 'label': yb},
+                       fetch_list=[avg_cost, acc])
+        accs.append(float(a))
+    assert np.mean(accs[-10:]) > 0.9, np.mean(accs[-10:])
+
+    # eval program shares parameters and runs without optimizer ops
+    test_prog = prog.clone(for_test=True)
+    xb, yb = _digit_batch(rng, 32)
+    a_test, = exe.run(test_prog, feed={'img': xb, 'label': yb},
+                      fetch_list=[acc.name])
+    assert float(a_test) > 0.8

@@ -1,0 +1,175 @@
+"""Elementwise / activation / scale op tests (pattern of reference
+tests/unittests/test_elementwise_*_op.py, test_activation_op.py)."""
+import numpy as np
+
+from op_test import OpTest
+
+
+class TestElementwiseAdd(OpTest):
+    op_type = 'elementwise_add'
+
+    def setup(self):
+        x = np.random.rand(3, 4).astype('float32')
+        y = np.random.rand(3, 4).astype('float32')
+        self.inputs = {'X': x, 'Y': y}
+        self.outputs = {'Out': x + y}
+
+    def test_output(self):
+        self.setup()
+        self.check_output()
+
+    def test_grad(self):
+        self.setup()
+        self.check_grad(['X', 'Y'])
+
+
+class TestElementwiseAddBroadcastAxis(OpTest):
+    op_type = 'elementwise_add'
+
+    def setup(self):
+        x = np.random.rand(2, 3, 4).astype('float32')
+        y = np.random.rand(3).astype('float32')
+        self.inputs = {'X': x, 'Y': y}
+        self.attrs = {'axis': 1}
+        self.outputs = {'Out': x + y.reshape(1, 3, 1)}
+
+    def test_output(self):
+        self.setup()
+        self.check_output()
+
+    def test_grad(self):
+        self.setup()
+        self.check_grad(['X', 'Y'])
+
+
+class TestElementwiseSub(OpTest):
+    op_type = 'elementwise_sub'
+
+    def test_all(self):
+        x = np.random.rand(3, 4).astype('float32')
+        y = np.random.rand(3, 4).astype('float32')
+        self.inputs = {'X': x, 'Y': y}
+        self.outputs = {'Out': x - y}
+        self.check_output()
+        self.check_grad(['X', 'Y'])
+
+
+class TestElementwiseMul(OpTest):
+    op_type = 'elementwise_mul'
+
+    def test_all(self):
+        x = np.random.rand(3, 4).astype('float32')
+        y = np.random.rand(3, 4).astype('float32')
+        self.inputs = {'X': x, 'Y': y}
+        self.outputs = {'Out': x * y}
+        self.check_output()
+        self.check_grad(['X', 'Y'])
+
+
+class TestElementwiseDiv(OpTest):
+    op_type = 'elementwise_div'
+
+    def test_all(self):
+        x = np.random.rand(3, 4).astype('float32') + 0.5
+        y = np.random.rand(3, 4).astype('float32') + 0.5
+        self.inputs = {'X': x, 'Y': y}
+        self.outputs = {'Out': x / y}
+        self.check_output()
+        self.check_grad(['X', 'Y'], max_relative_error=0.02)
+
+
+class TestElementwiseMax(OpTest):
+    op_type = 'elementwise_max'
+
+    def test_output(self):
+        x = np.random.rand(3, 4).astype('float32')
+        y = np.random.rand(3, 4).astype('float32')
+        self.inputs = {'X': x, 'Y': y}
+        self.outputs = {'Out': np.maximum(x, y)}
+        self.check_output()
+
+
+class TestElementwisePow(OpTest):
+    op_type = 'elementwise_pow'
+
+    def test_output(self):
+        x = np.random.rand(3, 4).astype('float32') + 1.0
+        y = np.random.rand(3, 4).astype('float32') * 2
+        self.inputs = {'X': x, 'Y': y}
+        self.outputs = {'Out': np.power(x, y)}
+        self.check_output()
+
+
+class TestScale(OpTest):
+    op_type = 'scale'
+
+    def test_all(self):
+        x = np.random.rand(4, 5).astype('float32')
+        self.inputs = {'X': x}
+        self.attrs = {'scale': 2.5, 'bias': 0.7}
+        self.outputs = {'Out': x * 2.5 + 0.7}
+        self.check_output()
+        self.check_grad(['X'])
+
+
+class TestClip(OpTest):
+    op_type = 'clip'
+
+    def test_output(self):
+        x = (np.random.rand(4, 5).astype('float32') - 0.5) * 4
+        self.inputs = {'X': x}
+        self.attrs = {'min': -0.5, 'max': 0.5}
+        self.outputs = {'Out': np.clip(x, -0.5, 0.5)}
+        self.check_output()
+
+
+def _unary_case(op_type, fn, low=0.1, high=1.0, grad=True, **attrs):
+    class _T(OpTest):
+        pass
+    _T.op_type = op_type
+
+    def test_all(self):
+        x = (np.random.rand(3, 7) * (high - low) + low).astype('float32')
+        self.inputs = {'X': x}
+        self.attrs = attrs
+        self.outputs = {'Out': fn(x)}
+        self.check_output(atol=1e-4)
+        if grad:
+            self.check_grad(['X'], max_relative_error=0.02)
+    _T.test_all = test_all
+    _T.__name__ = 'Test' + op_type.title().replace('_', '')
+    return _T
+
+
+TestRelu = _unary_case('relu', lambda x: np.maximum(x, 0), low=-1, high=1,
+                       grad=False)
+TestSigmoid = _unary_case('sigmoid', lambda x: 1 / (1 + np.exp(-x)),
+                          low=-2, high=2)
+TestTanh = _unary_case('tanh', np.tanh, low=-2, high=2)
+TestExp = _unary_case('exp', np.exp, low=-1, high=1)
+TestLog = _unary_case('log', np.log, low=0.2, high=2)
+TestSquare = _unary_case('square', np.square, low=-1, high=1)
+TestSqrt = _unary_case('sqrt', np.sqrt, low=0.2, high=2)
+TestAbs = _unary_case('abs', np.abs, low=0.2, high=1)  # avoid kink at 0
+TestReciprocal = _unary_case('reciprocal', lambda x: 1 / x, low=0.5, high=2)
+TestSoftplus = _unary_case('softplus', lambda x: np.log1p(np.exp(x)),
+                           low=-2, high=2)
+TestLeakyRelu = _unary_case('leaky_relu',
+                            lambda x: np.where(x >= 0, x, 0.1 * x),
+                            low=0.1, high=1, alpha=0.1)
+TestGelu = _unary_case(
+    'gelu',
+    lambda x: 0.5 * x * (1 + np.tanh(np.sqrt(2 / np.pi)
+                                     * (x + 0.044715 * x ** 3))),
+    low=-2, high=2, grad=False)
+
+
+class TestCast(OpTest):
+    op_type = 'cast'
+
+    def test_output(self):
+        x = np.random.rand(3, 4).astype('float32')
+        self.inputs = {'X': x}
+        self.attrs = {'out_dtype': 'int32'}
+        self.outputs = {'Out': x.astype('int32')}
+        self.check_output()

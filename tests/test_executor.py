@@ -1,0 +1,148 @@
+"""Executor behaviors: compile cache, host-op segmentation, scope semantics,
+save/load, RNG determinism (re-design of reference executor tests +
+test_executor_and_mul.py)."""
+import os
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.framework import Program, program_guard
+
+
+def test_feed_fetch_roundtrip():
+    prog, startup = Program(), Program()
+    with program_guard(prog, startup):
+        x = fluid.layers.data(name='x', shape=[3], dtype='float32')
+        out = fluid.layers.scale(x, scale=3.0)
+    exe = fluid.Executor(fluid.CPUPlace())
+    xv = np.arange(6, dtype='float32').reshape(2, 3)
+    r, = exe.run(prog, feed={'x': xv}, fetch_list=[out])
+    np.testing.assert_allclose(r, xv * 3)
+
+
+def test_compile_cache_reused():
+    prog, startup = Program(), Program()
+    with program_guard(prog, startup):
+        x = fluid.layers.data(name='x', shape=[3], dtype='float32')
+        out = fluid.layers.scale(x, scale=2.0)
+    exe = fluid.Executor(fluid.CPUPlace())
+    xv = np.ones((2, 3), dtype='float32')
+    exe.run(prog, feed={'x': xv}, fetch_list=[out])
+    assert len(exe._prepared_cache) == 1
+    exe.run(prog, feed={'x': xv * 2}, fetch_list=[out])
+    assert len(exe._prepared_cache) == 1          # same shape: cache hit
+    exe.run(prog, feed={'x': np.ones((4, 3), 'float32')}, fetch_list=[out])
+    assert len(exe._prepared_cache) == 2          # new batch size: new entry
+
+
+def test_program_mutation_invalidates_cache():
+    prog, startup = Program(), Program()
+    with program_guard(prog, startup):
+        x = fluid.layers.data(name='x', shape=[3], dtype='float32')
+        out = fluid.layers.scale(x, scale=2.0)
+    exe = fluid.Executor(fluid.CPUPlace())
+    xv = np.ones((2, 3), dtype='float32')
+    r1, = exe.run(prog, feed={'x': xv}, fetch_list=[out])
+    with program_guard(prog, startup):
+        out2 = fluid.layers.scale(out, scale=5.0)
+    r2, = exe.run(prog, feed={'x': xv}, fetch_list=[out2])
+    np.testing.assert_allclose(r2, xv * 10)
+
+
+def test_persistable_state_across_runs():
+    prog, startup = Program(), Program()
+    with program_guard(prog, startup):
+        counter = fluid.layers.create_global_var(
+            shape=[1], value=0.0, dtype='float32', persistable=True,
+            name='counter')
+        fluid.layers.increment(counter, value=1.0)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    for i in range(3):
+        exe.run(prog, fetch_list=[])
+    assert float(fluid.fetch_var('counter')) == 3.0
+
+
+def test_host_op_print_between_device_segments(capfd):
+    prog, startup = Program(), Program()
+    with program_guard(prog, startup):
+        x = fluid.layers.data(name='x', shape=[2], dtype='float32')
+        a = fluid.layers.scale(x, scale=2.0)
+        block = prog.global_block()
+        block.append_op(type='print', inputs={'In': [a]}, outputs={},
+                        attrs={'message': 'DBG'})
+        b = fluid.layers.scale(a, scale=3.0)
+    exe = fluid.Executor(fluid.CPUPlace())
+    r, = exe.run(prog, feed={'x': np.ones((1, 2), 'float32')},
+                 fetch_list=[b])
+    np.testing.assert_allclose(r, np.full((1, 2), 6.0))
+    err = capfd.readouterr().err
+    assert 'DBG' in err
+
+
+def test_save_load_persistables(tmp_path):
+    prog, startup = Program(), Program()
+    with program_guard(prog, startup):
+        x = fluid.layers.data(name='x', shape=[3], dtype='float32')
+        y = fluid.layers.fc(input=x, size=2,
+                            param_attr=fluid.ParamAttr(name='wsl'))
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    w_orig = fluid.fetch_var('wsl').copy()
+    fluid.io.save_persistables(exe, str(tmp_path), prog)
+    fluid.global_scope().set_var('wsl', np.zeros_like(w_orig))
+    fluid.io.load_persistables(exe, str(tmp_path), prog)
+    np.testing.assert_allclose(fluid.fetch_var('wsl'), w_orig)
+    assert os.path.exists(str(tmp_path / 'wsl'))
+
+
+def test_save_load_combined(tmp_path):
+    prog, startup = Program(), Program()
+    with program_guard(prog, startup):
+        x = fluid.layers.data(name='x', shape=[3], dtype='float32')
+        fluid.layers.fc(input=x, size=2, param_attr='wa', bias_attr='ba')
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    w_orig = fluid.fetch_var('wa').copy()
+    fluid.io.save_persistables(exe, str(tmp_path), prog,
+                               filename='all_params')
+    fluid.global_scope().set_var('wa', np.zeros_like(w_orig))
+    fluid.io.load_persistables(exe, str(tmp_path), prog,
+                               filename='all_params')
+    np.testing.assert_allclose(fluid.fetch_var('wa'), w_orig)
+
+
+def test_rng_determinism_with_seed():
+    def draw(seed):
+        prog, startup = Program(), Program()
+        startup.random_seed = seed
+        with program_guard(prog, startup):
+            fluid.layers.create_parameter(
+                shape=[4, 4], dtype='float32', name='wr%d' % seed,
+                default_initializer=fluid.initializer.Normal(0, 1))
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        return fluid.fetch_var('wr%d' % seed).copy()
+    # Different Executor instances, same seed -> identical init is only
+    # guaranteed per-instance step counter; use two fresh scopes.
+    a = draw(7)
+    b = draw(7)
+    assert a.shape == (4, 4)
+    np.testing.assert_allclose(a, b)
+
+
+def test_scope_isolation():
+    prog, startup = Program(), Program()
+    with program_guard(prog, startup):
+        fluid.layers.create_global_var(shape=[1], value=5.0,
+                                       dtype='float32',
+                                       persistable=True, name='gv')
+    exe = fluid.Executor(fluid.CPUPlace())
+    s1, s2 = fluid.Scope(), fluid.Scope()
+    with fluid.scope_guard(s1):
+        exe.run(startup)
+        fluid.global_scope().set_var('gv', np.array([1.0], 'float32'))
+    with fluid.scope_guard(s2):
+        exe.run(startup)
+        assert float(fluid.fetch_var('gv')) == 5.0
+    assert float(np.asarray(s1.find_var('gv'))) == 1.0

@@ -1,0 +1,103 @@
+"""Program/Block/Operator IR tests (pattern of reference test_program.py,
+test_operator_desc.py, test_variable.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.framework import Program, program_guard
+
+
+def build_simple():
+    prog, startup = Program(), Program()
+    with program_guard(prog, startup):
+        x = fluid.layers.data(name='x', shape=[4], dtype='float32')
+        y = fluid.layers.fc(input=x, size=3, act='relu')
+        loss = fluid.layers.mean(y)
+    return prog, startup, loss
+
+
+def test_program_structure():
+    prog, startup, loss = build_simple()
+    block = prog.global_block()
+    types = [op.type for op in block.ops]
+    assert types == ['mul', 'elementwise_add', 'relu', 'mean']
+    assert block.var('x').shape == (-1, 4)
+    assert any(v.persistable for v in block.vars.values())
+    # startup got the init ops
+    st_types = [op.type for op in startup.global_block().ops]
+    assert 'uniform_random' in st_types   # Xavier default
+    assert 'fill_constant' in st_types    # bias
+
+
+def test_shape_inference():
+    prog, _, loss = build_simple()
+    block = prog.global_block()
+    fc_out = [op for op in block.ops if op.type == 'relu'][0]
+    out_var = block.var(fc_out.single_output('Out'))
+    assert out_var.shape == (-1, 3)
+    assert loss.shape == ()
+
+
+def test_clone_for_test_strips_backward():
+    prog, startup, loss = build_simple()
+    with program_guard(prog, startup):
+        fluid.optimizer.SGD(0.1).minimize(loss)
+    n_train_ops = len(prog.global_block().ops)
+    test_prog = prog.clone(for_test=True)
+    n_test_ops = len(test_prog.global_block().ops)
+    assert n_test_ops < n_train_ops
+    assert all(op.attr('op_role', 'forward') == 'forward'
+               for op in test_prog.global_block().ops)
+    # original untouched
+    assert len(prog.global_block().ops) == n_train_ops
+
+
+def test_prune():
+    prog, startup, loss = build_simple()
+    block = prog.global_block()
+    fc_pre = block.ops[0].single_output('Out')   # mul output
+    pruned = prog._prune([fc_pre])
+    assert [op.type for op in pruned.global_block().ops] == ['mul']
+
+
+def test_json_roundtrip():
+    prog, _, _ = build_simple()
+    s = prog.to_json()
+    prog2 = Program.from_json(s)
+    assert [op.type for op in prog2.global_block().ops] == \
+        [op.type for op in prog.global_block().ops]
+    assert prog2.global_block().var('x').shape == (-1, 4)
+    # parameters keep their trainable flag
+    from paddle_tpu.framework import Parameter
+    params = [v for v in prog2.global_block().vars.values()
+              if isinstance(v, Parameter)]
+    assert params and all(p.trainable for p in params)
+
+
+def test_duplicate_var_raises():
+    prog = Program()
+    prog.global_block().create_var(name='a', shape=[1], dtype='float32')
+    with pytest.raises(ValueError):
+        prog.global_block().create_var(name='a', shape=[1], dtype='float32')
+
+
+def test_operator_rename():
+    prog, _, _ = build_simple()
+    block = prog.global_block()
+    op = block.ops[0]
+    old = op.single_input('X')
+    op.rename_input(old, 'renamed_x')
+    assert op.single_input('X') == 'renamed_x'
+
+
+def test_variable_operator_sugar():
+    prog, startup = Program(), Program()
+    with program_guard(prog, startup):
+        a = fluid.layers.data(name='a', shape=[3], dtype='float32')
+        b = fluid.layers.data(name='b', shape=[3], dtype='float32')
+        c = a + b * 2.0 - b / 2.0
+    exe = fluid.Executor(fluid.CPUPlace())
+    av = np.array([[1., 2., 3.]], dtype='float32')
+    bv = np.array([[2., 4., 6.]], dtype='float32')
+    out, = exe.run(prog, feed={'a': av, 'b': bv}, fetch_list=[c])
+    np.testing.assert_allclose(out, av + bv * 2 - bv / 2, rtol=1e-6)

@@ -1,0 +1,203 @@
+"""mul / matmul / reductions / sum / mean / top_k / concat family
+(pattern of reference test_mul_op.py, test_matmul_op.py, test_reduce_op.py)."""
+import numpy as np
+
+from op_test import OpTest
+
+
+class TestMul(OpTest):
+    op_type = 'mul'
+
+    def test_all(self):
+        x = np.random.rand(4, 6).astype('float32')
+        y = np.random.rand(6, 3).astype('float32')
+        self.inputs = {'X': x, 'Y': y}
+        self.outputs = {'Out': x @ y}
+        self.check_output(atol=1e-4)
+        self.check_grad(['X', 'Y'], max_relative_error=0.02)
+
+
+class TestMulFlatten(OpTest):
+    op_type = 'mul'
+
+    def test_output(self):
+        x = np.random.rand(2, 3, 4).astype('float32')
+        y = np.random.rand(12, 5).astype('float32')
+        self.inputs = {'X': x, 'Y': y}
+        self.attrs = {'x_num_col_dims': 1}
+        self.outputs = {'Out': x.reshape(2, 12) @ y}
+        self.check_output(atol=1e-4)
+
+
+class TestMatmul(OpTest):
+    op_type = 'matmul'
+
+    def test_all(self):
+        x = np.random.rand(3, 4, 5).astype('float32')
+        y = np.random.rand(3, 5, 2).astype('float32')
+        self.inputs = {'X': x, 'Y': y}
+        self.outputs = {'Out': np.matmul(x, y)}
+        self.check_output(atol=1e-4)
+        self.check_grad(['X', 'Y'], max_relative_error=0.02)
+
+
+class TestMatmulTranspose(OpTest):
+    op_type = 'matmul'
+
+    def test_output(self):
+        x = np.random.rand(4, 3).astype('float32')
+        y = np.random.rand(5, 4).astype('float32')
+        self.inputs = {'X': x, 'Y': y}
+        self.attrs = {'transpose_X': True, 'transpose_Y': True}
+        self.outputs = {'Out': x.T @ y.T}
+        self.check_output(atol=1e-4)
+
+
+class TestSum(OpTest):
+    op_type = 'sum'
+
+    def test_all(self):
+        xs = [np.random.rand(3, 4).astype('float32') for _ in range(3)]
+        self.inputs = {'X': [('x%d' % i, x) for i, x in enumerate(xs)]}
+        self.outputs = {'Out': xs[0] + xs[1] + xs[2]}
+        self.check_output()
+        self.check_grad(['x0', 'x1'])
+
+
+class TestMean(OpTest):
+    op_type = 'mean'
+
+    def test_all(self):
+        x = np.random.rand(5, 7).astype('float32')
+        self.inputs = {'X': x}
+        self.outputs = {'Out': np.asarray(x.mean(), dtype='float32')}
+        self.check_output()
+        self.check_grad(['X'])
+
+
+class TestReduceSum(OpTest):
+    op_type = 'reduce_sum'
+
+    def test_all(self):
+        x = np.random.rand(3, 4, 5).astype('float32')
+        self.inputs = {'X': x}
+        self.attrs = {'dim': [1]}
+        self.outputs = {'Out': x.sum(axis=1)}
+        self.check_output(atol=1e-4)
+        self.check_grad(['X'])
+
+
+class TestReduceMeanKeepdim(OpTest):
+    op_type = 'reduce_mean'
+
+    def test_all(self):
+        x = np.random.rand(3, 4).astype('float32')
+        self.inputs = {'X': x}
+        self.attrs = {'dim': [-1], 'keep_dim': True}
+        self.outputs = {'Out': x.mean(axis=-1, keepdims=True)}
+        self.check_output()
+        self.check_grad(['X'])
+
+
+class TestReduceMax(OpTest):
+    op_type = 'reduce_max'
+
+    def test_output(self):
+        x = np.random.rand(3, 4).astype('float32')
+        self.inputs = {'X': x}
+        self.attrs = {'reduce_all': True}
+        self.outputs = {'Out': np.asarray(x.max(), dtype='float32')}
+        self.check_output()
+
+
+class TestTopK(OpTest):
+    op_type = 'top_k'
+
+    def test_output(self):
+        x = np.random.rand(4, 10).astype('float32')
+        self.attrs = {'k': 3}
+        idx = np.argsort(-x, axis=1)[:, :3]
+        vals = np.take_along_axis(x, idx, axis=1)
+        self.inputs = {'X': x}
+        self.outputs = {'Out': vals, 'Indices': idx.astype('int64')}
+        self.check_output(no_check_set=('Indices',))
+
+
+class TestConcat(OpTest):
+    op_type = 'concat'
+
+    def test_all(self):
+        xs = [np.random.rand(2, i + 2, 3).astype('float32')
+              for i in range(3)]
+        self.inputs = {'X': [('c%d' % i, x) for i, x in enumerate(xs)]}
+        self.attrs = {'axis': 1}
+        self.outputs = {'Out': np.concatenate(xs, axis=1)}
+        self.check_output()
+        self.check_grad(['c0', 'c2'])
+
+
+class TestSplit(OpTest):
+    op_type = 'split'
+
+    def test_output(self):
+        x = np.random.rand(4, 6).astype('float32')
+        parts = np.split(x, [2, 5], axis=1)
+        self.inputs = {'X': x}
+        self.attrs = {'sections': [2, 3, 1], 'axis': 1, 'num': 0}
+        self.outputs = {'Out': [('s0', parts[0]), ('s1', parts[1]),
+                                ('s2', parts[2])]}
+        self.check_output()
+
+
+class TestSoftmax(OpTest):
+    op_type = 'softmax'
+
+    def test_all(self):
+        x = np.random.rand(4, 7).astype('float32')
+        e = np.exp(x - x.max(axis=-1, keepdims=True))
+        self.inputs = {'X': x}
+        self.outputs = {'Out': e / e.sum(axis=-1, keepdims=True)}
+        self.check_output()
+        self.check_grad(['X'], max_relative_error=0.02)
+
+
+class TestCrossEntropy(OpTest):
+    op_type = 'cross_entropy'
+
+    def test_all(self):
+        p = np.random.rand(5, 4).astype('float32') + 0.1
+        p /= p.sum(axis=1, keepdims=True)
+        label = np.random.randint(0, 4, (5, 1)).astype('int32')
+        expect = -np.log(np.take_along_axis(p, label, axis=1))
+        self.inputs = {'X': p, 'Label': label}
+        self.outputs = {'Y': expect}
+        self.check_output()
+        self.check_grad(['X'], output_names='Y', max_relative_error=0.02)
+
+
+class TestSoftmaxWithCrossEntropy(OpTest):
+    op_type = 'softmax_with_cross_entropy'
+
+    def test_all(self):
+        logits = np.random.rand(5, 4).astype('float32') * 4
+        label = np.random.randint(0, 4, (5, 1)).astype('int32')
+        e = np.exp(logits - logits.max(axis=1, keepdims=True))
+        sm = e / e.sum(axis=1, keepdims=True)
+        loss = -np.log(np.take_along_axis(sm, label, axis=1))
+        self.inputs = {'Logits': logits, 'Label': label}
+        self.outputs = {'Softmax': sm, 'Loss': loss}
+        self.check_output(atol=1e-4)
+        self.check_grad(['Logits'], output_names='Loss',
+                        max_relative_error=0.02)
+
+
+class TestLookupTable(OpTest):
+    op_type = 'lookup_table'
+
+    def test_all(self):
+        w = np.random.rand(10, 4).astype('float32')
+        ids = np.random.randint(0, 10, (5, 1)).astype('int32')
+        self.inputs = {'W': w, 'Ids': ids}
+        self.outputs = {'Out': w[ids.reshape(-1)]}
+        self.check_output()
+        self.check_grad(['W'], max_relative_error=0.02)

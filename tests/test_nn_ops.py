@@ -1,0 +1,229 @@
+"""conv2d / pool2d / batch_norm / layer_norm / dropout / reshape family
+(pattern of reference test_conv2d_op.py, test_pool2d_op.py,
+test_batch_norm_op.py, test_layer_norm_op.py)."""
+import numpy as np
+
+from op_test import OpTest
+
+
+def np_conv2d(x, w, stride, pad):
+    n, c, h, wd = x.shape
+    oc, ic, kh, kw = w.shape
+    oh = (h + 2 * pad - kh) // stride + 1
+    ow = (wd + 2 * pad - kw) // stride + 1
+    xp = np.pad(x, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    out = np.zeros((n, oc, oh, ow), dtype=np.float64)
+    for i in range(oh):
+        for j in range(ow):
+            patch = xp[:, :, i * stride:i * stride + kh,
+                       j * stride:j * stride + kw]
+            out[:, :, i, j] = np.tensordot(patch, w, axes=([1, 2, 3],
+                                                           [1, 2, 3]))
+    return out.astype('float32')
+
+
+class TestConv2d(OpTest):
+    op_type = 'conv2d'
+
+    def test_all(self):
+        x = np.random.rand(2, 3, 7, 7).astype('float32')
+        w = np.random.rand(4, 3, 3, 3).astype('float32')
+        self.inputs = {'Input': x, 'Filter': w}
+        self.attrs = {'strides': [2, 2], 'paddings': [1, 1],
+                      'dilations': [1, 1], 'groups': 1}
+        self.outputs = {'Output': np_conv2d(x, w, 2, 1)}
+        self.check_output(atol=1e-3)
+        self.check_grad(['Input', 'Filter'], max_relative_error=0.03)
+
+
+class TestPool2dMax(OpTest):
+    op_type = 'pool2d'
+
+    def test_output(self):
+        x = np.random.rand(2, 3, 6, 6).astype('float32')
+        self.inputs = {'X': x}
+        self.attrs = {'pooling_type': 'max', 'ksize': [2, 2],
+                      'strides': [2, 2], 'paddings': [0, 0]}
+        expect = x.reshape(2, 3, 3, 2, 3, 2).max(axis=(3, 5))
+        self.outputs = {'Out': expect}
+        self.check_output()
+
+
+class TestPool2dAvg(OpTest):
+    op_type = 'pool2d'
+
+    def test_all(self):
+        x = np.random.rand(2, 3, 6, 6).astype('float32')
+        self.inputs = {'X': x}
+        self.attrs = {'pooling_type': 'avg', 'ksize': [2, 2],
+                      'strides': [2, 2], 'paddings': [0, 0]}
+        expect = x.reshape(2, 3, 3, 2, 3, 2).mean(axis=(3, 5))
+        self.outputs = {'Out': expect}
+        self.check_output()
+        self.check_grad(['X'])
+
+
+class TestPool2dGlobal(OpTest):
+    op_type = 'pool2d'
+
+    def test_output(self):
+        x = np.random.rand(2, 3, 5, 5).astype('float32')
+        self.inputs = {'X': x}
+        self.attrs = {'pooling_type': 'avg', 'ksize': [1, 1],
+                      'global_pooling': True}
+        self.outputs = {'Out': x.mean(axis=(2, 3), keepdims=True)}
+        self.check_output()
+
+
+class TestBatchNormTrain(OpTest):
+    op_type = 'batch_norm'
+
+    def test_output(self):
+        np.random.seed(3)
+        x = np.random.rand(4, 3, 5, 5).astype('float32') * 2
+        scale = np.random.rand(3).astype('float32')
+        bias = np.random.rand(3).astype('float32')
+        mean = np.zeros(3, dtype='float32')
+        var = np.ones(3, dtype='float32')
+        eps, momentum = 1e-5, 0.9
+        mu = x.mean(axis=(0, 2, 3))
+        v = x.var(axis=(0, 2, 3))
+        y = ((x - mu.reshape(1, 3, 1, 1))
+             / np.sqrt(v.reshape(1, 3, 1, 1) + eps)
+             * scale.reshape(1, 3, 1, 1) + bias.reshape(1, 3, 1, 1))
+        self.inputs = {'X': x, 'Scale': scale, 'Bias': bias,
+                       'Mean': mean, 'Variance': var}
+        self.attrs = {'epsilon': eps, 'momentum': momentum,
+                      'is_test': False}
+        self.outputs = {
+            'Y': y,
+            'MeanOut': mean * momentum + mu * (1 - momentum),
+            'VarianceOut': var * momentum + v * (1 - momentum),
+            'SavedMean': mu, 'SavedVariance': v,
+        }
+        self.check_output(atol=2e-4)
+
+
+class TestLayerNorm(OpTest):
+    op_type = 'layer_norm'
+
+    def test_all(self):
+        x = np.random.rand(3, 8).astype('float32')
+        scale = np.random.rand(8).astype('float32')
+        bias = np.random.rand(8).astype('float32')
+        eps = 1e-5
+        mu = x.mean(axis=1, keepdims=True)
+        v = x.var(axis=1, keepdims=True)
+        y = (x - mu) / np.sqrt(v + eps) * scale + bias
+        self.inputs = {'X': x, 'Scale': scale, 'Bias': bias}
+        self.attrs = {'epsilon': eps, 'begin_norm_axis': 1}
+        self.outputs = {'Y': y, 'Mean': mu.reshape(3),
+                        'Variance': v.reshape(3)}
+        self.check_output(atol=2e-4)
+        self.check_grad(['X', 'Scale', 'Bias'], output_names='Y',
+                        max_relative_error=0.03)
+
+
+class TestDropoutInfer(OpTest):
+    op_type = 'dropout'
+
+    def test_output(self):
+        x = np.random.rand(4, 5).astype('float32')
+        self.inputs = {'X': x}
+        self.attrs = {'dropout_prob': 0.35, 'is_test': True}
+        self.outputs = {'Out': x * (1 - 0.35)}
+        self.check_output()
+
+
+class TestReshape(OpTest):
+    op_type = 'reshape2'
+
+    def test_output(self):
+        x = np.random.rand(2, 3, 4).astype('float32')
+        self.inputs = {'X': x}
+        self.attrs = {'shape': [2, -1]}
+        self.outputs = {'Out': x.reshape(2, 12),
+                        'XShape': np.zeros((0, 2, 3, 4), 'float32')}
+        self.check_output(no_check_set=('XShape',))
+
+
+class TestTranspose(OpTest):
+    op_type = 'transpose2'
+
+    def test_output(self):
+        x = np.random.rand(2, 3, 4).astype('float32')
+        self.inputs = {'X': x}
+        self.attrs = {'axis': [1, 0, 2]}
+        self.outputs = {'Out': x.transpose(1, 0, 2),
+                        'XShape': np.zeros((0, 2, 3, 4), 'float32')}
+        self.check_output(no_check_set=('XShape',))
+
+
+class TestSlice(OpTest):
+    op_type = 'slice'
+
+    def test_output(self):
+        x = np.random.rand(4, 5, 6).astype('float32')
+        self.inputs = {'Input': x}
+        self.attrs = {'axes': [0, 2], 'starts': [1, 2], 'ends': [3, 6]}
+        self.outputs = {'Out': x[1:3, :, 2:6]}
+        self.check_output()
+
+
+class TestOneHot(OpTest):
+    op_type = 'one_hot'
+
+    def test_output(self):
+        ids = np.random.randint(0, 6, (5, 1)).astype('int32')
+        expect = np.zeros((5, 6), dtype='float32')
+        expect[np.arange(5), ids.reshape(-1)] = 1.0
+        self.inputs = {'X': ids}
+        self.attrs = {'depth': 6}
+        self.outputs = {'Out': expect}
+        self.check_output()
+
+
+class TestAccuracy(OpTest):
+    op_type = 'accuracy'
+
+    def test_output(self):
+        idx = np.array([[0, 1], [2, 3], [4, 0], [1, 2]]).astype('int64')
+        label = np.array([[1], [5], [4], [0]]).astype('int64')
+        # rows 0 and 2 contain the label in topk
+        self.inputs = {'Out': idx.astype('float32'), 'Indices': idx,
+                       'Label': label}
+        self.outputs = {
+            'Accuracy': np.asarray(0.5, 'float32'),
+            'Correct': np.asarray(2, 'int32'),
+            'Total': np.asarray(4, 'int32'),
+        }
+        self.check_output()
+
+
+class TestSigmoidCrossEntropyWithLogits(OpTest):
+    op_type = 'sigmoid_cross_entropy_with_logits'
+
+    def test_all(self):
+        x = (np.random.rand(4, 5).astype('float32') - 0.5) * 4
+        label = np.random.rand(4, 5).astype('float32')
+        expect = np.maximum(x, 0) - x * label + np.log1p(np.exp(-np.abs(x)))
+        self.inputs = {'X': x, 'Label': label}
+        self.outputs = {'Out': expect}
+        self.check_output(atol=1e-4)
+        self.check_grad(['X'], max_relative_error=0.02)
+
+
+class TestHuberLoss(OpTest):
+    op_type = 'huber_loss'
+
+    def test_output(self):
+        x = np.random.rand(5, 1).astype('float32')
+        y = np.random.rand(5, 1).astype('float32')
+        delta = 0.5
+        r = y - x
+        a = np.abs(r)
+        loss = np.where(a <= delta, 0.5 * r * r, delta * (a - 0.5 * delta))
+        self.inputs = {'X': x, 'Y': y}
+        self.attrs = {'delta': delta}
+        self.outputs = {'Out': loss.astype('float32'), 'Residual': r}
+        self.check_output(no_check_set=('Residual',))

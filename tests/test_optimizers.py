@@ -1,0 +1,168 @@
+"""Optimizer op semantics vs numpy references (pattern of reference
+test_sgd_op.py, test_adam_op.py, test_momentum_op.py, ...)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.framework import Program, program_guard
+
+
+def _run_steps(opt, steps=3, lr=0.1):
+    """Train z = mean((w*x - 1)^2) for a 1-var problem; return w history."""
+    prog, startup = Program(), Program()
+    with program_guard(prog, startup):
+        x = fluid.layers.data(name='x', shape=[4], dtype='float32')
+        w = fluid.layers.create_parameter(
+            shape=[4], dtype='float32', name='w',
+            default_initializer=fluid.initializer.Constant(0.5))
+        pred = fluid.layers.elementwise_mul(x, w, axis=1)
+        loss = fluid.layers.mean(fluid.layers.square(pred - 1.0))
+        opt.minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    xv = np.ones((2, 4), dtype='float32')
+    ws = [fluid.fetch_var('w').copy()]
+    for _ in range(steps):
+        exe.run(prog, feed={'x': xv}, fetch_list=[loss])
+        ws.append(fluid.fetch_var('w').copy())
+    return ws
+
+
+def _numpy_grad(w):
+    # loss = mean((w*1 - 1)^2) over 8 elements (2x4), d/dw = 2(w-1)*2/8
+    return 2.0 * (w - 1.0) * 2.0 / 8.0
+
+
+def test_sgd_matches_numpy():
+    ws = _run_steps(fluid.optimizer.SGD(learning_rate=0.1))
+    w = np.full(4, 0.5, dtype='float64')
+    for got in ws[1:]:
+        w = w - 0.1 * _numpy_grad(w)
+        np.testing.assert_allclose(got, w, rtol=1e-5)
+
+
+def test_momentum_matches_numpy():
+    ws = _run_steps(fluid.optimizer.Momentum(learning_rate=0.1,
+                                             momentum=0.9))
+    w = np.full(4, 0.5, dtype='float64')
+    v = np.zeros(4)
+    for got in ws[1:]:
+        g = _numpy_grad(w)
+        v = 0.9 * v + g
+        w = w - 0.1 * v
+        np.testing.assert_allclose(got, w, rtol=1e-5)
+
+
+def test_adam_matches_numpy():
+    ws = _run_steps(fluid.optimizer.Adam(learning_rate=0.1, beta1=0.9,
+                                         beta2=0.999, epsilon=1e-8))
+    w = np.full(4, 0.5, dtype='float64')
+    m1 = np.zeros(4)
+    m2 = np.zeros(4)
+    b1p, b2p = 0.9, 0.999
+    for got in ws[1:]:
+        g = _numpy_grad(w)
+        m1 = 0.9 * m1 + 0.1 * g
+        m2 = 0.999 * m2 + 0.001 * g * g
+        lr_t = 0.1 * np.sqrt(1 - b2p) / (1 - b1p)
+        w = w - lr_t * m1 / (np.sqrt(m2) + 1e-8)
+        b1p *= 0.9
+        b2p *= 0.999
+        np.testing.assert_allclose(got, w, rtol=1e-4)
+
+
+def test_adagrad_matches_numpy():
+    ws = _run_steps(fluid.optimizer.Adagrad(learning_rate=0.1,
+                                            epsilon=1e-6))
+    w = np.full(4, 0.5, dtype='float64')
+    mom = np.zeros(4)
+    for got in ws[1:]:
+        g = _numpy_grad(w)
+        mom = mom + g * g
+        w = w - 0.1 * g / (np.sqrt(mom) + 1e-6)
+        np.testing.assert_allclose(got, w, rtol=1e-4)
+
+
+def test_rmsprop_matches_numpy():
+    ws = _run_steps(fluid.optimizer.RMSProp(learning_rate=0.1, rho=0.95,
+                                            epsilon=1e-6))
+    w = np.full(4, 0.5, dtype='float64')
+    ms = np.zeros(4)
+    mom = np.zeros(4)
+    for got in ws[1:]:
+        g = _numpy_grad(w)
+        ms = 0.95 * ms + 0.05 * g * g
+        mom = 0.1 * g / np.sqrt(ms + 1e-6)
+        w = w - mom
+        np.testing.assert_allclose(got, w, rtol=1e-4)
+
+
+@pytest.mark.parametrize('opt_fn', [
+    lambda: fluid.optimizer.Adamax(learning_rate=0.05),
+    lambda: fluid.optimizer.Adadelta(learning_rate=1.0),
+    lambda: fluid.optimizer.DecayedAdagrad(learning_rate=0.1),
+    lambda: fluid.optimizer.Ftrl(learning_rate=0.1),
+])
+def test_optimizers_decrease_loss(opt_fn):
+    prog, startup = Program(), Program()
+    with program_guard(prog, startup):
+        x = fluid.layers.data(name='x', shape=[4], dtype='float32')
+        y = fluid.layers.fc(input=x, size=1)
+        loss = fluid.layers.mean(fluid.layers.square(y - 1.0))
+        opt_fn().minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    xv = np.random.RandomState(0).rand(8, 4).astype('float32')
+    losses = [float(exe.run(prog, feed={'x': xv}, fetch_list=[loss])[0])
+              for _ in range(25)]
+    assert losses[-1] < losses[0]
+
+
+def test_weight_decay_changes_update():
+    opt = fluid.optimizer.SGD(
+        learning_rate=0.1,
+        regularization=fluid.regularizer.L2Decay(0.1))
+    ws = _run_steps(opt, steps=1)
+    w = np.full(4, 0.5)
+    expect = w - 0.1 * (_numpy_grad(w) + 0.1 * w)
+    np.testing.assert_allclose(ws[1], expect, rtol=1e-5)
+
+
+def test_grad_clip_by_global_norm():
+    prog, startup = Program(), Program()
+    with program_guard(prog, startup):
+        x = fluid.layers.data(name='x', shape=[4], dtype='float32')
+        y = fluid.layers.fc(input=x, size=1,
+                            param_attr=fluid.ParamAttr(name='wc'))
+        loss = fluid.layers.mean(fluid.layers.square(y - 1.0))
+        fluid.clip.set_gradient_clip(
+            fluid.clip.GradientClipByGlobalNorm(clip_norm=1e-4),
+            program=prog)
+        fluid.optimizer.SGD(1.0).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    w0 = fluid.fetch_var('wc').copy()
+    xv = np.random.RandomState(1).rand(8, 4).astype('float32') * 10
+    exe.run(prog, feed={'x': xv}, fetch_list=[loss])
+    w1 = fluid.fetch_var('wc')
+    # with a tiny clip norm the update magnitude is bounded by lr*clip
+    assert np.abs(w1 - w0).max() <= 1.1e-4
+
+
+def test_lr_scheduler_piecewise():
+    prog, startup = Program(), Program()
+    with program_guard(prog, startup):
+        x = fluid.layers.data(name='x', shape=[2], dtype='float32')
+        y = fluid.layers.fc(input=x, size=1)
+        loss = fluid.layers.mean(y)
+        lr = fluid.layers.piecewise_decay([2, 4], [1.0, 0.1, 0.01])
+        fluid.optimizer.SGD(learning_rate=lr).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    xv = np.ones((2, 2), dtype='float32')
+    lrs = []
+    for _ in range(5):
+        lr_val, = exe.run(prog, feed={'x': xv}, fetch_list=[lr])
+        lrs.append(float(np.asarray(lr_val).reshape(-1)[0]))
+    # step counter is 1-based: steps 1..5 -> [1.0, 0.1, 0.1, 0.01, 0.01]
+    np.testing.assert_allclose(lrs, [1.0, 0.1, 0.1, 0.01, 0.01], rtol=1e-5)

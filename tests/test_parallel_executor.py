@@ -1,0 +1,87 @@
+"""ParallelExecutor: multi-device GSPMD data parallelism on the 8-device
+virtual CPU mesh (pattern of reference parallel_executor_test_base.py:
+same model trained 1-device vs N-device must give matching losses)."""
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.framework import Program, program_guard
+
+
+def _build(seed=5):
+    prog, startup = Program(), Program()
+    startup.random_seed = seed
+    with program_guard(prog, startup):
+        x = fluid.layers.data(name='x', shape=[8], dtype='float32')
+        y = fluid.layers.data(name='y', shape=[1], dtype='float32')
+        h = fluid.layers.fc(input=x, size=16, act='relu')
+        pred = fluid.layers.fc(input=h, size=1)
+        loss = fluid.layers.mean(
+            fluid.layers.square_error_cost(pred, y))
+        fluid.optimizer.SGD(0.05).minimize(loss)
+    return prog, startup, loss
+
+
+def _data(n_steps, bs):
+    rng = np.random.RandomState(42)
+    w = rng.randn(8, 1).astype('float32')
+    out = []
+    for _ in range(n_steps):
+        xb = rng.randn(bs, 8).astype('float32')
+        out.append((xb, xb @ w))
+    return out
+
+
+def test_pe_matches_single_device():
+    data = _data(10, 32)
+
+    # single device
+    prog, startup, loss = _build()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        single = [float(exe.run(prog, feed={'x': xb, 'y': yb},
+                                fetch_list=[loss])[0])
+                  for xb, yb in data]
+
+    # 8 devices, same global batch
+    prog2, startup2, loss2 = _build()
+    exe2 = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe2.run(startup2)
+        pe = fluid.ParallelExecutor(use_cuda=False, loss_name=loss2.name,
+                                    main_program=prog2)
+        assert pe.device_count == 8
+        multi = [float(pe.run(fetch_list=[loss2.name],
+                              feed={'x': xb, 'y': yb})[0])
+                 for xb, yb in data]
+
+    np.testing.assert_allclose(single, multi, rtol=2e-4)
+
+
+def test_pe_uneven_batch_raises():
+    prog, startup, loss = _build()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    pe = fluid.ParallelExecutor(use_cuda=False, loss_name=loss.name,
+                                main_program=prog)
+    import pytest
+    with pytest.raises(ValueError):
+        pe.run(fetch_list=[loss.name],
+               feed={'x': np.zeros((30, 8), 'float32'),
+                     'y': np.zeros((30, 1), 'float32')})
+
+
+def test_pe_strategies_accepted():
+    prog, startup, loss = _build()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    es = fluid.ExecutionStrategy()
+    es.num_iteration_per_drop_scope = 2
+    bs = fluid.BuildStrategy()
+    bs.reduce_strategy = fluid.BuildStrategy.ReduceStrategy.AllReduce
+    pe = fluid.ParallelExecutor(use_cuda=False, loss_name=loss.name,
+                                main_program=prog, exec_strategy=es,
+                                build_strategy=bs)
+    data = _data(3, 16)
+    for xb, yb in data:
+        pe.run(fetch_list=[loss.name], feed={'x': xb, 'y': yb})
