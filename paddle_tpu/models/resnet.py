@@ -1,0 +1,102 @@
+"""ResNet for ImageNet/CIFAR — the BASELINE.json flagship config
+("ResNet-50 ImageNet (benchmark/fluid; ParallelExecutor allreduce)").
+
+Structural parity with reference benchmark/fluid/models/resnet.py (bottleneck
+blocks, conv→bn→relu stem, stage widths 64/128/256/512) but written directly
+against paddle_tpu.layers. NCHW layout; XLA lays out for the MXU."""
+from __future__ import annotations
+
+from .. import layers
+
+
+def conv_bn_layer(input, ch_out, filter_size, stride, padding, act='relu',
+                  is_test=False):
+    conv = layers.conv2d(input=input, num_filters=ch_out,
+                         filter_size=filter_size, stride=stride,
+                         padding=padding, act=None, bias_attr=False)
+    return layers.batch_norm(input=conv, act=act, is_test=is_test)
+
+
+def shortcut(input, ch_out, stride, is_test=False):
+    ch_in = input.shape[1]
+    if ch_in != ch_out or stride != 1:
+        return conv_bn_layer(input, ch_out, 1, stride, 0, act=None,
+                             is_test=is_test)
+    return input
+
+
+def basicblock(input, ch_out, stride, is_test=False):
+    short = shortcut(input, ch_out, stride, is_test=is_test)
+    conv1 = conv_bn_layer(input, ch_out, 3, stride, 1, is_test=is_test)
+    conv2 = conv_bn_layer(conv1, ch_out, 3, 1, 1, act=None, is_test=is_test)
+    return layers.elementwise_add(x=short, y=conv2, act='relu')
+
+
+def bottleneck(input, ch_out, stride, is_test=False):
+    short = shortcut(input, ch_out * 4, stride, is_test=is_test)
+    conv1 = conv_bn_layer(input, ch_out, 1, stride, 0, is_test=is_test)
+    conv2 = conv_bn_layer(conv1, ch_out, 3, 1, 1, is_test=is_test)
+    conv3 = conv_bn_layer(conv2, ch_out * 4, 1, 1, 0, act=None,
+                          is_test=is_test)
+    return layers.elementwise_add(x=short, y=conv3, act='relu')
+
+
+def layer_warp(block_func, input, ch_out, count, stride, is_test=False):
+    res_out = block_func(input, ch_out, stride, is_test=is_test)
+    for _ in range(1, count):
+        res_out = block_func(res_out, ch_out, 1, is_test=is_test)
+    return res_out
+
+
+_DEPTH_CFG = {
+    18: (basicblock, [2, 2, 2, 2]),
+    34: (basicblock, [3, 4, 6, 3]),
+    50: (bottleneck, [3, 4, 6, 3]),
+    101: (bottleneck, [3, 4, 23, 3]),
+    152: (bottleneck, [3, 8, 36, 3]),
+}
+
+
+def resnet_imagenet(input, class_dim=1000, depth=50, is_test=False):
+    block_func, stages = _DEPTH_CFG[depth]
+    conv = conv_bn_layer(input, ch_out=64, filter_size=7, stride=2,
+                         padding=3, is_test=is_test)
+    pool = layers.pool2d(input=conv, pool_type='max', pool_size=3,
+                         pool_stride=2, pool_padding=1)
+    res = pool
+    for i, count in enumerate(stages):
+        res = layer_warp(block_func, res, 64 * (2 ** i), count,
+                         1 if i == 0 else 2, is_test=is_test)
+    pool = layers.pool2d(input=res, pool_size=7, pool_type='avg',
+                         global_pooling=True)
+    out = layers.fc(input=pool, size=class_dim, act='softmax')
+    return out
+
+
+def resnet_cifar10(input, class_dim=10, depth=32, is_test=False):
+    assert (depth - 2) % 6 == 0
+    n = (depth - 2) // 6
+    conv1 = conv_bn_layer(input, ch_out=16, filter_size=3, stride=1,
+                          padding=1, is_test=is_test)
+    res1 = layer_warp(basicblock, conv1, 16, n, 1, is_test=is_test)
+    res2 = layer_warp(basicblock, res1, 32, n, 2, is_test=is_test)
+    res3 = layer_warp(basicblock, res2, 64, n, 2, is_test=is_test)
+    pool = layers.pool2d(input=res3, pool_size=8, pool_type='avg',
+                         global_pooling=True)
+    out = layers.fc(input=pool, size=class_dim, act='softmax')
+    return out
+
+
+def train_network(image, label, class_dim=1000, depth=50, is_test=False,
+                  variant='imagenet'):
+    """Full training graph: predictions, mean cross-entropy loss, accuracy."""
+    if variant == 'imagenet':
+        predict = resnet_imagenet(image, class_dim=class_dim, depth=depth,
+                                  is_test=is_test)
+    else:
+        predict = resnet_cifar10(image, class_dim=class_dim, depth=depth,
+                                 is_test=is_test)
+    cost = layers.cross_entropy(input=predict, label=label)
+    avg_cost = layers.mean(x=cost)
+    acc = layers.accuracy(input=predict, label=label)
+    return predict, avg_cost, acc
