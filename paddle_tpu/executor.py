@@ -307,7 +307,18 @@ class PreparedProgram(object):
             step.out_names = sorted(
                 (writes & (later_reads | fetch_set | persistable)))
             step.needs_rng = any(
-                registry._REGISTRY[op.type].stateful for op in step.ops)
+                self._op_is_stateful(op) for op in step.ops)
+
+    def _op_is_stateful(self, op):
+        """stateful (RNG-using) check, recursing into control-flow
+        sub-blocks (dropout inside an RNN step still needs the key)."""
+        if registry._REGISTRY[op.type].stateful:
+            return True
+        sub_idx = op.attr('sub_block', None) if op.attrs else None
+        if sub_idx is not None:
+            sub = self.program.blocks[sub_idx]
+            return any(self._op_is_stateful(sop) for sop in sub.ops)
+        return False
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,553 @@
+"""Control-flow layers: While, Switch, IfElse, StaticRNN, DynamicRNN,
+array read/write, comparisons (reference python/paddle/fluid/layers/
+control_flow.py:430-1967).
+
+TPU-native redesign: every construct builds a sub-block in the Program IR,
+and the corresponding op lowers to XLA structured control flow
+(ops/control_flow_ops.py). DynamicRNN operates on padded batches with a
+sequence-lengths vector instead of LoD-shrunk batches (SURVEY.md §5.7's
+planned equivalence), so its recurrence is a masked lax.scan.
+"""
+from __future__ import annotations
+
+import contextlib
+
+from ..framework import Variable, VarType, default_main_program
+from ..layer_helper import LayerHelper
+from .. import unique_name
+
+__all__ = [
+    'While', 'Switch', 'IfElse', 'StaticRNN', 'DynamicRNN',
+    'array_write', 'array_read', 'array_length', 'create_array',
+    'less_than', 'less_equal', 'greater_than', 'greater_equal', 'equal',
+    'not_equal', 'increment', 'is_empty', 'max_sequence_len', 'Print',
+]
+
+
+# ---------------------------------------------------------------------------
+# comparisons (reference layers/control_flow.py less_than :1016, equal)
+# ---------------------------------------------------------------------------
+
+def _compare(op_type):
+    def layer(x, y, cond=None, force_cpu=None, name=None):
+        from .nn import binary_bool_op
+        return binary_bool_op(op_type, x, y, out=cond, name=name)
+    layer.__name__ = op_type
+    return layer
+
+
+less_than = _compare('less_than')
+less_equal = _compare('less_equal')
+greater_than = _compare('greater_than')
+greater_equal = _compare('greater_equal')
+equal = _compare('equal')
+not_equal = _compare('not_equal')
+
+
+def increment(x, value=1.0, in_place=True):
+    from . import ops as _ops
+    return _ops.increment(x, value=value, in_place=in_place)
+
+
+def is_empty(x, cond=None):
+    helper = LayerHelper('is_empty')
+    if cond is None:
+        cond = helper.create_variable_for_type_inference(dtype='bool')
+    helper.append_op(type='is_empty', inputs={'X': [x]},
+                     outputs={'Out': [cond]})
+    return cond
+
+
+def Print(input, first_n=-1, message=None, summarize=-1, print_tensor_name=True,
+          print_tensor_type=True, print_tensor_shape=True,
+          print_tensor_lod=False, print_phase='both'):
+    helper = LayerHelper('print')
+    helper.append_op(
+        type='print', inputs={'In': [input]}, outputs={'Out': [input]},
+        attrs={'first_n': first_n, 'message': message or '',
+               'summarize': summarize})
+    return input
+
+
+# ---------------------------------------------------------------------------
+# tensor arrays (reference layers/control_flow.py:930-1064)
+# ---------------------------------------------------------------------------
+
+def create_array(dtype):
+    helper = LayerHelper('array')
+    return helper.main_program.current_block().create_var(
+        name=unique_name.generate('array'), type=VarType.LOD_TENSOR_ARRAY,
+        dtype=dtype)
+
+
+def array_write(x, i, array=None):
+    helper = LayerHelper('array_write')
+    if array is None:
+        array = create_array(x.dtype)
+    helper.append_op(type='write_to_array',
+                     inputs={'X': [x], 'I': [i]},
+                     outputs={'Out': [array]})
+    return array
+
+
+def array_read(array, i):
+    helper = LayerHelper('array_read')
+    out = helper.create_variable_for_type_inference(dtype=array.dtype)
+    helper.append_op(type='read_from_array',
+                     inputs={'X': [array], 'I': [i]},
+                     outputs={'Out': [out]})
+    return out
+
+
+def array_length(array):
+    helper = LayerHelper('array_length')
+    out = helper.create_variable_for_type_inference(dtype='int64')
+    out.shape = (1,)
+    helper.append_op(type='lod_array_length', inputs={'X': [array]},
+                     outputs={'Out': [out]})
+    return out
+
+
+def max_sequence_len(rank_table):
+    helper = LayerHelper('max_sequence_len')
+    out = helper.create_variable_for_type_inference(dtype='int64')
+    out.shape = (1,)
+    helper.append_op(type='max_sequence_len',
+                     inputs={'RankTable': [rank_table]},
+                     outputs={'Out': [out]})
+    return out
+
+
+# ---------------------------------------------------------------------------
+# block-building helper
+# ---------------------------------------------------------------------------
+
+class BlockGuard(object):
+    """Enter a fresh sub-block of the main program on __enter__ and roll
+    back on __exit__ (reference layers/control_flow.py:27)."""
+
+    def __init__(self, main_program=None):
+        self.main_program = main_program or default_main_program()
+
+    def __enter__(self):
+        self.block = self.main_program._create_block()
+        return self.block
+
+    def __exit__(self, exc_type, exc_val, exc_tb):
+        self.main_program._rollback()
+        return False
+
+
+def _external_deps(sub_block):
+    """Vars a sub-block reads but does not itself define (become the
+    control-flow op's X inputs so dataflow analysis sees them)."""
+    defined = set(sub_block.vars)
+    written = set()
+    reads = []
+    for op in sub_block.ops:
+        for n in op.input_arg_names():
+            if n not in defined and n not in written and n not in reads:
+                reads.append(n)
+        written.update(op.output_arg_names())
+    return reads
+
+
+def _sub_block_io(sub_block):
+    """(x_names, out_names) for a control-flow op wrapping sub_block.
+    Out vars (outer vars the body writes) are ALSO listed as inputs: XLA
+    cond/while need their pre-block values (false branch / initial carry),
+    so dataflow must route them into the jitted env even when they only
+    live in the scope (e.g. persistable lr vars set by the startup
+    program)."""
+    x_names = _external_deps(sub_block)
+    out_names = []
+    for op in sub_block.ops:
+        for n in op.output_arg_names():
+            if n not in sub_block.vars and n not in out_names:
+                out_names.append(n)
+    for n in out_names:
+        if n not in x_names:
+            x_names.append(n)
+    return x_names, out_names
+
+
+# ---------------------------------------------------------------------------
+# While (reference layers/control_flow.py:655)
+# ---------------------------------------------------------------------------
+
+class While(object):
+    """
+        cond = layers.less_than(i, limit)
+        while_op = layers.While(cond)
+        with while_op.block():
+            ...body ops; must re-assign cond...
+    """
+
+    def __init__(self, cond, name=None):
+        if cond.dtype != 'bool':
+            raise TypeError('While condition must be bool')
+        self.cond_var = cond
+        self.helper = LayerHelper('while', name=name)
+
+    @contextlib.contextmanager
+    def block(self):
+        program = self.helper.main_program
+        parent_block = program.current_block()
+        guard = BlockGuard(program)
+        with guard as sub_block:
+            yield
+        x_names, out_names = _sub_block_io(sub_block)
+        step_scope = parent_block.create_var(
+            name=unique_name.generate('while_scope'),
+            type=VarType.STEP_SCOPES)
+        parent_block.append_op(
+            type='while',
+            inputs={'X': x_names, 'Condition': [self.cond_var]},
+            outputs={'Out': out_names, 'StepScopes': [step_scope]},
+            attrs={'sub_block': sub_block.idx})
+
+
+# ---------------------------------------------------------------------------
+# Switch (reference layers/control_flow.py:1286) -- used by lr schedulers
+# ---------------------------------------------------------------------------
+
+class Switch(object):
+    """
+        with layers.Switch() as switch:
+            with switch.case(cond1): ...assign...
+            with switch.default(): ...assign...
+
+    Cases are made mutually exclusive (first-match-wins) by conjoining each
+    case's condition with the negation of all earlier ones, then each case
+    becomes a conditional_block (lax.cond chain on device)."""
+
+    def __init__(self, name=None):
+        self.helper = LayerHelper('switch', name=name)
+        self.pre_not_conditions = []
+        self.inside = False
+
+    @contextlib.contextmanager
+    def case(self, condition):
+        from . import tensor as tensor_layers
+        from .nn import logical_and, logical_not
+        if self.pre_not_conditions:
+            combined = self.pre_not_conditions[-1]
+            cond = logical_and(x=combined, y=condition)
+        else:
+            cond = condition
+        not_cond = logical_not(x=condition)
+        if self.pre_not_conditions:
+            not_cond = logical_and(x=self.pre_not_conditions[-1], y=not_cond)
+        self.pre_not_conditions.append(not_cond)
+
+        with _ConditionalBlock(cond).block():
+            yield
+
+    @contextlib.contextmanager
+    def default(self):
+        if not self.pre_not_conditions:
+            raise ValueError('default case must follow at least one case')
+        with _ConditionalBlock(self.pre_not_conditions[-1]).block():
+            yield
+
+    def __enter__(self):
+        self.inside = True
+        return self
+
+    def __exit__(self, exc_type, exc_val, exc_tb):
+        self.inside = False
+        return False
+
+
+class _ConditionalBlock(object):
+    """(reference layers/control_flow.py ConditionalBlock:967)"""
+
+    def __init__(self, condition, is_scalar_condition=True, name=None):
+        self.cond_vars = condition if isinstance(condition, (list, tuple)) \
+            else [condition]
+        self.is_scalar_condition = is_scalar_condition
+        self.helper = LayerHelper('conditional_block', name=name)
+
+    @contextlib.contextmanager
+    def block(self):
+        program = self.helper.main_program
+        parent_block = program.current_block()
+        guard = BlockGuard(program)
+        with guard as sub_block:
+            yield
+        x_names, out_names = _sub_block_io(sub_block)
+        scope_var = parent_block.create_var(
+            name=unique_name.generate('cond_block_scope'),
+            type=VarType.STEP_SCOPES)
+        parent_block.append_op(
+            type='conditional_block',
+            inputs={'Cond': [v for v in self.cond_vars], 'X': x_names},
+            outputs={'Out': out_names, 'Scope': [scope_var]},
+            attrs={'sub_block': sub_block.idx,
+                   'is_scalar_condition': self.is_scalar_condition})
+
+
+ConditionalBlock = _ConditionalBlock
+
+
+# ---------------------------------------------------------------------------
+# IfElse (reference layers/control_flow.py IfElse:1393)
+# TPU redesign: the reference physically partitions batch rows between the
+# two branches (dynamic shapes). Here BOTH branches compute on the full
+# batch and outputs are row-wise selected by the mask -- the standard XLA
+# formulation, identical results for elementwise row semantics.
+# ---------------------------------------------------------------------------
+
+class IfElse(object):
+    OUT_IF_ELSE_BLOCKS = 2
+
+    def __init__(self, cond, name=None):
+        self.cond = cond          # [B, 1] bool
+        self.helper = LayerHelper('ifelse', name=name)
+        self._true_outs = None
+        self._false_outs = None
+        self._in_true = False
+
+    @contextlib.contextmanager
+    def true_block(self):
+        self._in_true = True
+        yield
+        self._in_true = False
+
+    @contextlib.contextmanager
+    def false_block(self):
+        self._in_true = False
+        yield
+
+    def input(self, x):
+        return x
+
+    def output(self, *outs):
+        if self._in_true:
+            self._true_outs = list(outs)
+        else:
+            self._false_outs = list(outs)
+
+    def __call__(self):
+        if self._true_outs is None or self._false_outs is None:
+            raise ValueError('both branches must call output()')
+        from .nn import _elementwise  # noqa: F401
+        from . import tensor as tensor_layers
+        from .nn import where_select
+        results = []
+        for t, f in zip(self._true_outs, self._false_outs):
+            results.append(where_select(self.cond, t, f))
+        return results
+
+
+# ---------------------------------------------------------------------------
+# StaticRNN (reference layers/control_flow.py:430)
+# ---------------------------------------------------------------------------
+
+class StaticRNN(object):
+    """
+        rnn = layers.StaticRNN()
+        with rnn.step():
+            word = rnn.step_input(x_seq)        # x_seq: [T, B, D]
+            prev = rnn.memory(shape=[B, H]) or rnn.memory(init=h0)
+            hidden = layers.fc(input=[word, prev], size=H)
+            rnn.update_memory(prev, hidden)
+            rnn.step_output(hidden)
+        outs = rnn()                             # [T, B, H]
+    """
+
+    def __init__(self, name=None, seq_lens=None, reverse=False):
+        self.helper = LayerHelper('static_rnn', name=name)
+        self.seq_lens = seq_lens       # optional [B] int lengths -> masking
+        self.reverse = reverse
+        self.seq_inputs = []           # (outer var, in-block var)
+        self.memories = []             # dict entries
+        self.outputs = []              # in-block vars
+        self.sub_block = None
+        self._status = 'outside'
+
+    @contextlib.contextmanager
+    def step(self):
+        program = self.helper.main_program
+        self.parent_block = program.current_block()
+        guard = BlockGuard(program)
+        with guard as sub_block:
+            self.sub_block = sub_block
+            self._status = 'inside'
+            yield
+            self._status = 'after'
+        self._complete_op()
+
+    def step_input(self, x):
+        if self._status != 'inside':
+            raise RuntimeError('step_input must be called inside step()')
+        ipt = self.sub_block.create_var(
+            name=unique_name.generate('rnn_input'),
+            shape=tuple(x.shape[1:]), dtype=x.dtype)
+        self.seq_inputs.append((x, ipt))
+        return ipt
+
+    def memory(self, init=None, shape=None, value=0.0, dtype='float32',
+               batch_ref=None, ref_batch_dim_idx=0, init_batch_dim_idx=0):
+        if self._status != 'inside':
+            raise RuntimeError('memory must be called inside step()')
+        if init is None:
+            if shape is None:
+                raise ValueError('memory needs init var or shape')
+            from . import tensor as tensor_layers
+            cur = self.helper.main_program.current_block()
+            # build the init in the PARENT block
+            prog = self.helper.main_program
+            prev_idx = prog.current_block_idx
+            prog.current_block_idx = self.parent_block.idx
+            try:
+                init = tensor_layers.fill_constant(
+                    shape=list(shape), dtype=dtype, value=value)
+            finally:
+                prog.current_block_idx = prev_idx
+        pre_mem = self.sub_block.create_var(
+            name=unique_name.generate('rnn_mem'),
+            shape=tuple(init.shape), dtype=init.dtype)
+        self.memories.append({'init': init, 'pre': pre_mem, 'new': None})
+        return pre_mem
+
+    def update_memory(self, mem, var):
+        for m in self.memories:
+            if m['pre'] is mem:
+                m['new'] = var
+                return
+        raise ValueError('update_memory: unknown memory var')
+
+    def step_output(self, o):
+        if self._status != 'inside':
+            raise RuntimeError('step_output must be called inside step()')
+        self.outputs.append(o)
+
+    def output(self, *outputs):
+        for o in outputs:
+            self.step_output(o)
+
+    def _complete_op(self):
+        for m in self.memories:
+            if m['new'] is None:
+                raise ValueError('memory %s never updated' % m['pre'].name)
+        T = self.seq_inputs[0][0].shape[0] if self.seq_inputs else None
+        out_vars = []
+        for o in self.outputs:
+            ov = self.parent_block.create_var(
+                name=unique_name.generate('rnn_out'),
+                shape=(T,) + tuple(o.shape or ()), dtype=o.dtype)
+            out_vars.append(ov)
+        final_vars = []
+        for m in self.memories:
+            fv = self.parent_block.create_var(
+                name=unique_name.generate('rnn_final'),
+                shape=tuple(m['init'].shape), dtype=m['init'].dtype)
+            final_vars.append(fv)
+
+        params = _external_deps(self.sub_block)
+        # exclude in-block placeholders fed by the recurrence itself
+        feed_names = {v.name for _, v in self.seq_inputs}
+        feed_names |= {m['pre'].name for m in self.memories}
+        params = [n for n in params if n not in feed_names]
+
+        attrs = {
+            'sub_block': self.sub_block.idx,
+            'step_input_names': [v.name for _, v in self.seq_inputs],
+            'ex_states': [m['pre'].name for m in self.memories],
+            'states': [m['new'].name for m in self.memories],
+            'output_names': [o.name for o in self.outputs],
+            'reverse': self.reverse,
+            'seq_lens_name': self.seq_lens.name if self.seq_lens is not None
+            else '',
+        }
+        inputs = {
+            'inputs': [x for x, _ in self.seq_inputs],
+            'initial_states': [m['init'] for m in self.memories],
+            'parameters': params,
+        }
+        if self.seq_lens is not None:
+            inputs['parameters'] = params + [self.seq_lens.name]
+        self.parent_block.append_op(
+            type='recurrent', inputs=inputs,
+            outputs={'outputs': out_vars, 'final_states': final_vars},
+            attrs=attrs)
+        self._out_vars = out_vars
+        self._final_vars = final_vars
+
+    def __call__(self, *args, **kwargs):
+        if len(self._out_vars) == 1:
+            return self._out_vars[0]
+        return self._out_vars
+
+    def final_states(self):
+        if len(self._final_vars) == 1:
+            return self._final_vars[0]
+        return self._final_vars
+
+
+class DynamicRNN(object):
+    """Variable-length RNN over a padded batch + lengths vector
+    (reference layers/control_flow.py DynamicRNN:1133).
+
+    The reference consumes LoD-ragged batches and shrinks the batch as
+    short sequences finish (lod_rank_table + shrink_rnn_memory). The TPU
+    redesign keeps the batch FULL and masks state updates past each row's
+    length -- identical final states/outputs, static shapes (SURVEY.md §7.7).
+
+    block() iterates over time-major [T, B, ...] views of batch-major
+    [B, T, ...] inputs: step_input transposes automatically.
+    """
+
+    def __init__(self, name=None):
+        self.helper = LayerHelper('dynamic_rnn', name=name)
+        self._rnn = None
+        self._lens = None
+        self._outputs = []
+
+    @contextlib.contextmanager
+    def block(self, seq_lens=None):
+        self._rnn = StaticRNN(seq_lens=seq_lens)
+        self._lens = seq_lens
+        with self._rnn.step():
+            yield
+
+    def step_input(self, x, batch_major=True):
+        from . import nn as nn_layers
+        if batch_major:
+            # build the [B,T,...]->[T,B,...] transpose in the PARENT block;
+            # we are inside the step sub-block here
+            prog = self.helper.main_program
+            prev_idx = prog.current_block_idx
+            prog.current_block_idx = self._rnn.parent_block.idx
+            try:
+                perm = [1, 0] + list(range(2, len(x.shape)))
+                x = nn_layers.transpose(x, perm=perm)
+            finally:
+                prog.current_block_idx = prev_idx
+        return self._rnn.step_input(x)
+
+    def memory(self, **kwargs):
+        return self._rnn.memory(**kwargs)
+
+    def update_memory(self, mem, var):
+        return self._rnn.update_memory(mem, var)
+
+    def output(self, *outputs):
+        self._rnn.output(*outputs)
+
+    def __call__(self, batch_major=True):
+        from . import nn as nn_layers
+        outs = self._rnn()
+        single = not isinstance(outs, (list, tuple))
+        outs_list = [outs] if single else list(outs)
+        if batch_major:
+            res = []
+            for o in outs_list:
+                perm = [1, 0] + list(range(2, len(o.shape)))
+                res.append(nn_layers.transpose(o, perm=perm))
+            outs_list = res
+        return outs_list[0] if single else outs_list
+
+    def final_states(self):
+        return self._rnn.final_states()

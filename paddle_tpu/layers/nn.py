@@ -25,6 +25,7 @@ __all__ = [
     'log', 'l2_normalize', 'smooth_l1', 'huber_loss', 'prelu', 'lrn',
     'pad', 'label_smooth', 'flatten', 'stack', 'expand', 'squeeze',
     'unsqueeze', 'gather', 'scatter', 'slice', 'shape', 'autoincreased_step_counter',
+    'logical_and', 'logical_or', 'logical_xor', 'logical_not', 'where_select',
 ]
 
 
@@ -699,6 +700,48 @@ def shape(input):
     helper = LayerHelper('shape')
     out = helper.create_variable_for_type_inference(dtype='int64')
     helper.append_op(type='shape', inputs={'Input': [input]},
+                     outputs={'Out': [out]})
+    return out
+
+
+def binary_bool_op(op_type, x, y, out=None, name=None):
+    """Shared builder for bool-valued binary ops (comparisons + logicals)."""
+    helper = LayerHelper(op_type, name=name)
+    if out is None:
+        out = helper.create_variable_for_type_inference(dtype='bool')
+    helper.append_op(type=op_type, inputs={'X': [x], 'Y': [y]},
+                     outputs={'Out': [out]})
+    return out
+
+
+def _logical_binary(op_type):
+    def layer(x, y, out=None, name=None):
+        return binary_bool_op(op_type, x, y, out=out, name=name)
+    layer.__name__ = op_type
+    return layer
+
+
+logical_and = _logical_binary('logical_and')
+logical_or = _logical_binary('logical_or')
+logical_xor = _logical_binary('logical_xor')
+
+
+def logical_not(x, out=None, name=None):
+    helper = LayerHelper('logical_not', name=name)
+    if out is None:
+        out = helper.create_variable_for_type_inference(dtype='bool')
+    helper.append_op(type='logical_not', inputs={'X': [x]},
+                     outputs={'Out': [out]})
+    return out
+
+
+def where_select(cond, x, y, name=None):
+    """Row-wise/elementwise select: out = cond ? x : y (broadcasting cond
+    over trailing dims). Backs the TPU formulation of IfElse."""
+    helper = LayerHelper('where_select', name=name)
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    helper.append_op(type='where', inputs={'Cond': [cond], 'X': [x],
+                                           'Y': [y]},
                      outputs={'Out': [out]})
     return out
 

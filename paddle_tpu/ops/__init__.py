@@ -6,3 +6,5 @@ from . import tensor_ops    # noqa: F401
 from . import nn_ops        # noqa: F401
 from . import optimizer_ops  # noqa: F401
 from . import io_ops        # noqa: F401
+from . import control_flow_ops  # noqa: F401
+from . import array_ops    # noqa: F401

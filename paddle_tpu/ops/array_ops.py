@@ -1,0 +1,129 @@
+"""LoDTensorArray ops (reference operators/tensor_array_read_write.cc,
+lod_rank_table_op.cc, array_to_lod_tensor_op.cc, max_sequence_len_op.cc).
+
+TPU-native representation: during tracing a LOD_TENSOR_ARRAY variable's
+env value is a plain Python list of traced arrays. Tracing happens once at
+compile time, so list indices must be compile-time constants -- which they
+are for every in-tree pattern (fill_constant + increment chains stay
+concrete under jax.jit tracing because they never mix with traced feeds).
+Data-dependent indexed arrays inside loops are handled by the scan-based
+RNN layers instead (layers/control_flow.py), which is the XLA-idiomatic
+replacement for the reference's while+array DynamicRNN machinery."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..registry import register_op, op_emitter
+
+
+def _concrete_index(ctx, op, slot='I'):
+    """Constant-fold the index var over the IR (everything is a tracer under
+    jit, so the fold walks the producing ops instead of the traced value).
+    Handles the in-tree index idioms: fill_constant / increment / assign /
+    cast chains."""
+    name = op.single_input(slot)
+    upto = getattr(ctx, '_op_index', len(ctx.block.ops))
+
+    def fold(n, limit):
+        for idx in range(min(limit, len(ctx.block.ops)) - 1, -1, -1):
+            o = ctx.block.ops[idx]
+            if n not in o.output_arg_names():
+                continue
+            if o.type == 'fill_constant':
+                return int(o.attr('value'))
+            if o.type == 'increment':
+                return fold(o.single_input('X'), idx) + \
+                    int(o.attr('step', 1.0))
+            if o.type in ('assign', 'cast'):
+                return fold(o.single_input('X'), idx)
+            raise RuntimeError(
+                '%s index %r is data-dependent (produced by %r); XLA needs '
+                'compile-time-constant array indices outside scan-based '
+                'recurrences. Use StaticRNN/DynamicRNN for in-loop arrays.'
+                % (op.type, n, o.type))
+        raise RuntimeError(
+            '%s index %r has no constant producer in this block (is it a '
+            'feed?)' % (op.type, n))
+
+    return fold(name, upto)
+
+
+@op_emitter('write_to_array')
+def _array_write_emit(ctx, op):
+    x = ctx.get(op.single_input('X'))
+    i = _concrete_index(ctx, op)
+    out_name = op.single_output('Out')
+    arr = ctx.env.get(out_name)
+    arr = [] if arr is None else list(arr)
+    while len(arr) <= i:
+        arr.append(None)
+    arr[i] = x
+    ctx.set(out_name, arr)
+
+
+@op_emitter('read_from_array')
+def _array_read_emit(ctx, op):
+    arr = ctx.get(op.single_input('X'))
+    i = _concrete_index(ctx, op)
+    ctx.set(op.single_output('Out'), arr[i])
+
+
+@op_emitter('lod_array_length')
+def _array_length_emit(ctx, op):
+    arr = ctx.env.get(op.single_input('X'), [])
+    # declared int64; x64 is off so the device dtype canonicalizes to int32
+    ctx.set(op.single_output('Out'), jnp.asarray([len(arr)]))
+
+
+def _array_len_infer(op, block):
+    out = block.var_recursive(op.single_output('Out'))
+    out.shape = (1,)
+    out.dtype = 'int64'
+
+
+register_op('write_to_array', infer_shape=lambda op, block: None,
+            no_grad=True)
+register_op('read_from_array', infer_shape=lambda op, block: None,
+            no_grad=True)
+register_op('lod_array_length', infer_shape=_array_len_infer, no_grad=True)
+
+
+# ---------------------------------------------------------------------------
+# array <-> tensor: in the padded/batch-major TPU representation an "array
+# over time" is just the leading axis.
+# ---------------------------------------------------------------------------
+
+@op_emitter('array_to_lod_tensor')
+def _array_to_lod_tensor_emit(ctx, op):
+    arr = ctx.get(op.single_input('X'))
+    ctx.set(op.single_output('Out'), jnp.stack(arr, axis=0))
+
+
+@op_emitter('lod_tensor_to_array')
+def _lod_tensor_to_array_emit(ctx, op):
+    x = ctx.get(op.single_input('X'))
+    ctx.set(op.single_output('Out'), [x[t] for t in range(x.shape[0])])
+
+
+register_op('array_to_lod_tensor', infer_shape=lambda op, block: None,
+            no_grad=True)
+register_op('lod_tensor_to_array', infer_shape=lambda op, block: None,
+            no_grad=True)
+
+
+@op_emitter('max_sequence_len')
+def _max_seq_len_emit(ctx, op):
+    # input: a lengths vector [B] (the padded-batch analog of the
+    # reference's LoDRankTable); output: scalar max length
+    lens = ctx.get(op.single_input('RankTable'))
+    ctx.set(op.single_output('Out'), jnp.max(lens).reshape((1,)))
+
+
+def _max_seq_len_infer(op, block):
+    out = block.var_recursive(op.single_output('Out'))
+    out.shape = (1,)
+    out.dtype = 'int64'
+
+
+register_op('max_sequence_len', infer_shape=_max_seq_len_infer, no_grad=True)

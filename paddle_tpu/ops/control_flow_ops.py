@@ -1,0 +1,365 @@
+"""Control-flow ops: while, conditional_block, recurrent (StaticRNN engine).
+
+TPU-native re-design of the reference host-side control flow
+(operators/while_op.cc:36, conditional_block_op.cc, recurrent_op.cc:237).
+The reference runs a nested framework::Executor over a sub-block per
+iteration -- host-driven, per-op dispatch. Here each construct lowers to the
+corresponding XLA structured-control-flow primitive (lax.while_loop /
+lax.cond / lax.scan) INSIDE the enclosing jitted block, so loop bodies stay
+on-device, get fused, and never bounce to the host.
+
+Constraints this imposes (XLA semantics): loop-carried values must have
+fixed shape/dtype, and every variable a loop body mutates must be
+initialized before the loop (the reference implicitly requires the same for
+while loops via its scope rules).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..registry import register_op, op_emitter
+from ..framework import grad_var_name
+
+
+def _sub_block(ctx, op):
+    return ctx.block.program.blocks[op.attr('sub_block')]
+
+
+def _run_sub_block(env, sub_block, rng_key, is_test, base_index,
+                   iter_index=None):
+    """Trace every op of a sub-block against `env` (a plain dict).
+    iter_index: traced loop counter; folded into the RNG key so stateful
+    ops (dropout...) draw fresh randomness every iteration."""
+    from ..executor import EmitContext
+    from .. import registry
+    if rng_key is not None and iter_index is not None:
+        rng_key = jax.random.fold_in(rng_key, iter_index)
+    sub_ctx = EmitContext(env, sub_block, rng_key, is_test)
+    for i, sop in enumerate(sub_block.ops):
+        sub_ctx._op_index = base_index * 1009 + i
+        opdef = registry._REGISTRY.get(sop.type)
+        if opdef is None or opdef.emit is None:
+            raise KeyError('op %r inside control-flow sub-block has no '
+                           'emitter' % sop.type)
+        if opdef.host:
+            raise RuntimeError(
+                'host op %r cannot run inside a device control-flow body'
+                % sop.type)
+        opdef.emit(sub_ctx, sop)
+    return env
+
+
+# ---------------------------------------------------------------------------
+# while  (reference operators/while_op.cc:36)
+# inputs:  X = external vars the body reads, Condition = bool scalar var
+# outputs: Out = vars the body writes that live on after the loop
+# attr:    sub_block
+# ---------------------------------------------------------------------------
+
+@op_emitter('while')
+def _while_emit(ctx, op):
+    sub_block = _sub_block(ctx, op)
+    cond_name = op.single_input('Condition')
+
+    body_writes = []
+    for sop in sub_block.ops:
+        for n in sop.output_arg_names():
+            if n not in body_writes:
+                body_writes.append(n)
+
+    # loop state: the condition + every body-written var that (a) already
+    # has a value (initialized before the loop) and (b) is listed in Out or
+    # re-read by the body. Body-local temporaries are re-created each
+    # iteration by tracing and are NOT carried.
+    out_set = set(op.output('Out'))
+    body_reads = set()
+    for sop in sub_block.ops:
+        body_reads.update(sop.input_arg_names())
+    carried = [cond_name]
+    for n in body_writes:
+        if n == cond_name:
+            continue
+        if (n in out_set or n in body_reads) and n in ctx.env:
+            carried.append(n)
+    for n in out_set:
+        if n not in ctx.env and n not in body_writes:
+            raise RuntimeError(
+                'while-loop var %r must be initialized before the loop '
+                '(XLA loop carries need a fixed initial value)' % n)
+
+    ext_env = dict(ctx.env)
+    carried_set = set(carried)
+
+    def cond_fn(carry):
+        return jnp.reshape(carry[0][0].astype(jnp.bool_), ())
+
+    def body_fn(carry):
+        it, vals = carry[1], carry[0]
+        env = dict(ext_env)
+        env.update(zip(carried, vals))
+        _run_sub_block(env, sub_block, ctx.rng_key, ctx.is_test,
+                       ctx._op_index, iter_index=it)
+        return (tuple(env[n] for n in carried), it + 1)
+
+    init = (tuple(ctx.env[n] for n in carried), jnp.zeros((), jnp.int32))
+    final, _ = jax.lax.while_loop(cond_fn, body_fn, init)
+    for n, v in zip(carried, final):
+        ctx.set(n, v)
+    # Out vars that are body-temporaries with no initial value cannot be
+    # returned from an XLA loop; expose their last-iteration value is
+    # impossible without carrying -- require carry membership.
+    for n in out_set - carried_set - {cond_name}:
+        if n not in ctx.env:
+            raise RuntimeError(
+                'while Out var %r was never initialized before the loop' % n)
+
+
+def _while_infer(op, block):
+    pass  # outputs alias pre-existing vars; shapes already known
+
+
+register_op('while', infer_shape=_while_infer, no_grad=True)
+
+
+# ---------------------------------------------------------------------------
+# conditional_block  (reference conditional_block_op.cc)
+# inputs: Cond (bool), X (external reads); outputs: Out; attr: sub_block,
+# is_scalar_condition. Lowered to lax.cond; the false branch passes the
+# pre-block values of Out through unchanged, so every Out var must be
+# initialized before the block (the masked-select redesign of the
+# reference's "skip the block entirely" host semantics).
+# ---------------------------------------------------------------------------
+
+@op_emitter('conditional_block')
+def _cond_block_emit(ctx, op):
+    sub_block = _sub_block(ctx, op)
+    cond_names = op.input('Cond')
+    cond = ctx.get(cond_names[0])
+    for extra in cond_names[1:]:
+        cond = jnp.logical_and(jnp.all(cond), jnp.all(ctx.get(extra)))
+    cond = jnp.reshape(jnp.all(cond), ())
+
+    out_names = [n for n in op.output('Out')]
+    for n in out_names:
+        if n not in ctx.env:
+            raise RuntimeError(
+                'conditional_block output %r must be initialized before the '
+                'block (XLA cond branches must return the same structure)'
+                % n)
+
+    ext_env = dict(ctx.env)
+    op_index = ctx._op_index
+
+    def true_fn(out_vals):
+        env = dict(ext_env)
+        env.update(zip(out_names, out_vals))
+        _run_sub_block(env, sub_block, ctx.rng_key, ctx.is_test, op_index)
+        return tuple(env[n] for n in out_names)
+
+    def false_fn(out_vals):
+        return tuple(out_vals)
+
+    init = tuple(ctx.env[n] for n in out_names)
+    result = jax.lax.cond(cond, true_fn, false_fn, init)
+    for n, v in zip(out_names, result):
+        ctx.set(n, v)
+
+
+register_op('conditional_block', infer_shape=lambda op, block: None,
+            no_grad=True)
+
+
+# ---------------------------------------------------------------------------
+# recurrent  (reference recurrent_op.cc:237 -- the StaticRNN engine)
+#
+# inputs:
+#   inputs          step inputs, each [T, ...]; sliced along dim 0 per step
+#   initial_states  initial memory values (one per state)
+#   parameters      external vars read by the step block (weights etc.)
+# outputs:
+#   outputs         stacked step outputs, each [T, ...]
+#   final_states    last value of each state
+# attrs: sub_block, states (in-block state var names), ex_states (in-block
+#   pre-state var names), step_input_names / output_names (in-block names),
+#   seq_lens_name ('' or an [B] int array var for masked/dynamic semantics)
+#
+# Lowered to lax.scan -- the recurrence is compiled, unrolled-free, and
+# differentiable (grad registered via jax.vjp over the scan).
+# ---------------------------------------------------------------------------
+
+def _recurrent_fwd(ctx, op):
+    sub_block = _sub_block(ctx, op)
+    step_input_names = op.attr('step_input_names')   # in-block names
+    ex_state_names = op.attr('ex_states')            # read by block
+    state_names = op.attr('states')                  # written by block
+    step_output_names = op.attr('output_names')
+    reverse = bool(op.attr('reverse', False))
+
+    seq_inputs = [ctx.get(n) for n in op.input('inputs')]
+    init_states = [ctx.get(n) for n in op.input('initial_states')]
+    param_env = {n: ctx.get(n) for n in op.input('parameters')}
+
+    seq_lens = None
+    if op.attr('seq_lens_name', ''):
+        seq_lens = ctx.get(op.attr('seq_lens_name'))
+
+    T = seq_inputs[0].shape[0] if seq_inputs else op.attr('max_len')
+    rng_key = ctx.rng_key
+    is_test = ctx.is_test
+    op_index = ctx._op_index
+
+    def step(carry, xs):
+        states, t = carry
+        env = dict(param_env)
+        for name, val in zip(ex_state_names, states):
+            env[name] = val
+        for name, val in zip(step_input_names, xs):
+            env[name] = val
+        _run_sub_block(env, sub_block, rng_key, is_test, op_index,
+                       iter_index=t)
+        new_states = [env[n] for n in state_names]
+        if seq_lens is not None:
+            # masked recurrence: rows whose sequence already ended keep
+            # their previous state (the redesign of the reference's
+            # shrink_rnn_memory batch-shrinking)
+            active = (t < seq_lens)
+            masked = []
+            for old, new in zip(states, new_states):
+                m = active.reshape((-1,) + (1,) * (new.ndim - 1))
+                masked.append(jnp.where(m, new, old))
+            new_states = masked
+        outs = tuple(env[n] for n in step_output_names)
+        return (tuple(new_states), t + 1), outs
+
+    xs = tuple(seq_inputs)
+    if reverse:
+        xs = tuple(jnp.flip(x, axis=0) for x in xs)
+    (final_states, _), stacked = jax.lax.scan(
+        step, (tuple(init_states), jnp.zeros((), jnp.int32)), xs, length=T)
+    if reverse:
+        stacked = tuple(jnp.flip(s, axis=0) for s in stacked)
+    return stacked, final_states
+
+
+@op_emitter('recurrent')
+def _recurrent_emit(ctx, op):
+    stacked, final_states = _recurrent_fwd(ctx, op)
+    for n, v in zip(op.output('outputs'), stacked):
+        ctx.set(n, v)
+    for n, v in zip(op.output('final_states'), final_states):
+        ctx.set(n, v)
+
+
+def _recurrent_infer(op, block):
+    pass  # output shapes ([T] + step shape) are set by the RNN layer builder
+
+
+def _recurrent_grad_maker(op, block):
+    """Differentiate through the scan with jax.vjp (reference: hand-built
+    RecurrentGradOp, recurrent_op.cc:237)."""
+    inputs = {
+        'inputs': list(op.input('inputs')),
+        'initial_states': list(op.input('initial_states')),
+        'parameters': list(op.input('parameters')),
+    }
+    for n in op.output('outputs'):
+        inputs.setdefault('outputs@GRAD', []).append(grad_var_name(n))
+    # final-state cotangents too: models that train on the last hidden
+    # state (encoder-final patterns) must backprop through it
+    for n in op.output('final_states'):
+        inputs.setdefault('final_states@GRAD', []).append(grad_var_name(n))
+    outputs = {}
+    seen = set()
+
+    def grads_for(slot):
+        names = []
+        for n in op.input(slot):
+            if n in seen:
+                names.append('')
+            else:
+                seen.add(n)
+                names.append(grad_var_name(n))
+        return names
+
+    outputs['inputs@GRAD'] = grads_for('inputs')
+    outputs['initial_states@GRAD'] = grads_for('initial_states')
+    outputs['parameters@GRAD'] = grads_for('parameters')
+    return [dict(type='recurrent_grad', inputs=inputs, outputs=outputs,
+                 attrs=dict(op.attrs))]
+
+
+@op_emitter('recurrent_grad')
+def _recurrent_grad_emit(ctx, op):
+    from ..framework import Operator
+    fwd_op = Operator.__new__(Operator)
+    fwd_op.block = op.block
+    fwd_op.type = 'recurrent'
+    fwd_op.inputs = {'inputs': list(op.input('inputs')),
+                     'initial_states': list(op.input('initial_states')),
+                     'parameters': list(op.input('parameters'))}
+    fwd_op.outputs = {}
+    fwd_op.attrs = dict(op.attrs)
+
+    diff_names = []
+    for slot in ('inputs', 'initial_states', 'parameters'):
+        for n in op.input(slot):
+            if n not in diff_names:
+                diff_names.append(n)
+
+    def f(*xs):
+        env_vals = dict(zip(diff_names, xs))
+
+        class _Ctx(object):
+            env = env_vals
+            block = ctx.block
+            rng_key = ctx.rng_key
+            is_test = ctx.is_test
+            _op_index = ctx._op_index
+
+            def get(self, name):
+                return env_vals[name]
+
+            def set(self, name, value):
+                env_vals[name] = value
+
+        stacked, finals = _recurrent_fwd(_Ctx(), fwd_op)
+        return tuple(stacked) + tuple(finals)
+
+    primals = tuple(ctx.get(n) for n in diff_names)
+    _, vjp_fn = jax.vjp(f, *primals)
+    cots = tuple(ctx.get(g) for g in op.input('outputs@GRAD')) + \
+        tuple(ctx.get(g) for g in op.input('final_states@GRAD'))
+    grads = dict(zip(diff_names, vjp_fn(cots)))
+    for slot in ('inputs', 'initial_states', 'parameters'):
+        for fwd_n, g_n in zip(op.input(slot), op.output(slot + '@GRAD')):
+            if not g_n:
+                continue
+            g = grads[fwd_n]
+            if g.dtype == jax.dtypes.float0:  # int inputs (e.g. seq lens)
+                continue
+            ctx.set(g_n, g)
+
+
+register_op('recurrent', grad=_recurrent_grad_maker,
+            infer_shape=_recurrent_infer)
+register_op('recurrent_grad')
+
+
+# ---------------------------------------------------------------------------
+# is_empty (reference operators/is_empty_op.cc)
+# ---------------------------------------------------------------------------
+
+@op_emitter('is_empty')
+def _is_empty_emit(ctx, op):
+    x = ctx.get(op.single_input('X'))
+    ctx.set(op.single_output('Out'), jnp.asarray(x.size == 0))
+
+
+def _is_empty_infer(op, block):
+    out = block.var_recursive(op.single_output('Out'))
+    out.shape = ()
+    out.dtype = 'bool'
+
+
+register_op('is_empty', infer_shape=_is_empty_infer, no_grad=True)

@@ -480,7 +480,8 @@ register_vjp_grad('cumsum')
 @op_emitter('increment')
 def _increment_emit(ctx, op):
     x = ctx.get(op.single_input('X'))
-    ctx.set(op.single_output('Out'), x + op.attr('step', 1.0))
+    step = jnp.asarray(op.attr('step', 1.0)).astype(x.dtype)
+    ctx.set(op.single_output('Out'), x + step)
 
 
 register_op('increment', infer_shape=same_shape_infer(), no_grad=True)
@@ -498,3 +499,36 @@ def _squared_l2_norm_emit(ctx, op):
 
 register_op('squared_l2_norm', infer_shape=_scalar_infer)
 register_vjp_grad('squared_l2_norm')
+
+
+# ---------------------------------------------------------------------------
+# where: elementwise/row-wise select (backs layers.where_select / IfElse)
+# ---------------------------------------------------------------------------
+
+def _where_emit(ctx, op):
+    cond = ctx.get(op.single_input('Cond'))
+    x = ctx.get(op.single_input('X'))
+    y = ctx.get(op.single_input('Y'))
+    # align cond's rank to x's: drop size-1 trailing axes (e.g. [B,1] cond
+    # vs [B] operands), then pad with size-1 trailing axes for row-wise
+    # broadcast -- result shape must equal x's
+    while cond.ndim > x.ndim and cond.shape[-1] == 1:
+        cond = cond.reshape(cond.shape[:-1])
+    if cond.ndim > x.ndim:
+        raise ValueError(
+            'where: cond rank %d not broadcastable to operand rank %d'
+            % (cond.ndim, x.ndim))
+    if cond.ndim < x.ndim:
+        cond = cond.reshape(cond.shape + (1,) * (x.ndim - cond.ndim))
+    ctx.set(op.single_output('Out'), jnp.where(cond, x, y))
+
+
+def _where_infer(op, block):
+    x = block.var_recursive(op.single_input('X'))
+    out = block.var_recursive(op.single_output('Out'))
+    out.shape = x.shape
+    out.dtype = x.dtype
+
+
+register_op('where', emit=_where_emit, infer_shape=_where_infer)
+register_vjp_grad('where', in_slots=('X', 'Y'), nondiff_slots=('Cond',))

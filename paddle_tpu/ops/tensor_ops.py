@@ -25,7 +25,10 @@ def _fill_constant_emit(ctx, op):
     shape = op.attr('shape', [])
     dtype = op.attr('dtype', 'float32')
     value = op.attr('value', 0.0)
-    ctx.set(op.single_output('Out'), jnp.full(shape, value, dtype=dtype))
+    # canonicalize declared dtype to the device dtype (x64 off: int64->int32)
+    # up front, avoiding per-trace truncation warnings
+    dev_dtype = jax.dtypes.canonicalize_dtype(np.dtype(dtype))
+    ctx.set(op.single_output('Out'), jnp.full(shape, value, dtype=dev_dtype))
 
 
 def _fill_constant_infer(op, block):

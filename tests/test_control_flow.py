@@ -1,0 +1,293 @@
+"""Control-flow: While -> lax.while_loop, Switch/conditional_block ->
+lax.cond, StaticRNN/DynamicRNN -> lax.scan, tensor arrays
+(re-design of reference test_while_op.py, test_switch.py,
+test_recurrent_op.py, test_dyn_rnn.py, test_array_read_write.py)."""
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.framework import Program, program_guard
+
+
+def _run(prog, feed, fetch):
+    exe = fluid.Executor(fluid.CPUPlace())
+    return exe.run(prog, feed=feed, fetch_list=fetch)
+
+
+def test_while_counts_to_ten():
+    prog, startup = Program(), Program()
+    with program_guard(prog, startup):
+        i = layers.fill_constant(shape=[1], dtype='int64', value=0)
+        limit = layers.fill_constant(shape=[1], dtype='int64', value=10)
+        total = layers.fill_constant(shape=[1], dtype='float32', value=0.0)
+        cond = layers.less_than(x=i, y=limit)
+        while_op = layers.While(cond=cond)
+        with while_op.block():
+            t = layers.cast(i, 'float32')
+            new_total = layers.elementwise_add(total, t)
+            layers.assign(new_total, output=total)
+            layers.increment(x=i, value=1, in_place=True)
+            layers.less_than(x=i, y=limit, cond=cond)
+    r, = _run(prog, {}, [total])
+    assert r[0] == sum(range(10))
+
+
+def test_while_with_accumulating_feed():
+    prog, startup = Program(), Program()
+    with program_guard(prog, startup):
+        x = fluid.layers.data(name='x', shape=[4], dtype='float32')
+        i = layers.fill_constant(shape=[1], dtype='int64', value=0)
+        n = layers.fill_constant(shape=[1], dtype='int64', value=3)
+        acc = layers.fill_constant(shape=[1, 4], dtype='float32', value=0.0)
+        cond = layers.less_than(x=i, y=n)
+        while_op = layers.While(cond=cond)
+        with while_op.block():
+            doubled = layers.elementwise_add(acc, x)
+            layers.assign(doubled, output=acc)
+            layers.increment(x=i, value=1, in_place=True)
+            layers.less_than(x=i, y=n, cond=cond)
+    xv = np.array([[1., 2., 3., 4.]], dtype='float32')
+    r, = _run(prog, {'x': xv}, [acc])
+    np.testing.assert_allclose(r, xv * 3)
+
+
+def test_switch_piecewise():
+    prog, startup = Program(), Program()
+    with program_guard(prog, startup):
+        step = fluid.layers.data(name='step', shape=[1], dtype='float32')
+        lr = layers.fill_constant(shape=[1], dtype='float32', value=0.0)
+        b1 = layers.fill_constant(shape=[1], dtype='float32', value=10.0)
+        b2 = layers.fill_constant(shape=[1], dtype='float32', value=20.0)
+        with layers.Switch() as switch:
+            with switch.case(layers.less_than(step, b1)):
+                v = layers.fill_constant(shape=[1], dtype='float32', value=1.0)
+                layers.assign(v, output=lr)
+            with switch.case(layers.less_than(step, b2)):
+                v = layers.fill_constant(shape=[1], dtype='float32', value=0.5)
+                layers.assign(v, output=lr)
+            with switch.default():
+                v = layers.fill_constant(shape=[1], dtype='float32', value=0.1)
+                layers.assign(v, output=lr)
+    for step_val, want in [(5.0, 1.0), (15.0, 0.5), (25.0, 0.1)]:
+        r, = _run(prog, {'step': np.array([step_val], 'float32')}, [lr])
+        assert r[0] == np.float32(want), (step_val, r)
+
+
+def test_ifelse_rowwise_select():
+    prog, startup = Program(), Program()
+    with program_guard(prog, startup):
+        x = fluid.layers.data(name='x', shape=[1], dtype='float32')
+        zero = layers.fill_constant(shape=[1], dtype='float32', value=0.0)
+        cond = layers.greater_than(x, zero)
+        ie = layers.IfElse(cond)
+        with ie.true_block():
+            ie.output(layers.scale(x, scale=2.0))
+        with ie.false_block():
+            ie.output(layers.scale(x, scale=-1.0))
+        out, = ie()
+    xv = np.array([[1.], [-2.], [3.], [-4.]], dtype='float32')
+    r, = _run(prog, {'x': xv}, [out])
+    np.testing.assert_allclose(r, np.where(xv > 0, xv * 2, -xv))
+
+
+def test_array_write_read():
+    prog, startup = Program(), Program()
+    with program_guard(prog, startup):
+        x = fluid.layers.data(name='x', shape=[3], dtype='float32')
+        i = layers.fill_constant(shape=[1], dtype='int64', value=0)
+        arr = layers.array_write(x, i)
+        i2 = layers.fill_constant(shape=[1], dtype='int64', value=1)
+        layers.array_write(layers.scale(x, scale=2.0), i2, array=arr)
+        length = layers.array_length(arr)
+        second = layers.array_read(arr, i2)
+        stacked_var = prog.current_block().create_var(
+            name='stacked', dtype='float32')
+        prog.current_block().append_op(
+            type='array_to_lod_tensor', inputs={'X': [arr]},
+            outputs={'Out': [stacked_var]})
+    xv = np.ones((2, 3), dtype='float32')
+    ln, sec, stk = _run(prog, {'x': xv}, [length, second, 'stacked'])
+    assert ln[0] == 2
+    np.testing.assert_allclose(sec, xv * 2)
+    assert stk.shape == (2, 2, 3)
+
+
+def test_static_rnn_cumsum():
+    prog, startup = Program(), Program()
+    with program_guard(prog, startup):
+        x = fluid.layers.data(name='x', shape=[4, 2, 3], dtype='float32',
+                              append_batch_size=False)
+        rnn = layers.StaticRNN()
+        with rnn.step():
+            xt = rnn.step_input(x)
+            prev = rnn.memory(shape=[2, 3], value=0.0)
+            acc = layers.elementwise_add(xt, prev)
+            rnn.update_memory(prev, acc)
+            rnn.step_output(acc)
+        out = rnn()
+    xv = np.random.RandomState(0).rand(4, 2, 3).astype('float32')
+    r, = _run(prog, {'x': xv}, [out])
+    np.testing.assert_allclose(r, np.cumsum(xv, axis=0), rtol=1e-5)
+
+
+def test_static_rnn_fc_trains():
+    """Gradients flow through the scan: a tiny RNN regression must learn."""
+    prog, startup = Program(), Program()
+    with program_guard(prog, startup):
+        x = fluid.layers.data(name='x', shape=[5, 8, 4], dtype='float32',
+                              append_batch_size=False)
+        y = fluid.layers.data(name='y', shape=[8, 1], dtype='float32',
+                              append_batch_size=False)
+        rnn = layers.StaticRNN()
+        with rnn.step():
+            xt = rnn.step_input(x)
+            prev = rnn.memory(shape=[8, 6], value=0.0)
+            h = layers.fc(input=[xt, prev], size=6, act='tanh')
+            rnn.update_memory(prev, h)
+            rnn.step_output(h)
+        outs = rnn()
+        last = layers.slice(outs, axes=[0], starts=[4], ends=[5])
+        last = layers.reshape(layers.squeeze(last, axes=[0]), shape=[8, 6])
+        pred = layers.fc(input=last, size=1)
+        loss = layers.mean(layers.square_error_cost(pred, y))
+        fluid.optimizer.Adam(learning_rate=0.05).minimize(loss)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    rng = np.random.RandomState(1)
+    xv = rng.rand(5, 8, 4).astype('float32')
+    yv = xv.sum(axis=(0, 2), keepdims=False).reshape(8, 1).astype('float32')
+    first = None
+    for _ in range(80):
+        l, = exe.run(prog, feed={'x': xv, 'y': yv}, fetch_list=[loss])
+        if first is None:
+            first = float(l)
+    assert float(l) < 0.1 * first, (first, float(l))
+
+
+def test_static_rnn_seq_lens_masking():
+    """Rows past their length keep their state (shrink_rnn_memory analog)."""
+    prog, startup = Program(), Program()
+    with program_guard(prog, startup):
+        x = fluid.layers.data(name='x', shape=[4, 3, 2], dtype='float32',
+                              append_batch_size=False)
+        lens = fluid.layers.data(name='lens', shape=[3], dtype='int32',
+                                 append_batch_size=False)
+        rnn = layers.StaticRNN(seq_lens=lens)
+        with rnn.step():
+            xt = rnn.step_input(x)
+            prev = rnn.memory(shape=[3, 2], value=0.0)
+            acc = layers.elementwise_add(xt, prev)
+            rnn.update_memory(prev, acc)
+            rnn.step_output(acc)
+        rnn()
+        final = rnn.final_states()
+    xv = np.ones((4, 3, 2), dtype='float32')
+    lv = np.array([4, 2, 1], dtype='int32')
+    r, = _run(prog, {'x': xv, 'lens': lv}, [final])
+    np.testing.assert_allclose(r[:, 0], [4., 2., 1.])
+
+
+def test_dynamic_rnn_batch_major():
+    prog, startup = Program(), Program()
+    with program_guard(prog, startup):
+        x = fluid.layers.data(name='x', shape=[3, 4, 2], dtype='float32',
+                              append_batch_size=False)  # [B=3, T=4, D=2]
+        lens = fluid.layers.data(name='lens', shape=[3], dtype='int32',
+                                 append_batch_size=False)
+        drnn = layers.DynamicRNN()
+        with drnn.block(seq_lens=lens):
+            xt = drnn.step_input(x)
+            prev = drnn.memory(shape=[3, 2], value=0.0)
+            acc = layers.elementwise_add(xt, prev)
+            drnn.update_memory(prev, acc)
+            drnn.output(acc)
+        out = drnn()
+        final = drnn.final_states()
+    xv = np.ones((3, 4, 2), dtype='float32')
+    lv = np.array([4, 2, 3], dtype='int32')
+    out_v, fin_v = _run(prog, {'x': xv, 'lens': lv}, [out, final])
+    assert out_v.shape == (3, 4, 2)
+    np.testing.assert_allclose(fin_v[:, 0], [4., 2., 3.])
+
+
+def test_final_states_gradient_flows():
+    """Training on the RNN's FINAL state must update step-block params
+    (regression: final_states cotangent was dropped)."""
+    prog, startup = Program(), Program()
+    with program_guard(prog, startup):
+        x = fluid.layers.data(name='x', shape=[5, 4, 3], dtype='float32',
+                              append_batch_size=False)
+        rnn = layers.StaticRNN()
+        with rnn.step():
+            xt = rnn.step_input(x)
+            prev = rnn.memory(shape=[4, 6], value=0.0)
+            h = layers.fc(input=[xt, prev], size=6, act='tanh')
+            rnn.update_memory(prev, h)
+            rnn.step_output(h)
+        rnn()
+        final = rnn.final_states()
+        loss = layers.mean(final)
+        params = [p.name for p in prog.global_block().all_parameters()]
+        fluid.optimizer.SGD(0.1).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    xv = np.random.RandomState(0).rand(5, 4, 3).astype('float32')
+    before = {p: np.array(fluid.fetch_var(p)) for p in params}
+    exe.run(prog, feed={'x': xv}, fetch_list=[loss])
+    after = {p: np.array(fluid.fetch_var(p)) for p in params}
+    changed = [p for p in params
+               if not np.allclose(before[p], after[p])]
+    assert changed, 'no parameter moved: final_states grad is zero'
+
+
+def test_dropout_varies_per_rnn_step():
+    """Dropout inside a scan step must draw fresh randomness per timestep
+    (regression: fixed all-zero key reused every iteration)."""
+    prog, startup = Program(), Program()
+    prog.random_seed = 7
+    with program_guard(prog, startup):
+        x = fluid.layers.data(name='x', shape=[6, 2, 50], dtype='float32',
+                              append_batch_size=False)
+        rnn = layers.StaticRNN()
+        with rnn.step():
+            xt = rnn.step_input(x)
+            prev = rnn.memory(shape=[2, 50], value=0.0)
+            d = layers.dropout(xt, dropout_prob=0.5)
+            acc = layers.elementwise_add(d, prev)
+            rnn.update_memory(prev, acc)
+            rnn.step_output(d)
+        out = rnn()
+    exe = fluid.Executor(fluid.CPUPlace())
+    xv = np.ones((6, 2, 50), dtype='float32')
+    r, = exe.run(prog, feed={'x': xv}, fetch_list=[out])
+    masks = (r != 0)
+    distinct = {masks[t].tobytes() for t in range(6)}
+    assert len(distinct) > 1, 'dropout mask identical across timesteps'
+
+
+def test_switch_assigns_persistable_scope_var():
+    """Switch writing an lr var that lives only in the scope (startup-
+    initialized) -- the scheduler pattern (regression: spurious
+    'must be initialized' error)."""
+    prog, startup = Program(), Program()
+    with program_guard(prog, startup):
+        lr = layers.create_global_var(shape=[1], value=0.0, dtype='float32',
+                                      persistable=True, name='lr_var')
+        step = fluid.layers.data(name='step', shape=[1], dtype='float32')
+        b1 = layers.fill_constant(shape=[1], dtype='float32', value=10.0)
+        with layers.Switch() as switch:
+            with switch.case(layers.less_than(step, b1)):
+                layers.assign(layers.fill_constant(
+                    shape=[1], dtype='float32', value=1.0), output=lr)
+            with switch.default():
+                layers.assign(layers.fill_constant(
+                    shape=[1], dtype='float32', value=0.1), output=lr)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    r, = exe.run(prog, feed={'step': np.array([5.], 'float32')},
+                 fetch_list=[lr])
+    assert r[0] == np.float32(1.0)
+    r, = exe.run(prog, feed={'step': np.array([50.], 'float32')},
+                 fetch_list=[lr])
+    assert r[0] == np.float32(0.1)
