@@ -150,14 +150,71 @@ def fetch_var(name, scope=None, return_numpy=True):
     return np.asarray(val) if return_numpy else val
 
 
+def pad_lod_to_batch(flat, lod_level0_offsets):
+    """Flat LoD rows [N, ...] + level-0 offsets -> (padded [B, T, ...],
+    lengths [B] int32). The padded-batch lowering of the reference's
+    no-padding LoD batching (lod_tensor.h:58); masks/lengths carry the
+    raggedness instead of ragged shapes (XLA needs static shapes)."""
+    offs = list(lod_level0_offsets)
+    lens = np.diff(offs).astype('int32')
+    B, T = len(lens), (int(lens.max()) if len(lens) else 0)
+    padded = np.zeros((B, max(T, 1)) + flat.shape[1:], dtype=flat.dtype)
+    for b in range(B):
+        padded[b, :lens[b]] = flat[offs[b]:offs[b + 1]]
+    return padded, lens
+
+
+def _expand_sequence_feeds(program, feed):
+    """Expand LoD feeds into the padded + '@SEQ_LEN' companion pair."""
+    from .lod_tensor import LoDTensor
+    out = {}
+    for name, value in feed.items():
+        var = program.global_block().vars.get(name)
+        if var is None or var.lod_level == 0:
+            out[name] = value
+            continue
+        lens_name = name + '@SEQ_LEN'
+        if isinstance(value, LoDTensor) and value.lod():
+            lod = value.lod()
+            if len(lod) != 1:
+                raise NotImplementedError(
+                    'only lod_level=1 feeds are supported on TPU '
+                    '(got %d levels for %r)' % (len(lod), name))
+            padded, lens = pad_lod_to_batch(value.numpy(), lod[0])
+            out[name] = padded
+            out.setdefault(lens_name, lens)
+        elif isinstance(value, tuple) and len(value) == 2:
+            padded, lens = value
+            out[name] = np.asarray(padded)
+            out.setdefault(lens_name, np.asarray(lens, dtype='int32'))
+        else:
+            arr = np.asarray(value)
+            declared = len(var.shape or ())
+            if arr.ndim != declared + 1:
+                raise ValueError(
+                    'feed %r is a lod_level=%d var: feed a LoDTensor, a '
+                    '(padded, lengths) tuple, or a padded array of rank %d '
+                    '(got rank %d)' % (name, var.lod_level, declared + 1,
+                                       arr.ndim))
+            out[name] = arr
+            out.setdefault(lens_name,
+                           np.full((arr.shape[0],), arr.shape[1], 'int32'))
+    return out
+
+
 # ---------------------------------------------------------------------------
 # Emit contexts
 # ---------------------------------------------------------------------------
 
 class EmitContext(object):
-    """Traced-value environment handed to op emitters during lowering."""
+    """Traced-value environment handed to op emitters during lowering.
 
-    __slots__ = ('env', 'block', 'rng_key', 'is_test', '_op_index')
+    _op_index: globally-unique index for RNG folding (synthetic inside
+    sub-blocks). _block_pos: the op's position within ctx.block.ops (used
+    for IR-level constant folding, e.g. tensor-array indices)."""
+
+    __slots__ = ('env', 'block', 'rng_key', 'is_test', '_op_index',
+                 '_block_pos')
 
     def __init__(self, env, block, rng_key, is_test):
         self.env = env
@@ -165,6 +222,7 @@ class EmitContext(object):
         self.rng_key = rng_key
         self.is_test = is_test
         self._op_index = 0
+        self._block_pos = 0
 
     def get(self, name):
         try:
@@ -358,6 +416,7 @@ class Executor(object):
                        for v in fetch_list]
 
         feed_arrays = {}
+        feed = _expand_sequence_feeds(program, feed)
         for name, value in feed.items():
             from .lod_tensor import LoDTensor
             if isinstance(value, LoDTensor):
@@ -487,6 +546,7 @@ class Executor(object):
             ctx = EmitContext(env, block, rng_key, is_test)
             for op, off in zip(ops, offsets):
                 ctx._op_index = off
+                ctx._block_pos = off
                 registry._REGISTRY[op.type].emit(ctx, op)
             return tuple(env[n] for n in out_names)
 

@@ -99,6 +99,10 @@ class Variable(object):
         self.type = type
         self.is_data = is_data
         self.error_clip = kwargs.get('error_clip', None)
+        # padded-sequence companion: the Variable holding this var's [B]
+        # int32 sequence lengths (set for lod_level>0 vars; layers
+        # propagate it through sequence-preserving ops)
+        self.seq_lens = None
 
     # -- introspection -----------------------------------------------------
     @property

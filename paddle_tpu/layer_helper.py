@@ -33,7 +33,35 @@ class LayerHelper(object):
         return default_startup_program()
 
     def append_op(self, *args, **kwargs):
-        return self.main_program.current_block().append_op(*args, **kwargs)
+        block = self.main_program.current_block()
+        op = block.append_op(*args, **kwargs)
+        self._propagate_seq_lens(block, op)
+        return op
+
+    @staticmethod
+    def _propagate_seq_lens(block, op):
+        """Default sequence-length propagation: if an input var carries a
+        padded-sequence lengths companion, attach it to output vars too
+        (elementwise/activation/etc. are sequence-transparent). Layers
+        that REDUCE the sequence axis (sequence_pool) clear it explicitly."""
+        lens = None
+        for n in op.input_arg_names():
+            try:
+                v = block.var_recursive(n)
+            except KeyError:
+                continue
+            if getattr(v, 'seq_lens', None) is not None:
+                lens = v.seq_lens
+                break
+        if lens is None:
+            return
+        for n in op.output_arg_names():
+            try:
+                v = block.var_recursive(n)
+            except KeyError:
+                continue
+            if getattr(v, 'seq_lens', None) is None and v.name != lens.name:
+                v.seq_lens = lens
 
     # -- inputs ------------------------------------------------------------
     def multiple_input(self, input_param_name='input'):

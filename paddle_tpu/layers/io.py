@@ -25,6 +25,15 @@ def data(name, shape, append_batch_size=True, dtype='float32', lod_level=0,
     main_block = default_main_program().global_block()
     if main_block.has_var(name):
         return main_block.var(name)
-    return main_block.create_var(
+    var = main_block.create_var(
         name=name, shape=shape, dtype=dtype, lod_level=lod_level,
         is_data=True, stop_gradient=stop_gradient)
+    if lod_level > 0:
+        # padded-sequence contract (SURVEY.md §5.7): a LoD feed var is a
+        # padded [B, T, ...] tensor plus a companion [B] int32 lengths
+        # vector; LoDTensor feeds are expanded automatically (executor.py)
+        lens = main_block.create_var(
+            name=name + '@SEQ_LEN', shape=[-1], dtype='int32',
+            is_data=True, stop_gradient=True)
+        var.seq_lens = lens
+    return var

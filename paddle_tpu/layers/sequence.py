@@ -1,0 +1,212 @@
+"""Sequence layers over padded batches (reference python/paddle/fluid/
+layers/nn.py: dynamic_lstm:290, dynamic_gru, sequence_conv, sequence_pool,
+sequence_expand, sequence_softmax, sequence_first/last_step, linear_chain_crf,
+crf_decoding, cos_sim).
+
+Every layer threads the input Variable's `seq_lens` companion (set by
+layers.data(lod_level>0)) into the op's SeqLens input and propagates it to
+sequence-shaped outputs, so masking is automatic end-to-end."""
+from __future__ import annotations
+
+from ..layer_helper import LayerHelper
+
+__all__ = [
+    'dynamic_lstm', 'dynamic_gru', 'sequence_conv', 'sequence_pool',
+    'sequence_softmax', 'sequence_expand', 'sequence_first_step',
+    'sequence_last_step', 'sequence_concat', 'cos_sim',
+    'linear_chain_crf', 'crf_decoding',
+]
+
+
+def _seq_inputs(inputs, var):
+    if getattr(var, 'seq_lens', None) is not None:
+        inputs['SeqLens'] = [var.seq_lens]
+    return inputs
+
+
+def _propagate_lens(src, *outs):
+    lens = getattr(src, 'seq_lens', None)
+    for o in outs:
+        o.seq_lens = lens
+        o.lod_level = max(1, src.lod_level)
+    return outs[0] if len(outs) == 1 else outs
+
+
+def dynamic_lstm(input, size, h_0=None, c_0=None, param_attr=None,
+                 bias_attr=None, use_peepholes=True, is_reverse=False,
+                 gate_activation='sigmoid', cell_activation='tanh',
+                 candidate_activation='tanh', dtype='float32', name=None):
+    """(reference layers/nn.py:290). `size` is 4*hidden (Paddle contract:
+    the caller pre-projects x with an fc of size 4H)."""
+    helper = LayerHelper('lstm', param_attr=param_attr, bias_attr=bias_attr,
+                         name=name)
+    hidden_size = size // 4
+    weight = helper.create_parameter(
+        attr=helper.param_attr, shape=[hidden_size, 4 * hidden_size],
+        dtype=dtype)
+    bias_size = [1, 7 * hidden_size] if use_peepholes \
+        else [1, 4 * hidden_size]
+    bias = helper.create_parameter(attr=helper.bias_attr, shape=bias_size,
+                                   dtype=dtype, is_bias=True)
+    hidden = helper.create_variable_for_type_inference(dtype)
+    cell = helper.create_variable_for_type_inference(dtype)
+    inputs = {'Input': [input], 'Weight': [weight], 'Bias': [bias]}
+    if h_0 is not None:
+        inputs['H0'] = [h_0]
+    if c_0 is not None:
+        inputs['C0'] = [c_0]
+    helper.append_op(
+        type='lstm', inputs=_seq_inputs(inputs, input),
+        outputs={'Hidden': [hidden], 'Cell': [cell]},
+        attrs={'use_peepholes': use_peepholes, 'is_reverse': is_reverse,
+               'gate_activation': gate_activation,
+               'cell_activation': cell_activation,
+               'candidate_activation': candidate_activation})
+    return _propagate_lens(input, hidden, cell)
+
+
+def dynamic_gru(input, size, param_attr=None, bias_attr=None,
+                is_reverse=False, gate_activation='sigmoid',
+                candidate_activation='tanh', h_0=None, name=None):
+    """(reference layers/nn.py dynamic_gru). `size` is hidden; input is
+    pre-projected [*, 3H]."""
+    helper = LayerHelper('gru', param_attr=param_attr, bias_attr=bias_attr,
+                         name=name)
+    dtype = input.dtype
+    weight = helper.create_parameter(attr=helper.param_attr,
+                                     shape=[size, 3 * size], dtype=dtype)
+    bias = helper.create_parameter(attr=helper.bias_attr,
+                                   shape=[1, 3 * size], dtype=dtype,
+                                   is_bias=True)
+    hidden = helper.create_variable_for_type_inference(dtype)
+    inputs = {'Input': [input], 'Weight': [weight], 'Bias': [bias]}
+    if h_0 is not None:
+        inputs['H0'] = [h_0]
+    helper.append_op(
+        type='gru', inputs=_seq_inputs(inputs, input),
+        outputs={'Hidden': [hidden]},
+        attrs={'is_reverse': is_reverse,
+               'gate_activation': gate_activation,
+               'activation': candidate_activation})
+    return _propagate_lens(input, hidden)
+
+
+def sequence_conv(input, num_filters, filter_size=3, filter_stride=1,
+                  padding=None, bias_attr=None, param_attr=None, act=None,
+                  name=None):
+    """(reference layers/nn.py sequence_conv)"""
+    helper = LayerHelper('sequence_conv', param_attr=param_attr,
+                         bias_attr=bias_attr, act=act, name=name)
+    dtype = input.dtype
+    filter_shape = [filter_size * input.shape[-1], num_filters]
+    filter_param = helper.create_parameter(attr=helper.param_attr,
+                                           shape=filter_shape, dtype=dtype)
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        type='sequence_conv',
+        inputs=_seq_inputs({'X': [input], 'Filter': [filter_param]}, input),
+        outputs={'Out': [out]},
+        attrs={'contextStride': filter_stride,
+               'contextStart': -int(filter_size // 2),
+               'contextLength': filter_size})
+    _propagate_lens(input, out)
+    out = helper.append_bias_op(out, dim_start=len(out.shape) - 1)
+    out = helper.append_activation(out)
+    out.seq_lens = getattr(input, 'seq_lens', None)
+    out.lod_level = max(1, input.lod_level)
+    return out
+
+
+def sequence_pool(input, pool_type, is_test=False):
+    """(reference layers/nn.py sequence_pool)"""
+    helper = LayerHelper('sequence_pool')
+    dtype = input.dtype
+    out = helper.create_variable_for_type_inference(dtype)
+    max_index = helper.create_variable_for_type_inference('int32')
+    helper.append_op(
+        type='sequence_pool', inputs=_seq_inputs({'X': [input]}, input),
+        outputs={'Out': [out], 'MaxIndex': [max_index]},
+        attrs={'pooltype': pool_type.upper()})
+    out.lod_level = 0
+    out.seq_lens = None   # the sequence axis is reduced away
+    return out
+
+
+def sequence_first_step(input):
+    return sequence_pool(input, 'first')
+
+
+def sequence_last_step(input):
+    return sequence_pool(input, 'last')
+
+
+def sequence_softmax(input, use_cudnn=False, name=None):
+    helper = LayerHelper('sequence_softmax', name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        type='sequence_softmax',
+        inputs=_seq_inputs({'X': [input]}, input),
+        outputs={'Out': [out]})
+    return _propagate_lens(input, out)
+
+
+def sequence_expand(x, y, ref_level=-1, name=None):
+    helper = LayerHelper('sequence_expand', name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type='sequence_expand',
+                     inputs={'X': [x], 'Y': [y]},
+                     outputs={'Out': [out]},
+                     attrs={'ref_level': ref_level})
+    return _propagate_lens(y, out)
+
+
+def sequence_concat(input, name=None):
+    helper = LayerHelper('sequence_concat', name=name)
+    out = helper.create_variable_for_type_inference(input[0].dtype)
+    helper.append_op(type='sequence_concat',
+                     inputs=_seq_inputs({'X': list(input)}, input[0]),
+                     outputs={'Out': [out]})
+    return _propagate_lens(input[0], out)
+
+
+def cos_sim(X, Y):
+    helper = LayerHelper('cos_sim')
+    out = helper.create_variable_for_type_inference(X.dtype)
+    helper.append_op(type='cos_sim', inputs={'X': [X], 'Y': [Y]},
+                     outputs={'Out': [out]})
+    return out
+
+
+def linear_chain_crf(input, label, param_attr=None):
+    """(reference layers/nn.py linear_chain_crf). Returns the per-sequence
+    negative log-likelihood [B, 1]."""
+    helper = LayerHelper('linear_chain_crf', param_attr=param_attr)
+    size = input.shape[-1]
+    transition = helper.create_parameter(
+        attr=helper.param_attr, shape=[size + 2, size], dtype=input.dtype)
+    alpha = helper.create_variable_for_type_inference(input.dtype)
+    emission_exps = helper.create_variable_for_type_inference(input.dtype)
+    transition_exps = helper.create_variable_for_type_inference(input.dtype)
+    log_likelihood = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        type='linear_chain_crf',
+        inputs=_seq_inputs({'Emission': [input], 'Label': [label],
+                            'Transition': [transition]}, input),
+        outputs={'Alpha': [alpha], 'EmissionExps': [emission_exps],
+                 'TransitionExps': [transition_exps],
+                 'LogLikelihood': [log_likelihood]})
+    return log_likelihood
+
+
+def crf_decoding(input, param_attr, label=None):
+    """(reference layers/nn.py crf_decoding)"""
+    helper = LayerHelper('crf_decoding', param_attr=param_attr)
+    transition = helper.get_parameter(helper.param_attr.name)
+    viterbi_path = helper.create_variable_for_type_inference('int32')
+    inputs = {'Emission': [input], 'Transition': [transition]}
+    if label is not None:
+        inputs['Label'] = [label]
+    helper.append_op(type='crf_decoding',
+                     inputs=_seq_inputs(inputs, input),
+                     outputs={'ViterbiPath': [viterbi_path]})
+    return _propagate_lens(input, viterbi_path)

@@ -114,3 +114,12 @@ def scaled_dot_product_attention(queries, keys, values, num_heads=1,
                                  is_test=False)
     ctx_multiheads = layers.matmul(weights, v)
     return _combine_heads(ctx_multiheads)
+
+
+def sequence_conv_pool(input, num_filters, filter_size, param_attr=None,
+                       act='sigmoid', pool_type='max'):
+    """(reference nets.py sequence_conv_pool) conv over time then pool."""
+    conv_out = layers.sequence_conv(input=input, num_filters=num_filters,
+                                    filter_size=filter_size,
+                                    param_attr=param_attr, act=act)
+    return layers.sequence_pool(input=conv_out, pool_type=pool_type)

@@ -21,32 +21,38 @@ def _concrete_index(ctx, op, slot='I'):
     """Constant-fold the index var over the IR (everything is a tracer under
     jit, so the fold walks the producing ops instead of the traced value).
     Handles the in-tree index idioms: fill_constant / increment / assign /
-    cast chains."""
+    cast chains. Scans ops strictly BEFORE the current op's position in its
+    block (ctx._block_pos), then falls back to ancestor blocks in full
+    (an index both mutated inside and outside the sub-block would be
+    ambiguous -- rejected as data-dependent by construction)."""
     name = op.single_input(slot)
-    upto = getattr(ctx, '_op_index', len(ctx.block.ops))
+    upto = getattr(ctx, '_block_pos', len(ctx.block.ops))
 
-    def fold(n, limit):
-        for idx in range(min(limit, len(ctx.block.ops)) - 1, -1, -1):
-            o = ctx.block.ops[idx]
+    def fold(block, n, limit):
+        for idx in range(min(limit, len(block.ops)) - 1, -1, -1):
+            o = block.ops[idx]
             if n not in o.output_arg_names():
                 continue
             if o.type == 'fill_constant':
                 return int(o.attr('value'))
             if o.type == 'increment':
-                return fold(o.single_input('X'), idx) + \
+                return fold(block, o.single_input('X'), idx) + \
                     int(o.attr('step', 1.0))
             if o.type in ('assign', 'cast'):
-                return fold(o.single_input('X'), idx)
+                return fold(block, o.single_input('X'), idx)
             raise RuntimeError(
                 '%s index %r is data-dependent (produced by %r); XLA needs '
                 'compile-time-constant array indices outside scan-based '
                 'recurrences. Use StaticRNN/DynamicRNN for in-loop arrays.'
                 % (op.type, n, o.type))
+        if block.parent_block is not None:
+            return fold(block.parent_block, n,
+                        len(block.parent_block.ops))
         raise RuntimeError(
             '%s index %r has no constant producer in this block (is it a '
             'feed?)' % (op.type, n))
 
-    return fold(name, upto)
+    return fold(ctx.block, name, upto)
 
 
 @op_emitter('write_to_array')

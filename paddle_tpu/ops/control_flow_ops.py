@@ -38,6 +38,7 @@ def _run_sub_block(env, sub_block, rng_key, is_test, base_index,
     sub_ctx = EmitContext(env, sub_block, rng_key, is_test)
     for i, sop in enumerate(sub_block.ops):
         sub_ctx._op_index = base_index * 1009 + i
+        sub_ctx._block_pos = i
         opdef = registry._REGISTRY.get(sop.type)
         if opdef is None or opdef.emit is None:
             raise KeyError('op %r inside control-flow sub-block has no '
@@ -307,6 +308,16 @@ def _recurrent_grad_emit(ctx, op):
             if n not in diff_names:
                 diff_names.append(n)
 
+    # re-trace the forward under the FORWARD op's block position so the
+    # RNG folding matches: stateful ops (dropout) must reproduce the exact
+    # masks the real forward drew, or the gradient belongs to a different
+    # network realization
+    fwd_index = next(
+        (i for i, o in enumerate(op.block.ops)
+         if o.type == 'recurrent'
+         and o.attr('sub_block') == op.attr('sub_block')),
+        ctx._op_index)
+
     def f(*xs):
         env_vals = dict(zip(diff_names, xs))
 
@@ -315,7 +326,7 @@ def _recurrent_grad_emit(ctx, op):
             block = ctx.block
             rng_key = ctx.rng_key
             is_test = ctx.is_test
-            _op_index = ctx._op_index
+            _op_index = fwd_index
 
             def get(self, name):
                 return env_vals[name]

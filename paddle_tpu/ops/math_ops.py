@@ -27,10 +27,17 @@ from ..registry import (register_op, op_emitter, same_shape_infer,
 def _broadcast_y(x, y, axis):
     if x.ndim == y.ndim:
         return y
-    if axis == -1:
-        axis = x.ndim - y.ndim
-    new_shape = [1] * axis + list(y.shape) + [1] * (x.ndim - axis - y.ndim)
-    return y.reshape(new_shape)
+    if axis != -1:
+        new_shape = [1] * axis + list(y.shape) + \
+            [1] * (x.ndim - axis - y.ndim)
+        if len(new_shape) == x.ndim and all(
+                n in (1, s) for n, s in zip(new_shape, x.shape)):
+            return y.reshape(new_shape)
+        # declared-rank alignment doesn't fit the runtime shape -- the
+        # padded-sequence layout inserts a time axis after batch (runtime
+        # rank = declared rank + 1) -- so align to trailing dims instead
+    axis = x.ndim - y.ndim
+    return y.reshape([1] * axis + list(y.shape))
 
 
 def _register_elementwise(name, fn):
@@ -68,21 +75,31 @@ _register_elementwise('floordiv', jnp.floor_divide)
 # mul: the FC matmul with dim-flattening (reference mul_op.cc: x_num_col_dims)
 # ---------------------------------------------------------------------------
 
-def _flatten2d(a, num_col_dims):
-    lead = int(np.prod(a.shape[:num_col_dims])) if num_col_dims > 0 else 1
-    return a.reshape(lead, -1)
-
-
 @op_emitter('mul')
 def _mul_emit(ctx, op):
     x = ctx.get(op.single_input('X'))
     y = ctx.get(op.single_input('Y'))
     xnc = op.attr('x_num_col_dims', 1)
     ync = op.attr('y_num_col_dims', 1)
-    x2 = _flatten2d(x, xnc)
     y2 = y.reshape(int(np.prod(y.shape[:ync])), -1)
+    k = y2.shape[0]
+    # honor the declared x_num_col_dims contract when it fits; when it
+    # doesn't (padded-sequence runtime rank = declared rank + 1, e.g.
+    # [B, T, D] @ [D, H] built as [B, D] @ [D, H]) contract however many
+    # TRAILING dims multiply to k instead
+    nd = x.ndim - xnc
+    if int(np.prod(x.shape[x.ndim - nd:])) != k:
+        prod, nd = 1, 0
+        while prod < k and nd < x.ndim:
+            nd += 1
+            prod *= x.shape[x.ndim - nd]
+        if prod != k:
+            raise ValueError(
+                'mul: cannot align x shape %s with contraction size %d'
+                % (x.shape, k))
+    x2 = x.reshape(-1, int(np.prod(x.shape[x.ndim - nd:])))
     out2 = jnp.matmul(x2, y2, preferred_element_type=x2.dtype)
-    out_shape = x.shape[:xnc] + y.shape[ync:]
+    out_shape = x.shape[:x.ndim - nd] + y.shape[ync:]
     ctx.set(op.single_output('Out'), out2.reshape(out_shape))
 
 

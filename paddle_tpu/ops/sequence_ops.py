@@ -1,0 +1,572 @@
+"""Sequence ops over padded batches + length vectors.
+
+TPU-native re-design of the reference's LoD-aware sequence operators
+(operators/sequence_pool_op.cc, sequence_conv_op.cc, lstm_op.cc, gru_op.cc,
+sequence_expand_op.cc, sequence_softmax_op.cc, linear_chain_crf_op.cc,
+crf_decoding_op.cc, operators/math/sequence2batch.h). The reference batches
+ragged sequences without padding via LoD offsets and reorders to time-major
+batches per step; here every sequence tensor is a padded [B, T, ...] array
+with an explicit [B] int32 lengths input ('SeqLens'), recurrences are
+lax.scan over the (static) T axis with per-row masking, and padding never
+leaks: pools mask it out, convs zero it, recurrences freeze finished rows.
+Static shapes keep XLA happy; the MXU sees big batched matmuls.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..registry import register_op, op_emitter, register_vjp_grad
+
+
+def _lens(ctx, op, T, B):
+    if op.input('SeqLens'):
+        return ctx.get(op.single_input('SeqLens'))
+    return jnp.full((B,), T, dtype=jnp.int32)
+
+
+def _time_mask(lens, T, extra_dims=0):
+    """[B, T] (+ trailing 1s) bool mask of valid positions."""
+    m = jnp.arange(T)[None, :] < lens[:, None]
+    return m.reshape(m.shape + (1,) * extra_dims)
+
+
+# ---------------------------------------------------------------------------
+# sequence_pool (reference sequence_pool_op.cc; pooltype SUM/AVERAGE/SQRT/
+# MAX/LAST/FIRST). X: [B, T, D...] -> Out: [B, D...]
+# ---------------------------------------------------------------------------
+
+@op_emitter('sequence_pool')
+def _sequence_pool_emit(ctx, op):
+    x = ctx.get(op.single_input('X'))
+    B, T = x.shape[0], x.shape[1]
+    lens = _lens(ctx, op, T, B)
+    mask = _time_mask(lens, T, extra_dims=x.ndim - 2)
+    pooltype = op.attr('pooltype', 'AVERAGE').upper()
+    if pooltype == 'SUM':
+        out = jnp.sum(jnp.where(mask, x, 0), axis=1)
+    elif pooltype == 'AVERAGE':
+        denom = jnp.maximum(lens, 1).reshape((B,) + (1,) * (x.ndim - 2))
+        out = jnp.sum(jnp.where(mask, x, 0), axis=1) / denom.astype(x.dtype)
+    elif pooltype == 'SQRT':
+        denom = jnp.sqrt(jnp.maximum(lens, 1).astype(x.dtype))
+        denom = denom.reshape((B,) + (1,) * (x.ndim - 2))
+        out = jnp.sum(jnp.where(mask, x, 0), axis=1) / denom
+    elif pooltype == 'MAX':
+        neg = jnp.asarray(-3.4e38, dtype=x.dtype)
+        out = jnp.max(jnp.where(mask, x, neg), axis=1)
+    elif pooltype == 'LAST':
+        idx = jnp.maximum(lens - 1, 0)
+        out = jnp.take_along_axis(
+            x, idx.reshape((B, 1) + (1,) * (x.ndim - 2)), axis=1)
+        out = jnp.squeeze(out, axis=1)
+    elif pooltype == 'FIRST':
+        out = x[:, 0]
+    else:
+        raise ValueError('unknown pooltype %r' % pooltype)
+    ctx.set(op.single_output('Out'), out)
+    if op.output('MaxIndex'):
+        ctx.set(op.single_output('MaxIndex'),
+                jnp.argmax(jnp.where(mask, x, -3.4e38), axis=1))
+
+
+def _sequence_pool_infer(op, block):
+    x = block.var_recursive(op.single_input('X'))
+    out = block.var_recursive(op.single_output('Out'))
+    out.shape = x.shape  # declared flat-row shape [-1, D] is preserved
+    out.dtype = x.dtype
+    out.lod_level = 0
+
+
+register_op('sequence_pool', infer_shape=_sequence_pool_infer)
+register_vjp_grad('sequence_pool', in_slots=('X',),
+                  nondiff_slots=('SeqLens',))
+
+
+# ---------------------------------------------------------------------------
+# sequence_softmax: softmax over the time axis, padding excluded
+# ---------------------------------------------------------------------------
+
+@op_emitter('sequence_softmax')
+def _sequence_softmax_emit(ctx, op):
+    x = ctx.get(op.single_input('X'))  # [B, T] or [B, T, 1]
+    squeeze = x.ndim == 3 and x.shape[-1] == 1
+    v = x.reshape(x.shape[:2]) if squeeze else x
+    B, T = v.shape
+    lens = _lens(ctx, op, T, B)
+    mask = _time_mask(lens, T)
+    neg = jnp.asarray(-3.4e38, dtype=v.dtype)
+    logits = jnp.where(mask, v, neg)
+    out = jax.nn.softmax(logits, axis=1)
+    out = jnp.where(mask, out, 0)
+    ctx.set(op.single_output('Out'), out.reshape(x.shape))
+
+
+def _same_infer(op, block):
+    x = block.var_recursive(op.single_input('X'))
+    out = block.var_recursive(op.single_output('Out'))
+    out.shape = x.shape
+    out.dtype = x.dtype
+    out.lod_level = x.lod_level
+
+
+register_op('sequence_softmax', infer_shape=_same_infer)
+register_vjp_grad('sequence_softmax', in_slots=('X',),
+                  nondiff_slots=('SeqLens',))
+
+
+# ---------------------------------------------------------------------------
+# sequence_expand (reference sequence_expand_op.cc): each row b of X is
+# broadcast along Y's time axis. X: [B, D] (or [B, 1, D]) -> Out [B, T, D]
+# ---------------------------------------------------------------------------
+
+@op_emitter('sequence_expand')
+def _sequence_expand_emit(ctx, op):
+    x = ctx.get(op.single_input('X'))
+    y = ctx.get(op.single_input('Y'))
+    T = y.shape[1]
+    if x.ndim == 2:
+        out = jnp.broadcast_to(x[:, None, :], (x.shape[0], T, x.shape[1]))
+    else:
+        out = jnp.broadcast_to(x, (x.shape[0], T) + x.shape[2:])
+    ctx.set(op.single_output('Out'), out)
+
+
+def _sequence_expand_infer(op, block):
+    x = block.var_recursive(op.single_input('X'))
+    out = block.var_recursive(op.single_output('Out'))
+    out.shape = x.shape
+    out.dtype = x.dtype
+    y = block.var_recursive(op.single_input('Y'))
+    out.lod_level = max(1, y.lod_level)
+
+
+register_op('sequence_expand', infer_shape=_sequence_expand_infer)
+register_vjp_grad('sequence_expand', in_slots=('X',),
+                  nondiff_slots=('Y',))
+
+
+# ---------------------------------------------------------------------------
+# sequence_conv (reference sequence_conv_op.cc + math/context_project.h):
+# per-sequence sliding context window [contextStart, contextStart+len)
+# stacked then projected by Filter [len*D, H]. Padding rows are zeros,
+# windows never cross sequence boundaries (masked before gathering).
+# ---------------------------------------------------------------------------
+
+@op_emitter('sequence_conv')
+def _sequence_conv_emit(ctx, op):
+    x = ctx.get(op.single_input('X'))          # [B, T, D]
+    w = ctx.get(op.single_input('Filter'))     # [len*D, H]
+    clen = op.attr('contextLength', 3)
+    cstart = op.attr('contextStart', -((clen - 1) // 2))
+    B, T, D = x.shape
+    lens = _lens(ctx, op, T, B)
+    xm = jnp.where(_time_mask(lens, T, 1), x, 0)
+    cols = []
+    for k in range(clen):
+        off = cstart + k
+        shifted = jnp.roll(xm, -off, axis=1)
+        # zero positions that rolled across the edge
+        t_idx = jnp.arange(T) + off
+        valid = (t_idx >= 0) & (t_idx < T)
+        cols.append(jnp.where(valid[None, :, None], shifted, 0))
+    ctx_mat = jnp.concatenate(cols, axis=-1)        # [B, T, len*D]
+    out = jnp.matmul(ctx_mat, w, preferred_element_type=x.dtype)
+    out = jnp.where(_time_mask(lens, T, 1), out, 0)
+    ctx.set(op.single_output('Out'), out)
+
+
+def _sequence_conv_infer(op, block):
+    x = block.var_recursive(op.single_input('X'))
+    w = block.var_recursive(op.single_input('Filter'))
+    out = block.var_recursive(op.single_output('Out'))
+    out.shape = tuple(x.shape[:-1]) + (w.shape[-1],)
+    out.dtype = x.dtype
+    out.lod_level = max(1, x.lod_level)
+
+
+register_op('sequence_conv', infer_shape=_sequence_conv_infer)
+register_vjp_grad('sequence_conv', in_slots=('X', 'Filter'),
+                  nondiff_slots=('SeqLens',))
+
+
+# ---------------------------------------------------------------------------
+# lstm (reference lstm_op.cc, math/lstm_compute): dynamic LSTM over
+# pre-projected gates. Input [B, T, 4H] (x @ W_x done by the caller's fc,
+# same contract as the reference), Weight [H, 4H] recurrent, Bias [1, 4H]
+# (+ [1, 7H] with peepholes). Gate layout matches the reference kernel
+# (lstm_cpu_kernel.h:44-47): candidate, input-gate, forget-gate, output-gate.
+# ---------------------------------------------------------------------------
+
+_ACT = {
+    'sigmoid': jax.nn.sigmoid, 'tanh': jnp.tanh, 'relu': jax.nn.relu,
+    'identity': lambda v: v, '': lambda v: v,
+}
+
+
+@op_emitter('lstm')
+def _lstm_emit(ctx, op):
+    x = ctx.get(op.single_input('Input'))       # [B, T, 4H]
+    w = ctx.get(op.single_input('Weight'))      # [H, 4H]
+    b = ctx.get(op.single_input('Bias'))        # [1, 4H] or [1, 7H]
+    B, T, H4 = x.shape
+    H = H4 // 4
+    lens = _lens(ctx, op, T, B)
+    use_peepholes = op.attr('use_peepholes', False)
+    is_reverse = op.attr('is_reverse', False)
+    act_g = _ACT[op.attr('gate_activation', 'sigmoid')]
+    act_c = _ACT[op.attr('cell_activation', 'tanh')]
+    act_h = _ACT[op.attr('candidate_activation', 'tanh')]
+
+    gate_b = b[:, :4 * H]
+    if use_peepholes:
+        w_ic, w_fc, w_oc = (b[:, 4 * H:5 * H], b[:, 5 * H:6 * H],
+                            b[:, 6 * H:7 * H])
+
+    h0 = jnp.zeros((B, H), x.dtype)
+    c0 = jnp.zeros((B, H), x.dtype)
+    if op.input('H0'):
+        h0 = ctx.get(op.single_input('H0'))
+    if op.input('C0'):
+        c0 = ctx.get(op.single_input('C0'))
+
+    xs = jnp.swapaxes(x, 0, 1)                   # [T, B, 4H]
+    ts = jnp.arange(T)
+    if is_reverse:
+        xs = jnp.flip(xs, axis=0)
+        steps = T - 1 - ts
+    else:
+        steps = ts
+
+    def step(carry, inp):
+        h_prev, c_prev = carry
+        xt, t = inp
+        gates = xt + jnp.matmul(h_prev, w,
+                                preferred_element_type=x.dtype) + gate_b
+        gc, gi, gf, go = jnp.split(gates, 4, axis=-1)
+        if use_peepholes:
+            gi = gi + c_prev * w_ic
+            gf = gf + c_prev * w_fc
+        i, f, cand = act_g(gi), act_g(gf), act_c(gc)
+        c = f * c_prev + i * cand
+        if use_peepholes:
+            go = go + c * w_oc
+        o = act_g(go)
+        h = o * act_h(c)
+        active = (t < lens)[:, None]
+        h = jnp.where(active, h, h_prev)
+        c = jnp.where(active, c, c_prev)
+        return (h, c), (h, c)
+
+    (_, _), (hs, cs) = jax.lax.scan(step, (h0, c0), (xs, steps))
+    if is_reverse:
+        hs, cs = jnp.flip(hs, axis=0), jnp.flip(cs, axis=0)
+    hidden = jnp.swapaxes(hs, 0, 1)
+    cell = jnp.swapaxes(cs, 0, 1)
+    mask = _time_mask(lens, T, 1)
+    ctx.set(op.single_output('Hidden'), jnp.where(mask, hidden, 0))
+    ctx.set(op.single_output('Cell'), jnp.where(mask, cell, 0))
+
+
+def _lstm_infer(op, block):
+    x = block.var_recursive(op.single_input('Input'))
+    H = x.shape[-1] // 4
+    for slot in ('Hidden', 'Cell'):
+        out = block.var_recursive(op.single_output(slot))
+        out.shape = tuple(x.shape[:-1]) + (H,)
+        out.dtype = x.dtype
+        out.lod_level = max(1, x.lod_level)
+
+
+register_op('lstm', infer_shape=_lstm_infer)
+register_vjp_grad('lstm', in_slots=('Input', 'Weight', 'Bias', 'H0', 'C0'),
+                  out_slots=('Hidden', 'Cell'), nondiff_slots=('SeqLens',))
+
+
+# ---------------------------------------------------------------------------
+# gru (reference gru_op.cc): Input [B, T, 3H] pre-projected
+# (update|reset|candidate), Weight [H, 3H] = [W_uz | W_r | W_c], Bias [1,3H].
+# ---------------------------------------------------------------------------
+
+@op_emitter('gru')
+def _gru_emit(ctx, op):
+    x = ctx.get(op.single_input('Input'))       # [B, T, 3H]
+    w = ctx.get(op.single_input('Weight'))      # [H, 3H]
+    B, T, H3 = x.shape
+    H = H3 // 3
+    lens = _lens(ctx, op, T, B)
+    is_reverse = op.attr('is_reverse', False)
+    act_g = _ACT[op.attr('gate_activation', 'sigmoid')]
+    act_c = _ACT[op.attr('activation', 'tanh')]
+    b = ctx.get(op.single_input('Bias')) if op.input('Bias') \
+        else jnp.zeros((1, 3 * H), x.dtype)
+    w_g = w[:, :2 * H]     # update+reset recurrent weights
+    w_c = w[:, 2 * H:]     # candidate recurrent weights
+
+    h0 = ctx.get(op.single_input('H0')) if op.input('H0') \
+        else jnp.zeros((B, H), x.dtype)
+
+    xs = jnp.swapaxes(x, 0, 1)
+    ts = jnp.arange(T)
+    if is_reverse:
+        xs = jnp.flip(xs, axis=0)
+        steps = T - 1 - ts
+    else:
+        steps = ts
+
+    def step(h_prev, inp):
+        xt, t = inp
+        xt = xt + b
+        g = xt[:, :2 * H] + jnp.matmul(h_prev, w_g,
+                                       preferred_element_type=x.dtype)
+        u = act_g(g[:, :H])
+        r = act_g(g[:, H:])
+        c = act_c(xt[:, 2 * H:] + jnp.matmul(
+            r * h_prev, w_c, preferred_element_type=x.dtype))
+        # reference gru_kernel.h:62 gru_finalOutput:
+        # h = prev - u*prev + u*c = (1 - u) * h_prev + u * c
+        h = (1.0 - u) * h_prev + u * c
+        active = (t < lens)[:, None]
+        h = jnp.where(active, h, h_prev)
+        return h, h
+
+    _, hs = jax.lax.scan(step, h0, (xs, steps))
+    if is_reverse:
+        hs = jnp.flip(hs, axis=0)
+    hidden = jnp.swapaxes(hs, 0, 1)
+    ctx.set(op.single_output('Hidden'),
+            jnp.where(_time_mask(lens, T, 1), hidden, 0))
+
+
+def _gru_infer(op, block):
+    x = block.var_recursive(op.single_input('Input'))
+    H = x.shape[-1] // 3
+    out = block.var_recursive(op.single_output('Hidden'))
+    out.shape = tuple(x.shape[:-1]) + (H,)
+    out.dtype = x.dtype
+    out.lod_level = max(1, x.lod_level)
+
+
+register_op('gru', infer_shape=_gru_infer)
+register_vjp_grad('gru', in_slots=('Input', 'Weight', 'Bias', 'H0'),
+                  out_slots=('Hidden',), nondiff_slots=('SeqLens',))
+
+
+# ---------------------------------------------------------------------------
+# cos_sim (reference cos_sim_op.cc)
+# ---------------------------------------------------------------------------
+
+@op_emitter('cos_sim')
+def _cos_sim_emit(ctx, op):
+    x = ctx.get(op.single_input('X'))
+    y = ctx.get(op.single_input('Y'))
+    xn = jnp.sqrt(jnp.sum(jnp.square(x), axis=-1, keepdims=True))
+    yn = jnp.sqrt(jnp.sum(jnp.square(y), axis=-1, keepdims=True))
+    dot = jnp.sum(x * y, axis=-1, keepdims=True)
+    ctx.set(op.single_output('Out'), dot / (xn * yn + 1e-12))
+
+
+def _cos_sim_infer(op, block):
+    x = block.var_recursive(op.single_input('X'))
+    out = block.var_recursive(op.single_output('Out'))
+    out.shape = tuple(x.shape[:-1]) + (1,)
+    out.dtype = x.dtype
+
+
+register_op('cos_sim', infer_shape=_cos_sim_infer)
+register_vjp_grad('cos_sim', in_slots=('X', 'Y'))
+
+
+# ---------------------------------------------------------------------------
+# linear_chain_crf (reference linear_chain_crf_op.cc) + crf_decoding
+# (crf_decoding_op.cc). Emission [B, T, N], Transition [N+2, N] (row 0:
+# start scores, row 1: end scores, rows 2..: pairwise), Label [B, T, 1].
+# Forward algorithm / viterbi as lax.scan over the time axis with length
+# masking -- log-domain throughout (the reference tracks per-row
+# normalizers in linear space).
+# ---------------------------------------------------------------------------
+
+def _crf_log_alpha(emission, transition, lens):
+    B, T, N = emission.shape
+    start = transition[0]          # [N]
+    trans = transition[2:]         # [N, N] trans[i, j]: i -> j
+
+    alpha0 = start[None, :] + emission[:, 0]     # [B, N]
+
+    def step(alpha, inp):
+        emit_t, t = inp            # [B, N], scalar
+        # logsumexp_i(alpha_i + trans[i, j]) + emit_j
+        scores = alpha[:, :, None] + trans[None, :, :]
+        new_alpha = jax.scipy.special.logsumexp(scores, axis=1) + emit_t
+        active = (t < lens)[:, None]
+        alpha = jnp.where(active, new_alpha, alpha)
+        return alpha, None
+
+    emits = jnp.swapaxes(emission, 0, 1)[1:]     # [T-1, B, N]
+    ts = jnp.arange(1, T)
+    alpha, _ = jax.lax.scan(step, alpha0, (emits, ts))
+    return alpha
+
+
+@op_emitter('linear_chain_crf')
+def _linear_chain_crf_emit(ctx, op):
+    emission = ctx.get(op.single_input('Emission'))   # [B, T, N]
+    transition = ctx.get(op.single_input('Transition'))
+    label = ctx.get(op.single_input('Label'))         # [B, T, 1] or [B, T]
+    B, T, N = emission.shape
+    lens = _lens(ctx, op, T, B)
+    if label.ndim == 3:
+        label = label[..., 0]
+    label = label.astype(jnp.int32)
+
+    start, end, trans = transition[0], transition[1], transition[2:]
+
+    # log partition
+    alpha = _crf_log_alpha(emission, transition, lens)
+    last_idx = jnp.maximum(lens - 1, 0)
+    log_z = jax.scipy.special.logsumexp(alpha + end[None, :], axis=1)
+
+    # gold path score
+    mask = _time_mask(lens, T)                       # [B, T]
+    emit_scores = jnp.take_along_axis(
+        emission, label[..., None], axis=2)[..., 0]   # [B, T]
+    emit_sum = jnp.sum(jnp.where(mask, emit_scores, 0), axis=1)
+    trans_scores = trans[label[:, :-1], label[:, 1:]]  # [B, T-1]
+    tmask = mask[:, 1:]
+    trans_sum = jnp.sum(jnp.where(tmask, trans_scores, 0), axis=1)
+    start_score = start[label[:, 0]]
+    last_label = jnp.take_along_axis(label, last_idx[:, None], axis=1)[:, 0]
+    end_score = end[last_label]
+    gold = start_score + emit_sum + trans_sum + end_score
+
+    ll = (log_z - gold)[:, None]                    # negative log-likelihood
+    ctx.set(op.single_output('LogLikelihood'), ll)
+    if op.output('Alpha'):
+        ctx.set(op.single_output('Alpha'), alpha)
+    if op.output('EmissionExps'):
+        ctx.set(op.single_output('EmissionExps'), jnp.exp(emission))
+    if op.output('TransitionExps'):
+        ctx.set(op.single_output('TransitionExps'), jnp.exp(transition))
+
+
+def _crf_infer(op, block):
+    e = block.var_recursive(op.single_input('Emission'))
+    ll = block.var_recursive(op.single_output('LogLikelihood'))
+    ll.shape = (-1, 1)
+    ll.dtype = e.dtype
+    for slot in ('Alpha', 'EmissionExps'):
+        if op.output(slot):
+            v = block.var_recursive(op.single_output(slot))
+            v.shape = e.shape
+            v.dtype = e.dtype
+    if op.output('TransitionExps'):
+        t = block.var_recursive(op.single_input('Transition'))
+        v = block.var_recursive(op.single_output('TransitionExps'))
+        v.shape = t.shape
+        v.dtype = t.dtype
+
+
+register_op('linear_chain_crf', infer_shape=_crf_infer)
+register_vjp_grad('linear_chain_crf', in_slots=('Emission', 'Transition'),
+                  out_slots=('LogLikelihood',),
+                  nondiff_slots=('Label', 'SeqLens'))
+
+
+@op_emitter('crf_decoding')
+def _crf_decoding_emit(ctx, op):
+    emission = ctx.get(op.single_input('Emission'))   # [B, T, N]
+    transition = ctx.get(op.single_input('Transition'))
+    B, T, N = emission.shape
+    lens = _lens(ctx, op, T, B)
+    start, end, trans = transition[0], transition[1], transition[2:]
+
+    delta0 = start[None, :] + emission[:, 0]
+
+    def fwd(delta, inp):
+        emit_t, t = inp
+        scores = delta[:, :, None] + trans[None, :, :]    # [B, N, N]
+        best_prev = jnp.argmax(scores, axis=1)            # [B, N]
+        new_delta = jnp.max(scores, axis=1) + emit_t
+        active = (t < lens)[:, None]
+        delta = jnp.where(active, new_delta, delta)
+        best_prev = jnp.where(active, best_prev, jnp.arange(N)[None, :])
+        return delta, best_prev
+
+    emits = jnp.swapaxes(emission, 0, 1)[1:]
+    ts = jnp.arange(1, T)
+    delta, backptrs = jax.lax.scan(fwd, delta0, (emits, ts))  # [T-1, B, N]
+
+    last = jnp.argmax(delta + end[None, :], axis=1)       # [B]
+
+    def back(nxt, bp_t):
+        cur = jnp.take_along_axis(bp_t, nxt[:, None], axis=1)[:, 0]
+        return cur, cur
+
+    _, path_rev = jax.lax.scan(back, last, jnp.flip(backptrs, axis=0))
+    path = jnp.concatenate(
+        [jnp.flip(jnp.swapaxes(path_rev, 0, 1), axis=1),
+         last[:, None]], axis=1)                          # [B, T]
+    path = jnp.where(_time_mask(lens, T), path, 0)
+    out = path[..., None].astype(jnp.int32)
+
+    if op.input('Label'):
+        label = ctx.get(op.single_input('Label'))
+        if label.ndim == 3:
+            label = label[..., 0]
+        correct = (path == label.astype(path.dtype)) & _time_mask(lens, T)
+        ctx.set(op.single_output('ViterbiPath'),
+                correct[..., None].astype(jnp.int32))
+    else:
+        ctx.set(op.single_output('ViterbiPath'), out)
+
+
+def _crf_decoding_infer(op, block):
+    e = block.var_recursive(op.single_input('Emission'))
+    out = block.var_recursive(op.single_output('ViterbiPath'))
+    out.shape = tuple(e.shape[:-1]) + (1,)
+    out.dtype = 'int32'
+    out.lod_level = max(1, e.lod_level)
+
+
+register_op('crf_decoding', infer_shape=_crf_decoding_infer, no_grad=True)
+
+
+# ---------------------------------------------------------------------------
+# sequence_concat / sequence_reshape / sequence_slice -- padded analogs
+# ---------------------------------------------------------------------------
+
+@op_emitter('sequence_concat')
+def _sequence_concat_emit(ctx, op):
+    xs = [ctx.get(n) for n in op.input('X')]
+    ctx.set(op.single_output('Out'), jnp.concatenate(xs, axis=-1))
+
+
+def _sequence_concat_infer(op, block):
+    x0 = block.var_recursive(op.input('X')[0])
+    out = block.var_recursive(op.single_output('Out'))
+    last = sum(block.var_recursive(n).shape[-1] for n in op.input('X'))
+    out.shape = tuple(x0.shape[:-1]) + (last,)
+    out.dtype = x0.dtype
+    out.lod_level = max(1, x0.lod_level)
+
+
+register_op('sequence_concat', infer_shape=_sequence_concat_infer)
+register_vjp_grad('sequence_concat', in_slots=('X',))
+
+
+@op_emitter('sequence_first_step')
+def _seq_first_emit(ctx, op):
+    x = ctx.get(op.single_input('X'))
+    ctx.set(op.single_output('Out'), x[:, 0])
+
+
+@op_emitter('sequence_last_step')
+def _seq_last_emit(ctx, op):
+    x = ctx.get(op.single_input('X'))
+    B, T = x.shape[0], x.shape[1]
+    lens = _lens(ctx, op, T, B)
+    idx = jnp.maximum(lens - 1, 0)
+    out = jnp.take_along_axis(
+        x, idx.reshape((B, 1) + (1,) * (x.ndim - 2)), axis=1)
+    ctx.set(op.single_output('Out'), jnp.squeeze(out, axis=1))

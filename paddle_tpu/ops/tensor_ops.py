@@ -294,6 +294,10 @@ def _slice_infer(op, block):
         return
     shape = list(x.shape)
     for a, s, e in zip(op.attr('axes'), op.attr('starts'), op.attr('ends')):
+        if a >= len(shape):
+            # axis addresses the runtime-only padded time dim of a lod var
+            # (runtime rank = declared rank + 1); nothing to infer
+            continue
         dim = shape[a]
         if dim < 0:
             continue

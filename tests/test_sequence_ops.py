@@ -1,0 +1,263 @@
+"""Sequence ops over padded batches: pooling, conv, LSTM/GRU scans, CRF
+(re-design of reference test_sequence_pool.py, test_sequence_conv.py,
+test_lstm_op.py, test_gru_op.py, test_linear_chain_crf_op.py,
+test_crf_decoding_op.py -- numeric comparisons against numpy references)."""
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.framework import Program, program_guard
+from paddle_tpu.lod_tensor import create_lod_tensor
+
+
+def _run(prog, feed, fetch, startup=None):
+    exe = fluid.Executor(fluid.CPUPlace())
+    if startup is not None:
+        exe.run(startup)
+    return exe.run(prog, feed=feed, fetch_list=fetch)
+
+
+def _lod_feed():
+    # 3 sequences of lengths 3, 1, 2 with D=4
+    rng = np.random.RandomState(0)
+    flat = rng.rand(6, 4).astype('float32')
+    t = create_lod_tensor(flat, [[3, 1, 2]])
+    seqs = [flat[0:3], flat[3:4], flat[4:6]]
+    return t, seqs
+
+
+def test_lod_feed_expansion_and_pool_types():
+    t, seqs = _lod_feed()
+    prog, startup = Program(), Program()
+    with program_guard(prog, startup):
+        x = fluid.layers.data(name='x', shape=[4], dtype='float32',
+                              lod_level=1)
+        outs = {pt: layers.sequence_pool(x, pool_type=pt)
+                for pt in ('sum', 'average', 'sqrt', 'max', 'last', 'first')}
+    keys = list(outs)
+    results = _run(prog, {'x': t}, [outs[k] for k in keys])
+    expect = {
+        'sum': np.stack([s.sum(0) for s in seqs]),
+        'average': np.stack([s.mean(0) for s in seqs]),
+        'sqrt': np.stack([s.sum(0) / np.sqrt(len(s)) for s in seqs]),
+        'max': np.stack([s.max(0) for s in seqs]),
+        'last': np.stack([s[-1] for s in seqs]),
+        'first': np.stack([s[0] for s in seqs]),
+    }
+    for k, r in zip(keys, results):
+        np.testing.assert_allclose(r, expect[k], rtol=1e-5, err_msg=k)
+
+
+def test_sequence_softmax_masks_padding():
+    t, seqs = _lod_feed()
+    prog, startup = Program(), Program()
+    with program_guard(prog, startup):
+        x = fluid.layers.data(name='x', shape=[4], dtype='float32',
+                              lod_level=1)
+        first_col = layers.slice(x, axes=[2], starts=[0], ends=[1])
+        sm = layers.sequence_softmax(first_col)
+    r, = _run(prog, {'x': t}, [sm])
+    # each row's valid probs sum to 1, padded positions are 0
+    lens = [3, 1, 2]
+    for b, ln in enumerate(lens):
+        v = r[b, :, 0]
+        np.testing.assert_allclose(v[:ln].sum(), 1.0, rtol=1e-5)
+        assert np.all(v[ln:] == 0)
+
+
+def test_sequence_conv_respects_boundaries():
+    t, seqs = _lod_feed()
+    prog, startup = Program(), Program()
+    with program_guard(prog, startup):
+        x = fluid.layers.data(name='x', shape=[4], dtype='float32',
+                              lod_level=1)
+        out = layers.sequence_conv(x, num_filters=5, filter_size=3,
+                                   act=None, bias_attr=False)
+    r, = _run(prog, {'x': t}, [out], startup=startup)
+    w = np.array(fluid.fetch_var(
+        [p.name for p in prog.global_block().all_parameters()][0]))
+    # numpy reference: per-sequence context window [-1, 0, 1], zero padded
+    for b, s in enumerate(seqs):
+        T = len(s)
+        padded = np.vstack([np.zeros((1, 4), 'f4'), s,
+                            np.zeros((1, 4), 'f4')])
+        ctx_rows = np.stack([padded[i:i + 3].ravel() for i in range(T)])
+        want = ctx_rows @ w
+        np.testing.assert_allclose(r[b, :T], want, rtol=1e-4, atol=1e-5)
+
+
+def _np_lstm(x_proj, w, b, lens):
+    """numpy LSTM, reference kernel gate order c,i,f,o; no peepholes."""
+    B, T, H4 = x_proj.shape
+    H = H4 // 4
+    h = np.zeros((B, H), 'f4')
+    c = np.zeros((B, H), 'f4')
+    hs = np.zeros((B, T, H), 'f4')
+
+    def sig(v):
+        return 1.0 / (1.0 + np.exp(-v))
+
+    for t in range(T):
+        gates = x_proj[:, t] + h @ w + b
+        cc, i, f, o = np.split(gates, 4, axis=1)
+        i, f, o = sig(i), sig(f), sig(o)
+        cand = np.tanh(cc)
+        c_new = f * c + i * cand
+        h_new = o * np.tanh(c_new)
+        active = (t < lens)[:, None]
+        h = np.where(active, h_new, h)
+        c = np.where(active, c_new, c)
+        hs[:, t] = np.where(active, h_new, 0)
+    return hs
+
+
+def test_dynamic_lstm_matches_numpy():
+    rng = np.random.RandomState(3)
+    H = 5
+    flat = rng.randn(7, 4 * H).astype('float32') * 0.5
+    t = create_lod_tensor(flat, [[4, 3]])
+    prog, startup = Program(), Program()
+    with program_guard(prog, startup):
+        x = fluid.layers.data(name='x', shape=[4 * H], dtype='float32',
+                              lod_level=1)
+        hidden, cell = layers.dynamic_lstm(x, size=4 * H,
+                                           use_peepholes=False)
+    r, = _run(prog, {'x': t}, [hidden], startup=startup)
+    params = {p.name: np.array(fluid.fetch_var(p.name))
+              for p in prog.global_block().all_parameters()}
+    w = next(v for k, v in params.items() if v.shape == (H, 4 * H))
+    b = next(v for k, v in params.items() if v.shape == (1, 4 * H))
+    padded = np.zeros((2, 4, 4 * H), 'f4')
+    padded[0, :4] = flat[:4]
+    padded[1, :3] = flat[4:]
+    want = _np_lstm(padded, w, b[0], np.array([4, 3]))
+    np.testing.assert_allclose(r, want, rtol=1e-4, atol=1e-5)
+
+
+def test_dynamic_gru_shapes_and_masking():
+    rng = np.random.RandomState(4)
+    H = 6
+    flat = rng.randn(5, 3 * H).astype('float32')
+    t = create_lod_tensor(flat, [[2, 3]])
+    prog, startup = Program(), Program()
+    with program_guard(prog, startup):
+        x = fluid.layers.data(name='x', shape=[3 * H], dtype='float32',
+                              lod_level=1)
+        hidden = layers.dynamic_gru(x, size=H)
+    r, = _run(prog, {'x': t}, [hidden], startup=startup)
+    assert r.shape == (2, 3, H)
+    assert np.all(r[0, 2] == 0)          # padded position masked
+    assert not np.all(r[1, 2] == 0)      # valid position nonzero
+
+
+def test_lstm_trains_sentiment_style():
+    """fc -> lstm -> last-pool -> fc classifier overfits a tiny batch."""
+    prog, startup = Program(), Program()
+    with program_guard(prog, startup):
+        x = fluid.layers.data(name='x', shape=[1], dtype='int64',
+                              lod_level=1)
+        label = fluid.layers.data(name='label', shape=[1], dtype='int64')
+        emb = layers.embedding(x, size=[30, 16])
+        proj = layers.fc(input=emb, size=4 * 8)
+        hidden, _ = layers.dynamic_lstm(proj, size=4 * 8,
+                                        use_peepholes=False)
+        last = layers.sequence_pool(hidden, 'last')
+        predict = layers.fc(input=last, size=2, act='softmax')
+        cost = layers.cross_entropy(input=predict, label=label)
+        loss = layers.mean(cost)
+        fluid.optimizer.Adam(learning_rate=0.01).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    rng = np.random.RandomState(5)
+    ids = rng.randint(0, 30, size=(9, 1)).astype('int64')
+    t = create_lod_tensor(ids, [[4, 2, 3]])
+    yv = np.array([[0], [1], [0]], dtype='int64')
+    first = None
+    for _ in range(60):
+        l, = exe.run(prog, feed={'x': t, 'label': yv}, fetch_list=[loss])
+        if first is None:
+            first = float(l)
+    assert float(l) < 0.2 * first, (first, float(l))
+
+
+def _brute_force_crf(emission, transition, lens):
+    """Enumerate all paths for tiny N, T: returns (nll per seq, best path)."""
+    import itertools
+    B, T, N = emission.shape
+    start, end, trans = transition[0], transition[1], transition[2:]
+    nlls, paths = [], []
+    for b in range(B):
+        L = lens[b]
+        scores = {}
+        for path in itertools.product(range(N), repeat=L):
+            s = start[path[0]] + emission[b, 0, path[0]] + end[path[-1]]
+            for t in range(1, L):
+                s += trans[path[t - 1], path[t]] + emission[b, t, path[t]]
+            scores[path] = s
+        all_s = np.array(list(scores.values()))
+        m = all_s.max()
+        log_z = m + np.log(np.exp(all_s - m).sum())
+        best = max(scores, key=scores.get)
+        paths.append(list(best) + [0] * (T - L))
+        nlls.append(log_z)  # caller subtracts gold
+    return np.array(nlls), np.array(paths)
+
+
+def test_linear_chain_crf_and_decoding_vs_brute_force():
+    rng = np.random.RandomState(6)
+    N, B, T = 3, 2, 3
+    flat_emission = rng.randn(5, N).astype('float32')
+    flat_label = rng.randint(0, N, size=(5, 1)).astype('int64')
+    lens = [3, 2]
+    emission_t = create_lod_tensor(flat_emission, [lens])
+    label_t = create_lod_tensor(flat_label, [lens])
+
+    prog, startup = Program(), Program()
+    with program_guard(prog, startup):
+        em = fluid.layers.data(name='em', shape=[N], dtype='float32',
+                               lod_level=1)
+        lb = fluid.layers.data(name='lb', shape=[1], dtype='int64',
+                               lod_level=1)
+        crf = layers.linear_chain_crf(
+            em, lb, param_attr=fluid.ParamAttr(name='crfw'))
+        decode = layers.crf_decoding(
+            em, param_attr=fluid.ParamAttr(name='crfw'))
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    nll, path = exe.run(prog, feed={'em': emission_t, 'lb': label_t},
+                        fetch_list=[crf, decode])
+    transition = np.array(fluid.fetch_var('crfw'))
+
+    padded_em = np.zeros((B, T, N), 'f4')
+    padded_em[0] = flat_emission[:3]
+    padded_em[1, :2] = flat_emission[3:]
+    padded_lb = np.zeros((B, T), 'i8')
+    padded_lb[0] = flat_label[:3, 0]
+    padded_lb[1, :2] = flat_label[3:, 0]
+
+    log_z, best_paths = _brute_force_crf(padded_em, transition, lens)
+    start, end, trans = transition[0], transition[1], transition[2:]
+    for b in range(B):
+        L = lens[b]
+        lab = padded_lb[b]
+        gold = start[lab[0]] + padded_em[b, 0, lab[0]] + end[lab[L - 1]]
+        for t in range(1, L):
+            gold += trans[lab[t - 1], lab[t]] + padded_em[b, t, lab[t]]
+        np.testing.assert_allclose(nll[b, 0], log_z[b] - gold, rtol=1e-4)
+        np.testing.assert_allclose(path[b, :L, 0], best_paths[b][:L])
+
+
+def test_sequence_expand_broadcast():
+    prog, startup = Program(), Program()
+    with program_guard(prog, startup):
+        x = fluid.layers.data(name='x', shape=[3], dtype='float32')
+        y = fluid.layers.data(name='y', shape=[2], dtype='float32',
+                              lod_level=1)
+        out = layers.sequence_expand(x, y)
+    xv = np.arange(6, dtype='float32').reshape(2, 3)
+    flat_y = np.zeros((5, 2), 'f4')
+    yt = create_lod_tensor(flat_y, [[2, 3]])
+    r, = _run(prog, {'x': xv, 'y': yt}, [out])
+    assert r.shape == (2, 3, 3)
+    np.testing.assert_allclose(r[0, 0], xv[0])
+    np.testing.assert_allclose(r[1, 2], xv[1])
