@@ -37,6 +37,9 @@ from .executor import (Executor, Scope, global_scope, scope_guard,
 from . import lod_tensor
 from .lod_tensor import LoDTensor, create_lod_tensor, \
     create_random_int_lodtensor
+from . import reader
+from .batch import batch  # noqa: F401
+from . import dataset
 from . import io
 from . import nets
 from . import metrics

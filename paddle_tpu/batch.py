@@ -1,0 +1,21 @@
+"""paddle.batch (reference python/paddle/batch.py): group a sample reader
+into a minibatch reader."""
+from __future__ import annotations
+
+__all__ = ['batch']
+
+
+def batch(reader, batch_size, drop_last=False):
+    def batch_reader():
+        r = reader()
+        b = []
+        for instance in r:
+            b.append(instance)
+            if len(b) == batch_size:
+                yield b
+                b = []
+        if b and not drop_last:
+            yield b
+    if batch_size <= 0:
+        raise ValueError('batch_size should be a positive integer')
+    return batch_reader

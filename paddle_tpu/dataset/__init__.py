@@ -1,0 +1,22 @@
+"""Built-in datasets (reference python/paddle/dataset/: 15 modules with
+download+cache). This environment has no network egress, so each module
+serves DETERMINISTIC SYNTHETIC data with the exact sample format, dtypes,
+vocab objects, and reader-creator API of the original -- training code is
+source-compatible; only the underlying bytes differ. Real-data loading can
+be re-enabled by dropping files into common.DATA_HOME."""
+from . import common  # noqa: F401
+from . import uci_housing  # noqa: F401
+from . import mnist  # noqa: F401
+from . import cifar  # noqa: F401
+from . import imdb  # noqa: F401
+from . import imikolov  # noqa: F401
+from . import movielens  # noqa: F401
+from . import conll05  # noqa: F401
+from . import sentiment  # noqa: F401
+from . import wmt14  # noqa: F401
+from . import wmt16  # noqa: F401
+from . import flowers  # noqa: F401
+from . import uci_housing as housing  # noqa: F401
+
+__all__ = ['common', 'uci_housing', 'mnist', 'cifar', 'imdb', 'imikolov',
+           'movielens', 'conll05', 'sentiment', 'wmt14', 'wmt16', 'flowers']

@@ -1,0 +1,40 @@
+"""CIFAR-10/100 (reference python/paddle/dataset/cifar.py): samples are
+(3072 float32 in [0,1] laid out CHW, int label). Synthetic class-blob data."""
+from __future__ import annotations
+
+import numpy as np
+
+from . import common
+
+__all__ = ['train10', 'test10', 'train100', 'test100']
+
+_N_TRAIN, _N_TEST = 4096, 512
+
+
+def _creator(nclass, split, n):
+    rng_m = common.synthetic_rng('cifar%d' % nclass, 'means')
+    means = rng_m.rand(nclass, 3072).astype('float32')
+
+    def reader():
+        rng = common.synthetic_rng('cifar%d' % nclass, split)
+        for _ in range(n):
+            label = int(rng.randint(0, nclass))
+            img = means[label] + 0.2 * rng.randn(3072).astype('float32')
+            yield np.clip(img, 0.0, 1.0).astype('float32'), label
+    return reader
+
+
+def train10():
+    return _creator(10, 'train', _N_TRAIN)
+
+
+def test10():
+    return _creator(10, 'test', _N_TEST)
+
+
+def train100():
+    return _creator(100, 'train', _N_TRAIN)
+
+
+def test100():
+    return _creator(100, 'test', _N_TEST)

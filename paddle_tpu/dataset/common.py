@@ -1,0 +1,45 @@
+"""Dataset plumbing (reference python/paddle/dataset/common.py): cache dir,
+md5 checks, and the synthetic-data convention used by every module here."""
+from __future__ import annotations
+
+import hashlib
+import os
+
+import numpy as np
+
+__all__ = ['DATA_HOME', 'md5file', 'synthetic_rng']
+
+DATA_HOME = os.path.expanduser('~/.cache/paddle_tpu/dataset')
+
+
+def must_mkdirs(path):
+    os.makedirs(path, exist_ok=True)
+    return path
+
+
+def md5file(fname):
+    hash_md5 = hashlib.md5()
+    with open(fname, 'rb') as f:
+        for chunk in iter(lambda: f.read(4096), b''):
+            hash_md5.update(chunk)
+    return hash_md5.hexdigest()
+
+
+def download(url, module_name, md5sum, save_name=None):
+    """No-egress environment: if the file was pre-placed under DATA_HOME it
+    is used; otherwise callers fall back to synthetic data."""
+    dirname = os.path.join(DATA_HOME, module_name)
+    filename = os.path.join(
+        dirname, save_name or url.split('/')[-1])
+    if os.path.exists(filename):
+        return filename
+    raise IOError(
+        'no network egress: %s not cached under %s (synthetic data is '
+        'served instead by the dataset module)' % (url, dirname))
+
+
+def synthetic_rng(module_name, split):
+    """Deterministic per-(dataset, split) generator."""
+    seed = int(hashlib.md5(
+        ('%s/%s' % (module_name, split)).encode()).hexdigest()[:8], 16)
+    return np.random.RandomState(seed)

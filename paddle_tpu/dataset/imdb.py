@@ -1,0 +1,45 @@
+"""IMDB sentiment (reference python/paddle/dataset/imdb.py): samples are
+(list of word ids, 0/1 label). Synthetic: two vocab regions are biased by
+class so sentiment models genuinely learn; word_dict() matches the
+reference contract (word -> id, '<unk>' included)."""
+from __future__ import annotations
+
+import numpy as np
+
+from . import common
+
+__all__ = ['train', 'test', 'word_dict']
+
+_VOCAB = 5147          # smallish; reference's is ~5147 after cutoff
+_N_TRAIN, _N_TEST = 2048, 512
+
+
+def word_dict():
+    d = {('w%04d' % i): i for i in range(_VOCAB - 1)}
+    d['<unk>'] = _VOCAB - 1
+    return d
+
+
+def _creator(split, n):
+    def reader():
+        rng = common.synthetic_rng('imdb', split)
+        half = _VOCAB // 2
+        for _ in range(n):
+            label = int(rng.randint(0, 2))
+            length = int(rng.randint(8, 60))
+            # positive reviews draw 70% from the upper vocab half
+            biased = rng.rand(length) < 0.7
+            ids = np.where(
+                biased == bool(label),
+                rng.randint(half, _VOCAB - 1, length),
+                rng.randint(0, half, length))
+            yield ids.astype('int64').tolist(), label
+    return reader
+
+
+def train(word_idx=None):
+    return _creator('train', _N_TRAIN)
+
+
+def test(word_idx=None):
+    return _creator('test', _N_TEST)

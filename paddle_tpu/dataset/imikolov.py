@@ -1,0 +1,42 @@
+"""imikolov / PTB n-gram LM data (reference python/paddle/dataset/
+imikolov.py): build_dict() -> word->id; train(word_idx, n) yields n-gram
+id tuples (the word2vec book config). Synthetic markov-ish id streams."""
+from __future__ import annotations
+
+from . import common
+
+__all__ = ['build_dict', 'train', 'test', 'N']
+
+N = 5
+_VOCAB = 2074          # reference dict ~2074 after min_word_freq cutoff
+_N_TRAIN, _N_TEST = 4096, 512
+
+
+def build_dict(min_word_freq=50):
+    d = {('w%04d' % i): i for i in range(_VOCAB - 2)}
+    d['<s>'] = _VOCAB - 2
+    d['<e>'] = _VOCAB - 1
+    return d
+
+
+def _creator(split, n_samples, word_idx, n):
+    vocab = len(word_idx)
+
+    def reader():
+        rng = common.synthetic_rng('imikolov', split)
+        for _ in range(n_samples):
+            # weak sequential correlation: next id near previous
+            ids = [int(rng.randint(0, vocab))]
+            for _ in range(n - 1):
+                step = int(rng.randint(-20, 21))
+                ids.append(int((ids[-1] + step) % vocab))
+            yield tuple(ids)
+    return reader
+
+
+def train(word_idx, n=N):
+    return _creator('train', _N_TRAIN, word_idx, n)
+
+
+def test(word_idx, n=N):
+    return _creator('test', _N_TEST, word_idx, n)

@@ -1,0 +1,66 @@
+"""MovieLens-1M (reference python/paddle/dataset/movielens.py): the
+recommender book config. Samples: (user_id, gender_id, age_id, job_id,
+movie_id, category_ids, title_ids, score). Synthetic with reference-shaped
+vocab sizes."""
+from __future__ import annotations
+
+from . import common
+
+__all__ = ['train', 'test', 'max_user_id', 'max_movie_id', 'max_job_id',
+           'age_table', 'movie_categories', 'get_movie_title_dict']
+
+_MAX_USER, _MAX_MOVIE, _MAX_JOB = 6040, 3952, 20
+_N_CATEGORIES, _TITLE_VOCAB = 18, 1512
+_N_TRAIN, _N_TEST = 4096, 512
+
+age_table = [1, 18, 25, 35, 45, 50, 56]
+
+
+def max_user_id():
+    return _MAX_USER
+
+
+def max_movie_id():
+    return _MAX_MOVIE
+
+
+def max_job_id():
+    return _MAX_JOB
+
+
+def movie_categories():
+    return {('cat%02d' % i): i for i in range(_N_CATEGORIES)}
+
+
+def get_movie_title_dict():
+    return {('t%04d' % i): i for i in range(_TITLE_VOCAB)}
+
+
+def _creator(split, n):
+    def reader():
+        rng = common.synthetic_rng('movielens', split)
+        for _ in range(n):
+            user_id = int(rng.randint(1, _MAX_USER + 1))
+            gender_id = int(rng.randint(0, 2))
+            age_id = int(rng.randint(0, len(age_table)))
+            job_id = int(rng.randint(0, _MAX_JOB + 1))
+            movie_id = int(rng.randint(1, _MAX_MOVIE + 1))
+            n_cat = int(rng.randint(1, 4))
+            categories = rng.randint(0, _N_CATEGORIES, n_cat)
+            n_title = int(rng.randint(1, 6))
+            title = rng.randint(0, _TITLE_VOCAB, n_title)
+            # score correlates with (user+movie) parity so models can learn
+            base = 1.0 + 4.0 * (((user_id + movie_id) % 97) / 96.0)
+            score = float(min(5.0, max(1.0, base + 0.3 * rng.randn())))
+            yield (user_id, gender_id, age_id, job_id, movie_id,
+                   categories.astype('int64').tolist(),
+                   title.astype('int64').tolist(), score)
+    return reader
+
+
+def train():
+    return _creator('train', _N_TRAIN)
+
+
+def test():
+    return _creator('test', _N_TEST)

@@ -1,0 +1,19 @@
+"""Movie-review sentiment (reference python/paddle/dataset/sentiment.py).
+Same sample format as imdb; kept as its own module for API parity."""
+from __future__ import annotations
+
+from . import imdb
+
+__all__ = ['get_word_dict', 'train', 'test']
+
+
+def get_word_dict():
+    return imdb.word_dict()
+
+
+def train():
+    return imdb.train()
+
+
+def test():
+    return imdb.test()

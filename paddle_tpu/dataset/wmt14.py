@@ -1,0 +1,34 @@
+"""WMT-14 fr->en (reference python/paddle/dataset/wmt14.py): the
+machine_translation book config. Samples: (src_ids, trg_ids_with_<s>,
+trg_ids_with_<e>). Synthetic id sequences where trg is a noisy transform of
+src, so seq2seq attention genuinely learns."""
+from __future__ import annotations
+
+from . import common
+
+__all__ = ['train', 'test', 'N']
+
+N = 30000               # reference dict size per side
+
+
+def _creator(split, n_samples, dict_size):
+    def reader():
+        rng = common.synthetic_rng('wmt14', split)
+        for _ in range(n_samples):
+            slen = int(rng.randint(3, 12))
+            src = rng.randint(3, dict_size, slen).astype('int64')
+            # target: reversed source with small perturbation (learnable)
+            trg = ((src[::-1] + 7) % dict_size)
+            trg = [max(3, int(t)) for t in trg]
+            yield (src.tolist(),
+                   [0] + trg,        # <s> prefix
+                   trg + [1])        # <e> suffix
+    return reader
+
+
+def train(dict_size):
+    return _creator('train', 2048, dict_size)
+
+
+def test(dict_size):
+    return _creator('test', 256, dict_size)

@@ -1,0 +1,34 @@
+"""WMT-16 en<->de (reference python/paddle/dataset/wmt16.py). Same synthetic
+scheme as wmt14 with the reference's (src, trg, trg_next) sample format."""
+from __future__ import annotations
+
+from . import common
+
+__all__ = ['train', 'test', 'get_dict']
+
+
+def get_dict(lang, dict_size, reverse=False):
+    d = {('%s%05d' % (lang, i)): i for i in range(dict_size)}
+    if reverse:
+        return {v: k for k, v in d.items()}
+    return d
+
+
+def _creator(split, n_samples, src_dict_size, trg_dict_size):
+    def reader():
+        rng = common.synthetic_rng('wmt16', split)
+        for _ in range(n_samples):
+            slen = int(rng.randint(3, 12))
+            src = rng.randint(3, src_dict_size, slen).astype('int64')
+            trg = ((src[::-1] + 11) % trg_dict_size)
+            trg = [max(3, int(t)) for t in trg]
+            yield (src.tolist(), [0] + trg, trg + [1])
+    return reader
+
+
+def train(src_dict_size, trg_dict_size, src_lang='en'):
+    return _creator('train', 2048, src_dict_size, trg_dict_size)
+
+
+def test(src_dict_size, trg_dict_size, src_lang='en'):
+    return _creator('test', 256, src_dict_size, trg_dict_size)

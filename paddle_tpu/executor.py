@@ -214,7 +214,7 @@ class EmitContext(object):
     for IR-level constant folding, e.g. tensor-array indices)."""
 
     __slots__ = ('env', 'block', 'rng_key', 'is_test', '_op_index',
-                 '_block_pos')
+                 '_block_pos', '_fold_limits')
 
     def __init__(self, env, block, rng_key, is_test):
         self.env = env
@@ -223,6 +223,11 @@ class EmitContext(object):
         self.is_test = is_test
         self._op_index = 0
         self._block_pos = 0
+        # block idx -> op-position limit for IR constant folding: inside a
+        # sub-block, ancestor blocks may only be scanned up to the
+        # enclosing control-flow op's position (ops after it haven't
+        # "happened" yet)
+        self._fold_limits = {}
 
     def get(self, name):
         try:

@@ -62,6 +62,8 @@ class LayerHelper(object):
                 continue
             if getattr(v, 'seq_lens', None) is None and v.name != lens.name:
                 v.seq_lens = lens
+                if v.lod_level == 0:
+                    v.lod_level = 1
 
     # -- inputs ------------------------------------------------------------
     def multiple_input(self, input_param_name='input'):

@@ -57,13 +57,8 @@ def fc(input, size, num_flatten_dims=1, param_attr=None, bias_attr=None,
         helper.append_op(type='sum', inputs={'X': mul_results},
                          outputs={'Out': [pre_bias]})
     pre_act = helper.append_bias_op(pre_bias, dim_start=num_flatten_dims)
-    out = helper.append_activation(pre_act)
-    # seq_lens companion flows via LayerHelper._propagate_seq_lens;
-    # lod_level still needs the explicit copy (shape inference keeps 0)
-    first_in = input[0] if isinstance(input, (list, tuple)) else input
-    if getattr(first_in, 'seq_lens', None) is not None:
-        out.lod_level = first_in.lod_level
-    return out
+    # seq_lens + lod_level flow via LayerHelper._propagate_seq_lens
+    return helper.append_activation(pre_act)
 
 
 def embedding(input, size, is_sparse=False, is_distributed=False,
@@ -83,8 +78,6 @@ def embedding(input, size, is_sparse=False, is_distributed=False,
         outputs={'Out': [tmp]},
         attrs={'is_sparse': is_sparse, 'is_distributed': is_distributed,
                'padding_idx': padding_idx})
-    if getattr(input, 'seq_lens', None) is not None:
-        tmp.lod_level = input.lod_level
     return tmp
 
 

@@ -109,12 +109,9 @@ def sequence_conv(input, num_filters, filter_size=3, filter_stride=1,
         attrs={'contextStride': filter_stride,
                'contextStart': -int(filter_size // 2),
                'contextLength': filter_size})
-    _propagate_lens(input, out)
     out = helper.append_bias_op(out, dim_start=len(out.shape) - 1)
     out = helper.append_activation(out)
-    out.seq_lens = getattr(input, 'seq_lens', None)
-    out.lod_level = max(1, input.lod_level)
-    return out
+    return _propagate_lens(input, out)
 
 
 def sequence_pool(input, pool_type, is_test=False):
@@ -160,13 +157,28 @@ def sequence_expand(x, y, ref_level=-1, name=None):
     return _propagate_lens(y, out)
 
 
-def sequence_concat(input, name=None):
+def sequence_concat(input, axis=0, name=None):
+    """axis=0 (reference default): join sequences along time, lengths add.
+    axis>=1: concatenate features."""
     helper = LayerHelper('sequence_concat', name=name)
     out = helper.create_variable_for_type_inference(input[0].dtype)
-    helper.append_op(type='sequence_concat',
-                     inputs=_seq_inputs({'X': list(input)}, input[0]),
-                     outputs={'Out': [out]})
-    return _propagate_lens(input[0], out)
+    out_lens = helper.create_variable_for_type_inference('int32')
+    inputs = {'X': list(input)}
+    lens_vars = [getattr(v, 'seq_lens', None) for v in input]
+    if any(lv is not None for lv in lens_vars):
+        # every input needs a lengths entry for positional pairing
+        inputs['SeqLens'] = [
+            lv if lv is not None else input[i]
+            for i, lv in enumerate(lens_vars)]
+        if any(lv is None for lv in lens_vars):
+            raise ValueError('sequence_concat: all inputs need seq_lens '
+                             'when any has one')
+    helper.append_op(type='sequence_concat', inputs=inputs,
+                     outputs={'Out': [out], 'OutLens': [out_lens]},
+                     attrs={'axis': axis})
+    out.seq_lens = out_lens
+    out.lod_level = max(1, input[0].lod_level)
+    return out
 
 
 def cos_sim(X, Y):

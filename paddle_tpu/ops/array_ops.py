@@ -46,8 +46,11 @@ def _concrete_index(ctx, op, slot='I'):
                 'recurrences. Use StaticRNN/DynamicRNN for in-loop arrays.'
                 % (op.type, n, o.type))
         if block.parent_block is not None:
-            return fold(block.parent_block, n,
-                        len(block.parent_block.ops))
+            parent = block.parent_block
+            limits = getattr(ctx, '_fold_limits', {})
+            # only ops BEFORE the enclosing control-flow op have happened;
+            # without a recorded limit fall back to scanning nothing extra
+            return fold(parent, n, limits.get(parent.idx, len(parent.ops)))
         raise RuntimeError(
             '%s index %r has no constant producer in this block (is it a '
             'feed?)' % (op.type, n))

@@ -27,7 +27,7 @@ def _sub_block(ctx, op):
 
 
 def _run_sub_block(env, sub_block, rng_key, is_test, base_index,
-                   iter_index=None):
+                   iter_index=None, parent_ctx=None):
     """Trace every op of a sub-block against `env` (a plain dict).
     iter_index: traced loop counter; folded into the RNG key so stateful
     ops (dropout...) draw fresh randomness every iteration."""
@@ -36,6 +36,11 @@ def _run_sub_block(env, sub_block, rng_key, is_test, base_index,
     if rng_key is not None and iter_index is not None:
         rng_key = jax.random.fold_in(rng_key, iter_index)
     sub_ctx = EmitContext(env, sub_block, rng_key, is_test)
+    if parent_ctx is not None:
+        sub_ctx._fold_limits = dict(
+            getattr(parent_ctx, '_fold_limits', {}))
+        sub_ctx._fold_limits[parent_ctx.block.idx] = \
+            getattr(parent_ctx, '_block_pos', len(parent_ctx.block.ops))
     for i, sop in enumerate(sub_block.ops):
         sub_ctx._op_index = base_index * 1009 + i
         sub_ctx._block_pos = i
@@ -100,7 +105,7 @@ def _while_emit(ctx, op):
         env = dict(ext_env)
         env.update(zip(carried, vals))
         _run_sub_block(env, sub_block, ctx.rng_key, ctx.is_test,
-                       ctx._op_index, iter_index=it)
+                       ctx._op_index, iter_index=it, parent_ctx=ctx)
         return (tuple(env[n] for n in carried), it + 1)
 
     init = (tuple(ctx.env[n] for n in carried), jnp.zeros((), jnp.int32))
@@ -155,7 +160,8 @@ def _cond_block_emit(ctx, op):
     def true_fn(out_vals):
         env = dict(ext_env)
         env.update(zip(out_names, out_vals))
-        _run_sub_block(env, sub_block, ctx.rng_key, ctx.is_test, op_index)
+        _run_sub_block(env, sub_block, ctx.rng_key, ctx.is_test, op_index,
+                       parent_ctx=ctx)
         return tuple(env[n] for n in out_names)
 
     def false_fn(out_vals):
@@ -218,7 +224,7 @@ def _recurrent_fwd(ctx, op):
         for name, val in zip(step_input_names, xs):
             env[name] = val
         _run_sub_block(env, sub_block, rng_key, is_test, op_index,
-                       iter_index=t)
+                       iter_index=t, parent_ctx=ctx)
         new_states = [env[n] for n in state_names]
         if seq_lens is not None:
             # masked recurrence: rows whose sequence already ended keep

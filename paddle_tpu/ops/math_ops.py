@@ -24,18 +24,31 @@ from ..registry import (register_op, op_emitter, same_shape_infer,
 # ---------------------------------------------------------------------------
 
 
-def _broadcast_y(x, y, axis):
+def _declared_rank(ctx, op, slot):
+    """Rank recorded by shape inference for an input var, or None."""
+    try:
+        v = ctx.var(op.single_input(slot))
+    except (KeyError, AttributeError):
+        return None
+    return len(v.shape) if v.shape is not None else None
+
+
+def _broadcast_y(x, y, axis, x_declared_rank=None):
     if x.ndim == y.ndim:
         return y
     if axis != -1:
+        # padded-sequence runtime inserts the time axis at position 1
+        # (runtime rank = declared rank + 1), shifting alignment targets
+        # at positions >= 1 right by one. Decided from DECLARED rank, not
+        # runtime-shape guessing (a T that equals a bias dim must not
+        # change semantics).
+        if x_declared_rank is not None and x.ndim == x_declared_rank + 1 \
+                and axis >= 1:
+            axis += 1
         new_shape = [1] * axis + list(y.shape) + \
             [1] * (x.ndim - axis - y.ndim)
-        if len(new_shape) == x.ndim and all(
-                n in (1, s) for n, s in zip(new_shape, x.shape)):
+        if len(new_shape) == x.ndim:
             return y.reshape(new_shape)
-        # declared-rank alignment doesn't fit the runtime shape -- the
-        # padded-sequence layout inserts a time axis after batch (runtime
-        # rank = declared rank + 1) -- so align to trailing dims instead
     axis = x.ndim - y.ndim
     return y.reshape([1] * axis + list(y.shape))
 
@@ -47,7 +60,9 @@ def _register_elementwise(name, fn):
         x = ctx.get(op.single_input('X'))
         y = ctx.get(op.single_input('Y'))
         axis = op.attr('axis', -1)
-        ctx.set(op.single_output('Out'), fn(x, _broadcast_y(x, y, axis)))
+        ctx.set(op.single_output('Out'),
+                fn(x, _broadcast_y(x, y, axis,
+                                   _declared_rank(ctx, op, 'X'))))
 
     def infer(op, block):
         x = block.var_recursive(op.single_input('X'))
@@ -83,20 +98,21 @@ def _mul_emit(ctx, op):
     ync = op.attr('y_num_col_dims', 1)
     y2 = y.reshape(int(np.prod(y.shape[:ync])), -1)
     k = y2.shape[0]
-    # honor the declared x_num_col_dims contract when it fits; when it
-    # doesn't (padded-sequence runtime rank = declared rank + 1, e.g.
-    # [B, T, D] @ [D, H] built as [B, D] @ [D, H]) contract however many
-    # TRAILING dims multiply to k instead
-    nd = x.ndim - xnc
+    # number of contracted trailing dims comes from the DECLARED rank:
+    # the padded-sequence runtime inserts a time axis at position 1, so
+    # the trailing (declared_rank - xnc) feature dims are unchanged.
+    # ([B,T,D] built as [B,D]@[D,H] contracts 1 dim -> [B,T,H]; a batch
+    # whose max length is 1 must NOT collapse to [B,H].)
+    declared = _declared_rank(ctx, op, 'X')
+    if declared is not None and x.ndim == declared + 1 and xnc >= 1:
+        nd = declared - xnc
+    else:
+        nd = x.ndim - xnc
     if int(np.prod(x.shape[x.ndim - nd:])) != k:
-        prod, nd = 1, 0
-        while prod < k and nd < x.ndim:
-            nd += 1
-            prod *= x.shape[x.ndim - nd]
-        if prod != k:
-            raise ValueError(
-                'mul: cannot align x shape %s with contraction size %d'
-                % (x.shape, k))
+        raise ValueError(
+            'mul: cannot align x shape %s (declared rank %s, '
+            'x_num_col_dims %d) with contraction size %d'
+            % (x.shape, declared, xnc, k))
     x2 = x.reshape(-1, int(np.prod(x.shape[x.ndim - nd:])))
     out2 = jnp.matmul(x2, y2, preferred_element_type=x2.dtype)
     out_shape = x.shape[:x.ndim - nd] + y.shape[ync:]

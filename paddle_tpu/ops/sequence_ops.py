@@ -533,26 +533,72 @@ register_op('crf_decoding', infer_shape=_crf_decoding_infer, no_grad=True)
 
 
 # ---------------------------------------------------------------------------
-# sequence_concat / sequence_reshape / sequence_slice -- padded analogs
+# sequence_concat (reference sequence_concat_op.cc): DEFAULT axis=0 joins
+# each row's sequences along TIME (row b = seq_a_b ++ seq_b_b, lengths
+# add); axis>=1 concatenates features. Outputs OutLens (the new lengths).
 # ---------------------------------------------------------------------------
 
 @op_emitter('sequence_concat')
 def _sequence_concat_emit(ctx, op):
     xs = [ctx.get(n) for n in op.input('X')]
-    ctx.set(op.single_output('Out'), jnp.concatenate(xs, axis=-1))
+    axis = op.attr('axis', 0)
+    if axis != 0:
+        ctx.set(op.single_output('Out'), jnp.concatenate(xs, axis=-1))
+        if op.output('OutLens'):
+            B, T = xs[0].shape[0], xs[0].shape[1]
+            lens0 = (ctx.get(op.input('SeqLens')[0])
+                     if op.input('SeqLens')
+                     else jnp.full((B,), T, jnp.int32))
+            ctx.set(op.single_output('OutLens'), lens0)
+        return
+    B = xs[0].shape[0]
+    lens_list = []
+    for i, x in enumerate(xs):
+        if op.input('SeqLens') and i < len(op.input('SeqLens')):
+            lens_list.append(ctx.get(op.input('SeqLens')[i]))
+        else:
+            lens_list.append(jnp.full((B,), x.shape[1], jnp.int32))
+    T_out = sum(x.shape[1] for x in xs)
+    # out[b, t] = xs[k][b, t - offset_k(b)] where offset_k(b) is the sum of
+    # this row's earlier lengths: build by scattering each part at its
+    # per-row offset via gather indices
+    t_idx = jnp.arange(T_out)[None, :]                       # [1, Tout]
+    out = jnp.zeros((B, T_out) + xs[0].shape[2:], xs[0].dtype)
+    offset = jnp.zeros((B,), jnp.int32)
+    for x, lens in zip(xs, lens_list):
+        rel = t_idx - offset[:, None]                        # [B, Tout]
+        valid = (rel >= 0) & (rel < lens[:, None])
+        rel_c = jnp.clip(rel, 0, x.shape[1] - 1)
+        gathered = jnp.take_along_axis(
+            x, rel_c.reshape((B, T_out) + (1,) * (x.ndim - 2)), axis=1)
+        vmask = valid.reshape((B, T_out) + (1,) * (x.ndim - 2))
+        out = jnp.where(vmask, gathered, out)
+        offset = offset + lens
+    ctx.set(op.single_output('Out'), out)
+    if op.output('OutLens'):
+        ctx.set(op.single_output('OutLens'), offset)
 
 
 def _sequence_concat_infer(op, block):
     x0 = block.var_recursive(op.input('X')[0])
     out = block.var_recursive(op.single_output('Out'))
-    last = sum(block.var_recursive(n).shape[-1] for n in op.input('X'))
-    out.shape = tuple(x0.shape[:-1]) + (last,)
+    axis = op.attr('axis', 0)
+    if axis != 0:
+        last = sum(block.var_recursive(n).shape[-1] for n in op.input('X'))
+        out.shape = tuple(x0.shape[:-1]) + (last,)
+    else:
+        out.shape = x0.shape
     out.dtype = x0.dtype
     out.lod_level = max(1, x0.lod_level)
+    if op.output('OutLens'):
+        lv = block.var_recursive(op.single_output('OutLens'))
+        lv.shape = (-1,)
+        lv.dtype = 'int32'
 
 
 register_op('sequence_concat', infer_shape=_sequence_concat_infer)
-register_vjp_grad('sequence_concat', in_slots=('X',))
+register_vjp_grad('sequence_concat', in_slots=('X',),
+                  nondiff_slots=('SeqLens',))
 
 
 @op_emitter('sequence_first_step')

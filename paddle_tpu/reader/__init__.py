@@ -1,0 +1,11 @@
+"""Composable data-reader decorators (reference python/paddle/reader/
+decorator.py:33-341). A *reader* is a zero-arg callable returning an
+iterable of samples; a *reader creator* builds readers. All pure host-side
+Python -- identical contract to the reference."""
+from .decorator import (map_readers, buffered, shuffle, chain, compose,
+                        firstn, xmap_readers, cache, multiprocess_reader,
+                        PipeReader)
+
+__all__ = ['map_readers', 'buffered', 'shuffle', 'chain', 'compose',
+           'firstn', 'xmap_readers', 'cache', 'multiprocess_reader',
+           'PipeReader']

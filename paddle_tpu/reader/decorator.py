@@ -1,0 +1,247 @@
+"""Reader decorators (reference python/paddle/reader/decorator.py).
+
+Same semantics as the reference: shuffle:55 (windowed), buffered:169
+(background-thread prefetch queue), map_readers:33, xmap_readers:240
+(thread pool + optional ordering), chain/compose/firstn, cache, PipeReader:341.
+"""
+from __future__ import annotations
+
+import itertools
+import random
+import subprocess
+import threading
+from queue import Queue
+
+__all__ = ['map_readers', 'buffered', 'shuffle', 'chain', 'compose',
+           'firstn', 'xmap_readers', 'cache', 'multiprocess_reader',
+           'PipeReader']
+
+
+def map_readers(func, *readers):
+    """Apply func elementwise across samples drawn from several readers."""
+    def reader():
+        rs = [r() for r in readers]
+        for vals in zip(*rs):
+            yield func(*vals)
+    return reader
+
+
+def shuffle(reader, buf_size):
+    """Windowed shuffle: fill a buffer of buf_size, shuffle, drain."""
+    def data_reader():
+        buf = []
+        for e in reader():
+            buf.append(e)
+            if len(buf) >= buf_size:
+                random.shuffle(buf)
+                for b in buf:
+                    yield b
+                buf = []
+        if buf:
+            random.shuffle(buf)
+            for b in buf:
+                yield b
+    return data_reader
+
+
+def chain(*readers):
+    """Concatenate readers back to back."""
+    def reader():
+        for r in readers:
+            for e in r():
+                yield e
+    return reader
+
+
+class ComposeNotAligned(ValueError):
+    pass
+
+
+def compose(*readers, **kwargs):
+    """Zip readers into tuple samples; check_alignment raises if one reader
+    ends early (reference decorator.py compose)."""
+    check_alignment = kwargs.pop('check_alignment', True)
+
+    def make_tuple(x):
+        return x if isinstance(x, tuple) else (x,)
+
+    def reader():
+        rs = [r() for r in readers]
+        if not check_alignment:
+            for outputs in zip(*rs):
+                yield sum(map(make_tuple, outputs), ())
+        else:
+            for outputs in itertools.zip_longest(*rs):
+                if any(o is None for o in outputs):
+                    raise ComposeNotAligned(
+                        'outputs of readers are not aligned')
+                yield sum(map(make_tuple, outputs), ())
+    return reader
+
+
+def buffered(reader, size):
+    """Background-thread prefetch into a bounded queue."""
+    class _End(object):
+        pass
+
+    def data_reader():
+        r = reader()
+        q = Queue(maxsize=size)
+
+        def feed():
+            for d in r:
+                q.put(d)
+            q.put(_End)
+
+        t = threading.Thread(target=feed, daemon=True)
+        t.start()
+        while True:
+            e = q.get()
+            if e is _End:
+                break
+            yield e
+    return data_reader
+
+
+def firstn(reader, n):
+    def data_reader():
+        for i, item in enumerate(reader()):
+            if i == n:
+                break
+            yield item
+    return data_reader
+
+
+def cache(reader):
+    """Materialize once, replay from memory thereafter."""
+    all_data = []
+    filled = [False]
+
+    def data_reader():
+        if not filled[0]:
+            for d in reader():
+                all_data.append(d)
+                yield d
+            filled[0] = True
+        else:
+            for d in all_data:
+                yield d
+    return data_reader
+
+
+def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
+    """Parallel map over samples with a thread pool (reference
+    decorator.py:240 -- threads, not processes, same as reference)."""
+    end = XmapEndSignal()
+
+    def data_reader():
+        in_queue = Queue(buffer_size)
+        out_queue = Queue(buffer_size)
+        out_order = [0]
+
+        def read_worker():
+            for i, d in enumerate(reader()):
+                in_queue.put((i, d) if order else d)
+            in_queue.put(end)
+
+        def handle_worker():
+            sample = in_queue.get()
+            while not isinstance(sample, XmapEndSignal):
+                if order:
+                    i, d = sample
+                    r = mapper(d)
+                    while out_order[0] != i:
+                        pass
+                    out_queue.put(r)
+                    out_order[0] += 1
+                else:
+                    out_queue.put(mapper(sample))
+                sample = in_queue.get()
+            in_queue.put(end)
+            out_queue.put(end)
+
+        threading.Thread(target=read_worker, daemon=True).start()
+        workers = []
+        for _ in range(process_num):
+            w = threading.Thread(target=handle_worker, daemon=True)
+            w.start()
+            workers.append(w)
+
+        finished = 0
+        while finished < process_num:
+            sample = out_queue.get()
+            if isinstance(sample, XmapEndSignal):
+                finished += 1
+            else:
+                yield sample
+    return data_reader
+
+
+class XmapEndSignal(object):
+    pass
+
+
+def multiprocess_reader(readers, use_pipe=True, queue_size=1000):
+    """Run multiple readers concurrently in threads and merge their output
+    (thread-backed stand-in for the reference's fork-based version; the
+    sample stream contract is identical)."""
+    def data_reader():
+        q = Queue(queue_size)
+        done = [0]
+        lock = threading.Lock()
+
+        def worker(r):
+            for s in r():
+                q.put(s)
+            with lock:
+                done[0] += 1
+                if done[0] == len(readers):
+                    q.put(XmapEndSignal())
+
+        for r in readers:
+            threading.Thread(target=worker, args=(r,), daemon=True).start()
+        while True:
+            s = q.get()
+            if isinstance(s, XmapEndSignal):
+                break
+            yield s
+    return data_reader
+
+
+class PipeReader(object):
+    """Stream samples from a shell command's stdout (reference
+    decorator.py:341)."""
+
+    def __init__(self, command, bufsize=8192, file_type='plain'):
+        if not isinstance(command, str):
+            raise TypeError('command must be a string')
+        self.command = command
+        self.bufsize = bufsize
+        self.file_type = file_type
+        if file_type not in ('plain', 'gzip'):
+            raise TypeError('file_type %s is not allowed' % file_type)
+
+    def get_line(self, cut_lines=True, line_break='\n'):
+        process = subprocess.Popen(
+            self.command.split(' '), bufsize=self.bufsize,
+            stdout=subprocess.PIPE)
+        if self.file_type == 'gzip':
+            import zlib
+            dec = zlib.decompressobj(32 + zlib.MAX_WBITS)
+        remained = ''
+        while True:
+            buff = process.stdout.read(self.bufsize)
+            if not buff:
+                break
+            if self.file_type == 'gzip':
+                buff = dec.decompress(buff)
+            buff = buff.decode('utf-8', errors='ignore')
+            if cut_lines:
+                lines = (remained + buff).split(line_break)
+                remained = lines.pop(-1)
+                for line in lines:
+                    yield line
+            else:
+                yield buff
+        if remained:
+            yield remained

@@ -1,0 +1,135 @@
+"""Reader decorators, batch, synthetic datasets, DataFeeder
+(re-design of reference test_reader* / DataFeeder tests)."""
+import numpy as np
+
+import paddle_tpu as fluid
+import paddle_tpu.dataset as dataset
+import paddle_tpu.reader as reader
+from paddle_tpu.framework import Program, program_guard
+
+
+def _counter(n):
+    def r():
+        for i in range(n):
+            yield i
+    return r
+
+
+def test_decorators_compose():
+    r = reader.map_readers(lambda a, b: a + b, _counter(5), _counter(5))
+    assert list(r()) == [0, 2, 4, 6, 8]
+
+    r = reader.chain(_counter(2), _counter(3))
+    assert list(r()) == [0, 1, 0, 1, 2]
+
+    r = reader.compose(_counter(3), _counter(3))
+    assert list(r()) == [(0, 0), (1, 1), (2, 2)]
+
+    r = reader.firstn(_counter(100), 4)
+    assert list(r()) == [0, 1, 2, 3]
+
+    r = reader.buffered(_counter(10), 3)
+    assert sorted(r()) == list(range(10))
+
+    r = reader.shuffle(_counter(20), 10)
+    out = list(r())
+    assert sorted(out) == list(range(20))
+
+    r = reader.cache(_counter(5))
+    assert list(r()) == list(r())
+
+    r = reader.xmap_readers(lambda x: x * 2, _counter(10), 3, 4)
+    assert sorted(r()) == [2 * i for i in range(10)]
+
+
+def test_batch():
+    b = fluid.batch(_counter(7), 3)
+    batches = list(b())
+    assert [len(x) for x in batches] == [3, 3, 1]
+    b = fluid.batch(_counter(7), 3, drop_last=True)
+    assert [len(x) for x in list(b())] == [3, 3]
+
+
+def test_datasets_shapes():
+    x, y = next(dataset.uci_housing.train()())
+    assert x.shape == (13,) and y.shape == (1,)
+    img, lbl = next(dataset.mnist.train()())
+    assert img.shape == (784,) and 0 <= lbl < 10
+    img, lbl = next(dataset.cifar.train10()())
+    assert img.shape == (3072,) and 0 <= lbl < 10
+    ids, lbl = next(dataset.imdb.train()())
+    assert isinstance(ids, list) and lbl in (0, 1)
+    gram = next(dataset.imikolov.train(dataset.imikolov.build_dict())())
+    assert len(gram) == 5
+    s = next(dataset.movielens.train()())
+    assert len(s) == 8
+    s = next(dataset.conll05.test()())
+    assert len(s) == 9 and len(s[0]) == len(s[8])
+    s = next(dataset.wmt14.train(1000)())
+    assert len(s) == 3 and s[1][0] == 0 and s[2][-1] == 1
+
+
+def test_datasets_deterministic():
+    a = [s[1] for s in list(dataset.mnist.train()())[:20]]
+    b = [s[1] for s in list(dataset.mnist.train()())[:20]]
+    assert a == b
+
+
+def test_data_feeder_dense_and_lod():
+    prog, startup = Program(), Program()
+    with program_guard(prog, startup):
+        img = fluid.layers.data(name='img', shape=[784])
+        label = fluid.layers.data(name='label', shape=[1], dtype='int64')
+        words = fluid.layers.data(name='words', shape=[1], dtype='int64',
+                                  lod_level=1)
+        out = fluid.layers.fc(input=img, size=3)
+    feeder = fluid.DataFeeder(feed_list=[img, label, words],
+                              place=fluid.CPUPlace(), program=prog)
+    minibatch = [
+        (np.zeros(784, 'f4'), 3, [1, 2, 3]),
+        (np.ones(784, 'f4'), 1, [4, 5]),
+    ]
+    feed = feeder.feed(minibatch)
+    assert feed['img'].shape == (2, 784)
+    assert feed['label'].shape == (2, 1)
+    lod_t = feed['words']
+    assert lod_t.recursive_sequence_lengths() == [[3, 2]]
+
+    # the fed LoDTensor runs through a program end to end
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    r, = exe.run(prog, feed=feed, fetch_list=[out])
+    assert r.shape == (2, 3)
+
+
+def test_train_from_dataset_reader():
+    """fit_a_line wired exactly like the reference book chapter: dataset ->
+    shuffle -> batch -> DataFeeder -> exe.run."""
+    prog, startup = Program(), Program()
+    with program_guard(prog, startup):
+        x = fluid.layers.data(name='x', shape=[13], dtype='float32')
+        y = fluid.layers.data(name='y', shape=[1], dtype='float32')
+        y_predict = fluid.layers.fc(input=x, size=1, act=None)
+        cost = fluid.layers.square_error_cost(input=y_predict, label=y)
+        avg_loss = fluid.layers.mean(cost)
+        fluid.optimizer.SGD(learning_rate=0.01).minimize(avg_loss)
+
+    BATCH = 20
+    train_reader = fluid.batch(
+        reader.shuffle(dataset.uci_housing.train(), buf_size=500),
+        batch_size=BATCH)
+    feeder = fluid.DataFeeder(place=fluid.CPUPlace(), feed_list=[x, y],
+                              program=prog)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    first = last = None
+    for epoch in range(8):
+        for data in train_reader():
+            if len(data) != BATCH:
+                continue   # keep one compiled shape
+            l, = exe.run(prog, feed=feeder.feed(data),
+                         fetch_list=[avg_loss])
+            if first is None:
+                first = float(l)
+            last = float(l)
+    assert last < 0.2 * first, (first, last)

@@ -261,3 +261,57 @@ def test_sequence_expand_broadcast():
     assert r.shape == (2, 3, 3)
     np.testing.assert_allclose(r[0, 0], xv[0])
     np.testing.assert_allclose(r[1, 2], xv[1])
+
+
+def test_fc_bias_correct_when_T_equals_H():
+    """Regression: bias must broadcast over features, not time, even when
+    the padded max length equals the hidden size."""
+    H = 3
+    flat = np.zeros((5, 2), 'f4')
+    t = create_lod_tensor(flat, [[3, 2]])   # max len T == 3 == H
+    prog, startup = Program(), Program()
+    with program_guard(prog, startup):
+        x = fluid.layers.data(name='x', shape=[2], dtype='float32',
+                              lod_level=1)
+        out = layers.fc(input=x, size=H,
+                        bias_attr=fluid.ParamAttr(
+                            initializer=fluid.initializer.Constant(7.0)))
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    r, = exe.run(prog, feed={'x': t}, fetch_list=[out])
+    # zero input => every position should be exactly the bias (7)
+    np.testing.assert_allclose(r[0, :3], np.full((3, H), 7.0))
+
+
+def test_fc_keeps_time_axis_when_T_is_1():
+    """Regression: an all-length-1 batch must stay [B, 1, H] through fc so
+    downstream LSTM sees rank 3."""
+    flat = np.ones((2, 4), 'f4')
+    t = create_lod_tensor(flat, [[1, 1]])
+    prog, startup = Program(), Program()
+    with program_guard(prog, startup):
+        x = fluid.layers.data(name='x', shape=[4], dtype='float32',
+                              lod_level=1)
+        proj = layers.fc(input=x, size=4 * 3)
+        hidden, _ = layers.dynamic_lstm(proj, size=4 * 3,
+                                        use_peepholes=False)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    r, = exe.run(prog, feed={'x': t}, fetch_list=[hidden])
+    assert r.shape == (2, 1, 3)
+
+
+def test_sequence_concat_time_axis():
+    a = create_lod_tensor(np.array([[1.], [2.], [3.]], 'f4'), [[2, 1]])
+    b = create_lod_tensor(np.array([[10.], [20.], [30.]], 'f4'), [[1, 2]])
+    prog, startup = Program(), Program()
+    with program_guard(prog, startup):
+        xa = fluid.layers.data(name='a', shape=[1], dtype='float32',
+                               lod_level=1)
+        xb = fluid.layers.data(name='b', shape=[1], dtype='float32',
+                               lod_level=1)
+        out = layers.sequence_concat([xa, xb])
+        pooled = layers.sequence_pool(out, 'sum')
+    r, = _run(prog, {'a': a, 'b': b}, [pooled])
+    # row 0: [1,2] ++ [10] -> 13 ; row 1: [3] ++ [20,30] -> 53
+    np.testing.assert_allclose(r[:, 0], [13., 53.])
