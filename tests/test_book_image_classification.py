@@ -1,0 +1,58 @@
+"""Book chapter 3: image_classification (reference tests/book/
+test_image_classification.py) -- ResNet and VGG on cifar-shaped data,
+train until the loss drops, then save/load inference model."""
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.framework import Program, program_guard
+from paddle_tpu.models import resnet, vgg
+
+
+def _train(net_fn, steps=25, lr=0.01):
+    prog, startup = Program(), Program()
+    with program_guard(prog, startup):
+        images = fluid.layers.data(name='pixel', shape=[3, 32, 32],
+                                   dtype='float32')
+        label = fluid.layers.data(name='label', shape=[1], dtype='int64')
+        predict = net_fn(images)
+        cost = fluid.layers.cross_entropy(input=predict, label=label)
+        avg_cost = fluid.layers.mean(cost)
+        acc = fluid.layers.accuracy(input=predict, label=label)
+        fluid.optimizer.Adam(learning_rate=lr).minimize(avg_cost)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    rng = np.random.RandomState(0)
+    # one fixed batch: the book trains to a loss threshold; we overfit
+    xb = rng.rand(8, 3, 32, 32).astype('float32')
+    yb = rng.randint(0, 10, (8, 1)).astype('int64')
+    first = last = None
+    for _ in range(steps):
+        l, a = exe.run(prog, feed={'pixel': xb, 'label': yb},
+                       fetch_list=[avg_cost, acc])
+        if first is None:
+            first = float(l)
+        last = float(l)
+    assert np.isfinite(last)
+    assert last < first, (first, last)
+    return prog, predict, exe
+
+
+def test_resnet_cifar10_trains(tmp_path):
+    prog, predict, exe = _train(
+        lambda img: resnet.resnet_cifar10(img, class_dim=10, depth=8))
+    fluid.io.save_inference_model(str(tmp_path), ['pixel'], [predict], exe,
+                                  main_program=prog)
+    infer_prog, feed_names, fetch_vars = \
+        fluid.io.load_inference_model(str(tmp_path), exe)
+    out, = exe.run(infer_prog,
+                   feed={feed_names[0]:
+                         np.zeros((2, 3, 32, 32), 'float32')},
+                   fetch_list=fetch_vars)
+    assert out.shape == (2, 10)
+    np.testing.assert_allclose(out.sum(axis=1), 1.0, rtol=1e-4)
+
+
+def test_vgg_trains():
+    def small_vgg(img):
+        return vgg.vgg16(img, class_dim=10)
+    _train(small_vgg, steps=12, lr=0.003)

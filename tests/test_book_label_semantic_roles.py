@@ -1,0 +1,114 @@
+"""Book chapter 7: label_semantic_roles (reference tests/book/
+test_label_semantic_roles.py) -- 8 feature embeddings, stacked
+bidirectional LSTMs, linear-chain CRF loss + viterbi decoding."""
+import numpy as np
+
+import paddle_tpu as fluid
+import paddle_tpu.dataset as dataset
+from paddle_tpu import layers
+from paddle_tpu.framework import Program, program_guard
+
+WORD_DIM = 8
+MARK_DIM = 4
+HIDDEN_DIM = 32      # 4 * lstm hidden (paddle contract: fc size = 4H)
+DEPTH = 2
+
+
+def db_lstm(word, predicate, ctx_n2, ctx_n1, ctx_0, ctx_p1, ctx_p2, mark,
+            word_dict_len, pred_dict_len, mark_dict_len, label_dict_len):
+    predicate_embedding = layers.embedding(
+        input=predicate, size=[pred_dict_len, WORD_DIM])
+    mark_embedding = layers.embedding(input=mark,
+                                      size=[mark_dict_len, MARK_DIM])
+    word_input = [word, ctx_n2, ctx_n1, ctx_0, ctx_p1, ctx_p2]
+    emb_layers = [layers.embedding(
+        input=x, size=[word_dict_len, WORD_DIM],
+        param_attr=fluid.ParamAttr(name='emb')) for x in word_input]
+    emb_layers.append(predicate_embedding)
+    emb_layers.append(mark_embedding)
+
+    hidden_0_layers = [layers.fc(input=emb, size=HIDDEN_DIM, act='tanh')
+                       for emb in emb_layers]
+    hidden_0 = layers.sums(input=hidden_0_layers)
+    lstm_0, _ = layers.dynamic_lstm(input=hidden_0, size=HIDDEN_DIM,
+                                    use_peepholes=False)
+    input_tmp = [hidden_0, lstm_0]
+    for i in range(1, DEPTH):
+        mix_hidden = layers.sums(input=[
+            layers.fc(input=input_tmp[0], size=HIDDEN_DIM, act='tanh'),
+            layers.fc(input=input_tmp[1], size=HIDDEN_DIM, act='tanh')])
+        lstm, _ = layers.dynamic_lstm(input=mix_hidden, size=HIDDEN_DIM,
+                                      is_reverse=(i % 2) == 1,
+                                      use_peepholes=False)
+        input_tmp = [mix_hidden, lstm]
+
+    feature_out = layers.sums(input=[
+        layers.fc(input=input_tmp[0], size=label_dict_len, act='tanh'),
+        layers.fc(input=input_tmp[1], size=label_dict_len, act='tanh')])
+    return feature_out
+
+
+def test_label_semantic_roles_trains():
+    word_dict, verb_dict, label_dict = dataset.conll05.get_dict()
+    word_dict_len = len(word_dict)
+    label_dict_len = len(label_dict)
+    pred_dict_len = len(verb_dict)
+
+    prog, startup = Program(), Program()
+    with program_guard(prog, startup):
+        names = ['word_data', 'ctx_n2_data', 'ctx_n1_data', 'ctx_0_data',
+                 'ctx_p1_data', 'ctx_p2_data', 'verb_data', 'mark_data']
+        feeds = [fluid.layers.data(name=n, shape=[1], dtype='int64',
+                                   lod_level=1) for n in names]
+        target = fluid.layers.data(name='target', shape=[1], dtype='int64',
+                                   lod_level=1)
+        feature_out = db_lstm(feeds[0], feeds[6], feeds[1], feeds[2],
+                              feeds[3], feeds[4], feeds[5], feeds[7],
+                              word_dict_len, pred_dict_len, 2,
+                              label_dict_len)
+        crf_cost = layers.linear_chain_crf(
+            input=feature_out, label=target,
+            param_attr=fluid.ParamAttr(name='crfw'))
+        avg_cost = layers.mean(crf_cost)
+        fluid.optimizer.SGD(learning_rate=0.01).minimize(avg_cost)
+        crf_decode = layers.crf_decoding(
+            input=feature_out, param_attr=fluid.ParamAttr(name='crfw'))
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+
+    # fixed bucket: 4 sequences padded to length 10
+    samples = [s for s in list(dataset.conll05.test()())
+               if len(s[0]) <= 10][:4]
+    assert len(samples) == 4
+    T = 10
+
+    def pad_col(col_idx):
+        ids = np.zeros((4, T, 1), 'int64')
+        for i, s in enumerate(samples):
+            seq = s[col_idx][:T]
+            ids[i, :len(seq), 0] = seq
+        return ids
+
+    lens = np.array([min(len(s[0]), T) for s in samples], 'int32')
+    feed = {}
+    for k, name in enumerate(['word_data', 'ctx_n2_data', 'ctx_n1_data',
+                              'ctx_0_data', 'ctx_p1_data', 'ctx_p2_data',
+                              'verb_data', 'mark_data']):
+        # dataset column order: word, n2, n1, 0, p1, p2, verb, mark
+        feed[name] = (pad_col(k), lens)
+    # mark values are 0/1 -> vocab 2; target is column 8
+    feed['target'] = (pad_col(8), lens)
+
+    first = last = None
+    for _ in range(30):
+        l, = exe.run(prog, feed=feed, fetch_list=[avg_cost])
+        if first is None:
+            first = float(l)
+        last = float(l)
+    assert np.isfinite(last) and last < first, (first, last)
+
+    # decoding path runs and emits valid label ids
+    path, = exe.run(prog, feed=feed, fetch_list=[crf_decode])
+    assert path.shape[:2] == (4, T)
+    assert path.min() >= 0 and path.max() < label_dict_len

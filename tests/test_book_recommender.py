@@ -1,0 +1,86 @@
+"""Book chapter 5: recommender system (reference tests/book/
+test_recommender_system.py) -- user/movie feature towers, sequence-pooled
+categorical features, cosine similarity head, regression to the score."""
+import numpy as np
+
+import paddle_tpu as fluid
+import paddle_tpu.dataset as dataset
+from paddle_tpu import layers
+from paddle_tpu.framework import Program, program_guard
+
+EMB = 16
+
+
+def _tower(ids, vocab, name):
+    emb = layers.embedding(input=ids, size=[vocab, EMB],
+                           param_attr=fluid.ParamAttr(name=name))
+    return layers.fc(input=emb, size=EMB)
+
+
+def test_recommender_trains():
+    ml = dataset.movielens
+    prog, startup = Program(), Program()
+    with program_guard(prog, startup):
+        uid = fluid.layers.data(name='user_id', shape=[1], dtype='int64')
+        gender = fluid.layers.data(name='gender_id', shape=[1],
+                                   dtype='int64')
+        age = fluid.layers.data(name='age_id', shape=[1], dtype='int64')
+        job = fluid.layers.data(name='job_id', shape=[1], dtype='int64')
+        mid = fluid.layers.data(name='movie_id', shape=[1], dtype='int64')
+        cats = fluid.layers.data(name='category_id', shape=[1],
+                                 dtype='int64', lod_level=1)
+        title = fluid.layers.data(name='movie_title', shape=[1],
+                                  dtype='int64', lod_level=1)
+        score = fluid.layers.data(name='score', shape=[1], dtype='float32')
+
+        usr = layers.concat([
+            _tower(uid, ml.max_user_id() + 1, 'user_emb'),
+            _tower(gender, 2, 'gender_emb'),
+            _tower(age, len(ml.age_table), 'age_emb'),
+            _tower(job, ml.max_job_id() + 1, 'job_emb')], axis=-1)
+        usr_feat = layers.fc(input=usr, size=32, act='tanh')
+
+        mov_emb = _tower(mid, ml.max_movie_id() + 1, 'movie_emb')
+        cat_emb = layers.embedding(cats, size=[len(ml.movie_categories()),
+                                               EMB])
+        cat_pool = layers.sequence_pool(cat_emb, 'sum')
+        cat_pool = fluid.layers.reshape(cat_pool, shape=[-1, EMB])
+        title_emb = layers.embedding(title, size=[
+            len(ml.get_movie_title_dict()), EMB])
+        title_pool = layers.sequence_pool(title_emb, 'sum')
+        title_pool = fluid.layers.reshape(title_pool, shape=[-1, EMB])
+        mov = layers.concat([mov_emb, cat_pool, title_pool], axis=-1)
+        mov_feat = layers.fc(input=mov, size=32, act='tanh')
+
+        sim = layers.cos_sim(X=usr_feat, Y=mov_feat)
+        predict = layers.scale(sim, scale=5.0)
+        cost = fluid.layers.square_error_cost(input=predict, label=score)
+        avg_cost = fluid.layers.mean(cost)
+        fluid.optimizer.Adam(learning_rate=0.01).minimize(avg_cost)
+
+    feeder = fluid.DataFeeder(
+        feed_list=['user_id', 'gender_id', 'age_id', 'job_id', 'movie_id',
+                   'category_id', 'movie_title', 'score'],
+        place=fluid.CPUPlace(), program=prog)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+
+    # fixed tiny batch, pad category/title to a fixed bucket to keep one
+    # compiled shape (XLA static shapes): bucket via repetition
+    raw = list(dataset.movielens.train()())[:16]
+
+    def bucket(sample):
+        u, g, a, j, m, cat, tit, s = sample
+        cat = (cat * 3)[:3]
+        tit = (tit * 5)[:5]
+        return u, g, a, j, m, cat, tit, [s]
+
+    data = [bucket(s) for s in raw]
+    feed = feeder.feed(data)
+    first = last = None
+    for _ in range(40):
+        l, = exe.run(prog, feed=feed, fetch_list=[avg_cost])
+        if first is None:
+            first = float(l)
+        last = float(l)
+    assert np.isfinite(last) and last < 0.7 * first, (first, last)

@@ -1,0 +1,85 @@
+"""Book chapter 6: understand_sentiment (reference tests/book/
+test_understand_sentiment.py) -- both the conv (sequence_conv_pool x2) and
+stacked-LSTM variants on imdb-shaped data."""
+import numpy as np
+
+import paddle_tpu as fluid
+import paddle_tpu.dataset as dataset
+from paddle_tpu import layers, nets
+from paddle_tpu.framework import Program, program_guard
+
+EMB_DIM = 16
+HID_DIM = 16
+STACKED_NUM = 3
+CLASS_DIM = 2
+
+
+def convolution_net(data, input_dim):
+    emb = layers.embedding(input=data, size=[input_dim, EMB_DIM])
+    conv_3 = nets.sequence_conv_pool(input=emb, num_filters=HID_DIM,
+                                     filter_size=3, act='tanh',
+                                     pool_type='sqrt')
+    conv_4 = nets.sequence_conv_pool(input=emb, num_filters=HID_DIM,
+                                     filter_size=4, act='tanh',
+                                     pool_type='sqrt')
+    return layers.fc(input=[conv_3, conv_4], size=CLASS_DIM, act='softmax')
+
+
+def stacked_lstm_net(data, input_dim):
+    emb = layers.embedding(input=data, size=[input_dim, EMB_DIM])
+    fc1 = layers.fc(input=emb, size=HID_DIM)
+    lstm1, cell1 = layers.dynamic_lstm(input=fc1, size=HID_DIM,
+                                       use_peepholes=False)
+    inputs = [fc1, lstm1]
+    for i in range(2, STACKED_NUM + 1):
+        fc = layers.fc(input=inputs, size=HID_DIM)
+        lstm, cell = layers.dynamic_lstm(
+            input=fc, size=HID_DIM, is_reverse=(i % 2) == 0,
+            use_peepholes=False)
+        inputs = [fc, lstm]
+    fc_last = layers.sequence_pool(input=inputs[0], pool_type='max')
+    lstm_last = layers.sequence_pool(input=inputs[1], pool_type='max')
+    return layers.fc(input=[fc_last, lstm_last], size=CLASS_DIM,
+                     act='softmax')
+
+
+def _train(net_fn, steps=50, lr=0.005):
+    word_dict = dataset.imdb.word_dict()
+    prog, startup = Program(), Program()
+    with program_guard(prog, startup):
+        data = fluid.layers.data(name='words', shape=[1], dtype='int64',
+                                 lod_level=1)
+        label = fluid.layers.data(name='label', shape=[1], dtype='int64')
+        predict = net_fn(data, len(word_dict))
+        cost = fluid.layers.cross_entropy(input=predict, label=label)
+        avg_cost = fluid.layers.mean(cost)
+        fluid.optimizer.Adam(learning_rate=lr).minimize(avg_cost)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    # fixed bucketed batch (pad/truncate to length 24: one compiled shape)
+    samples = list(dataset.imdb.train()())[:8]
+    ids = np.zeros((8, 24, 1), 'int64')
+    lens = np.zeros((8,), 'int32')
+    labels = np.zeros((8, 1), 'int64')
+    for i, (seq, lab) in enumerate(samples):
+        seq = seq[:24]
+        ids[i, :len(seq), 0] = seq
+        lens[i] = len(seq)
+        labels[i] = lab
+    first = last = None
+    for _ in range(steps):
+        l, = exe.run(prog, feed={'words': (ids, lens), 'label': labels},
+                     fetch_list=[avg_cost])
+        if first is None:
+            first = float(l)
+        last = float(l)
+    assert np.isfinite(last) and last < 0.5 * first, (first, last)
+
+
+def test_sentiment_conv():
+    _train(convolution_net)
+
+
+def test_sentiment_stacked_lstm():
+    _train(stacked_lstm_net, steps=50, lr=0.01)

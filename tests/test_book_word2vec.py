@@ -1,0 +1,52 @@
+"""Book chapter 4: word2vec n-gram LM (reference tests/book/
+test_word2vec.py) -- 4 context embeddings -> concat -> hidden -> softmax."""
+import numpy as np
+
+import paddle_tpu as fluid
+import paddle_tpu.dataset as dataset
+from paddle_tpu.framework import Program, program_guard
+
+EMBED_SIZE = 16
+HIDDEN_SIZE = 64
+BATCH_SIZE = 32
+
+
+def test_word2vec_trains():
+    word_dict = dataset.imikolov.build_dict()
+    dict_size = len(word_dict)
+
+    prog, startup = Program(), Program()
+    with program_guard(prog, startup):
+        words = [fluid.layers.data(name=n, shape=[1], dtype='int64')
+                 for n in ('firstw', 'secondw', 'thirdw', 'forthw',
+                           'nextw')]
+        embs = [fluid.layers.embedding(
+            input=w, size=[dict_size, EMBED_SIZE],
+            param_attr=fluid.ParamAttr(name='shared_w'))
+            for w in words[:4]]
+        concat = fluid.layers.concat(input=embs, axis=-1)
+        concat = fluid.layers.reshape(concat, shape=[-1, 4 * EMBED_SIZE])
+        hidden1 = fluid.layers.fc(input=concat, size=HIDDEN_SIZE,
+                                  act='sigmoid')
+        predict = fluid.layers.fc(input=hidden1, size=dict_size,
+                                  act='softmax')
+        cost = fluid.layers.cross_entropy(input=predict, label=words[4])
+        avg_cost = fluid.layers.mean(cost)
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(avg_cost)
+
+    train_reader = fluid.batch(dataset.imikolov.train(word_dict),
+                               BATCH_SIZE, drop_last=True)
+    feeder = fluid.DataFeeder(
+        feed_list=['firstw', 'secondw', 'thirdw', 'forthw', 'nextw'],
+        place=fluid.CPUPlace(), program=prog)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    first = last = None
+    for i, data in enumerate(train_reader()):
+        l, = exe.run(prog, feed=feeder.feed(data), fetch_list=[avg_cost])
+        if first is None:
+            first = float(l)
+        last = float(l)
+        if i >= 40:
+            break
+    assert np.isfinite(last) and last < first, (first, last)
