@@ -37,6 +37,7 @@ from .executor import (Executor, Scope, global_scope, scope_guard,
 from . import lod_tensor
 from .lod_tensor import LoDTensor, create_lod_tensor, \
     create_random_int_lodtensor
+from . import parallel
 from . import reader
 from .batch import batch  # noqa: F401
 from . import dataset

@@ -214,7 +214,7 @@ class EmitContext(object):
     for IR-level constant folding, e.g. tensor-array indices)."""
 
     __slots__ = ('env', 'block', 'rng_key', 'is_test', '_op_index',
-                 '_block_pos', '_fold_limits')
+                 '_block_pos', '_fold_limits', 'mesh')
 
     def __init__(self, env, block, rng_key, is_test):
         self.env = env
@@ -228,6 +228,9 @@ class EmitContext(object):
         # enclosing control-flow op's position (ops after it haven't
         # "happened" yet)
         self._fold_limits = {}
+        # device mesh for sharding_constraint emitters; None on a plain
+        # single-device Executor (ParallelExecutor sets its Mesh)
+        self.mesh = None
 
     def get(self, name):
         try:
@@ -538,6 +541,10 @@ class Executor(object):
         """Hook: extra jax.jit kwargs (in_shardings for the SPMD path)."""
         return {}
 
+    def _emit_mesh(self):
+        """Hook: mesh visible to emitters (sharding constraints)."""
+        return None
+
     def _compile_segment(self, segment, block, program, feed_names=()):
         is_test = program._is_test
         ops = segment.ops
@@ -549,6 +556,7 @@ class Executor(object):
             env.update(const)
             env.update(donated)
             ctx = EmitContext(env, block, rng_key, is_test)
+            ctx.mesh = self._emit_mesh()
             for op, off in zip(ops, offsets):
                 ctx._op_index = off
                 ctx._block_pos = off

@@ -103,6 +103,9 @@ class Variable(object):
         # int32 sequence lengths (set for lod_level>0 vars; layers
         # propagate it through sequence-preserving ops)
         self.seq_lens = None
+        # sharding annotation: tuple of mesh-axis-name/None per dim
+        # (parallel/api.py shard_tensor); consumed by ParallelExecutor
+        self.dist_attr = None
 
     # -- introspection -----------------------------------------------------
     @property

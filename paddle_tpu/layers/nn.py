@@ -26,6 +26,7 @@ __all__ = [
     'pad', 'label_smooth', 'flatten', 'stack', 'expand', 'squeeze',
     'unsqueeze', 'gather', 'scatter', 'slice', 'shape', 'autoincreased_step_counter',
     'logical_and', 'logical_or', 'logical_xor', 'logical_not', 'where_select',
+    'causal_mask_bias', 'position_embedding',
 ]
 
 
@@ -764,3 +765,26 @@ def autoincreased_step_counter(counter_name=None, begin=1, step=1):
             outputs={'Out': [counter]}, attrs={'step': float(step)})
         counter.stop_gradient = True
     return counter
+
+
+def causal_mask_bias(scores, name=None):
+    """Mask future positions of [.., Tq, Tk] attention scores with -1e9."""
+    helper = LayerHelper('causal_mask', name=name)
+    out = helper.create_variable_for_type_inference(scores.dtype)
+    helper.append_op(type='causal_mask', inputs={'X': [scores]},
+                     outputs={'Out': [out]})
+    return out
+
+
+def position_embedding(x, max_len, param_attr=None, name=None):
+    """Learned positional embedding table sliced to x's time axis."""
+    helper = LayerHelper('position_embedding', param_attr=param_attr,
+                         name=name)
+    D = x.shape[-1]
+    pos = helper.create_parameter(attr=helper.param_attr,
+                                  shape=[max_len, D], dtype=x.dtype)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type='position_embedding',
+                     inputs={'X': [x], 'Pos': [pos]},
+                     outputs={'Out': [out]})
+    return out

@@ -1,0 +1,119 @@
+"""Transformer language model — the flagship parallel config.
+
+The reference's Transformer lives in its benchmark suite
+(benchmark/fluid/machine_translation.py-era NMT); this is the modern
+decoder-only formulation built on the framework's Program IR with the full
+parallel-axis treatment (SURVEY.md §2.11 extension):
+
+- dp: batch sharded
+- tp: attention heads + FFN features Megatron-split via column/row
+  parallel fc (GSPMD inserts the psum pair per block)
+- sp: activation time axis sharded between blocks
+  (sequence parallelism for norm/elementwise regions)
+- ep: optional MoE FFN with experts sharded
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .. import layers as L
+from ..parallel.layers import (column_parallel_fc, row_parallel_fc,
+                               vocab_parallel_embedding, moe_layer,
+                               sequence_parallel_scope)
+from ..parallel.api import sharding_constraint
+
+
+class TransformerConfig(object):
+    def __init__(self, vocab=1000, dim=64, heads=4, layers=2, ffn=128,
+                 max_len=64, moe_experts=0, use_tp=True, use_sp=True):
+        self.vocab, self.dim, self.heads = vocab, dim, heads
+        self.layers, self.ffn, self.max_len = layers, ffn, max_len
+        self.moe_experts = moe_experts
+        self.use_tp, self.use_sp = use_tp, use_sp
+
+
+def _attention(x, cfg, prefix):
+    """Multi-head self-attention, heads split over tp: qkv is
+    column-parallel (head dim sharded), output proj row-parallel."""
+    D, H = cfg.dim, cfg.heads
+    dh = D // H
+    T = cfg.max_len
+    if cfg.use_tp:
+        qkv = column_parallel_fc(x, 3 * D, name=prefix + '_qkv')
+    else:
+        qkv = L.fc(input=x, size=3 * D, num_flatten_dims=2,
+                   name=prefix + '_qkv')
+
+    def heads(sl_start, sl_end):
+        part = L.slice(qkv, axes=[2], starts=[sl_start], ends=[sl_end])
+        part = L.reshape(part, shape=[-1, T, H, dh])
+        part = L.transpose(part, perm=[0, 2, 1, 3])        # [B, H, T, dh]
+        if cfg.use_tp:
+            part = sharding_constraint(part, ('dp', 'tp', None, None))
+        return part
+
+    q, k, v = heads(0, D), heads(D, 2 * D), heads(2 * D, 3 * D)
+    scores = L.matmul(q, k, transpose_y=True, alpha=1.0 / np.sqrt(dh))
+    causal = L.causal_mask_bias(scores)                    # [B, H, T, T]
+    probs = L.softmax(causal)
+    ctx = L.matmul(probs, v)                               # [B, H, T, dh]
+    ctx = L.transpose(ctx, perm=[0, 2, 1, 3])
+    ctx = L.reshape(ctx, shape=[-1, T, D])
+    if cfg.use_tp:
+        ctx = sharding_constraint(ctx, ('dp', None, 'tp'))
+        out = row_parallel_fc(ctx, D, name=prefix + '_proj')
+    else:
+        out = L.fc(input=ctx, size=D, num_flatten_dims=2,
+                   name=prefix + '_proj')
+    return out
+
+
+def _ffn(x, cfg, prefix):
+    if cfg.moe_experts:
+        return moe_layer(x, cfg.moe_experts, cfg.ffn)
+    if cfg.use_tp:
+        h = column_parallel_fc(x, cfg.ffn, act='gelu', name=prefix + '_up')
+        return row_parallel_fc(h, cfg.dim, name=prefix + '_down')
+    h = L.fc(input=x, size=cfg.ffn, act='gelu', num_flatten_dims=2,
+             name=prefix + '_up')
+    return L.fc(input=h, size=cfg.dim, num_flatten_dims=2,
+                name=prefix + '_down')
+
+
+def _block(x, cfg, i):
+    prefix = 'layer%d' % i
+    ln1 = L.layer_norm(x, begin_norm_axis=2)
+    if cfg.use_sp:
+        ln1 = sequence_parallel_scope(ln1)
+    attn = _attention(ln1, cfg, prefix)
+    x = L.elementwise_add(x, attn)
+    ln2 = L.layer_norm(x, begin_norm_axis=2)
+    if cfg.use_sp:
+        ln2 = sequence_parallel_scope(ln2)
+    ffn = _ffn(ln2, cfg, prefix)
+    return L.elementwise_add(x, ffn)
+
+
+def language_model(tokens, cfg):
+    """tokens: [B, T, 1] int64 ids (no lod: fixed T). Returns softmax
+    probabilities [B, T, vocab]."""
+    if cfg.use_tp:
+        emb = vocab_parallel_embedding(tokens, [cfg.vocab, cfg.dim])
+    else:
+        emb = L.embedding(tokens, size=[cfg.vocab, cfg.dim])
+    pos = L.position_embedding(emb, cfg.max_len)
+    x = L.elementwise_add(emb, pos)
+    for i in range(cfg.layers):
+        x = _block(x, cfg, i)
+    x = L.layer_norm(x, begin_norm_axis=2)
+    logits = L.fc(input=x, size=cfg.vocab, num_flatten_dims=2,
+                  act='softmax')
+    return logits
+
+
+def train_network(tokens, labels, cfg):
+    """Full LM training graph: next-token cross entropy."""
+    probs = language_model(tokens, cfg)
+    cost = L.cross_entropy(input=probs, label=labels)
+    avg_cost = L.mean(cost)
+    return probs, avg_cost

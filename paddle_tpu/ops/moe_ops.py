@@ -1,0 +1,58 @@
+"""Mixture-of-experts FFN op (no reference analog -- the reference's
+nearest precursor is the distributed lookup table, SURVEY.md §2.11; this is
+the modern EP capability the framework adds).
+
+Dense dispatch formulation: every token is combined with every expert via
+einsum and weighted by the (top-k masked) gate. With the expert dimension
+of WUp/WDown sharded over the 'ep' mesh axis, GSPMD gives each device its
+local experts and inserts the psum combine over ICI -- no hand-written
+all-to-all. Exact (no capacity dropping); compute is dense over experts,
+the standard trade for small expert counts."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..registry import register_op, op_emitter, register_vjp_grad
+
+_ACT = {'gelu': jax.nn.gelu, 'relu': jax.nn.relu, 'tanh': jnp.tanh,
+        'sigmoid': jax.nn.sigmoid, '': lambda v: v, None: lambda v: v}
+
+
+@op_emitter('moe_ffn')
+def _moe_ffn_emit(ctx, op):
+    x = ctx.get(op.single_input('X'))          # [..., D]
+    gate = ctx.get(op.single_input('Gate'))    # [..., E] probabilities
+    w_up = ctx.get(op.single_input('WUp'))     # [E, D, H]
+    w_down = ctx.get(op.single_input('WDown'))  # [E, H, D]
+    act = _ACT[op.attr('act', 'gelu')]
+    k = op.attr('k', 1)
+    E = gate.shape[-1]
+
+    if k >= E:
+        route = gate
+    else:
+        # top-k mask, renormalized; gradient flows through the gate probs
+        thresh = jnp.sort(gate, axis=-1)[..., E - k][..., None]
+        mask = (gate >= thresh).astype(gate.dtype)
+        route = gate * mask
+        route = route / jnp.maximum(
+            jnp.sum(route, axis=-1, keepdims=True), 1e-9)
+
+    h = jnp.einsum('...d,edh->...eh', x, w_up)
+    h = act(h)
+    y = jnp.einsum('...eh,ehd->...ed', h, w_down)
+    out = jnp.einsum('...ed,...e->...d', y, route)
+    ctx.set(op.single_output('Out'), out)
+
+
+def _moe_infer(op, block):
+    x = block.var_recursive(op.single_input('X'))
+    out = block.var_recursive(op.single_output('Out'))
+    out.shape = x.shape
+    out.dtype = x.dtype
+    out.lod_level = x.lod_level
+
+
+register_op('moe_ffn', infer_shape=_moe_infer)
+register_vjp_grad('moe_ffn', in_slots=('X', 'Gate', 'WUp', 'WDown'))

@@ -687,3 +687,46 @@ def _lrn_infer(op, block):
 
 register_op('lrn', infer_shape=_lrn_infer)
 register_vjp_grad('lrn', in_slots=('X',))
+
+
+# ---------------------------------------------------------------------------
+# causal_mask: add a -inf upper-triangular bias to attention scores
+# (decoder-only transformer; no reference analog -- 2018 codebase)
+# ---------------------------------------------------------------------------
+
+@op_emitter('causal_mask')
+def _causal_mask_emit(ctx, op):
+    s = ctx.get(op.single_input('X'))          # [..., Tq, Tk]
+    Tq, Tk = s.shape[-2], s.shape[-1]
+    mask = jnp.tril(jnp.ones((Tq, Tk), dtype=bool))
+    neg = jnp.asarray(-1e9, dtype=s.dtype)
+    ctx.set(op.single_output('Out'), jnp.where(mask, s, neg))
+
+
+register_op('causal_mask', infer_shape=same_shape_infer())
+register_vjp_grad('causal_mask')
+
+
+# ---------------------------------------------------------------------------
+# position_embedding: learned positions [max_len, D] added per time step
+# ---------------------------------------------------------------------------
+
+@op_emitter('position_embedding')
+def _position_embedding_emit(ctx, op):
+    x = ctx.get(op.single_input('X'))          # [B, T, D]
+    pos = ctx.get(op.single_input('Pos'))      # [max_len, D]
+    T = x.shape[1]
+    ctx.set(op.single_output('Out'),
+            jnp.broadcast_to(pos[None, :T, :], x.shape))
+
+
+def _position_embedding_infer(op, block):
+    x = block.var_recursive(op.single_input('X'))
+    out = block.var_recursive(op.single_output('Out'))
+    out.shape = x.shape
+    out.dtype = x.dtype
+
+
+register_op('position_embedding', infer_shape=_position_embedding_infer)
+register_vjp_grad('position_embedding', in_slots=('Pos',),
+                  nondiff_slots=('X',))

@@ -1,0 +1,17 @@
+"""Parallelism subsystem: mesh topology, sharding annotations, tensor/
+sequence/expert-parallel layers, pipeline scheduling.
+
+The reference's multi-device engine (SURVEY.md §2.11) covers data
+parallelism (ParallelExecutor allreduce/reduce) and parameter-server
+sharding; TP/PP/SP/EP are absent there. This subsystem provides all of
+them TPU-natively: a named `jax.sharding.Mesh` over (dp, tp, sp, pp, ep)
+axes, PartitionSpec annotations on IR Variables, and GSPMD/shard_map
+lowering that puts the collectives on ICI.
+"""
+from .mesh import MeshConfig, get_mesh, set_mesh, mesh_scope
+from .api import shard_tensor, sharding_constraint
+from . import layers as players  # noqa: F401
+from .strategy import DistributedStrategy
+
+__all__ = ['MeshConfig', 'get_mesh', 'set_mesh', 'mesh_scope',
+           'shard_tensor', 'sharding_constraint', 'DistributedStrategy']

@@ -1,0 +1,66 @@
+"""Sharding annotations on IR Variables.
+
+shard_tensor(var, spec) records a PartitionSpec-shaped tuple on the
+Variable; the ParallelExecutor places feeds/params accordingly and GSPMD
+propagates + inserts collectives. sharding_constraint(x, spec) additionally
+pins an INTERMEDIATE value's layout inside the compiled block (the
+with_sharding_constraint escape hatch for when propagation needs a hint)."""
+from __future__ import annotations
+
+import jax
+
+from ..layer_helper import LayerHelper
+from ..registry import register_op, op_emitter, same_shape_infer
+from .mesh import get_mesh, named_sharding
+
+__all__ = ['shard_tensor', 'sharding_constraint']
+
+
+def shard_tensor(var, spec):
+    """Annotate a Variable (param or feed) with a dim->axis spec, e.g.
+    shard_tensor(w, (None, 'tp'))."""
+    var.dist_attr = tuple(spec)
+    return var
+
+
+@op_emitter('sharding_constraint')
+def _sharding_constraint_emit(ctx, op):
+    x = ctx.get(op.single_input('X'))
+    mesh = getattr(ctx, 'mesh', None)
+    spec = tuple(op.attr('spec'))
+    if mesh is None:
+        ctx.set(op.single_output('Out'), x)
+        return
+    # pad the spec to the runtime rank (padded-sequence vars gain a time
+    # axis at position 1); an over-long spec is a caller bug -- raise
+    # rather than silently sharding the wrong dim
+    if len(spec) < x.ndim:
+        spec = (spec[0],) + (None,) * (x.ndim - len(spec)) + spec[1:]
+    elif len(spec) > x.ndim:
+        raise ValueError(
+            'sharding_constraint: spec %s has rank %d but value has rank '
+            '%d' % (spec, len(spec), x.ndim))
+    ctx.set(op.single_output('Out'),
+            jax.lax.with_sharding_constraint(x, named_sharding(mesh, spec)))
+
+
+register_op('sharding_constraint', infer_shape=same_shape_infer())
+
+
+def _sharding_constraint_grad(op, block):
+    from ..framework import grad_var_name
+    return [dict(type='sharding_constraint',
+                 inputs={'X': [grad_var_name(op.single_output('Out'))]},
+                 outputs={'Out': [grad_var_name(op.single_input('X'))]},
+                 attrs=dict(op.attrs))]
+
+
+register_op('sharding_constraint', grad=_sharding_constraint_grad)
+
+
+def sharding_constraint(x, spec, name=None):
+    helper = LayerHelper('sharding_constraint', name=name)
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    helper.append_op(type='sharding_constraint', inputs={'X': [x]},
+                     outputs={'Out': [out]}, attrs={'spec': list(spec)})
+    return out

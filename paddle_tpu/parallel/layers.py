@@ -1,0 +1,120 @@
+"""Model-parallel layers: tensor-parallel fc/embedding, sequence-parallel
+constraints, expert-parallel MoE.
+
+The Megatron-style pair done the GSPMD way (scaling-book recipe): instead
+of manual allreduce ops, parameters carry shard annotations and
+activations get sharding constraints; XLA inserts the all-gather /
+reduce-scatter / psum on ICI.
+
+column_parallel_fc: weight [D, H] sharded (None, 'tp') -> output sharded
+    on features.
+row_parallel_fc: weight [D, H] sharded ('tp', None), input sharded on
+    features -> XLA emits the psum that completes the matmul.
+vocab_parallel_embedding: table sharded over vocab rows.
+"""
+from __future__ import annotations
+
+from .. import layers as L
+from ..layer_helper import LayerHelper
+from .api import shard_tensor, sharding_constraint
+
+__all__ = ['column_parallel_fc', 'row_parallel_fc',
+           'vocab_parallel_embedding', 'sequence_parallel_scope',
+           'moe_layer']
+
+
+def _fc(input, size, param_spec, act=None, param_attr=None, bias_attr=None,
+        num_flatten_dims=None, name=None):
+    """L.fc with the weight annotated param_spec. Delegates to the standard
+    fc builder (one code path) and annotates the created parameter; the
+    weight gets a known name so it can be found afterwards. Bias vars are
+    tiny and stay replicated."""
+    from .. import unique_name
+    from ..param_attr import ParamAttr
+    if num_flatten_dims is None:
+        # contract the feature (last) dim only: parallel fc keeps
+        # batch/time structure ([B, T, D] @ [D, H] -> [B, T, H])
+        num_flatten_dims = max(len(input.shape) - 1, 1)
+    if param_attr is None:
+        param_attr = ParamAttr(
+            name=unique_name.generate(name or 'parallel_fc') + '.w')
+    out = L.fc(input=input, size=size, act=act,
+               num_flatten_dims=num_flatten_dims, param_attr=param_attr,
+               bias_attr=bias_attr, name=name)
+    w = input.block.program.global_block().var(param_attr.name)
+    shard_tensor(w, param_spec)
+    return out
+
+
+def column_parallel_fc(input, size, act=None, param_attr=None,
+                       bias_attr=None, axis='tp', name=None):
+    """Output-feature-sharded linear: y[:, shard] = x @ W[:, shard]."""
+    out = _fc(input, size, (None, axis), act=act, param_attr=param_attr,
+              bias_attr=bias_attr, name=name)
+    return sharding_constraint(out, ('dp', axis))
+
+
+def row_parallel_fc(input, size, act=None, param_attr=None,
+                    bias_attr=None, axis='tp', name=None):
+    """Input-feature-sharded linear; XLA inserts the completing psum."""
+    out = _fc(input, size, (axis, None), act=act, param_attr=param_attr,
+              bias_attr=bias_attr, name=name)
+    return sharding_constraint(out, ('dp', None))
+
+
+def vocab_parallel_embedding(input, size, param_attr=None, dtype='float32',
+                             axis='tp', name=None):
+    """Embedding with the table sharded over vocab rows (the TP analog of
+    the reference's distributed lookup table, SURVEY.md §2.11)."""
+    helper = LayerHelper('embedding', param_attr=param_attr, name=name)
+    w = helper.create_parameter(attr=helper.param_attr, shape=size,
+                                dtype=dtype)
+    shard_tensor(w, (axis, None))
+    tmp = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(type='lookup_table',
+                     inputs={'Ids': [input], 'W': [w]},
+                     outputs={'Out': [tmp]}, attrs={'padding_idx': -1})
+    return tmp
+
+
+def sequence_parallel_scope(x, axis='sp'):
+    """Pin the time axis of [B, T, D] activations to the sp mesh axis —
+    sequence parallelism for the memory-heavy elementwise/norm regions
+    (Korthikanti et al.; PAPERS.md)."""
+    return sharding_constraint(x, ('dp', axis, None))
+
+
+def moe_layer(input, num_experts, hidden_size, act='gelu', k=1,
+              param_attr=None, axis='ep', name=None):
+    """Expert-parallel MoE FFN (top-1 switch routing).
+
+    Experts' weights are stacked [E, D, H]/[E, H, D] and sharded over the
+    'ep' axis; tokens are dispatched by a dense one-hot combine (einsum
+    formulation -- XLA turns the dispatch into an all-to-all over ep).
+    Capacity is implicit (dense dispatch): exact, no token dropping."""
+    helper = LayerHelper('moe', param_attr=param_attr, name=name)
+    D = input.shape[-1]
+    dtype = input.dtype
+
+    nfd = max(len(input.shape) - 1, 1)
+    gate = L.fc(input=input, size=num_experts, act='softmax',
+                num_flatten_dims=nfd)         # [..., E]
+
+    w_up = helper.create_parameter(
+        attr=helper.param_attr, shape=[num_experts, D, hidden_size],
+        dtype=dtype)
+    shard_tensor(w_up, (axis, None, None))
+    w_down = helper.create_parameter(
+        attr=helper.param_attr, shape=[num_experts, hidden_size, D],
+        dtype=dtype)
+    shard_tensor(w_down, (axis, None, None))
+
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        type='moe_ffn',
+        inputs={'X': [input], 'Gate': [gate], 'WUp': [w_up],
+                'WDown': [w_down]},
+        outputs={'Out': [out]},
+        attrs={'act': act, 'k': k})
+    out.lod_level = input.lod_level
+    return out

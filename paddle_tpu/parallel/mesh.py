@@ -1,0 +1,84 @@
+"""Device-mesh management (the TPU answer to the reference's
+NCCLContextMap places/ranks bookkeeping, platform/nccl_helper.h:81).
+
+A MeshConfig names logical axes and their sizes; build() lays the
+physical devices out as a jax.sharding.Mesh. Axis order follows the
+ICI-locality rule of thumb: model axes (tp, sp, ep) innermost so their
+collectives ride the fastest links, dp/pp outermost (their transfers are
+smaller or overlappable)."""
+from __future__ import annotations
+
+import contextlib
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+__all__ = ['MeshConfig', 'get_mesh', 'set_mesh', 'mesh_scope']
+
+# canonical axis order, outermost first
+AXIS_ORDER = ('pp', 'dp', 'ep', 'sp', 'tp')
+
+
+class MeshConfig(object):
+    """Named parallel-axis sizes, e.g. MeshConfig(dp=2, tp=4)."""
+
+    def __init__(self, devices=None, **axis_sizes):
+        for ax in axis_sizes:
+            if ax not in AXIS_ORDER:
+                raise ValueError('unknown mesh axis %r (valid: %s)'
+                                 % (ax, AXIS_ORDER))
+        self.axis_sizes = {ax: int(axis_sizes.get(ax, 1))
+                           for ax in AXIS_ORDER}
+        self.devices = devices
+
+    @property
+    def size(self):
+        return int(np.prod(list(self.axis_sizes.values())))
+
+    def build(self):
+        devices = self.devices if self.devices is not None \
+            else jax.devices()[:self.size]
+        if len(devices) < self.size:
+            raise ValueError('mesh needs %d devices, have %d'
+                             % (self.size, len(devices)))
+        axes = [ax for ax in AXIS_ORDER if self.axis_sizes[ax] > 1]
+        if not axes:
+            axes = ['dp']
+        shape = [self.axis_sizes[ax] for ax in axes]
+        arr = np.array(devices[:int(np.prod(shape))]).reshape(shape)
+        return Mesh(arr, tuple(axes))
+
+
+_current_mesh = None
+
+
+def set_mesh(mesh):
+    global _current_mesh
+    _current_mesh = mesh
+    return mesh
+
+
+def get_mesh():
+    return _current_mesh
+
+
+@contextlib.contextmanager
+def mesh_scope(mesh):
+    global _current_mesh
+    prev, _current_mesh = _current_mesh, mesh
+    try:
+        yield mesh
+    finally:
+        _current_mesh = prev
+
+
+def named_sharding(mesh, spec):
+    """spec: tuple of axis-name/None per dim (a PartitionSpec in tuple
+    form, e.g. ('dp', None) or (None, 'tp'))."""
+    if spec is None:
+        return NamedSharding(mesh, PartitionSpec())
+    names = set(mesh.axis_names)
+    cleaned = tuple(s if (s in names or s is None) else None for s in spec)
+    return NamedSharding(mesh, PartitionSpec(*cleaned))

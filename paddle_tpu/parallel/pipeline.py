@@ -1,0 +1,88 @@
+"""Pipeline parallelism: GPipe microbatch schedule over the 'pp' mesh axis.
+
+No reference analog (SURVEY.md §2.11: PP absent in the 2018 codebase); this
+is the TPU-native design: all stages share one code path (SPMD), stage
+weights are STACKED on a leading [n_stages, ...] axis and sharded over
+'pp', and activations rotate stage-to-stage with lax.ppermute inside a
+lax.scan over schedule ticks -- the classic collective-pipeline formulation
+(scaling-book). Autodiff through the schedule gives the 1F1B-equivalent
+backward for free (XLA schedules the reverse ppermutes).
+
+Works standalone on any mesh with a 'pp' axis; composable with dp/tp axes
+(stage_fn's internals may carry their own sharding constraints).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+__all__ = ['pipeline_apply', 'stack_stage_params']
+
+
+def stack_stage_params(per_stage_params):
+    """[{k: leaf}, ...] per stage -> one pytree with leaves stacked on a
+    leading n_stages axis (the shardable layout)."""
+    return jax.tree_util.tree_map(
+        lambda *leaves: jnp.stack(leaves, axis=0), *per_stage_params)
+
+
+def pipeline_apply(stage_fn, mesh, n_micro, params_stacked, x_micro,
+                   axis='pp'):
+    """Run x_micro ([M, mb, ...]) through n_stages pipelined stages.
+
+    stage_fn(stage_params, x) -> y must map activation shapes to
+    themselves (uniform-stage pipeline, transformer-block style).
+    params_stacked: pytree with leading n_stages axis on every leaf.
+    Returns [M, mb, ...] outputs (last stage's results, in microbatch
+    order).
+    """
+    n_stages = mesh.shape[axis]
+    M = n_micro
+    T = M + n_stages - 1
+
+    def per_device(params_local, xs):
+        # params_local: leaves [1, ...] (this device's stage); xs: full
+        # [M, mb, ...] (replicated; only stage 0 reads it)
+        params = jax.tree_util.tree_map(lambda l: l[0], params_local)
+        s = jax.lax.axis_index(axis)
+        mb_shape = xs.shape[1:]
+        carry = jnp.zeros(mb_shape, xs.dtype)
+        outputs = jnp.zeros((M,) + mb_shape, xs.dtype)
+
+        def tick(state, t):
+            carry, outputs = state
+            inject = jax.lax.dynamic_index_in_dim(
+                xs, jnp.clip(t, 0, M - 1), axis=0, keepdims=False)
+            x_in = jnp.where(s == 0, inject, carry)
+            y = stage_fn(params, x_in)
+            # the microbatch index this device just finished
+            m = t - s
+            is_valid_out = jnp.logical_and(
+                s == n_stages - 1,
+                jnp.logical_and(m >= 0, m < M))
+            outputs = jax.lax.cond(
+                is_valid_out,
+                lambda o: jax.lax.dynamic_update_index_in_dim(
+                    o, y, jnp.clip(m, 0, M - 1), axis=0),
+                lambda o: o, outputs)
+            nxt = jax.lax.ppermute(
+                y, axis, [(i, (i + 1) % n_stages)
+                          for i in range(n_stages)])
+            return (nxt, outputs), None
+
+        (carry, outputs), _ = jax.lax.scan(
+            tick, (carry, outputs), jnp.arange(T))
+        # zero non-final-stage buffers, then psum: the global result is the
+        # last stage's outputs replicated across 'pp'
+        outputs = jnp.where(s == n_stages - 1, outputs, 0)
+        return jax.lax.psum(outputs, axis)
+
+    in_specs = (
+        jax.tree_util.tree_map(lambda _: P(axis), params_stacked),
+        P(),
+    )
+    f = jax.shard_map(per_device, mesh=mesh,
+                      in_specs=in_specs, out_specs=P(),
+                      check_vma=False)
+    return f(params_stacked, x_micro)

@@ -1,0 +1,34 @@
+"""DistributedStrategy: one config object for the whole parallel stack
+(the TPU-era analog of the reference's BuildStrategy/ExecutionStrategy
+pair plus the transpiler's config, SURVEY.md §2.6)."""
+from __future__ import annotations
+
+from .mesh import MeshConfig
+
+__all__ = ['DistributedStrategy']
+
+
+class DistributedStrategy(object):
+    """Axis sizes plus engine knobs.
+
+    dp/tp/sp/pp/ep: parallel degrees (product must divide device count)
+    sharded_optimizer: ZeRO-1-style optimizer-state sharding over dp
+        (the reference BuildStrategy.kReduce analog; consumed by
+        ParallelExecutor._bcast_params)
+    micro_batches: pipeline microbatch count, consumed by the pp engine
+        (parallel/pipeline.py pipeline_apply's n_micro)
+    """
+
+    def __init__(self, dp=1, tp=1, sp=1, pp=1, ep=1,
+                 sharded_optimizer=False, micro_batches=1):
+        self.dp, self.tp, self.sp, self.pp, self.ep = dp, tp, sp, pp, ep
+        self.sharded_optimizer = sharded_optimizer
+        self.micro_batches = micro_batches
+
+    def mesh_config(self, devices=None):
+        return MeshConfig(devices=devices, dp=self.dp, tp=self.tp,
+                          sp=self.sp, pp=self.pp, ep=self.ep)
+
+    @property
+    def world_size(self):
+        return self.dp * self.tp * self.sp * self.pp * self.ep

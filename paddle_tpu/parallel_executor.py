@@ -83,7 +83,7 @@ class ParallelExecutor(Executor):
     def __init__(self, use_cuda=True, loss_name=None, main_program=None,
                  share_vars_from=None, exec_strategy=None,
                  build_strategy=None, num_trainers=1, trainer_id=0,
-                 scope=None, devices=None, **kwargs):
+                 scope=None, devices=None, strategy=None, **kwargs):
         super(ParallelExecutor, self).__init__(TPUPlace())
         self._main_program = main_program or default_main_program()
         self._loss_name = loss_name
@@ -98,9 +98,17 @@ class ParallelExecutor(Executor):
         if devices is None:
             devices = jax.devices()
         self._devices = list(devices)
-        self.mesh = Mesh(np.array(self._devices), ('dp',))
+        self._strategy = strategy
+        if strategy is not None:
+            # multi-axis mesh (dp/tp/sp/pp/ep) from a DistributedStrategy
+            self.mesh = strategy.mesh_config(self._devices).build()
+        else:
+            self.mesh = Mesh(np.array(self._devices), ('dp',))
+        self._dp_size = (self.mesh.shape['dp']
+                         if 'dp' in self.mesh.axis_names else 1)
         self._replicated = NamedSharding(self.mesh, P())
-        self._batch_sharded = NamedSharding(self.mesh, P('dp'))
+        self._batch_sharded = NamedSharding(
+            self.mesh, P('dp' if 'dp' in self.mesh.axis_names else None))
         self._params_placed = False
         self._run_count = 0
 
@@ -109,17 +117,33 @@ class ParallelExecutor(Executor):
         return len(self._devices)
 
     # -- Executor hooks ----------------------------------------------------
+    def _var_sharding(self, name):
+        """NamedSharding for an annotated var, else None."""
+        var = self._main_program.global_block().vars.get(name)
+        spec = getattr(var, 'dist_attr', None) if var is not None else None
+        if spec is None:
+            return None
+        from .parallel.mesh import named_sharding
+        return named_sharding(self.mesh, spec)
+
     def _put_feed(self, name, arr):
-        """Shard the global batch on dim 0 across the mesh (the analog of
+        """Shard the global batch on dim 0 over 'dp' (the analog of
         feed_and_split_tensor_into_local_scopes,
-        reference parallel_executor.py:168)."""
+        reference parallel_executor.py:168). Vars with explicit dist_attr
+        annotations are placed per annotation."""
+        explicit = self._var_sharding(name)
+        if explicit is not None:
+            return jax.device_put(arr, explicit)
         if arr.ndim == 0:
             return jax.device_put(arr, self._replicated)
-        if arr.shape[0] % len(self._devices) != 0:
+        if arr.shape[0] % self._dp_size != 0:
             raise ValueError(
-                'batch size %d not divisible by device count %d'
-                % (arr.shape[0], len(self._devices)))
+                'batch size %d not divisible by dp degree %d'
+                % (arr.shape[0], self._dp_size))
         return jax.device_put(arr, self._batch_sharded)
+
+    def _emit_mesh(self):
+        return self.mesh
 
     def _jit_options(self, segment, feed_names):
         feed_set = set(feed_names)
@@ -130,12 +154,18 @@ class ParallelExecutor(Executor):
                       if n not in set(donated_keys)]
 
         def spec(name):
+            explicit = self._var_sharding(name)
+            if explicit is not None:
+                return explicit
             if name in feed_set:
                 var = self._main_program.global_block().vars.get(name)
                 if var is not None and var.shape:
                     return self._batch_sharded
                 return self._replicated
-            return self._replicated
+            # non-annotated state (optimizer moments, bn stats...): None =
+            # inherit the argument's current sharding -- GSPMD may shard
+            # these on step 1 and they must round-trip unchanged
+            return None
 
         in_shardings = (
             {n: spec(n) for n in donated_keys},
@@ -149,6 +179,10 @@ class ParallelExecutor(Executor):
         """Re-place startup-initialized params into the mesh's replicated
         sharding (analog of BCastParamsToDevices ncclBcast,
         reference parallel_executor.cc:210)."""
+        from .framework import Parameter
+        zero1 = (self._strategy is not None
+                 and self._strategy.sharded_optimizer
+                 and self._dp_size > 1)
         block = self._main_program.global_block()
         for name, var in block.vars.items():
             if not var.persistable:
@@ -156,8 +190,22 @@ class ParallelExecutor(Executor):
             val = self._scope.find_var(name)
             if val is None:
                 continue
+            sharding = self._var_sharding(name)
+            if sharding is None and zero1 and \
+                    not isinstance(var, Parameter) and \
+                    var.shape and len(var.shape) >= 1 and \
+                    var.shape[0] and var.shape[0] > 0 and \
+                    var.shape[0] % self._dp_size == 0:
+                # ZeRO-1-style: optimizer accumulators (persistable
+                # non-Parameter state) sharded over dp -- the reference
+                # BuildStrategy.kReduce analog (multi_devices_graph_pass
+                # :413-422). Elementwise optimizer math partitions exactly;
+                # GSPMD reshards grads into the shards.
+                sharding = NamedSharding(
+                    self.mesh, P('dp', *([None] * (len(var.shape) - 1))))
             self._scope.set_var(
-                name, jax.device_put(np.asarray(val), self._replicated))
+                name, jax.device_put(np.asarray(val),
+                                     sharding or self._replicated))
         self._params_placed = True
 
     def run(self, fetch_list, feed=None, feed_dict=None, return_numpy=True):
