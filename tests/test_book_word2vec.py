@@ -33,7 +33,7 @@ def test_word2vec_trains():
                                   act='softmax')
         cost = fluid.layers.cross_entropy(input=predict, label=words[4])
         avg_cost = fluid.layers.mean(cost)
-        fluid.optimizer.SGD(learning_rate=0.1).minimize(avg_cost)
+        fluid.optimizer.Adam(learning_rate=0.005).minimize(avg_cost)
 
     train_reader = fluid.batch(dataset.imikolov.train(word_dict),
                                BATCH_SIZE, drop_last=True)
@@ -46,7 +46,7 @@ def test_word2vec_trains():
     for i, data in enumerate(train_reader()):
         l, = exe.run(prog, feed=feeder.feed(data), fetch_list=[avg_cost])
         losses.append(float(l))
-        if i >= 60:
+        if i >= 120:
             break
     first, last = np.mean(losses[:5]), np.mean(losses[-5:])
     assert np.isfinite(last) and last < first, (first, last)
