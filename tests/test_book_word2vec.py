@@ -49,4 +49,4 @@ def test_word2vec_trains():
         if i >= 120:
             break
     first, last = np.mean(losses[:5]), np.mean(losses[-5:])
-    assert np.isfinite(last) and last < first, (first, last)
+    assert np.isfinite(last) and last < first - 0.8, (first, last)
