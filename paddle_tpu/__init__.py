@@ -50,6 +50,7 @@ from . import parallel_executor
 from .parallel_executor import (ParallelExecutor, ExecutionStrategy,
                                 BuildStrategy)
 from . import core
+from . import contrib
 
 __version__ = '0.1.0'
 

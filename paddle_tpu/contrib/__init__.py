@@ -1,0 +1,2 @@
+"""contrib: extras mirroring reference python/paddle/fluid/contrib/."""
+from . import mixed_precision  # noqa: F401

@@ -1,0 +1,65 @@
+"""Mixed-precision training: bf16 MXU compute, fp32 master weights.
+
+TPU-native successor of the reference's software float16
+(paddle/fluid/platform/float16.h:69) and fp16 save-conversion
+(operators/save_op.cc save_as_fp16). On TPU the right dtype is bfloat16:
+same exponent range as fp32, so NO loss scaling is required -- decorate()
+therefore has no LossScaler machinery. Matmul/conv emitters cast their
+operands to bf16 and accumulate in fp32 (`preferred_element_type`); master
+weights, batch-norm statistics, softmax and losses stay fp32.
+
+Usage (matches later-reference fluid.contrib.mixed_precision.decorate):
+
+    optimizer = fluid.optimizer.Momentum(learning_rate=0.1, momentum=0.9)
+    optimizer = fluid.contrib.mixed_precision.decorate(optimizer)
+    optimizer.minimize(avg_cost)
+"""
+from __future__ import annotations
+
+from ..framework import default_main_program
+
+__all__ = ['decorate', 'bf16_guard']
+
+
+class OptimizerWithMixedPrecision(object):
+    """Wraps an optimizer; minimize() marks the main program for bf16
+    emission. Parameter tensors and optimizer state remain fp32 (master
+    weights); only the jitted compute is downcast."""
+
+    def __init__(self, optimizer):
+        self._optimizer = optimizer
+
+    def __getattr__(self, name):
+        return getattr(self._optimizer, name)
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        program = loss.block.program
+        program._use_bf16 = True
+        return self._optimizer.minimize(
+            loss, startup_program=startup_program,
+            parameter_list=parameter_list, no_grad_set=no_grad_set)
+
+
+def decorate(optimizer, init_loss_scaling=1.0, use_dynamic_loss_scaling=False,
+             amp_lists=None):
+    """Reference-compatible signature; loss-scaling args are accepted and
+    ignored (bf16 needs none)."""
+    return OptimizerWithMixedPrecision(optimizer)
+
+
+class bf16_guard(object):
+    """Context manager marking a program for bf16 emission without touching
+    the optimizer: `with fluid.contrib.mixed_precision.bf16_guard(prog): ...`
+    or used directly on the default main program."""
+
+    def __init__(self, program=None):
+        self.program = program
+
+    def __enter__(self):
+        p = self.program or default_main_program()
+        p._use_bf16 = True
+        return p
+
+    def __exit__(self, *exc):
+        return False

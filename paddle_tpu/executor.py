@@ -214,13 +214,14 @@ class EmitContext(object):
     for IR-level constant folding, e.g. tensor-array indices)."""
 
     __slots__ = ('env', 'block', 'rng_key', 'is_test', '_op_index',
-                 '_block_pos', '_fold_limits', 'mesh')
+                 '_block_pos', '_fold_limits', 'mesh', 'amp')
 
-    def __init__(self, env, block, rng_key, is_test):
+    def __init__(self, env, block, rng_key, is_test, amp=False):
         self.env = env
         self.block = block
         self.rng_key = rng_key
         self.is_test = is_test
+        self.amp = amp
         self._op_index = 0
         self._block_pos = 0
         # block idx -> op-position limit for IR constant folding: inside a
@@ -551,11 +552,13 @@ class Executor(object):
         offsets = segment.op_offsets
         out_names = segment.out_names
 
+        amp = getattr(program, '_use_bf16', False)
+
         def seg_fn(donated, const, rng_key):
             env = {}
             env.update(const)
             env.update(donated)
-            ctx = EmitContext(env, block, rng_key, is_test)
+            ctx = EmitContext(env, block, rng_key, is_test, amp=amp)
             ctx.mesh = self._emit_mesh()
             for op, off in zip(ops, offsets):
                 ctx._op_index = off

@@ -388,6 +388,7 @@ class Program(object):
         self._version = 0          # bumped on any mutation; keys compile cache
         self._seed = 0             # program-level RNG seed (0 = nondeterministic)
         self._is_test = False
+        self._use_bf16 = False     # AMP: bf16 MXU compute, fp32 master weights
         self.random_seed = 0
         self._op_role = 'forward'  # forward | backward | optimize | rpc
         self.lr_schedule_hook = None

@@ -38,6 +38,7 @@ def _run_sub_block(env, sub_block, rng_key, is_test, base_index,
     sub_ctx = EmitContext(env, sub_block, rng_key, is_test)
     if parent_ctx is not None:
         sub_ctx.mesh = getattr(parent_ctx, 'mesh', None)
+        sub_ctx.amp = getattr(parent_ctx, 'amp', False)
         sub_ctx._fold_limits = dict(
             getattr(parent_ctx, '_fold_limits', {}))
         sub_ctx._fold_limits[parent_ctx.block.idx] = \

@@ -15,7 +15,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..registry import (register_op, op_emitter, same_shape_infer,
-                        register_vjp_grad)
+                        register_vjp_grad, amp_cast)
 
 # ---------------------------------------------------------------------------
 # elementwise binary family with Paddle's `axis` broadcast contract
@@ -114,7 +114,11 @@ def _mul_emit(ctx, op):
             'x_num_col_dims %d) with contraction size %d'
             % (x.shape, declared, xnc, k))
     x2 = x.reshape(-1, int(np.prod(x.shape[x.ndim - nd:])))
-    out2 = jnp.matmul(x2, y2, preferred_element_type=x2.dtype)
+    x2, y2 = amp_cast(ctx, x2, y2)
+    out2 = jnp.matmul(
+        x2, y2,
+        preferred_element_type=jnp.float32
+        if x2.dtype == jnp.bfloat16 else x2.dtype).astype(x2.dtype)
     out_shape = x.shape[:x.ndim - nd] + y.shape[ync:]
     ctx.set(op.single_output('Out'), out2.reshape(out_shape))
 
@@ -142,7 +146,11 @@ def _matmul_emit(ctx, op):
         x = jnp.swapaxes(x, -1, -2) if x.ndim > 1 else x
     if op.attr('transpose_Y', False):
         y = jnp.swapaxes(y, -1, -2) if y.ndim > 1 else y
-    out = jnp.matmul(x, y)
+    x, y = amp_cast(ctx, x, y)
+    out = jnp.matmul(
+        x, y,
+        preferred_element_type=jnp.float32
+        if x.dtype == jnp.bfloat16 else None).astype(x.dtype)
     alpha = op.attr('alpha', 1.0)
     if alpha != 1.0:
         out = out * alpha

@@ -17,7 +17,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..registry import (register_op, op_emitter, same_shape_infer,
-                        register_vjp_grad)
+                        register_vjp_grad, amp_cast)
 
 
 # ---------------------------------------------------------------------------
@@ -27,20 +27,23 @@ from ..registry import (register_op, op_emitter, same_shape_infer,
 def _conv2d_common_emit(ctx, op):
     x = ctx.get(op.single_input('Input'))
     w = ctx.get(op.single_input('Filter'))
+    x, w = amp_cast(ctx, x, w)
     strides = op.attr('strides', [1, 1])
     paddings = op.attr('paddings', [0, 0])
     dilations = op.attr('dilations', [1, 1])
     groups = op.attr('groups', 1) or 1
     if op.type == 'depthwise_conv2d':
         groups = x.shape[1]
+    # bf16 operands: no explicit accumulator upcast -- the MXU accumulates
+    # bf16 convs in fp32 internally, and JAX's conv transpose rule rejects
+    # mixed-dtype operands that preferred_element_type would create.
     out = jax.lax.conv_general_dilated(
         x, w,
         window_strides=tuple(strides),
         padding=[(paddings[0], paddings[0]), (paddings[1], paddings[1])],
         rhs_dilation=tuple(dilations),
         dimension_numbers=('NCHW', 'OIHW', 'NCHW'),
-        feature_group_count=groups,
-        preferred_element_type=jnp.float32 if x.dtype == jnp.bfloat16 else None)
+        feature_group_count=groups)
     ctx.set(op.single_output('Output'), out.astype(x.dtype))
 
 
@@ -76,6 +79,7 @@ for _conv_type in ('conv2d', 'depthwise_conv2d'):
 def _conv2d_transpose_emit(ctx, op):
     x = ctx.get(op.single_input('Input'))
     w = ctx.get(op.single_input('Filter'))   # [in_c, out_c/g, kh, kw]
+    x, w = amp_cast(ctx, x, w)
     strides = op.attr('strides', [1, 1])
     paddings = op.attr('paddings', [0, 0])
     dilations = op.attr('dilations', [1, 1])
@@ -351,7 +355,11 @@ register_vjp_grad('layer_norm', in_slots=('X', 'Scale', 'Bias'),
 @op_emitter('softmax')
 def _softmax_emit(ctx, op):
     x = ctx.get(op.single_input('X'))
-    ctx.set(op.single_output('Out'), jax.nn.softmax(x, axis=-1))
+    # always reduce in fp32: bf16 exp/sum loses too much for wide vocabs
+    out = jax.nn.softmax(x.astype(jnp.float32), axis=-1)
+    if not getattr(ctx, 'amp', False):
+        out = out.astype(x.dtype)
+    ctx.set(op.single_output('Out'), out)
 
 
 register_op('softmax', infer_shape=same_shape_infer())

@@ -173,6 +173,10 @@ class _SandboxCtx(object):
     def is_test(self):
         return self.parent.is_test
 
+    @property
+    def amp(self):
+        return getattr(self.parent, 'amp', False)
+
 
 def register_vjp_grad(fwd_type, in_slots=('X',), out_slots=('Out',),
                       nondiff_slots=()):
@@ -273,6 +277,23 @@ def register_vjp_grad(fwd_type, in_slots=('X',), out_slots=('Out',),
 
     register_op(fwd_type, grad=maker)
     register_op(grad_type, emit=emit)
+
+
+# -- mixed precision (TPU-native successor of reference float16.h) ---------
+
+def amp_cast(ctx, *arrays):
+    """Under AMP (program._use_bf16), cast fp32 operands of MXU ops to
+    bf16 at emit time. Master weights stay fp32 in the Scope; the cast is
+    inside the jitted step so XLA fuses it, and jax.vjp through the cast
+    yields fp32 parameter gradients automatically -- no loss scaling is
+    needed since bf16 keeps fp32's exponent range."""
+    import jax.numpy as jnp
+    if not getattr(ctx, 'amp', False):
+        return arrays if len(arrays) > 1 else arrays[0]
+    out = tuple(a.astype(jnp.bfloat16)
+                if hasattr(a, 'dtype') and a.dtype == jnp.float32 else a
+                for a in arrays)
+    return out if len(out) > 1 else out[0]
 
 
 # -- numpy helpers shared by infer_shape fns -------------------------------
