@@ -453,7 +453,7 @@ class Executor(object):
 
         feed_sig = tuple(sorted(
             (n, a.shape, str(a.dtype)) for n, a in feed_arrays.items()))
-        cache_key = (id(program), program._version, 0, feed_sig,
+        cache_key = (program._uid, program._version, 0, feed_sig,
                      tuple(fetch_names))
         prepared = self._prepared_cache.get(cache_key) \
             if use_program_cache else None

@@ -13,6 +13,7 @@ from __future__ import annotations
 import collections
 import contextlib
 import copy
+import itertools
 import json
 
 import numpy as np
@@ -382,9 +383,14 @@ class Program(object):
     """A whole computation: list of blocks, block 0 is global
     (reference framework.py:1339)."""
 
+    _uid_counter = itertools.count()
+
     def __init__(self):
         self.blocks = [Block(self, 0)]
         self.current_block_idx = 0
+        # process-unique id: compile-cache keys must survive id() reuse
+        # after a Program is garbage-collected
+        self._uid = next(Program._uid_counter)
         self._version = 0          # bumped on any mutation; keys compile cache
         self._seed = 0             # program-level RNG seed (0 = nondeterministic)
         self._is_test = False
@@ -424,6 +430,7 @@ class Program(object):
         for_test=True, ops get is_test=True and backward/optimize ops are
         stripped (the common eval-program pattern)."""
         p = copy.deepcopy(self)
+        p._uid = next(Program._uid_counter)   # distinct cache identity
         if for_test:
             for block in p.blocks:
                 kept = []
@@ -446,6 +453,7 @@ class Program(object):
         for t in targets:
             target_names.add(t.name if isinstance(t, Variable) else t)
         p = copy.deepcopy(self)
+        p._uid = next(Program._uid_counter)
         block = p.global_block()
         needed = set(target_names)
         kept = []

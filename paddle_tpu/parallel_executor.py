@@ -180,9 +180,11 @@ class ParallelExecutor(Executor):
         sharding (analog of BCastParamsToDevices ncclBcast,
         reference parallel_executor.cc:210)."""
         from .framework import Parameter
-        zero1 = (self._strategy is not None
-                 and self._strategy.sharded_optimizer
-                 and self._dp_size > 1)
+        zero1 = self._dp_size > 1 and (
+            (self._strategy is not None
+             and self._strategy.sharded_optimizer)
+            or self._build_strategy.reduce_strategy ==
+            BuildStrategy.ReduceStrategy.Reduce)
         block = self._main_program.global_block()
         for name, var in block.vars.items():
             if not var.persistable:

@@ -227,3 +227,38 @@ def test_zero1_sharded_optimizer_state():
             assert sharded_any, moment_names
     np.testing.assert_allclose(results['replicated'], results['zero1'],
                                rtol=2e-3)
+
+
+def test_reduce_strategy_knob_drives_zero1():
+    """Setting only the reference-API BuildStrategy.ReduceStrategy.Reduce
+    (no DistributedStrategy) must shard optimizer state -- the knob used
+    to be accepted-and-ignored (reference details/build_strategy.h,
+    multi_devices_graph_pass.cc:413-422)."""
+    prog, startup = Program(), Program()
+    prog.random_seed = startup.random_seed = 9
+    with program_guard(prog, startup):
+        x = fluid.layers.data(name='x', shape=[16], dtype='float32')
+        y = fluid.layers.data(name='y', shape=[1], dtype='float32')
+        pred = fluid.layers.fc(input=x, size=1)
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        fluid.optimizer.Adam(learning_rate=0.01).minimize(loss)
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup, scope=scope)
+    bs = fluid.BuildStrategy()
+    bs.reduce_strategy = fluid.BuildStrategy.ReduceStrategy.Reduce
+    pe = fluid.ParallelExecutor(
+        use_cuda=True, loss_name=loss.name, main_program=prog,
+        scope=scope, devices=jax.devices()[:8], build_strategy=bs)
+    rng = np.random.RandomState(0)
+    xv = rng.rand(16, 16).astype('f4')
+    yv = xv.sum(1, keepdims=True).astype('f4')
+    val = pe.run(fetch_list=[loss.name], feed={'x': xv, 'y': yv})[0]
+    assert np.isfinite(np.asarray(val)).all()
+    sharded_any = False
+    for n in scope.local_var_names():
+        if 'moment' in n.lower():
+            v = scope.find_var(n)
+            if v is not None and 'dp' in str(getattr(v, 'sharding', '')):
+                sharded_any = True
+    assert sharded_any
