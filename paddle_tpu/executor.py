@@ -395,10 +395,19 @@ class PreparedProgram(object):
 class Executor(object):
     def __init__(self, place=None):
         self.place = place if place is not None else TPUPlace()
-        self.device = self.place.jax_device()
+        # device resolved lazily: constructing an Executor must not touch
+        # the JAX backend (a later ParallelExecutor(num_trainers>1) in the
+        # same script still needs to run jax.distributed.initialize first)
+        self._device = None
         self._prepared_cache = {}
         self._step = 0
         self._base_key = None
+
+    @property
+    def device(self):
+        if self._device is None:
+            self._device = self.place.jax_device()
+        return self._device
 
     # -- rng ---------------------------------------------------------------
     def _rng_key(self, program):
@@ -467,8 +476,13 @@ class Executor(object):
                                     scope, program)
         self._step += 1
         if return_numpy:
-            return [np.asarray(r) for r in result]
+            return [self._to_numpy(r) for r in result]
         return result
+
+    def _to_numpy(self, value):
+        """Hook: fetch one result to host (ParallelExecutor overrides to
+        all-gather multi-host-sharded results)."""
+        return np.asarray(value)
 
     # -- internals ---------------------------------------------------------
     def _run_prepared(self, prepared, feed_arrays, fetch_names, scope,

@@ -12,6 +12,9 @@ from .mesh import MeshConfig, get_mesh, set_mesh, mesh_scope
 from .api import shard_tensor, sharding_constraint
 from . import layers as players  # noqa: F401
 from .strategy import DistributedStrategy
+from . import distributed
+from .distributed import init_parallel_env
 
 __all__ = ['MeshConfig', 'get_mesh', 'set_mesh', 'mesh_scope',
-           'shard_tensor', 'sharding_constraint', 'DistributedStrategy']
+           'shard_tensor', 'sharding_constraint', 'DistributedStrategy',
+           'distributed', 'init_parallel_env']

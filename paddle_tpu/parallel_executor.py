@@ -84,6 +84,13 @@ class ParallelExecutor(Executor):
                  share_vars_from=None, exec_strategy=None,
                  build_strategy=None, num_trainers=1, trainer_id=0,
                  scope=None, devices=None, strategy=None, **kwargs):
+        # multi-trainer: connect to the coordination service BEFORE any
+        # device lookup (the gen_nccl_id/NCCLContextMap analog; reference
+        # nccl_helper.h:118). After this, jax.devices() is global.
+        from .parallel import distributed as dist
+        if num_trainers > 1:
+            dist.init_parallel_env(trainer_id=trainer_id,
+                                   num_trainers=num_trainers)
         super(ParallelExecutor, self).__init__(TPUPlace())
         self._main_program = main_program or default_main_program()
         self._loss_name = loss_name
@@ -130,12 +137,32 @@ class ParallelExecutor(Executor):
         """Shard the global batch on dim 0 over 'dp' (the analog of
         feed_and_split_tensor_into_local_scopes,
         reference parallel_executor.py:168). Vars with explicit dist_attr
-        annotations are placed per annotation."""
+        annotations are placed per annotation.
+
+        Multi-trainer: each process feeds its LOCAL batch; the global
+        batch is their dp-order concatenation."""
+        from .parallel import distributed as dist
+        from jax.sharding import PartitionSpec
+        multihost = jax.process_count() > 1
         explicit = self._var_sharding(name)
         if explicit is not None:
+            if multihost:
+                return dist.host_value_to_global(
+                    np.asarray(arr), self.mesh, explicit.spec)
             return jax.device_put(arr, explicit)
         if arr.ndim == 0:
+            if multihost:
+                return dist.local_batch_to_global(
+                    np.asarray(arr), self.mesh, PartitionSpec())
             return jax.device_put(arr, self._replicated)
+        if multihost:
+            local_dp = self._dp_size // jax.process_count()
+            if local_dp and np.asarray(arr).shape[0] % local_dp != 0:
+                raise ValueError(
+                    'local batch size %d not divisible by local dp degree %d'
+                    % (np.asarray(arr).shape[0], local_dp))
+            return dist.local_batch_to_global(
+                np.asarray(arr), self.mesh, self._batch_sharded.spec)
         if arr.shape[0] % self._dp_size != 0:
             raise ValueError(
                 'batch size %d not divisible by dp degree %d'
@@ -205,10 +232,23 @@ class ParallelExecutor(Executor):
                 # GSPMD reshards grads into the shards.
                 sharding = NamedSharding(
                     self.mesh, P('dp', *([None] * (len(var.shape) - 1))))
-            self._scope.set_var(
-                name, jax.device_put(np.asarray(val),
-                                     sharding or self._replicated))
+            target = sharding or self._replicated
+            if jax.process_count() > 1:
+                from .parallel import distributed as dist
+                self._scope.set_var(name, dist.host_value_to_global(
+                    np.asarray(val), self.mesh, target.spec))
+            else:
+                self._scope.set_var(
+                    name, jax.device_put(np.asarray(val), target))
         self._params_placed = True
+
+    def _to_numpy(self, value):
+        if jax.process_count() > 1 and isinstance(value, jax.Array) and \
+                not value.is_fully_replicated:
+            from jax.experimental import multihost_utils
+            return np.asarray(
+                multihost_utils.process_allgather(value, tiled=True))
+        return np.asarray(value)
 
     def run(self, fetch_list, feed=None, feed_dict=None, return_numpy=True):
         feed = feed if feed is not None else feed_dict

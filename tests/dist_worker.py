@@ -1,0 +1,75 @@
+"""Worker for the multi-host DP test (subprocess-localhost pattern,
+reference tests/unittests/test_dist_base.py:13-100). Launched by
+test_dist_multihost.py with the PADDLE_* env contract set. Trains an MLP
+on a deterministic stream, feeding only this trainer's LOCAL half-batch,
+and prints per-step losses as JSON on the last line."""
+import json
+import os
+import sys
+
+os.environ['XLA_FLAGS'] = (os.environ.get('XLA_FLAGS', '')
+                           + ' --xla_force_host_platform_device_count=4')
+
+import jax  # noqa: E402
+
+jax.config.update('jax_platforms', 'cpu')
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+import paddle_tpu as fluid  # noqa: E402
+from paddle_tpu.framework import Program, program_guard  # noqa: E402
+
+GLOBAL_BATCH = 32
+STEPS = 5
+
+
+def build():
+    prog, startup = Program(), Program()
+    prog.random_seed = startup.random_seed = 11
+    with program_guard(prog, startup):
+        x = fluid.layers.data(name='x', shape=[8], dtype='float32')
+        y = fluid.layers.data(name='y', shape=[1], dtype='float32')
+        h = fluid.layers.fc(input=x, size=16, act='relu')
+        pred = fluid.layers.fc(input=h, size=1)
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    return prog, startup, loss
+
+
+def batches():
+    rng = np.random.RandomState(7)
+    for _ in range(STEPS):
+        xv = rng.rand(GLOBAL_BATCH, 8).astype('float32')
+        yv = xv.sum(1, keepdims=True).astype('float32')
+        yield xv, yv
+
+
+def main():
+    num_trainers = int(os.environ.get('PADDLE_TRAINERS_NUM', 1))
+    trainer_id = int(os.environ.get('PADDLE_TRAINER_ID', 0))
+
+    prog, startup, loss = build()
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+
+    pe = fluid.ParallelExecutor(use_cuda=False, loss_name=loss.name,
+                                main_program=prog, scope=scope,
+                                num_trainers=num_trainers,
+                                trainer_id=trainer_id)
+    with fluid.scope_guard(scope):
+        exe.run(startup, scope=scope)
+
+    losses = []
+    per = GLOBAL_BATCH // num_trainers
+    for xv, yv in batches():
+        lo, hi = trainer_id * per, (trainer_id + 1) * per
+        l, = pe.run(fetch_list=[loss.name],
+                    feed={'x': xv[lo:hi], 'y': yv[lo:hi]})
+        losses.append(float(np.asarray(l)))
+    print('LOSSES ' + json.dumps(losses), flush=True)
+
+
+if __name__ == '__main__':
+    main()
