@@ -607,8 +607,43 @@ def _lookup_table_infer(op, block):
     out.lod_level = ids.lod_level
 
 
-register_op('lookup_table', infer_shape=_lookup_table_infer)
-register_vjp_grad('lookup_table', in_slots=('W',), nondiff_slots=('Ids',))
+def _lookup_table_grad_maker(op, block):
+    from ..framework import grad_var_name
+    attrs = dict(op.attrs)
+    inputs = {'Ids': list(op.input('Ids')), 'W': list(op.input('W')),
+              'Out@GRAD': [grad_var_name(op.single_output('Out'))]}
+    outputs = {'W@GRAD': [grad_var_name(op.single_input('W'))]}
+    return [dict(type='lookup_table_grad', inputs=inputs, outputs=outputs,
+                 attrs=attrs)]
+
+
+@op_emitter('lookup_table_grad')
+def _lookup_table_grad_emit(ctx, op):
+    """is_sparse=True: gradient as SelectedRows (rows = the step's ids,
+    values = upstream grad rows) with STATIC row count — the TPU shape of
+    the reference's dynamically-sized SelectedRows grad
+    (lookup_table_op.cc grad kernel). Dense path: scatter-add."""
+    from ..selected_rows import SelectedRows
+    w = ctx.get(op.single_input('W'))
+    ids = ctx.get(op.single_input('Ids'))
+    gout = ctx.get(op.single_input('Out@GRAD'))
+    squeeze_last = ids.ndim > 1 and ids.shape[-1] == 1
+    flat = (ids.reshape(ids.shape[:-1]) if squeeze_last else ids)
+    flat = flat.reshape(-1).astype(jnp.int32)
+    rows_g = gout.reshape((len(flat),) + tuple(w.shape[1:]))
+    pad = op.attr('padding_idx', -1)
+    if pad != -1:
+        rows_g = jnp.where((flat == pad)[..., None], 0.0, rows_g)
+    if op.attr('is_sparse', False):
+        ctx.set(op.single_output('W@GRAD'),
+                SelectedRows(rows_g.astype(w.dtype), flat, w.shape[0]))
+    else:
+        gw = jnp.zeros_like(w).at[flat].add(rows_g.astype(w.dtype))
+        ctx.set(op.single_output('W@GRAD'), gw)
+
+
+register_op('lookup_table', infer_shape=_lookup_table_infer,
+            grad=_lookup_table_grad_maker)
 
 
 # ---------------------------------------------------------------------------

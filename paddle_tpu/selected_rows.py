@@ -1,0 +1,68 @@
+"""SelectedRows: sparse row-set tensor (reference paddle/fluid/framework/
+selected_rows.h:32) — the gradient format of sparse embeddings and the wire
+format of the distributed lookup table.
+
+TPU-native design: a registered JAX pytree of (values [N, ...], rows [N])
+plus a static height, so it flows through jit/vjp with STATIC shapes (N =
+number of lookups in the step, fixed at trace time — XLA-friendly, unlike
+the reference's dynamically-sized rows vector). Optimizer emitters apply it
+as a scatter update; the RPC layer ships rows+values instead of the dense
+table (the bandwidth win that motivates the format)."""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ['SelectedRows']
+
+
+@jax.tree_util.register_pytree_node_class
+class SelectedRows(object):
+    __slots__ = ('values', 'rows', 'height')
+
+    def __init__(self, values, rows, height):
+        self.values = values        # [N, ...] gradient rows
+        self.rows = rows            # [N] int32 row ids (repeats allowed)
+        self.height = int(height)   # dense dim0
+
+    def tree_flatten(self):
+        return (self.values, self.rows), self.height
+
+    @classmethod
+    def tree_unflatten(cls, height, children):
+        values, rows = children
+        return cls(values, rows, height)
+
+    @property
+    def dtype(self):
+        return self.values.dtype
+
+    @property
+    def dense_shape(self):
+        return (self.height,) + tuple(self.values.shape[1:])
+
+    def to_dense(self):
+        """Dense [height, ...] with repeated rows summed (the reference
+        merge+densify semantics)."""
+        z = jnp.zeros(self.dense_shape, self.values.dtype)
+        return z.at[jnp.asarray(self.rows, jnp.int32)].add(self.values)
+
+    def numpy(self):
+        return np.asarray(self.to_dense())
+
+    def merged(self):
+        """Host-side dedup: sum values of duplicate rows (the reference
+        scatter::MergeAdd). Returns numpy-backed SelectedRows."""
+        rows = np.asarray(self.rows)
+        vals = np.asarray(self.values)
+        uniq, inv = np.unique(rows, return_inverse=True)
+        out = np.zeros((len(uniq),) + vals.shape[1:], vals.dtype)
+        np.add.at(out, inv, vals)
+        return SelectedRows(out, uniq.astype('int32'), self.height)
+
+    def __repr__(self):
+        return 'SelectedRows(height=%d, nrows=%s, value_shape=%s)' % (
+            self.height, getattr(self.rows, 'shape', '?'),
+            getattr(self.values, 'shape', '?'))
