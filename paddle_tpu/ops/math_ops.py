@@ -250,9 +250,20 @@ _register_unary('logit', None,
 
 @op_emitter('scale')
 def _scale_emit(ctx, op):
+    from ..selected_rows import SelectedRows
     x = ctx.get(op.single_input('X'))
     scale = op.attr('scale', 1.0)
     bias = op.attr('bias', 0.0)
+    if isinstance(x, SelectedRows):
+        # scale on SelectedRows scales the rows (bias must be 0 — a bias
+        # would densify; the reference scale kernel is dense-only and the
+        # DP loss-scale path only ever multiplies).
+        if bias != 0.0:
+            raise NotImplementedError(
+                'scale with nonzero bias on a SelectedRows grad')
+        ctx.set(op.single_output('Out'),
+                SelectedRows(x.values * scale, x.rows, x.height))
+        return
     if op.attr('bias_after_scale', True):
         out = x * scale + bias
     else:
@@ -294,7 +305,21 @@ register_vjp_grad('clip_by_norm')
 
 @op_emitter('sum')
 def _sum_emit(ctx, op):
+    from ..selected_rows import SelectedRows
     xs = [ctx.get(n) for n in op.input('X')]
+    if any(isinstance(x, SelectedRows) for x in xs):
+        # Reference sum_op SelectedRows path (math/selected_rows_functor.cc):
+        # all-sparse inputs concatenate rows (dedup deferred to the
+        # consumer's scatter-add); mixed dense+sparse densifies.
+        if all(isinstance(x, SelectedRows) for x in xs):
+            import jax.numpy as _j
+            vals = _j.concatenate([x.values for x in xs], axis=0)
+            rows = _j.concatenate(
+                [_j.asarray(x.rows, _j.int32) for x in xs], axis=0)
+            ctx.set(op.single_output('Out'),
+                    SelectedRows(vals, rows, xs[0].height))
+            return
+        xs = [x.to_dense() if isinstance(x, SelectedRows) else x for x in xs]
     out = xs[0]
     for x in xs[1:]:
         out = out + x

@@ -34,9 +34,15 @@ def _conv2d_common_emit(ctx, op):
     groups = op.attr('groups', 1) or 1
     if op.type == 'depthwise_conv2d':
         groups = x.shape[1]
-    # bf16 operands: no explicit accumulator upcast -- the MXU accumulates
-    # bf16 convs in fp32 internally, and JAX's conv transpose rule rejects
-    # mixed-dtype operands that preferred_element_type would create.
+    # bf16 operands on TPU: no explicit accumulator upcast -- the MXU
+    # accumulates bf16 convs in fp32 internally, and JAX's conv transpose
+    # rule rejects mixed-dtype operands that preferred_element_type would
+    # create. Off-TPU (CPU tests, GPU) there is no such hardware guarantee,
+    # so keep fp32 accumulation by upcasting the operands.
+    out_dtype = x.dtype
+    if x.dtype == jnp.bfloat16 and jax.default_backend() != 'tpu':
+        x = x.astype(jnp.float32)
+        w = w.astype(jnp.float32)
     out = jax.lax.conv_general_dilated(
         x, w,
         window_strides=tuple(strides),
@@ -44,7 +50,7 @@ def _conv2d_common_emit(ctx, op):
         rhs_dilation=tuple(dilations),
         dimension_numbers=('NCHW', 'OIHW', 'NCHW'),
         feature_group_count=groups)
-    ctx.set(op.single_output('Output'), out.astype(x.dtype))
+    ctx.set(op.single_output('Output'), out.astype(out_dtype))
 
 
 def _conv_out_size(in_size, k, pad, stride, dilation):

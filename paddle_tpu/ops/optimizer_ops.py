@@ -12,6 +12,23 @@ from __future__ import annotations
 import jax.numpy as jnp
 
 from ..registry import register_op
+from ..selected_rows import SelectedRows
+
+
+def _densify(g):
+    """Fallback for optimizers without a dedicated sparse kernel: merge the
+    SelectedRows grad into its dense form (reference behavior for ops that
+    only register a dense kernel)."""
+    return g.to_dense() if isinstance(g, SelectedRows) else g
+
+
+def _row_mask(g):
+    """[height, 1] 0/1 mask of rows present in a SelectedRows grad — the
+    static-shape TPU analog of the reference's merged-row iteration
+    (operators/math/selected_rows_functor.cc MergeAdd): updates are applied
+    only where mask==1, leaving untouched rows' state bit-identical."""
+    m = jnp.zeros((g.height, 1), g.values.dtype)
+    return m.at[jnp.asarray(g.rows, jnp.int32)].max(1.0)
 
 
 def _passthrough_infer(pairs):
@@ -31,6 +48,15 @@ def _sgd_emit(ctx, op):
     p = ctx.get(op.single_input('Param'))
     g = ctx.get(op.single_input('Grad'))
     lr = ctx.get(op.single_input('LearningRate'))
+    if isinstance(g, SelectedRows):
+        # Sparse kernel (reference operators/sgd_op.h SelectedRows path):
+        # scatter-subtract only the touched rows; duplicate row ids
+        # accumulate, which is exactly the dense semantics since the dense
+        # grad is the scatter-add of the row grads.
+        rows = jnp.asarray(g.rows, jnp.int32)
+        p_new = p.at[rows].add(-(lr * g.values.astype(p.dtype)))
+        ctx.set(op.single_output('ParamOut'), p_new)
+        return
     ctx.set(op.single_output('ParamOut'), p - lr * g.astype(p.dtype))
 
 
@@ -41,6 +67,7 @@ register_op('sgd', emit=_sgd_emit, no_grad=True,
 def _momentum_emit(ctx, op):
     p = ctx.get(op.single_input('Param'))
     g = ctx.get(op.single_input('Grad'))
+    g = _densify(g)
     v = ctx.get(op.single_input('Velocity'))
     lr = ctx.get(op.single_input('LearningRate'))
     mu = op.attr('mu')
@@ -69,6 +96,34 @@ def _adam_emit(ctx, op):
     b1 = op.attr('beta1', 0.9)
     b2 = op.attr('beta2', 0.999)
     eps = op.attr('epsilon', 1e-8)
+    if isinstance(g, SelectedRows):
+        if op.attr('lazy_mode', False):
+            # Lazy sparse kernel (reference SparseAdamFunctor lazy loop):
+            # moments and params of rows NOT present in this step's grad
+            # are left untouched; present rows get the full update with
+            # the merged row grad.
+            mask = _row_mask(g).astype(m1.dtype)
+            gd = g.to_dense().astype(m1.dtype)
+            m1_new = jnp.where(mask > 0, b1 * m1 + (1 - b1) * gd, m1)
+            m2_new = jnp.where(mask > 0,
+                               b2 * m2 + (1 - b2) * jnp.square(gd), m2)
+            lr_t = lr * jnp.sqrt(1 - b2p) / (1 - b1p)
+            p_new = jnp.where(
+                mask > 0,
+                p - (lr_t * m1_new
+                     / (jnp.sqrt(m2_new) + eps)).astype(p.dtype), p)
+            ctx.set(op.single_output('ParamOut'), p_new)
+            ctx.set(op.single_output('Moment1Out'), m1_new)
+            ctx.set(op.single_output('Moment2Out'), m2_new)
+            if op.output('Beta1PowOut'):
+                ctx.set(op.single_output('Beta1PowOut'), b1p * b1)
+            if op.output('Beta2PowOut'):
+                ctx.set(op.single_output('Beta2PowOut'), b2p * b2)
+            return
+        # Non-lazy (the reference default, lazy_mode=False): absent rows
+        # are grad=0 but moments still decay and every row updates —
+        # identical to the dense kernel on the merged-dense grad.
+        g = g.to_dense()
     m1_new = b1 * m1 + (1 - b1) * g
     m2_new = b2 * m2 + (1 - b2) * jnp.square(g)
     lr_t = lr * jnp.sqrt(1 - b2p) / (1 - b1p)
@@ -95,6 +150,17 @@ def _adagrad_emit(ctx, op):
     m = ctx.get(op.single_input('Moment'))
     lr = ctx.get(op.single_input('LearningRate'))
     eps = op.attr('epsilon', 1e-6)
+    if isinstance(g, SelectedRows):
+        # Sparse kernel (reference SparseAdagradFunctor): touched rows only.
+        mask = _row_mask(g).astype(m.dtype)
+        gd = g.to_dense().astype(m.dtype)
+        m_new = m + jnp.where(mask > 0, jnp.square(gd), 0.0)
+        p_new = jnp.where(
+            mask > 0,
+            p - (lr * gd / (jnp.sqrt(m_new) + eps)).astype(p.dtype), p)
+        ctx.set(op.single_output('ParamOut'), p_new)
+        ctx.set(op.single_output('MomentOut'), m_new)
+        return
     m_new = m + jnp.square(g)
     p_new = p - lr * g / (jnp.sqrt(m_new) + eps)
     ctx.set(op.single_output('ParamOut'), p_new)
@@ -109,6 +175,7 @@ register_op('adagrad', emit=_adagrad_emit, no_grad=True,
 def _decayed_adagrad_emit(ctx, op):
     p = ctx.get(op.single_input('Param'))
     g = ctx.get(op.single_input('Grad'))
+    g = _densify(g)
     m = ctx.get(op.single_input('Moment'))
     lr = ctx.get(op.single_input('LearningRate'))
     decay = op.attr('decay', 0.95)
@@ -127,6 +194,7 @@ register_op('decayed_adagrad', emit=_decayed_adagrad_emit, no_grad=True,
 def _adamax_emit(ctx, op):
     p = ctx.get(op.single_input('Param'))
     g = ctx.get(op.single_input('Grad'))
+    g = _densify(g)
     m = ctx.get(op.single_input('Moment'))
     inf_norm = ctx.get(op.single_input('InfNorm'))
     lr = ctx.get(op.single_input('LearningRate'))
@@ -152,6 +220,7 @@ register_op('adamax', emit=_adamax_emit, no_grad=True,
 def _adadelta_emit(ctx, op):
     p = ctx.get(op.single_input('Param'))
     g = ctx.get(op.single_input('Grad'))
+    g = _densify(g)
     avg_sq_grad = ctx.get(op.single_input('AvgSquaredGrad'))
     avg_sq_upd = ctx.get(op.single_input('AvgSquaredUpdate'))
     rho = op.attr('rho', 0.95)
@@ -174,6 +243,7 @@ register_op('adadelta', emit=_adadelta_emit, no_grad=True,
 def _rmsprop_emit(ctx, op):
     p = ctx.get(op.single_input('Param'))
     g = ctx.get(op.single_input('Grad'))
+    g = _densify(g)
     ms = ctx.get(op.single_input('MeanSquare'))
     mom = ctx.get(op.single_input('Moment'))
     lr = ctx.get(op.single_input('LearningRate'))
@@ -196,6 +266,7 @@ register_op('rmsprop', emit=_rmsprop_emit, no_grad=True,
 def _ftrl_emit(ctx, op):
     p = ctx.get(op.single_input('Param'))
     g = ctx.get(op.single_input('Grad'))
+    g = _densify(g)
     sq_accum = ctx.get(op.single_input('SquaredAccumulator'))
     lin_accum = ctx.get(op.single_input('LinearAccumulator'))
     lr = ctx.get(op.single_input('LearningRate'))

@@ -205,12 +205,13 @@ class Adam(Optimizer):
     _beta2_pow_acc_str = 'beta2_pow_acc'
 
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
-                 epsilon=1e-8, **kwargs):
+                 epsilon=1e-8, lazy_mode=False, **kwargs):
         super(Adam, self).__init__(learning_rate, **kwargs)
         self.type = 'adam'
         self._beta1 = beta1
         self._beta2 = beta2
         self._epsilon = epsilon
+        self._lazy_mode = lazy_mode
 
     def _create_accumulators(self, block, parameters):
         for p in parameters:
@@ -237,7 +238,7 @@ class Adam(Optimizer):
                      'Moment2Out': [moment2], 'Beta1PowOut': [beta1_pow],
                      'Beta2PowOut': [beta2_pow]},
             attrs={'beta1': self._beta1, 'beta2': self._beta2,
-                   'epsilon': self._epsilon})
+                   'epsilon': self._epsilon, 'lazy_mode': self._lazy_mode})
 
 
 class Adamax(Optimizer):

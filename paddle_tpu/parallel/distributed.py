@@ -51,15 +51,32 @@ def is_initialized():
     return _initialized or _coordination_client_up()
 
 
+def _backend_already_live():
+    """True if some JAX backend has been created — then querying
+    process_index/count is side-effect free (covers multi-process TPU pods
+    where PJRT is multi-process without jax.distributed.initialize)."""
+    try:
+        from jax._src import xla_bridge
+        return bool(xla_bridge._backends)
+    except Exception:
+        return False
+
+
 def trainer_id():
-    if jax.process_count() > 1:
-        return jax.process_index()
+    # Consult the backend only when it is already live (coordination client
+    # connected, or backend created some other way): jax.process_index()
+    # on a cold process initializes the backend, which would permanently
+    # prevent a later init_parallel_env() from connecting.
+    if _coordination_client_up() or _backend_already_live():
+        if jax.process_count() > 1:
+            return jax.process_index()
     return int(os.environ.get('PADDLE_TRAINER_ID', 0))
 
 
 def num_trainers():
-    if jax.process_count() > 1:
-        return jax.process_count()
+    if _coordination_client_up() or _backend_already_live():
+        if jax.process_count() > 1:
+            return jax.process_count()
     return int(os.environ.get('PADDLE_TRAINERS_NUM',
                               os.environ.get('PADDLE_TRAINERS', 1)))
 
@@ -137,14 +154,44 @@ def host_value_to_global(arr, mesh, pspec):
         shard_rows_for_process(arr, mesh, first), mesh, pspec)
 
 
-def shard_rows_for_process(arr, mesh, axis_name):
-    """Rows of the full array owned by this process when dim 0 is sharded
-    over `axis_name` (processes own contiguous equal slices in mesh
-    device order)."""
-    n = jax.process_count()
-    pid = jax.process_index()
+def shard_rows_for_process(arr, mesh, axis_entry):
+    """Rows of the full array that THIS process's host-local view covers
+    when dim 0 is sharded over `axis_entry` (an axis name or tuple of axis
+    names from a PartitionSpec).
+
+    Derived from the mesh's actual device->process mapping rather than
+    assuming the axis spans processes contiguously in process-index order:
+    each dim-0 shard index is owned by the devices at that coordinate along
+    the sharding axes; this process's view is the union of shards its
+    local devices sit on (which host_local_array_to_global_array requires
+    to be one contiguous range — asserted)."""
+    names = axis_entry if isinstance(axis_entry, tuple) else (axis_entry,)
+    axes = list(mesh.axis_names)
+    dev_arr = np.asarray(mesh.devices)
+    total = 1
+    for nm in names:
+        total *= mesh.shape[nm]
     rows = arr.shape[0]
-    if rows % n != 0:
-        raise ValueError('dim0=%d not divisible by %d processes' % (rows, n))
-    per = rows // n
-    return arr[pid * per:(pid + 1) * per]
+    if rows % total != 0:
+        raise ValueError('dim0=%d not divisible by %d shards along %r'
+                         % (rows, total, names))
+    per = rows // total
+    pid = jax.process_index()
+    mine = set()
+    for idx in np.ndindex(*dev_arr.shape):
+        coord = 0
+        for nm in names:
+            coord = coord * mesh.shape[nm] + idx[axes.index(nm)]
+        if dev_arr[idx].process_index == pid:
+            mine.add(coord)
+    if not mine:
+        raise ValueError(
+            'process %d owns no devices in the mesh (axes %r) — every '
+            'participating process must contribute devices' % (pid, names))
+    lo = min(mine)
+    if sorted(mine) != list(range(lo, lo + len(mine))):
+        raise ValueError(
+            'axis %r maps to non-contiguous dim-0 shards %s for process %d; '
+            'reorder the mesh so dim-0 sharding is contiguous per host'
+            % (names, sorted(mine), pid))
+    return arr[lo * per:(lo + len(mine)) * per]

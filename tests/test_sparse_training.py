@@ -1,0 +1,163 @@
+"""Sparse (SelectedRows-grad) embedding training.
+
+Reference: book word2vec runs embedding(is_sparse=True) as a first-class
+variant (python/paddle/fluid/tests/book/test_word2vec.py); the sparse grad
+is a SelectedRows consumed by SelectedRows-aware optimizer kernels
+(operators/sgd_op.h, adam_op.h SparseAdamFunctor,
+math/selected_rows_functor.cc).
+
+TPU design under test: lookup_table_grad emits a static-shape SelectedRows
+pytree; sgd scatter-subtracts rows exactly (== dense); adam/adagrad apply
+the reference's lazy row-masked update.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+
+
+def _build(is_sparse, optimizer_fn, vocab=50, dim=8):
+    main = fluid.Program()
+    startup = fluid.Program()
+    main.random_seed = 7
+    startup.random_seed = 7
+    with fluid.program_guard(main, startup):
+        ids = fluid.layers.data(name='ids', shape=[4], dtype='int64')
+        label = fluid.layers.data(name='label', shape=[1], dtype='float32')
+        emb = fluid.layers.embedding(ids, size=[vocab, dim],
+                                     is_sparse=is_sparse,
+                                     param_attr=fluid.ParamAttr(
+                                         name='emb_w',
+                                         initializer=fluid.initializer.
+                                         Normal(seed=7)))
+        pooled = fluid.layers.reduce_mean(emb, dim=1)
+        pred = fluid.layers.fc(pooled, size=1,
+                               param_attr=fluid.ParamAttr(
+                                   name='fc_w',
+                                   initializer=fluid.initializer.
+                                   Normal(seed=11)))
+        cost = fluid.layers.square_error_cost(pred, label)
+        avg = fluid.layers.mean(cost)
+        optimizer_fn().minimize(avg)
+    return main, startup, avg
+
+
+def _train(is_sparse, optimizer_fn, steps=5, vocab=50):
+    main, startup, avg = _build(is_sparse, optimizer_fn, vocab=vocab)
+    place = fluid.CPUPlace()
+    exe = fluid.Executor(place)
+    scope = fluid.core.Scope()
+    rng = np.random.RandomState(3)
+    losses = []
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        for _ in range(steps):
+            ids = rng.randint(0, vocab, size=(16, 4)).astype('int64')
+            lbl = rng.rand(16, 1).astype('float32')
+            loss, = exe.run(main, feed={'ids': ids, 'label': lbl},
+                            fetch_list=[avg])
+            losses.append(float(loss))
+        w = np.asarray(scope.find_var('emb_w'))
+    return losses, w
+
+
+def test_sparse_sgd_parity_with_dense():
+    """sgd's SelectedRows scatter update is EXACTLY the dense update."""
+    dense_losses, dense_w = _train(False, lambda: fluid.optimizer.SGD(0.1))
+    sparse_losses, sparse_w = _train(True, lambda: fluid.optimizer.SGD(0.1))
+    np.testing.assert_allclose(dense_losses, sparse_losses, rtol=1e-5)
+    np.testing.assert_allclose(dense_w, sparse_w, rtol=1e-5, atol=1e-6)
+
+
+def test_sparse_adam_default_parity_with_dense():
+    """Default adam (lazy_mode=False, the reference default) on a sparse
+    grad matches the dense run exactly — absent rows are grad=0 but
+    moments still decay everywhere."""
+    dense_losses, dense_w = _train(False, lambda: fluid.optimizer.Adam(0.05))
+    sparse_losses, sparse_w = _train(True, lambda: fluid.optimizer.Adam(0.05))
+    np.testing.assert_allclose(dense_losses, sparse_losses, rtol=1e-5)
+    np.testing.assert_allclose(dense_w, sparse_w, rtol=1e-5, atol=1e-6)
+
+
+def test_sparse_adam_trains_and_is_lazy():
+    """adam(lazy_mode=True) on a sparse grad decreases loss and leaves
+    untouched rows' params bit-identical (lazy loop of the reference
+    SparseAdamFunctor)."""
+    vocab = 50
+    main, startup, avg = _build(
+        True, lambda: fluid.optimizer.Adam(0.05, lazy_mode=True),
+        vocab=vocab)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.core.Scope()
+    rng = np.random.RandomState(5)
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        w0 = np.asarray(scope.find_var('emb_w')).copy()
+        # Only ever touch rows < 10.
+        losses = []
+        for _ in range(6):
+            ids = rng.randint(0, 10, size=(16, 4)).astype('int64')
+            lbl = rng.rand(16, 1).astype('float32')
+            loss, = exe.run(main, feed={'ids': ids, 'label': lbl},
+                            fetch_list=[avg])
+            losses.append(float(loss))
+        w1 = np.asarray(scope.find_var('emb_w'))
+    assert losses[-1] < losses[0]
+    # Rows never looked up must be untouched (no dense decay applied).
+    np.testing.assert_array_equal(w0[10:], w1[10:])
+    assert np.abs(w0[:10] - w1[:10]).max() > 1e-6
+
+
+def test_sparse_adagrad_trains():
+    losses, _ = _train(True, lambda: fluid.optimizer.Adagrad(0.1))
+    assert losses[-1] < losses[0]
+
+
+def test_sparse_momentum_densify_parity():
+    """Optimizers without a sparse kernel densify the grad — results match
+    the dense path exactly."""
+    dense_losses, dense_w = _train(
+        False, lambda: fluid.optimizer.Momentum(0.1, momentum=0.9))
+    sparse_losses, sparse_w = _train(
+        True, lambda: fluid.optimizer.Momentum(0.1, momentum=0.9))
+    np.testing.assert_allclose(dense_losses, sparse_losses, rtol=1e-5)
+    np.testing.assert_allclose(dense_w, sparse_w, rtol=1e-5, atol=1e-6)
+
+
+def test_sparse_shared_embedding_fanout_sum():
+    """Two lookups into the SAME table produce two SelectedRows grads that
+    backward's dedup sums (reference sum_op SelectedRows concat path)."""
+    main = fluid.Program()
+    startup = fluid.Program()
+    main.random_seed = 9
+    startup.random_seed = 9
+    with fluid.program_guard(main, startup):
+        a = fluid.layers.data(name='a', shape=[3], dtype='int64')
+        b = fluid.layers.data(name='b', shape=[3], dtype='int64')
+        attr = fluid.ParamAttr(
+            name='shared_w',
+            initializer=fluid.initializer.Normal(seed=9))
+        ea = fluid.layers.embedding(a, size=[30, 6], is_sparse=True,
+                                    param_attr=attr)
+        eb = fluid.layers.embedding(b, size=[30, 6], is_sparse=True,
+                                    param_attr=attr)
+        s = fluid.layers.elementwise_add(
+            fluid.layers.reduce_mean(ea, dim=1),
+            fluid.layers.reduce_mean(eb, dim=1))
+        avg = fluid.layers.mean(s)
+        fluid.optimizer.SGD(0.1).minimize(avg)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.core.Scope()
+    rng = np.random.RandomState(2)
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        w0 = np.asarray(scope.find_var('shared_w')).copy()
+        av = rng.randint(0, 30, size=(8, 3)).astype('int64')
+        bv = rng.randint(0, 30, size=(8, 3)).astype('int64')
+        exe.run(main, feed={'a': av, 'b': bv}, fetch_list=[avg])
+        w1 = np.asarray(scope.find_var('shared_w'))
+    touched = np.unique(np.concatenate([av.ravel(), bv.ravel()]))
+    untouched = np.setdiff1d(np.arange(30), touched)
+    assert np.abs(w1[touched] - w0[touched]).max() > 0
+    if len(untouched):
+        np.testing.assert_array_equal(w1[untouched], w0[untouched])
