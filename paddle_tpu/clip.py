@@ -111,16 +111,16 @@ class GradientClipByGlobalNorm(BaseGradientClipAttr):
         self._scale_var = nn.elementwise_div(clip_const, denom)
 
 
-_gradient_clip_attr_default = None
-
-
 def set_gradient_clip(clip, param_list=None, program=None):
-    """Set clip attr on params (reference clip.py set_gradient_clip)."""
-    global _gradient_clip_attr_default
+    """Set clip attr on params (reference clip.py set_gradient_clip).
+
+    Scoped to the given program's parameters (the reference semantics) —
+    NOT a process-global default, so one program's clip policy never leaks
+    into another program built later in the same process.
+    """
     from .framework import default_main_program, Parameter
     program = program or default_main_program()
     if param_list is None:
-        _gradient_clip_attr_default = clip
         param_list = [v for v in program.global_block().vars.values()
                       if isinstance(v, Parameter)]
     else:
@@ -137,8 +137,7 @@ def append_gradient_clip_ops(param_grads):
         if g is None:
             clips.append(None)
             continue
-        clip_attr = getattr(p, 'gradient_clip_attr', None) \
-            or _gradient_clip_attr_default
+        clip_attr = getattr(p, 'gradient_clip_attr', None)
         if clip_attr is None:
             clip_attr = NullGradientClipAttr()
         clip_attr._process_context(context, p, g)

@@ -57,9 +57,21 @@ def _register_elementwise(name, fn):
     op_type = 'elementwise_' + name
 
     def emit(ctx, op):
+        from ..selected_rows import SelectedRows
         x = ctx.get(op.single_input('X'))
         y = ctx.get(op.single_input('Y'))
         axis = op.attr('axis', -1)
+        if isinstance(y, SelectedRows):
+            y = y.to_dense()
+        if isinstance(x, SelectedRows):
+            # mul/div by a scalar are linear per-row, so the sparse format
+            # survives (the grad-clip scale path); anything else needs the
+            # merged dense view (reference elementwise ops merge first).
+            if name in ('mul', 'div') and jnp.ndim(y) == 0:
+                ctx.set(op.single_output('Out'),
+                        SelectedRows(fn(x.values, y), x.rows, x.height))
+                return
+            x = x.to_dense()
         ctx.set(op.single_output('Out'),
                 fn(x, _broadcast_y(x, y, axis,
                                    _declared_rank(ctx, op, 'X'))))
@@ -277,7 +289,13 @@ register_vjp_grad('scale')
 
 @op_emitter('clip')
 def _clip_emit(ctx, op):
+    from ..selected_rows import SelectedRows
     x = ctx.get(op.single_input('X'))
+    if isinstance(x, SelectedRows):
+        # clip is nonlinear, so duplicate rows must be merged before
+        # clipping (reference clip_op.h SelectedRows path merges first);
+        # densify = merge with static shapes.
+        x = x.to_dense()
     ctx.set(op.single_output('Out'),
             jnp.clip(x, op.attr('min'), op.attr('max')))
 
@@ -288,8 +306,19 @@ register_vjp_grad('clip')
 
 @op_emitter('clip_by_norm')
 def _clip_by_norm_emit(ctx, op):
+    from ..selected_rows import SelectedRows
     x = ctx.get(op.single_input('X'))
     max_norm = op.attr('max_norm')
+    if isinstance(x, SelectedRows):
+        # norm must be taken over the MERGED rows (reference
+        # clip_by_norm_op.h merges first), but the rescale itself is
+        # linear, so the output stays sparse.
+        norm = jnp.sqrt(jnp.sum(jnp.square(x.to_dense())))
+        scale = jnp.where(norm > max_norm,
+                          max_norm / jnp.maximum(norm, 1e-12), 1.0)
+        ctx.set(op.single_output('Out'),
+                SelectedRows(x.values * scale, x.rows, x.height))
+        return
     norm = jnp.sqrt(jnp.sum(jnp.square(x)))
     scale = jnp.where(norm > max_norm, max_norm / jnp.maximum(norm, 1e-12), 1.0)
     ctx.set(op.single_output('Out'), x * scale)
@@ -312,10 +341,9 @@ def _sum_emit(ctx, op):
         # all-sparse inputs concatenate rows (dedup deferred to the
         # consumer's scatter-add); mixed dense+sparse densifies.
         if all(isinstance(x, SelectedRows) for x in xs):
-            import jax.numpy as _j
-            vals = _j.concatenate([x.values for x in xs], axis=0)
-            rows = _j.concatenate(
-                [_j.asarray(x.rows, _j.int32) for x in xs], axis=0)
+            vals = jnp.concatenate([x.values for x in xs], axis=0)
+            rows = jnp.concatenate(
+                [jnp.asarray(x.rows, jnp.int32) for x in xs], axis=0)
             ctx.set(op.single_output('Out'),
                     SelectedRows(vals, rows, xs[0].height))
             return
@@ -559,7 +587,11 @@ register_op('increment', infer_shape=same_shape_infer(), no_grad=True)
 
 @op_emitter('squared_l2_norm')
 def _squared_l2_norm_emit(ctx, op):
+    from ..selected_rows import SelectedRows
     x = ctx.get(op.single_input('X'))
+    if isinstance(x, SelectedRows):
+        # duplicate rows sum before the square (merge semantics)
+        x = x.to_dense()
     ctx.set(op.single_output('Out'), jnp.sum(jnp.square(x)))
 
 

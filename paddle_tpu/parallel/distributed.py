@@ -23,6 +23,7 @@ runs here unmodified with `ParallelExecutor(num_trainers=..., trainer_id=...)`.
 """
 from __future__ import annotations
 
+import functools
 import os
 
 import numpy as np
@@ -166,17 +167,27 @@ def shard_rows_for_process(arr, mesh, axis_entry):
     local devices sit on (which host_local_array_to_global_array requires
     to be one contiguous range — asserted)."""
     names = axis_entry if isinstance(axis_entry, tuple) else (axis_entry,)
-    axes = list(mesh.axis_names)
-    dev_arr = np.asarray(mesh.devices)
-    total = 1
-    for nm in names:
-        total *= mesh.shape[nm]
+    lo, nmine, total = _process_shard_range(mesh, names)
     rows = arr.shape[0]
     if rows % total != 0:
         raise ValueError('dim0=%d not divisible by %d shards along %r'
                          % (rows, total, names))
     per = rows // total
+    return arr[lo * per:(lo + nmine) * per]
+
+
+@functools.lru_cache(maxsize=64)
+def _process_shard_range(mesh, names):
+    """(lo_shard, n_shards, total_shards) for this process along `names`.
+    Depends only on (mesh, names) within a process — memoized, since the
+    device walk is O(mesh size) and startup broadcast calls this per
+    parameter."""
     pid = jax.process_index()
+    axes = list(mesh.axis_names)
+    dev_arr = np.asarray(mesh.devices)
+    total = 1
+    for nm in names:
+        total *= mesh.shape[nm]
     mine = set()
     for idx in np.ndindex(*dev_arr.shape):
         coord = 0
@@ -194,4 +205,4 @@ def shard_rows_for_process(arr, mesh, axis_entry):
             'axis %r maps to non-contiguous dim-0 shards %s for process %d; '
             'reorder the mesh so dim-0 sharding is contiguous per host'
             % (names, sorted(mine), pid))
-    return arr[lo * per:(lo + len(mine)) * per]
+    return (lo, len(mine), total)

@@ -161,3 +161,63 @@ def test_sparse_shared_embedding_fanout_sum():
     assert np.abs(w1[touched] - w0[touched]).max() > 0
     if len(untouched):
         np.testing.assert_array_equal(w1[untouched], w0[untouched])
+
+
+@pytest.mark.parametrize('clip', [
+    'global_norm', 'by_norm', 'by_value'])
+def test_sparse_grad_clip_parity_with_dense(clip):
+    """Gradient clipping on a SelectedRows grad matches the dense path
+    (reference clip_op.h / clip_by_norm_op.h merge-then-clip SelectedRows
+    kernels)."""
+    def make_opt():
+        return fluid.optimizer.SGD(0.5)
+
+    def build_and_train(is_sparse):
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = 13
+        startup.random_seed = 13
+        with fluid.program_guard(main, startup):
+            ids = fluid.layers.data(name='ids', shape=[4], dtype='int64')
+            label = fluid.layers.data(name='label', shape=[1],
+                                      dtype='float32')
+            emb = fluid.layers.embedding(
+                ids, size=[40, 8], is_sparse=is_sparse,
+                param_attr=fluid.ParamAttr(
+                    name='cw', initializer=fluid.initializer.Normal(seed=13)))
+            pooled = fluid.layers.reduce_mean(emb, dim=1)
+            pred = fluid.layers.fc(pooled, size=1,
+                                   param_attr=fluid.ParamAttr(
+                                       name='cfc',
+                                       initializer=fluid.initializer.
+                                       Normal(seed=17)))
+            avg = fluid.layers.mean(
+                fluid.layers.square_error_cost(pred, label))
+            if clip == 'global_norm':
+                fluid.clip.set_gradient_clip(
+                    fluid.clip.GradientClipByGlobalNorm(0.01), program=main)
+            elif clip == 'by_norm':
+                fluid.clip.set_gradient_clip(
+                    fluid.clip.GradientClipByNorm(0.01), program=main)
+            else:
+                fluid.clip.set_gradient_clip(
+                    fluid.clip.GradientClipByValue(1e-3), program=main)
+            make_opt().minimize(avg)
+        exe = fluid.Executor(fluid.CPUPlace())
+        scope = fluid.core.Scope()
+        rng = np.random.RandomState(21)
+        losses = []
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            for _ in range(4):
+                idv = rng.randint(0, 40, size=(16, 4)).astype('int64')
+                lbl = rng.rand(16, 1).astype('float32')
+                loss, = exe.run(main, feed={'ids': idv, 'label': lbl},
+                                fetch_list=[avg])
+                losses.append(float(loss))
+            w = np.asarray(scope.find_var('cw')).copy()
+        return losses, w
+
+    dl, dw = build_and_train(False)
+    sl, sw = build_and_train(True)
+    np.testing.assert_allclose(dl, sl, rtol=1e-5)
+    np.testing.assert_allclose(dw, sw, rtol=1e-5, atol=1e-7)
