@@ -51,6 +51,9 @@ from .parallel_executor import (ParallelExecutor, ExecutionStrategy,
                                 BuildStrategy)
 from . import core
 from . import contrib
+from . import transpiler
+from .transpiler import DistributeTranspiler, DistributeTranspilerConfig
+from . import distributed
 
 __version__ = '0.1.0'
 

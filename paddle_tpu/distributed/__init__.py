@@ -1,0 +1,22 @@
+"""Distributed runtime: parameter-server RPC layer + services.
+
+Two distinct distributed modes exist in the framework, mirroring the
+reference's split (SURVEY §2.11):
+
+- **Collective mode** (`paddle_tpu.parallel`): XLA collectives over
+  ICI/DCN via the JAX coordination service — dense data/model parallel
+  training (the NCCL path analog).
+- **Parameter-server mode** (this package): host-CPU parameter services
+  over TCP, TPU trainers pushing gradients / pulling parameters — the
+  sparse/CTR half (the gRPC `operators/distributed/` analog:
+  rpc_client.h:30, grpc_server.h:46, listen_and_serv_op.cc:39).
+
+The wire format ships SelectedRows natively (rows + values) so sparse
+embedding gradients cost O(touched rows), not O(vocab) — the bandwidth
+win that motivates the parameter-server design for CTR models.
+"""
+from .rpc import PSClient, PSServer, get_client, close_all_clients
+from .param_service import ParameterService
+
+__all__ = ['PSClient', 'PSServer', 'ParameterService', 'get_client',
+           'close_all_clients']

@@ -1,0 +1,145 @@
+"""Parameter service: the listen_and_serv sync/async loop state machine.
+
+Semantics transplanted from the reference pserver
+(operators/listen_and_serv_op.cc — RunSyncLoop :102, RunAsyncLoop :178):
+
+sync mode, per round:
+  1. every trainer pushes its gradients (SEND_VAR) then a BATCH_BARRIER;
+  2. when all live trainers' barriers arrived, gradients are merged
+     (sum / num_trainers — averaging half-batch mean-loss grads
+     reproduces the full-batch gradient exactly) and the optimize blocks
+     run against the pserver scope;
+  3. parameter pulls (GET_VAR / PREFETCH) issued after a trainer's
+     barrier block until that round's update is applied, then serve the
+     fresh values; FETCH_BARRIER ends the trainer's round.
+
+async mode: each SEND_VAR immediately runs that gradient's optimize
+block (no barriers, no merge — the reference's async SGD).
+
+A COMPLETE message retires a trainer; barriers re-evaluate against the
+live set so stragglers don't deadlock (reference rpc_server.cc
+DecreaseClientNum), and the server shuts down once every trainer
+completed.
+
+Sparse merge: SelectedRows from several trainers concatenate rows/values
+(duplicate rows are legal — optimizer scatter-adds merge them), then
+values scale by 1/num_trainers in sync mode.
+"""
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+__all__ = ['ParameterService']
+
+
+class ParameterService(object):
+    def __init__(self, num_trainers, sync_mode, get_param, run_round,
+                 run_one_grad=None, prefetch=None):
+        """get_param(name) -> value; run_round(merged: {grad: value});
+        run_one_grad(grad_name, value) for async; prefetch(table, ids)."""
+        self.num_trainers = num_trainers
+        self.sync_mode = sync_mode
+        self._get_param = get_param
+        self._run_round = run_round
+        self._run_one_grad = run_one_grad
+        self._prefetch = prefetch
+
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._pending = {}            # grad name -> {tid: value}
+        self._barrier_tids = set()    # tids whose BATCH_BARRIER arrived
+        self._trainer_rounds = {}     # tid -> rounds contributed
+        self._completed_rounds = 0
+        self._done_tids = set()
+        self._error = None
+
+    # -- helpers -----------------------------------------------------------
+    def _live_count(self):
+        return self.num_trainers - len(self._done_tids)
+
+    def _merge(self, values):
+        """Merge one grad's per-trainer values: sum, then average over the
+        ORIGINAL trainer count (a retired trainer's mean-grad contribution
+        is treated as zero for the remaining steps)."""
+        from ..selected_rows import SelectedRows
+        scale = 1.0 / float(self.num_trainers)
+        vs = list(values)
+        if isinstance(vs[0], SelectedRows):
+            rows = np.concatenate([np.asarray(v.rows) for v in vs])
+            vals = np.concatenate([np.asarray(v.values) for v in vs])
+            return SelectedRows(vals * scale, rows.astype('int32'),
+                                vs[0].height)
+        out = np.asarray(vs[0], dtype=np.result_type(vs[0]))
+        for v in vs[1:]:
+            out = out + np.asarray(v)
+        return out * scale
+
+    def _maybe_run_round_locked(self):
+        if not self._barrier_tids:
+            return
+        if len(self._barrier_tids) < self._live_count():
+            return
+        merged = {g: self._merge(per_tid.values())
+                  for g, per_tid in self._pending.items() if per_tid}
+        try:
+            self._run_round(merged)
+        except Exception as e:
+            self._error = e
+            raise
+        finally:
+            self._pending.clear()
+            self._barrier_tids.clear()
+            self._completed_rounds += 1
+            self._cond.notify_all()
+
+    def _wait_for_trainer_round_locked(self, tid):
+        """Block until every round this trainer contributed to is applied
+        (its own GET arrives, by per-connection ordering, after its
+        BATCH_BARRIER)."""
+        while self._completed_rounds < self._trainer_rounds.get(tid, 0):
+            if self._error is not None:
+                raise RuntimeError('pserver optimize failed: %s'
+                                   % self._error)
+            self._cond.wait(timeout=1.0)
+
+    # -- service interface (called from PSServer threads) ------------------
+    def on_send_var(self, name, tid, value):
+        if not self.sync_mode and self._run_one_grad is not None:
+            with self._lock:
+                self._run_one_grad(name, value)
+            return
+        with self._lock:
+            self._pending.setdefault(name, {})[tid] = value
+
+    def on_batch_barrier(self, tid):
+        with self._lock:
+            self._barrier_tids.add(tid)
+            self._trainer_rounds[tid] = self._trainer_rounds.get(tid, 0) + 1
+            self._maybe_run_round_locked()
+
+    def on_get_var(self, name, tid):
+        with self._lock:
+            if self.sync_mode:
+                self._wait_for_trainer_round_locked(tid)
+            return self._get_param(name)
+
+    def on_prefetch(self, name, tid, ids):
+        if self._prefetch is None:
+            raise RuntimeError('this pserver hosts no lookup table')
+        with self._lock:
+            if self.sync_mode:
+                self._wait_for_trainer_round_locked(tid)
+            return self._prefetch(name, np.asarray(ids))
+
+    def on_fetch_barrier(self, tid):
+        pass    # round already closed by the sync wait in on_get_var
+
+    def on_complete(self, tid):
+        with self._lock:
+            self._done_tids.add(tid)
+            self._barrier_tids.discard(tid)
+            # a straggler-free round may now be unblocked
+            self._maybe_run_round_locked()
+            return len(self._done_tids) >= self.num_trainers

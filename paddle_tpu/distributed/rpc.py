@@ -1,0 +1,209 @@
+"""TCP RPC client/server for parameter-server mode.
+
+The capability analog of the reference's GRPCClient (operators/distributed/
+grpc_client.h:175: AsyncSendVar/AsyncGetVar/AsyncPrefetchVar/
+AsyncSendBatchBarrier/AsyncSendFetchBarrier/AsyncSendComplete) and
+AsyncGRPCServer (grpc_server.h:46), re-based on plain sockets + the binary
+wire format in wire.py. Each trainer holds one persistent connection per
+pserver; the server runs one thread per connection and dispatches into a
+service object (param_service.ParameterService) — the threading shape of
+the reference's RunSyncLoop server.
+"""
+from __future__ import annotations
+
+import socket
+import threading
+import time
+
+from . import wire
+
+__all__ = ['PSClient', 'PSServer', 'get_client', 'close_all_clients']
+
+
+class PSClient(object):
+    """One trainer's connection to one pserver endpoint."""
+
+    def __init__(self, endpoint, trainer_id=0, timeout=120.0,
+                 connect_retry_secs=60.0):
+        self.endpoint = endpoint
+        self.trainer_id = trainer_id
+        host, port = endpoint.rsplit(':', 1)
+        # trainers routinely start before their pservers finish binding
+        # (reference GRPC clients block on channel readiness) — retry
+        deadline = time.monotonic() + connect_retry_secs
+        while True:
+            try:
+                self._sock = socket.create_connection(
+                    (host, int(port)), timeout=timeout)
+                break
+            except (ConnectionRefusedError, OSError):
+                if time.monotonic() >= deadline:
+                    raise
+                time.sleep(0.2)
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._lock = threading.Lock()
+
+    def _call(self, msg_type, meta=None, value=None):
+        meta = dict(meta or {})
+        meta['trainer_id'] = self.trainer_id
+        with self._lock:
+            wire.write_msg(self._sock, msg_type, meta, value)
+            rtype, rmeta, rvalue = wire.read_msg(self._sock)
+        if rtype == wire.REPLY_ERR:
+            raise RuntimeError('pserver %s: %s'
+                               % (self.endpoint, rmeta.get('error')))
+        return rmeta, rvalue
+
+    def send_var(self, name, value):
+        """Push a gradient (dense array or SelectedRows)."""
+        self._call(wire.SEND_VAR, {'name': name}, value)
+
+    def get_var(self, name):
+        """Pull a parameter value."""
+        _, value = self._call(wire.GET_VAR, {'name': name})
+        return value
+
+    def prefetch(self, table_name, ids):
+        """Distributed lookup table: local row ids -> embedding rows."""
+        import numpy as np
+        _, rows = self._call(wire.PREFETCH, {'name': table_name},
+                             np.asarray(ids, dtype='int32'))
+        return rows
+
+    def batch_barrier(self):
+        self._call(wire.BATCH_BARRIER)
+
+    def fetch_barrier(self):
+        self._call(wire.FETCH_BARRIER)
+
+    def complete(self):
+        self._call(wire.COMPLETE)
+
+    def close(self):
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+# module-level client pool: one PSClient per endpoint for this process
+# (the analog of GRPCClient's channel cache); Executor.close() drains it.
+_clients = {}
+_clients_lock = threading.Lock()
+
+
+def get_client(endpoint, trainer_id=0):
+    with _clients_lock:
+        c = _clients.get(endpoint)
+        if c is None:
+            c = _clients[endpoint] = PSClient(endpoint, trainer_id)
+        return c
+
+
+def close_all_clients(send_complete=True):
+    """Notify every connected pserver this trainer is done and drop the
+    connections (reference Executor::Close -> SendComplete)."""
+    with _clients_lock:
+        for c in _clients.values():
+            if send_complete:
+                try:
+                    c.complete()
+                except (RuntimeError, OSError, ConnectionError):
+                    pass
+            c.close()
+        _clients.clear()
+
+
+class PSServer(object):
+    """Threaded TCP server dispatching wire messages into a service.
+
+    service interface (see param_service.ParameterService):
+      on_send_var(name, trainer_id, value)
+      on_get_var(name, trainer_id) -> value
+      on_prefetch(name, trainer_id, ids) -> rows
+      on_batch_barrier(trainer_id)
+      on_fetch_barrier(trainer_id)
+      on_complete(trainer_id)  -> True when ALL trainers completed
+    """
+
+    def __init__(self, endpoint, service):
+        host, port = endpoint.rsplit(':', 1)
+        self.service = service
+        self._lsock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._lsock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._lsock.bind((host, int(port)))
+        self._lsock.listen(64)
+        self.port = self._lsock.getsockname()[1]
+        self._done = threading.Event()
+        self._threads = []
+
+    def serve_forever(self):
+        """Accept + dispatch until the service reports all trainers
+        complete (the RunSyncLoop exit condition, listen_and_serv_op.cc:
+        exit_flag on COMPLETE messages)."""
+        accept_t = threading.Thread(target=self._accept_loop, daemon=True)
+        accept_t.start()
+        self._done.wait()
+        try:
+            self._lsock.close()
+        except OSError:
+            pass
+        for t in self._threads:
+            t.join(timeout=5.0)
+
+    def shutdown(self):
+        self._done.set()
+
+    def _accept_loop(self):
+        while not self._done.is_set():
+            try:
+                conn, _ = self._lsock.accept()
+            except OSError:
+                return
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            t = threading.Thread(target=self._serve_conn, args=(conn,),
+                                 daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def _serve_conn(self, conn):
+        svc = self.service
+        try:
+            while True:
+                try:
+                    msg_type, meta, value = wire.read_msg(conn)
+                except (ConnectionError, OSError):
+                    return
+                tid = int(meta.get('trainer_id', 0))
+                name = meta.get('name')
+                try:
+                    if msg_type == wire.SEND_VAR:
+                        svc.on_send_var(name, tid, value)
+                        wire.write_msg(conn, wire.REPLY_OK)
+                    elif msg_type == wire.GET_VAR:
+                        out = svc.on_get_var(name, tid)
+                        wire.write_msg(conn, wire.REPLY_VAR, value=out)
+                    elif msg_type == wire.PREFETCH:
+                        out = svc.on_prefetch(name, tid, value)
+                        wire.write_msg(conn, wire.REPLY_VAR, value=out)
+                    elif msg_type == wire.BATCH_BARRIER:
+                        svc.on_batch_barrier(tid)
+                        wire.write_msg(conn, wire.REPLY_OK)
+                    elif msg_type == wire.FETCH_BARRIER:
+                        svc.on_fetch_barrier(tid)
+                        wire.write_msg(conn, wire.REPLY_OK)
+                    elif msg_type == wire.COMPLETE:
+                        all_done = svc.on_complete(tid)
+                        wire.write_msg(conn, wire.REPLY_OK)
+                        if all_done:
+                            self.shutdown()
+                    else:
+                        wire.write_msg(conn, wire.REPLY_ERR,
+                                       {'error': 'bad msg type %d' % msg_type})
+                except Exception as e:   # surface server-side op errors
+                    wire.write_msg(conn, wire.REPLY_ERR, {'error': str(e)})
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass

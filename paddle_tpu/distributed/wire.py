@@ -1,0 +1,97 @@
+"""Binary wire format for the parameter-server RPC layer.
+
+Replaces the reference's protobuf `send_recv.proto.in` (VariableMessage:
+varname, type, dims, serialized LoDTensor/SelectedRows bytes) with a
+compact JSON-header + raw-bytes framing — same capability (dense tensors
+and SelectedRows cross the wire; sparse ships rows+values only), no
+protobuf dependency.
+
+Frame layout (all integers little-endian):
+
+    u32 body_len | u8 msg_type | u32 meta_len | meta (JSON, utf-8) | payload
+
+Dense payload:        raw C-contiguous array bytes (dtype/shape in meta).
+SelectedRows payload: values bytes followed by int32 rows bytes
+                      (meta: value dtype/shape, nrows, height).
+"""
+from __future__ import annotations
+
+import json
+import struct
+
+import numpy as np
+
+# message types
+SEND_VAR = 1        # trainer -> pserver: push a gradient (dense or sparse)
+GET_VAR = 2         # trainer -> pserver: pull a parameter
+PREFETCH = 3        # trainer -> pserver: distributed-lookup-table row fetch
+BATCH_BARRIER = 4   # trainer -> pserver: all grads for this step sent
+FETCH_BARRIER = 5   # trainer -> pserver: all params for this step fetched
+COMPLETE = 6        # trainer -> pserver: this trainer is done training
+REPLY_VAR = 7       # pserver -> trainer: a variable value
+REPLY_OK = 8        # pserver -> trainer: ack
+REPLY_ERR = 9       # pserver -> trainer: error (meta['error'])
+
+_HDR = struct.Struct('<IBI')   # body_len, msg_type, meta_len
+
+
+def _payload_of(value):
+    """(meta_fields, payload_bytes) for a dense array or SelectedRows."""
+    from ..selected_rows import SelectedRows
+    if isinstance(value, SelectedRows):
+        vals = np.ascontiguousarray(np.asarray(value.values))
+        rows = np.ascontiguousarray(np.asarray(value.rows, dtype=np.int32))
+        meta = {'sparse': True, 'dtype': vals.dtype.name,
+                'shape': list(vals.shape), 'height': int(value.height)}
+        return meta, vals.tobytes() + rows.tobytes()
+    arr = np.ascontiguousarray(np.asarray(value))
+    meta = {'sparse': False, 'dtype': arr.dtype.name,
+            'shape': list(arr.shape)}
+    return meta, arr.tobytes()
+
+
+def _value_of(meta, payload):
+    """Inverse of _payload_of."""
+    from ..selected_rows import SelectedRows
+    dtype = np.dtype(meta['dtype'])
+    shape = tuple(meta['shape'])
+    if meta.get('sparse'):
+        nval = int(np.prod(shape)) * dtype.itemsize
+        values = np.frombuffer(payload[:nval], dtype=dtype).reshape(shape)
+        rows = np.frombuffer(payload[nval:], dtype=np.int32)
+        return SelectedRows(values, rows, meta['height'])
+    n = int(np.prod(shape)) * dtype.itemsize
+    return np.frombuffer(payload[:n], dtype=dtype).reshape(shape)
+
+
+def write_msg(sock, msg_type, meta=None, value=None, payload=b''):
+    meta = dict(meta or {})
+    if value is not None:
+        vmeta, payload = _payload_of(value)
+        meta.update(vmeta)
+    mb = json.dumps(meta).encode('utf-8')
+    body_len = 1 + 4 + len(mb) + len(payload)
+    sock.sendall(_HDR.pack(body_len, msg_type, len(mb)) + mb + payload)
+
+
+def _read_exact(sock, n):
+    chunks = []
+    while n > 0:
+        b = sock.recv(min(n, 1 << 20))
+        if not b:
+            raise ConnectionError('peer closed the connection')
+        chunks.append(b)
+        n -= len(b)
+    return b''.join(chunks)
+
+
+def read_msg(sock):
+    """-> (msg_type, meta dict, value or None). value is a numpy array or
+    SelectedRows when the meta describes one."""
+    hdr = _read_exact(sock, _HDR.size)
+    body_len, msg_type, meta_len = _HDR.unpack(hdr)
+    body = _read_exact(sock, body_len - 1 - 4) if body_len > 5 else b''
+    meta = json.loads(body[:meta_len].decode('utf-8')) if meta_len else {}
+    payload = body[meta_len:]
+    value = _value_of(meta, payload) if 'dtype' in meta else None
+    return msg_type, meta, value

@@ -268,8 +268,19 @@ class HostContext(object):
             raise KeyError('host op input %r not found in scope' % name)
         return np.asarray(val)
 
+    def get_raw(self, name):
+        """Like get() but without numpy coercion — for host ops consuming
+        structured values (SelectedRows gradients in the send op)."""
+        val = self.scope.find_var(name)
+        if val is None:
+            raise KeyError('host op input %r not found in scope' % name)
+        return val
+
     def set(self, name, value):
         self.scope.set_var(name, np.asarray(value))
+
+    def set_raw(self, name, value):
+        self.scope.set_var(name, value)
 
     def delete(self, name):
         self.scope.erase(name)
@@ -308,11 +319,16 @@ class _HostStep(object):
 class PreparedProgram(object):
     """Analog of reference ExecutorPrepareContext (executor.h:28)."""
 
-    def __init__(self, program, block_id, feed_names, fetch_names):
+    def __init__(self, program, block_id, feed_names, fetch_names,
+                 donate=True):
         self.program = program
         self.block = program.blocks[block_id]
         self.feed_names = list(feed_names)
         self.fetch_names = list(fetch_names)
+        # donate=False for pserver optimize blocks: the RPC threads may
+        # serve a parameter concurrently with the next async update, so
+        # buffers must not be invalidated in place
+        self.donate = donate
         self.steps = []          # list of _DeviceSegment | _HostStep
         self._build_segments()
         self._analyze_dataflow()
@@ -513,7 +529,8 @@ class Executor(object):
             if step.jitted is None:
                 step.jitted = self._compile_segment(
                     step, block, program,
-                    feed_names=tuple(feed_arrays.keys()))
+                    feed_names=tuple(feed_arrays.keys()),
+                    donate=prepared.donate)
             donated = {}
             const = {}
             out_set = set(step.out_names)
@@ -531,6 +548,13 @@ class Executor(object):
             for name, val in zip(step.out_names, outs):
                 local[name] = val
                 var = block.vars.get(name)
+                if var is None and block.parent_block is not None:
+                    # sub-block execution (pserver optimize blocks): the
+                    # written var usually lives in an ancestor block
+                    try:
+                        var = block.var_recursive(name)
+                    except KeyError:
+                        var = None
                 if var is not None and var.persistable:
                     scope.set_var(name, val)
                 else:
@@ -560,7 +584,32 @@ class Executor(object):
         """Hook: mesh visible to emitters (sharding constraints)."""
         return None
 
-    def _compile_segment(self, segment, block, program, feed_names=()):
+    def run_block(self, program, block_id, scope, fetch_names=()):
+        """Run one block (no feeds) against `scope` — the nested-executor
+        entry used by host ops that interpret sub-blocks on the host
+        (listen_and_serv optimize blocks; reference
+        listen_and_serv_op.cc:148 ParallelExecuteBlocks). Buffers are NOT
+        donated: RPC threads may read a parameter concurrently."""
+        # 'block_run' tag: run() caches donate=True entries for block 0
+        # under a colliding signature — never share them
+        cache_key = ('block_run', program._uid, program._version, block_id,
+                     tuple(fetch_names))
+        prepared = self._prepared_cache.get(cache_key)
+        if prepared is None:
+            prepared = PreparedProgram(program, block_id, (),
+                                       list(fetch_names), donate=False)
+            self._prepared_cache[cache_key] = prepared
+        return self._run_prepared(prepared, {}, list(fetch_names), scope,
+                                  program)
+
+    def close(self):
+        """Notify pservers this trainer is done (reference
+        executor.cc:48 Executor::Close -> SendComplete)."""
+        from .distributed.rpc import close_all_clients
+        close_all_clients(send_complete=True)
+
+    def _compile_segment(self, segment, block, program, feed_names=(),
+                         donate=True):
         is_test = program._is_test
         ops = segment.ops
         offsets = segment.op_offsets
@@ -580,7 +629,7 @@ class Executor(object):
                 registry._REGISTRY[op.type].emit(ctx, op)
             return tuple(env[n] for n in out_names)
 
-        return jax.jit(seg_fn, donate_argnums=(0,),
+        return jax.jit(seg_fn, donate_argnums=(0,) if donate else (),
                        **self._jit_options(segment, feed_names))
 
 
@@ -596,8 +645,19 @@ class _RunHostContext(HostContext):
             return np.asarray(self.local[name])
         return super(_RunHostContext, self).get(name)
 
+    def get_raw(self, name):
+        if name in self.local:
+            return self.local[name]
+        return super(_RunHostContext, self).get_raw(name)
+
     def set(self, name, value):
         self.local[name] = np.asarray(value)
         if self.scope.has_var(name) or \
                 (name in self.block.vars and self.block.vars[name].persistable):
             self.scope.set_var(name, np.asarray(value))
+
+    def set_raw(self, name, value):
+        self.local[name] = value
+        if self.scope.has_var(name) or \
+                (name in self.block.vars and self.block.vars[name].persistable):
+            self.scope.set_var(name, value)

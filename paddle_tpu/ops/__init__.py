@@ -10,3 +10,4 @@ from . import control_flow_ops  # noqa: F401
 from . import array_ops    # noqa: F401
 from . import sequence_ops  # noqa: F401
 from . import moe_ops       # noqa: F401
+from . import dist_ops      # noqa: F401

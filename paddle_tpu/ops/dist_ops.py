@@ -1,0 +1,262 @@
+"""Parameter-server host ops: send / recv / barriers / prefetch /
+split_selected_rows / split_ids / merge_ids / slice_rows / listen_and_serv.
+
+Capability analogs of the reference's RPC operators
+(paddle/fluid/operators/{send_op.cc, recv_op.cc, send_barrier_op.cc,
+fetch_barrier_op.cc, prefetch_op.cc:27, listen_and_serv_op.cc:39,
+split_selected_rows_op.cc, split_ids_op.cc, merge_ids_op.cc}), running as
+host steps between jitted device segments. The device does forward +
+backward in one XLA executable; these ops then ship gradients to the
+parameter services over TCP (distributed/rpc.py) and pull fresh
+parameters — on a TPU the pserver loop is pure host work, so none of
+this belongs in the compiled graph.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..registry import register_op
+from ..selected_rows import SelectedRows
+
+
+def _to_host(value):
+    """Device SelectedRows/array -> host (numpy-backed) value."""
+    if isinstance(value, SelectedRows):
+        return SelectedRows(np.asarray(value.values),
+                            np.asarray(value.rows, dtype=np.int32),
+                            value.height)
+    return np.asarray(value)
+
+
+def _client(ctx_op, endpoint):
+    from ..distributed.rpc import get_client
+    return get_client(endpoint, trainer_id=ctx_op.attr('trainer_id', 0))
+
+
+# -- send / recv / barriers -------------------------------------------------
+
+def _send_emit(ctx, op):
+    """Push each input var to its pserver (epmap aligned with X).
+    Var names are identical on both sides — the service keys arrivals by
+    (name, trainer_id), so no '.trainer_%d' renaming is needed."""
+    epmap = op.attr('epmap')
+    for name, ep in zip(op.input('X'), epmap):
+        _client(op, ep).send_var(name, _to_host(ctx.get_raw(name)))
+
+
+register_op('send', emit=_send_emit, host=True, no_grad=True)
+
+
+def _recv_emit(ctx, op):
+    epmap = op.attr('epmap')
+    for name, ep in zip(op.output('Out'), epmap):
+        ctx.set(name, _client(op, ep).get_var(name))
+
+
+register_op('recv', emit=_recv_emit, host=True, no_grad=True)
+
+
+def _send_barrier_emit(ctx, op):
+    for ep in op.attr('endpoints'):
+        _client(op, ep).batch_barrier()
+
+
+register_op('send_barrier', emit=_send_barrier_emit, host=True, no_grad=True)
+
+
+def _fetch_barrier_emit(ctx, op):
+    for ep in op.attr('endpoints'):
+        _client(op, ep).fetch_barrier()
+
+
+register_op('fetch_barrier', emit=_fetch_barrier_emit, host=True,
+            no_grad=True)
+
+
+# -- split/merge helpers for sharded values ---------------------------------
+
+def _split_selected_rows_emit(ctx, op):
+    """Route a SelectedRows grad to row-range shards (reference
+    split_selected_rows_op.cc): shard i covers rows
+    [offset_i, offset_i + height_sections[i]); emitted rows are LOCAL to
+    the shard (global - offset) so the pserver block applies them
+    directly."""
+    grad = ctx.get_raw(op.single_input('X'))
+    if not isinstance(grad, SelectedRows):
+        raise TypeError('split_selected_rows expects a SelectedRows input')
+    grad = _to_host(grad)
+    sections = op.attr('height_sections')
+    offsets = np.concatenate([[0], np.cumsum(sections)])
+    for i, name in enumerate(op.output('Out')):
+        m = (grad.rows >= offsets[i]) & (grad.rows < offsets[i + 1])
+        ctx.set_raw(name, SelectedRows(
+            grad.values[m], (grad.rows[m] - offsets[i]).astype('int32'),
+            int(sections[i])))
+
+
+register_op('split_selected_rows', emit=_split_selected_rows_emit, host=True,
+            no_grad=True)
+
+
+def _split_ids_emit(ctx, op):
+    """Shard by id modulo (reference split_ids_op.cc): shard i gets
+    entries with id %% nshards == i, re-indexed locally as id // nshards
+    (the distributed-lookup-table routing). Works on raw id arrays and on
+    SelectedRows grads."""
+    x = ctx.get_raw(op.single_input('Ids'))
+    outs = op.output('Out')
+    n = len(outs)
+    if isinstance(x, SelectedRows):
+        x = _to_host(x)
+        shard_h = [(x.height + n - 1 - i) // n for i in range(n)]
+        for i, name in enumerate(outs):
+            m = (x.rows % n) == i
+            ctx.set_raw(name, SelectedRows(
+                x.values[m], (x.rows[m] // n).astype('int32'), shard_h[i]))
+    else:
+        ids = np.asarray(x).reshape(-1)
+        for i, name in enumerate(outs):
+            ctx.set(name, (ids[(ids % n) == i] // n).astype('int64'))
+
+
+register_op('split_ids', emit=_split_ids_emit, host=True, no_grad=True)
+
+
+def _merge_ids_emit(ctx, op):
+    """Inverse of split_ids for prefetched rows (reference
+    merge_ids_op.cc): scatter each shard's returned rows back to the
+    original id positions."""
+    ids = np.asarray(ctx.get(op.single_input('Ids'))).reshape(-1)
+    n = len(op.input('X'))
+    shards = [np.asarray(ctx.get(name)) for name in op.input('X')]
+    width = shards[0].shape[-1]
+    out = np.zeros((len(ids), width), dtype=shards[0].dtype)
+    for i in range(n):
+        out[(ids % n) == i] = shards[i]
+    ctx.set(op.single_output('Out'), out)
+
+
+register_op('merge_ids', emit=_merge_ids_emit, host=True, no_grad=True)
+
+
+def _slice_rows_emit(ctx, op):
+    """arr[start:end:step] along dim 0 — used by pserver startup programs
+    to carve this server's shard out of a full-parameter initialization
+    (contiguous blocks: step=1; mod-sharded lookup tables: start=shard,
+    step=nshards)."""
+    x = ctx.get(op.single_input('X'))
+    end = op.attr('end', None)
+    ctx.set(op.single_output('Out'),
+            x[op.attr('start', 0):(None if end in (None, -1) else end):
+              op.attr('step', 1)])
+
+
+register_op('slice_rows', emit=_slice_rows_emit, host=True, no_grad=True)
+
+
+# -- prefetch (distributed lookup table forward) ----------------------------
+
+def _prefetch_emit(ctx, op):
+    """Remote embedding lookup (reference prefetch_op.cc:27 +
+    lookup_sparse_table semantics): split the step's ids by id %% npserver,
+    fetch each shard's rows, scatter back to the original order, reshape
+    to the lookup_table output shape."""
+    epmap = op.attr('epmap')
+    n = len(epmap)
+    table = op.attr('table_name')
+    ids = np.asarray(ctx.get(op.single_input('Ids')))
+    squeeze_last = ids.ndim > 1 and ids.shape[-1] == 1
+    shaped = ids.reshape(ids.shape[:-1]) if squeeze_last else ids
+    flat = shaped.reshape(-1)
+    width = int(op.attr('emb_dim'))
+    out = np.zeros((flat.size, width), dtype=op.attr('dtype', 'float32'))
+    for i, ep in enumerate(epmap):
+        m = (flat % n) == i
+        if not m.any():
+            continue
+        rows = _client(op, ep).prefetch(table, flat[m] // n)
+        out[m] = rows
+    ctx.set(op.single_output('Out'),
+            out.reshape(shaped.shape + (width,)))
+
+
+register_op('prefetch', emit=_prefetch_emit, host=True, no_grad=True)
+
+
+# -- listen_and_serv (the pserver) ------------------------------------------
+
+def _listen_and_serv_emit(ctx, op):
+    """Run this process as a parameter service until every trainer sends
+    COMPLETE (reference listen_and_serv_op.cc RunSyncLoop :102 /
+    RunAsyncLoop :178). Blocks the executor — exactly like the reference
+    op blocks its thread.
+
+    attrs:
+      endpoint        "host:port" to bind
+      Fanin           number of trainers
+      sync_mode       bool
+      grad_to_block_id  ["gradname:block_idx", ...] — optimize sub-block
+                        per gradient var
+      lr_block_id     block of cloned LR-schedule ops run once per round
+                      (-1: none)
+      prefetch_table  lookup-table param name served by PREFETCH ('' if
+                      none); its var in scope is this server's shard
+    """
+    from ..distributed.param_service import ParameterService
+    from ..distributed.rpc import PSServer
+    from ..executor import Executor, CPUPlace
+
+    program = ctx.block.program
+    scope = ctx.scope
+    exe = Executor(CPUPlace())
+    sync_mode = op.attr('sync_mode', True)
+    num_trainers = op.attr('Fanin', 1)
+    lr_block = op.attr('lr_block_id', -1)
+    grad_to_block = [e.split(':') for e in op.attr('grad_to_block_id', [])]
+    grad_to_block = {g: int(b) for g, b in grad_to_block}
+
+    def run_block(idx):
+        exe.run_block(program, idx, scope)
+
+    def run_round(merged):
+        # deterministic order: lr schedule first, then each grad's block
+        if lr_block >= 0:
+            run_block(lr_block)
+        for g in sorted(merged):
+            scope.set_var(g, merged[g])
+        for g in sorted(grad_to_block):
+            if g in merged:
+                run_block(grad_to_block[g])
+
+    # async mode: the LR schedule must advance once per trainer STEP, not
+    # once per gradient push — tick it on arrivals of one designated grad
+    # (each trainer pushes every grad exactly once per step)
+    lr_trigger = min(grad_to_block) if grad_to_block else None
+
+    def run_one_grad(name, value):       # async mode
+        if lr_block >= 0 and name == lr_trigger:
+            run_block(lr_block)
+        scope.set_var(name, value)
+        run_block(grad_to_block[name])
+
+    def get_param(name):
+        val = scope.find_var(name)
+        if val is None:
+            raise KeyError('pserver has no var %r' % name)
+        return np.asarray(val)
+
+    def prefetch(table, local_ids):
+        shard = np.asarray(scope.find_var(op.attr('prefetch_table')))
+        return shard[np.asarray(local_ids, dtype=np.int64)]
+
+    service = ParameterService(
+        num_trainers=num_trainers, sync_mode=sync_mode,
+        get_param=get_param, run_round=run_round,
+        run_one_grad=run_one_grad,
+        prefetch=prefetch if op.attr('prefetch_table', '') else None)
+    server = PSServer(op.attr('endpoint'), service)
+    server.serve_forever()
+
+
+register_op('listen_and_serv', emit=_listen_and_serv_emit, host=True,
+            no_grad=True)

@@ -630,21 +630,30 @@ def _lookup_table_grad_emit(ctx, op):
     the reference's dynamically-sized SelectedRows grad
     (lookup_table_op.cc grad kernel). Dense path: scatter-add."""
     from ..selected_rows import SelectedRows
-    w = ctx.get(op.single_input('W'))
+    if op.input('W'):
+        w = ctx.get(op.single_input('W'))
+        w_shape, w_dtype = tuple(w.shape), w.dtype
+    else:
+        # distributed lookup table: the trainer never holds W — the
+        # transpiler removed the input and recorded the table geometry
+        w = None
+        w_shape = tuple(op.attr('__table_shape__'))
+        w_dtype = jnp.dtype(op.attr('__table_dtype__', 'float32'))
     ids = ctx.get(op.single_input('Ids'))
     gout = ctx.get(op.single_input('Out@GRAD'))
     squeeze_last = ids.ndim > 1 and ids.shape[-1] == 1
     flat = (ids.reshape(ids.shape[:-1]) if squeeze_last else ids)
     flat = flat.reshape(-1).astype(jnp.int32)
-    rows_g = gout.reshape((len(flat),) + tuple(w.shape[1:]))
+    rows_g = gout.reshape((len(flat),) + w_shape[1:])
     pad = op.attr('padding_idx', -1)
     if pad != -1:
         rows_g = jnp.where((flat == pad)[..., None], 0.0, rows_g)
     if op.attr('is_sparse', False):
         ctx.set(op.single_output('W@GRAD'),
-                SelectedRows(rows_g.astype(w.dtype), flat, w.shape[0]))
+                SelectedRows(rows_g.astype(w_dtype), flat, w_shape[0]))
     else:
-        gw = jnp.zeros_like(w).at[flat].add(rows_g.astype(w.dtype))
+        gw = jnp.zeros((w_shape), w_dtype).at[flat].add(
+            rows_g.astype(w_dtype))
         ctx.set(op.single_output('W@GRAD'), gw)
 
 

@@ -467,11 +467,24 @@ register_op('one_hot', infer_shape=_one_hot_infer, no_grad=True)
 # random ops
 # ---------------------------------------------------------------------------
 
+def _init_key(ctx, op):
+    """RNG key for init-style random ops. A nonzero `seed` attr fully
+    determines the draw (reference {uniform,gaussian}_random_op semantics:
+    the op seeds its own engine), making seeded initializers reproducible
+    regardless of op position or program — the property pserver startup
+    programs rely on when re-running cloned initializers. seed==0 falls
+    back to the executor's positional key stream."""
+    seed = op.attr('seed', 0)
+    if seed:
+        return jax.random.PRNGKey(seed)
+    return ctx.rng(op)
+
+
 @op_emitter('uniform_random', stateful=True)
 def _uniform_random_emit(ctx, op):
     shape = op.attr('shape')
     dtype = op.attr('dtype', 'float32')
-    key = ctx.rng(op)
+    key = _init_key(ctx, op)
     ctx.set(op.single_output('Out'),
             jax.random.uniform(key, tuple(shape), dtype=jnp.float32,
                                minval=op.attr('min', -1.0),
@@ -491,7 +504,7 @@ register_op('uniform_random', infer_shape=_random_infer, no_grad=True)
 def _gaussian_random_emit(ctx, op):
     shape = op.attr('shape')
     dtype = op.attr('dtype', 'float32')
-    key = ctx.rng(op)
+    key = _init_key(ctx, op)
     val = (jax.random.normal(key, tuple(shape), dtype=jnp.float32)
            * op.attr('std', 1.0) + op.attr('mean', 0.0))
     ctx.set(op.single_output('Out'), val.astype(dtype))
@@ -504,7 +517,7 @@ register_op('gaussian_random', infer_shape=_random_infer, no_grad=True)
 def _truncated_gaussian_random_emit(ctx, op):
     shape = op.attr('shape')
     dtype = op.attr('dtype', 'float32')
-    key = ctx.rng(op)
+    key = _init_key(ctx, op)
     val = jax.random.truncated_normal(key, -2.0, 2.0, tuple(shape),
                                       dtype=jnp.float32)
     val = val * op.attr('std', 1.0) + op.attr('mean', 0.0)

@@ -1,0 +1,13 @@
+"""Program transpilers (reference python/paddle/fluid/transpiler/).
+
+DistributeTranspiler rewrites a local program into trainer + pserver
+programs for parameter-server mode. The reference's memory-optimize
+transpiler has no analog here by design: XLA buffer liveness + donated
+persistables already provide in-place variable reuse.
+"""
+from .distribute_transpiler import (DistributeTranspiler,
+                                    DistributeTranspilerConfig)
+from .ps_dispatcher import PSDispatcher, RoundRobin, HashName
+
+__all__ = ['DistributeTranspiler', 'DistributeTranspilerConfig',
+           'PSDispatcher', 'RoundRobin', 'HashName']

@@ -1,0 +1,40 @@
+"""Pserver dispatchers: how parameter blocks map to parameter servers
+(reference python/paddle/fluid/transpiler/ps_dispatcher.py)."""
+from __future__ import annotations
+
+__all__ = ['PSDispatcher', 'RoundRobin', 'HashName']
+
+
+class PSDispatcher(object):
+    def __init__(self, pserver_endpoints):
+        self._eps = list(pserver_endpoints)
+        self._step = 0
+
+    @property
+    def eps(self):
+        return self._eps
+
+    def reset(self):
+        self._step = 0
+
+    def dispatch(self, varlist):
+        raise NotImplementedError
+
+
+class RoundRobin(PSDispatcher):
+    """Blocks go to pservers in rotation — balanced for equal-size blocks."""
+
+    def dispatch(self, varlist):
+        out = []
+        for _ in varlist:
+            out.append(self._eps[self._step % len(self._eps)])
+            self._step += 1
+        return out
+
+
+class HashName(PSDispatcher):
+    """Deterministic by name hash — stable across runs regardless of
+    block creation order."""
+
+    def dispatch(self, varlist):
+        return [self._eps[hash(str(v)) % len(self._eps)] for v in varlist]
