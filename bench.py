@@ -1,18 +1,25 @@
-"""Driver benchmark: ResNet-50 bf16 training throughput + MFU on one chip.
+"""Driver benchmark: ResNet-50 + Transformer-LM bf16 training on one chip.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...extras}.
+Prints ONE JSON line. The primary metric keeps the r02 series
+(ResNet-50 images/sec, bs=256, bf16) for trend continuity; the same line
+carries the Transformer-LM tokens/sec + MFU as extra keys — the
+MXU-dense config where the chip's ~79% matmul ceiling is approachable
+(PERF.md gap analysis).
 
 vs_baseline is computed against the reference repo's strongest published
 single-machine ResNet-50 training number — 84.08 images/sec (bs=256,
 MKL-DNN, 2x Xeon 6148; reference benchmark/IntelOptimizedPaddle.md:40-45;
-the reference publishes no Fluid-GPU ResNet numbers). The north star is
-≥70% MFU on a v5e-class chip, so the line also carries an honest "mfu"
-figure: achieved model FLOP/s over the chip's peak bf16 FLOP/s, with model
-FLOPs = 3x forward (fwd + bwd ≈ 2x fwd) analytic conv/fc FLOPs.
+the reference publishes no Fluid-GPU ResNet numbers).
 
-The model is built through the full framework path (Program IR -> autodiff
--> Momentum optimizer -> bf16 AMP -> whole-block XLA jit via
-ParallelExecutor), not a raw JAX hand-loop — it benchmarks the framework.
+Both configs run through the FULL framework path: Program IR -> autodiff
+-> optimizer ops -> bf16 AMP -> whole-block XLA jit (ParallelExecutor),
+fed by the framework's own async input pipeline
+(fluid.layers.py_reader + double_buffer, reference
+benchmark/fluid/fluid_benchmark.py:116 uses the same reader stack) — not
+a hand-rolled loop.
+
+MFU = achieved model FLOP/s over the chip's peak bf16 FLOP/s, with model
+FLOPs = 3x forward (fwd + bwd ~= 2x fwd) analytic matmul/conv FLOPs.
 """
 from __future__ import annotations
 
@@ -29,6 +36,7 @@ import jax  # noqa: E402
 
 import paddle_tpu as fluid  # noqa: E402
 from paddle_tpu.models import resnet  # noqa: E402
+from paddle_tpu.models import transformer as tfm  # noqa: E402
 
 BASELINE_IMG_PER_SEC = 84.08
 
@@ -82,10 +90,30 @@ def _resnet50_train_flops_per_image(image_hw, class_dim):
     return 3 * flops
 
 
-def main():
-    on_tpu = any(d.platform == 'tpu' for d in jax.devices())
-    # Sized for one chip: real ImageNet shapes on TPU; tiny on CPU so the
-    # driver smoke-run finishes.
+def _transformer_train_flops_per_token(cfg):
+    """Analytic fwd FLOPs per token (2*MACs), x3 for fwd+bwd."""
+    d, f, t, v, n = cfg.dim, cfg.ffn, cfg.max_len, cfg.vocab, cfg.layers
+    per_layer = 4 * d * d + 2 * d * f        # qkv+proj, ffn up+down (MACs)
+    attn = 2 * t * d                         # q@k^T + probs@v per token
+    head = d * v                             # logits projection
+    return 3 * 2 * (n * (per_layer + attn) + head)
+
+
+def _run_steps(pe, fetch_name, warmup, iters):
+    """Timed async step loop; sync via host fetch only at the ends
+    (block_until_ready does not reliably block through remoted PJRT —
+    PERF.md measurement note)."""
+    for _ in range(warmup):
+        wl = pe.run(fetch_list=[fetch_name], return_numpy=False)
+    float(np.asarray(wl[0]))
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        loss = pe.run(fetch_list=[fetch_name], return_numpy=False)
+    float(np.asarray(loss[0]))
+    return time.perf_counter() - t0
+
+
+def bench_resnet(on_tpu):
     if on_tpu:
         batch, image_hw, class_dim, depth = 256, 224, 1000, 50
         warmup, iters = 3, 30
@@ -96,10 +124,12 @@ def main():
     main_prog = fluid.Program()
     startup_prog = fluid.Program()
     with fluid.program_guard(main_prog, startup_prog):
-        image = fluid.layers.data(name='image',
-                                  shape=[3, image_hw, image_hw],
-                                  dtype='float32')
-        label = fluid.layers.data(name='label', shape=[1], dtype='int64')
+        rdr = fluid.layers.py_reader(
+            capacity=4,
+            shapes=[(-1, 3, image_hw, image_hw), (-1, 1)],
+            dtypes=['float32', 'int64'], name='resnet_reader',
+            use_double_buffer=True)
+        image, label = fluid.layers.read_file(rdr)
         _, avg_cost, _ = resnet.train_network(
             image, label, class_dim=class_dim, depth=depth)
         opt = fluid.optimizer.Momentum(learning_rate=0.01, momentum=0.9)
@@ -108,33 +138,23 @@ def main():
 
     exe = fluid.Executor(fluid.TPUPlace())
     exe.run(startup_prog)
-
     pe = fluid.ParallelExecutor(use_cuda=True, loss_name=avg_cost.name,
                                 main_program=main_prog)
 
     rng = np.random.RandomState(0)
-    img = rng.rand(batch, 3, image_hw, image_hw).astype('float32')
-    lbl = rng.randint(0, class_dim, size=(batch, 1)).astype('int64')
-    # pre-place the batch on device, as the double-buffered reader path
-    # would (host->device transfer overlaps compute in real input pipelines)
-    feed = {'image': pe._put_feed('image', img),
-            'label': pe._put_feed('label', lbl)}
+    img = jax.device_put(rng.rand(batch, 3, image_hw, image_hw)
+                         .astype('float32'))
+    lbl = jax.device_put(rng.randint(0, class_dim, size=(batch, 1))
+                         .astype('int64'))
 
-    for _ in range(warmup):
-        wl = pe.run(fetch_list=[avg_cost.name], feed=feed,
-                    return_numpy=False)
-    float(np.asarray(wl[0]))   # true sync (host fetch)
+    def provider():
+        while True:
+            yield [img, lbl]
 
-    # return_numpy=False keeps steps async on device; sync once at the end
-    # via a host fetch (a per-step fetch would serialize on the
-    # host<->device link; block_until_ready alone does not reliably block
-    # through remoted PJRT transports).
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        loss = pe.run(fetch_list=[avg_cost.name], feed=feed,
-                      return_numpy=False)
-    float(np.asarray(loss[0]))
-    dt = time.perf_counter() - t0
+    rdr.decorate_tensor_provider(provider)
+    rdr.start()
+    dt = _run_steps(pe, avg_cost.name, warmup, iters)
+    rdr.reset()
 
     img_per_sec = batch * iters / dt
     out = {
@@ -147,8 +167,76 @@ def main():
     peak = _peak_flops(jax.devices()[0])
     if peak and depth == 50:
         model_flops = _resnet50_train_flops_per_image(image_hw, class_dim)
-        out['model_tflops_per_sec'] = round(img_per_sec * model_flops / 1e12, 1)
+        out['model_tflops_per_sec'] = round(
+            img_per_sec * model_flops / 1e12, 1)
         out['mfu'] = round(img_per_sec * model_flops / peak, 4)
+    return out
+
+
+def bench_transformer(on_tpu):
+    if on_tpu:
+        cfg = tfm.TransformerConfig(vocab=32768, dim=2048, heads=16,
+                                    layers=12, ffn=8192, max_len=512,
+                                    use_tp=False, use_sp=False)
+        batch, warmup, iters = 8, 3, 20
+    else:
+        cfg = tfm.TransformerConfig(vocab=256, dim=64, heads=4, layers=2,
+                                    ffn=128, max_len=32,
+                                    use_tp=False, use_sp=False)
+        batch, warmup, iters = 2, 1, 3
+
+    main_prog = fluid.Program()
+    startup_prog = fluid.Program()
+    with fluid.program_guard(main_prog, startup_prog):
+        rdr = fluid.layers.py_reader(
+            capacity=4,
+            shapes=[(-1, cfg.max_len, 1), (-1, cfg.max_len, 1)],
+            dtypes=['int64', 'int64'], name='tfm_reader',
+            use_double_buffer=True)
+        tokens, labels = fluid.layers.read_file(rdr)
+        emb = tfm.language_model_logits(tokens, cfg)
+        cost = fluid.layers.softmax_with_cross_entropy(emb, labels)
+        avg_cost = fluid.layers.mean(cost)
+        opt = fluid.optimizer.Momentum(learning_rate=0.001, momentum=0.9)
+        opt = fluid.contrib.mixed_precision.decorate(opt)
+        opt.minimize(avg_cost)
+
+    exe = fluid.Executor(fluid.TPUPlace())
+    exe.run(startup_prog)
+    pe = fluid.ParallelExecutor(use_cuda=True, loss_name=avg_cost.name,
+                                main_program=main_prog)
+
+    rng = np.random.RandomState(0)
+
+    def provider():
+        while True:
+            toks = rng.randint(0, cfg.vocab,
+                               size=(batch, cfg.max_len, 1)).astype('int64')
+            yield [toks, np.roll(toks, -1, axis=1)]
+
+    rdr.decorate_tensor_provider(provider)
+    rdr.start()
+    dt = _run_steps(pe, avg_cost.name, warmup, iters)
+    rdr.reset()
+
+    tokens_per_sec = batch * cfg.max_len * iters / dt
+    out = {'transformer_tokens_per_sec': round(tokens_per_sec, 1),
+           'transformer_config': 'L%d_D%d_F%d_T%d_V%d_bs%d_bf16' % (
+               cfg.layers, cfg.dim, cfg.ffn, cfg.max_len, cfg.vocab,
+               batch)}
+    peak = _peak_flops(jax.devices()[0])
+    if peak:
+        fl = _transformer_train_flops_per_token(cfg)
+        out['transformer_tflops_per_sec'] = round(
+            tokens_per_sec * fl / 1e12, 1)
+        out['transformer_mfu'] = round(tokens_per_sec * fl / peak, 4)
+    return out
+
+
+def main():
+    on_tpu = any(d.platform == 'tpu' for d in jax.devices())
+    out = bench_resnet(on_tpu)
+    out.update(bench_transformer(on_tpu))
     print(json.dumps(out))
 
 

@@ -8,6 +8,7 @@ import jax
 
 from .executor import CPUPlace, TPUPlace, XLAPlace, CUDAPlace, Scope  # noqa
 from .lod_tensor import LoDTensor  # noqa: F401
+from .reader.pipeline import EOFException  # noqa: F401
 
 
 def is_compiled_with_cuda():

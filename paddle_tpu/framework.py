@@ -341,6 +341,12 @@ class Block(object):
     # -- op management -----------------------------------------------------
     def append_op(self, type, inputs=None, outputs=None, attrs=None):
         op = Operator(self, type, inputs, outputs, attrs)
+        # pipeline-stage annotation (parallel.api.pipeline_stage_guard):
+        # ops built under an active guard carry their stage id, the unit
+        # the pp lowering partitions on
+        stage = getattr(self.program, '_pp_stage', None)
+        if stage is not None and 'pp_stage' not in op.attrs:
+            op.attrs['pp_stage'] = stage
         self.ops.append(op)
         self.program._bump_version()
         from . import registry

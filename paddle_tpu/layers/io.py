@@ -1,10 +1,12 @@
-"""Data-input layers (reference python/paddle/fluid/layers/io.py:38 data)."""
+"""Data-input layers (reference python/paddle/fluid/layers/io.py: data
+:38, py_reader :474, double_buffer :891, read_file)."""
 from __future__ import annotations
 
 from ..framework import default_main_program, default_startup_program
 from ..layer_helper import LayerHelper
+from .. import unique_name
 
-__all__ = ['data']
+__all__ = ['data', 'py_reader', 'read_file', 'double_buffer']
 
 
 def data(name, shape, append_batch_size=True, dtype='float32', lod_level=0,
@@ -37,3 +39,59 @@ def data(name, shape, append_batch_size=True, dtype='float32', lod_level=0,
             is_data=True, stop_gradient=True)
         var.seq_lens = lens
     return var
+
+
+def py_reader(capacity, shapes, dtypes, lod_levels=None, name=None,
+              use_double_buffer=True):
+    """Async Python-fed reader (reference layers/io.py:474): a feeder
+    thread pushes batches from a Python generator into a bounded blocking
+    queue; with use_double_buffer=True a placer thread device_puts them
+    ahead of consumption, so each training step consumes an HBM-resident
+    batch with no host round-trip (the capability of
+    create_py_reader_op + create_double_buffer_reader_op).
+
+    Returns a reader handle: call `decorate_paddle_reader(...)` or
+    `decorate_tensor_provider(...)`, then `.start()`; catch
+    fluid.core.EOFException from Executor.run at pass end and `.reset()`.
+    Wire it into the program with fluid.layers.read_file(reader).
+    """
+    from ..reader.pipeline import PyReader
+    if name is None:
+        name = unique_name.generate('py_reader')
+    block = default_main_program().global_block()
+    # the reader appears in the program as a var (reference creates a
+    # VarType.READER var); the runtime object lives in the registry
+    if not block.has_var(name):
+        block.create_var(name=name, shape=(), dtype='float32',
+                         persistable=False, stop_gradient=True)
+    return PyReader(name, shapes, dtypes, lod_levels=lod_levels,
+                    capacity=capacity, use_double_buffer=use_double_buffer)
+
+
+def read_file(reader):
+    """Pop one batch from a py_reader into fresh variables (reference
+    layers/io.py read_file -> read op). Returns one Variable per slot."""
+    block = default_main_program().global_block()
+    outs = []
+    for i, (shape, dtype, lod) in enumerate(
+            zip(reader.shapes, reader.dtypes, reader.lod_levels)):
+        v = block.create_var(
+            name=unique_name.generate('%s_slot%d' % (reader.name, i)),
+            shape=tuple(shape), dtype=dtype, lod_level=lod,
+            is_data=True, stop_gradient=True)
+        outs.append(v)
+    block.append_op(type='read', inputs={},
+                    outputs={'Out': [v.name for v in outs]},
+                    attrs={'reader_name': reader.name})
+    return outs if len(outs) > 1 else outs[0]
+
+
+def double_buffer(reader, place=None, name=None):
+    """Enable device-side prefetch on a py_reader (reference
+    layers/io.py:891 double_buffer). The prefetch machinery is built into
+    the reader runtime; this just switches it on (and pins the target
+    device when a place is given)."""
+    reader.use_double_buffer = True
+    if place is not None:
+        reader.device = place.jax_device()
+    return reader

@@ -20,16 +20,20 @@ from .. import layers as L
 from ..parallel.layers import (column_parallel_fc, row_parallel_fc,
                                vocab_parallel_embedding, moe_layer,
                                sequence_parallel_scope)
-from ..parallel.api import sharding_constraint
+from ..parallel.api import sharding_constraint, pipeline_stage_guard
 
 
 class TransformerConfig(object):
     def __init__(self, vocab=1000, dim=64, heads=4, layers=2, ffn=128,
-                 max_len=64, moe_experts=0, use_tp=True, use_sp=True):
+                 max_len=64, moe_experts=0, use_tp=True, use_sp=True,
+                 pp_stages=0):
         self.vocab, self.dim, self.heads = vocab, dim, heads
         self.layers, self.ffn, self.max_len = layers, ffn, max_len
         self.moe_experts = moe_experts
         self.use_tp, self.use_sp = use_tp, use_sp
+        # pp_stages > 0: annotate blocks with pipeline stages (layers
+        # must divide evenly); consumed by DistributedStrategy(pp=...)
+        self.pp_stages = pp_stages
 
 
 def _attention(x, cfg, prefix):
@@ -94,6 +98,24 @@ def _block(x, cfg, i):
     return L.elementwise_add(x, ffn)
 
 
+
+def _blocks(x, cfg):
+    """All transformer blocks; with cfg.pp_stages set, layers are grouped
+    into uniform pipeline stages via pipeline_stage_guard (consumed by
+    the pp lowering under DistributedStrategy(pp=...))."""
+    if cfg.pp_stages:
+        if cfg.layers % cfg.pp_stages:
+            raise ValueError('layers %d not divisible by pp_stages %d'
+                             % (cfg.layers, cfg.pp_stages))
+        for i in range(cfg.layers):
+            with pipeline_stage_guard(i * cfg.pp_stages // cfg.layers):
+                x = _block(x, cfg, i)
+        return x
+    for i in range(cfg.layers):
+        x = _block(x, cfg, i)
+    return x
+
+
 def language_model(tokens, cfg):
     """tokens: [B, T, 1] int64 ids (no lod: fixed T). Returns softmax
     probabilities [B, T, vocab]."""
@@ -103,12 +125,27 @@ def language_model(tokens, cfg):
         emb = L.embedding(tokens, size=[cfg.vocab, cfg.dim])
     pos = L.position_embedding(emb, cfg.max_len)
     x = L.elementwise_add(emb, pos)
-    for i in range(cfg.layers):
-        x = _block(x, cfg, i)
+    x = _blocks(x, cfg)
     x = L.layer_norm(x, begin_norm_axis=2)
     logits = L.fc(input=x, size=cfg.vocab, num_flatten_dims=2,
                   act='softmax')
     return logits
+
+
+def language_model_logits(tokens, cfg):
+    """Like language_model but returns raw logits [B, T, vocab] — pair
+    with softmax_with_cross_entropy so XLA fuses the softmax into the
+    loss (the MXU-dense benchmark path)."""
+    if cfg.use_tp:
+        emb = vocab_parallel_embedding(tokens, [cfg.vocab, cfg.dim])
+    else:
+        emb = L.embedding(tokens, size=[cfg.vocab, cfg.dim])
+    pos = L.position_embedding(emb, cfg.max_len)
+    x = L.elementwise_add(emb, pos)
+    x = _blocks(x, cfg)
+    x = L.layer_norm(x, begin_norm_axis=2)
+    return L.fc(input=x, size=cfg.vocab, num_flatten_dims=2,
+                name='lm_head')
 
 
 def train_network(tokens, labels, cfg):

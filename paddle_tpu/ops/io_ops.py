@@ -142,3 +142,21 @@ def _delete_var_emit(ctx, op):
 
 
 register_op('delete_var', emit=_delete_var_emit, host=True, no_grad=True)
+
+
+def _read_emit(ctx, op):
+    """Pop one batch from the named py_reader (reference read op +
+    blocking-queue pop). Values are set raw: with double buffering they
+    are jax.Arrays already resident on device, and the following jitted
+    segment consumes them without any host copy."""
+    from ..reader.pipeline import get_reader
+    values = get_reader(op.attr('reader_name')).read()
+    outs = op.output('Out')
+    if len(values) != len(outs):
+        raise ValueError('py_reader %r yields %d slots, program expects %d'
+                         % (op.attr('reader_name'), len(values), len(outs)))
+    for name, val in zip(outs, values):
+        ctx.set_raw(name, val)
+
+
+register_op('read', emit=_read_emit, host=True, no_grad=True)

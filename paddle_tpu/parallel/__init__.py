@@ -9,7 +9,7 @@ axes, PartitionSpec annotations on IR Variables, and GSPMD/shard_map
 lowering that puts the collectives on ICI.
 """
 from .mesh import MeshConfig, get_mesh, set_mesh, mesh_scope
-from .api import shard_tensor, sharding_constraint
+from .api import shard_tensor, sharding_constraint, pipeline_stage_guard
 from . import layers as players  # noqa: F401
 from .strategy import DistributedStrategy
 from . import distributed

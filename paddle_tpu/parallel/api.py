@@ -13,7 +13,8 @@ from ..layer_helper import LayerHelper
 from ..registry import register_op, op_emitter, same_shape_infer
 from .mesh import get_mesh, named_sharding
 
-__all__ = ['shard_tensor', 'sharding_constraint']
+__all__ = ['shard_tensor', 'sharding_constraint',
+           'pipeline_stage_guard']
 
 
 def shard_tensor(var, spec):
@@ -64,3 +65,22 @@ def sharding_constraint(x, spec, name=None):
     helper.append_op(type='sharding_constraint', inputs={'X': [x]},
                      outputs={'Out': [out]}, attrs={'spec': list(spec)})
     return out
+
+
+import contextlib
+
+
+@contextlib.contextmanager
+def pipeline_stage_guard(stage):
+    """Ops appended inside carry attrs['pp_stage']=stage — the unit the
+    pipeline-parallel lowering (parallel/pp_lowering.py) partitions the
+    program on. No reference analog (the 2018 codebase has no pp); the
+    shape follows the reference's device_guard op-placement idiom."""
+    from ..framework import default_main_program
+    prog = default_main_program()
+    prev = getattr(prog, '_pp_stage', None)
+    prog._pp_stage = int(stage)
+    try:
+        yield
+    finally:
+        prog._pp_stage = prev

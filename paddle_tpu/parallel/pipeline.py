@@ -82,7 +82,11 @@ def pipeline_apply(stage_fn, mesh, n_micro, params_stacked, x_micro,
         jax.tree_util.tree_map(lambda _: P(axis), params_stacked),
         P(),
     )
-    f = jax.shard_map(per_device, mesh=mesh,
+    # manual ONLY over 'pp' (axis_names): the other mesh axes stay
+    # automatic, so dp batch sharding propagates through the schedule and
+    # tp/sp sharding constraints inside stage_fn remain legal — the
+    # partial-manual composition that makes pp x dp x tp one executable
+    f = jax.shard_map(per_device, mesh=mesh, axis_names=frozenset({axis}),
                       in_specs=in_specs, out_specs=P(),
                       check_vma=False)
     return f(params_stacked, x_micro)

@@ -1,0 +1,225 @@
+"""Pipeline-parallel lowering of a Program training step.
+
+Consumes `DistributedStrategy(pp=K, micro_batches=M)` from the
+ParallelExecutor: a device segment whose ops carry `pp_stage`
+annotations (parallel.api.pipeline_stage_guard) compiles into
+
+    pre ops -> pipeline_apply(uniform stages over 'pp') -> post ops
+    -> whole-graph jax.grad -> optimizer ops
+
+instead of the per-op emission path. The program's per-op backward ops
+are NOT emitted in this mode: the gradient of the whole pipelined
+forward comes from one jax.value_and_grad, which differentiates through
+the ppermute/scan schedule (the 1F1B-equivalent backward falls out of
+XLA). This is the TPU-native design decision: under pipelining the
+backward must interleave with the schedule, so it cannot be a per-op op
+list — whole-graph autodiff replaces it. (No reference analog: the 2018
+codebase has no pipeline engine; SURVEY §2.11 'beyond ref'.)
+
+Requirements checked at compile time: the annotated stages must be
+UNIFORM (same op sequence, same parameter shapes — transformer blocks),
+carry exactly one activation in/out, and contain no RNG ops; gradient
+clipping/regularization ops (which live between backward and optimizer)
+are not supported under pp.
+"""
+from __future__ import annotations
+
+from collections import OrderedDict
+
+import jax
+import jax.numpy as jnp
+
+from .pipeline import pipeline_apply
+
+__all__ = ['segment_has_pp', 'build_pp_segment_fn']
+
+
+def segment_has_pp(segment):
+    return any(op.attr('pp_stage', None) is not None for op in segment.ops)
+
+
+def _reads_writes(ops):
+    reads, writes = [], set()
+    for op, _off in ops:
+        for n in op.input_arg_names():
+            if n not in writes and n not in reads:
+                reads.append(n)
+        writes.update(op.output_arg_names())
+    return reads, writes
+
+
+def _partition(segment):
+    """Split segment ops into pre / stages / post forward ops, plus
+    optimizer ops; backward ops are dropped (whole-graph grad)."""
+    pre, stages, post, opt = [], OrderedDict(), [], []
+    for op, off in zip(segment.ops, segment.op_offsets):
+        role = op.attr('op_role', 'forward')
+        if role == 'optimize':
+            opt.append((op, off))
+            continue
+        if role == 'backward':
+            if op.type in ('squared_l2_norm', 'clip', 'clip_by_norm'):
+                raise NotImplementedError(
+                    'gradient clipping is not supported under pipeline '
+                    'parallelism (grads come from whole-graph autodiff)')
+            continue
+        st = op.attr('pp_stage', None)
+        if st is None:
+            (post if stages else pre).append((op, off))
+        else:
+            stages.setdefault(int(st), []).append((op, off))
+    return pre, stages, post, opt
+
+
+def _sig_attrs(op):
+    """Attrs that must MATCH across stages for uniformity: everything
+    except the stage id itself (stage 0's trace is reused for every
+    stage, so any attr divergence would silently compute stage 0's op)."""
+    return {k: v for k, v in op.attrs.items()
+            if k not in ('pp_stage', 'op_role', 'op_namescope')
+            and not k.startswith('__')}
+
+
+def _validate_stages(stages, block):
+    keys = sorted(stages)
+    sigs = [[op.type for op, _ in stages[k]] for k in keys]
+    if any(s != sigs[0] for s in sigs[1:]):
+        raise ValueError('pipeline stages must be uniform (same op '
+                         'sequence per stage); got %s' %
+                         {k: len(stages[k]) for k in keys})
+    for k in keys[1:]:
+        for (op0, _), (opk, _) in zip(stages[keys[0]], stages[k]):
+            if _sig_attrs(op0) != _sig_attrs(opk):
+                raise ValueError(
+                    'pipeline stages not uniform: op %r attrs differ '
+                    'between stage %d and stage %d (%s vs %s)'
+                    % (op0.type, keys[0], k, _sig_attrs(op0),
+                       _sig_attrs(opk)))
+    from ..registry import _REGISTRY
+    for op, _ in stages[keys[0]]:
+        if _REGISTRY[op.type].stateful:
+            raise NotImplementedError(
+                'RNG op %r inside a pipeline stage' % op.type)
+    return keys
+
+
+def _stage_io(stages, keys, block):
+    """Per-stage (param_names, x_in, x_out). Params = persistable reads;
+    the single non-persistable read is the carried activation."""
+    infos = []
+    for k in keys:
+        reads, writes = _reads_writes(stages[k])
+        params, acts = [], []
+        for n in reads:
+            var = block.var_recursive(n)
+            (params if var.persistable else acts).append(n)
+        if len(acts) != 1:
+            raise ValueError(
+                'pipeline stage %d must carry exactly one activation '
+                '(got inputs %s)' % (k, acts))
+        infos.append({'params': params, 'x_in': acts[0], 'writes': writes})
+    # x_out of stage k = the write that stage k+1 (or the post ops) reads
+    for i, k in enumerate(keys):
+        nxt = infos[i + 1]['x_in'] if i + 1 < len(keys) else None
+        if nxt is not None and nxt in infos[i]['writes']:
+            infos[i]['x_out'] = nxt
+        else:
+            # last stage: its final op's output is the region output
+            infos[i]['x_out'] = stages[k][-1][0].output_arg_names()[-1]
+    # parameter lists must be shape-uniform across stages
+    shapes0 = [tuple(block.var_recursive(n).shape)
+               for n in infos[0]['params']]
+    for info in infos[1:]:
+        shapes = [tuple(block.var_recursive(n).shape)
+                  for n in info['params']]
+        if shapes != shapes0:
+            raise ValueError('pipeline stage parameter shapes differ: '
+                             '%s vs %s' % (shapes0, shapes))
+    return infos
+
+
+def build_pp_segment_fn(pe, segment, block, program):
+    """The seg_fn for a pp-annotated device segment (same signature the
+    executor jits: (donated, const, rng_key) -> outputs tuple)."""
+    from ..executor import EmitContext
+    from .. import registry
+
+    strategy = pe._strategy
+    mesh = pe.mesh
+    n_micro = max(int(strategy.micro_batches or 0), strategy.pp)
+    loss_name = pe._loss_name
+    if not loss_name:
+        raise ValueError('pipeline parallelism needs '
+                         'ParallelExecutor(loss_name=...)')
+
+    pre, stages, post, opt = _partition(segment)
+    keys = _validate_stages(stages, block)
+    infos = _stage_io(stages, keys, block)
+    stage0_ops = stages[keys[0]]
+    region_out = infos[-1]['x_out']
+    region_in = infos[0]['x_in']
+
+    # param -> grad var name, from the optimizer ops
+    grad_of = {}
+    for op, _ in opt:
+        if op.input('Param'):
+            grad_of[op.single_input('Param')] = op.single_input('Grad')
+
+    is_test = program._is_test
+    amp = getattr(program, '_use_bf16', False)
+    out_names = segment.out_names
+
+    def emit_ops(ctx, op_list):
+        for op, off in op_list:
+            ctx._op_index = off
+            ctx._block_pos = off
+            registry._REGISTRY[op.type].emit(ctx, op)
+
+    def seg_fn(donated, const, rng_key):
+        env = {}
+        env.update(const)
+        env.update(donated)
+        diff_params = {p: env[p] for p in sorted(grad_of) if p in env}
+
+        def loss_fn(pvals):
+            env2 = dict(env)
+            env2.update(pvals)
+            ctx = EmitContext(env2, block, rng_key, is_test, amp=amp)
+            ctx.mesh = mesh
+            emit_ops(ctx, pre)
+
+            def stage_fn(plist, x):
+                e3 = dict(zip(infos[0]['params'], plist))
+                e3[region_in] = x
+                sctx = EmitContext(e3, block, rng_key, is_test, amp=amp)
+                sctx.mesh = mesh
+                emit_ops(sctx, stage0_ops)
+                return e3[infos[0]['x_out']]
+
+            stacked = [jnp.stack([env2[info['params'][i]]
+                                  for info in infos])
+                       for i in range(len(infos[0]['params']))]
+            x = env2[region_in]
+            B = x.shape[0]
+            if B % n_micro != 0:
+                raise ValueError('batch %d not divisible by %d '
+                                 'microbatches' % (B, n_micro))
+            x_m = x.reshape((n_micro, B // n_micro) + x.shape[1:])
+            out = pipeline_apply(stage_fn, mesh, n_micro, stacked, x_m)
+            env2[region_out] = out.reshape((B,) + out.shape[2:])
+            emit_ops(ctx, post)
+            return env2[loss_name], env2
+
+        (_, fwd_env), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(diff_params)
+        for name in out_names:
+            if name in fwd_env:
+                env[name] = fwd_env[name]
+        for p, g in grads.items():
+            env[grad_of[p]] = g
+        ctx = EmitContext(env, block, rng_key, is_test, amp=amp)
+        ctx.mesh = mesh
+        emit_ops(ctx, opt)
+        return tuple(env[n] for n in out_names)
+
+    return seg_fn

@@ -201,6 +201,22 @@ class ParallelExecutor(Executor):
         )
         return {'in_shardings': in_shardings}
 
+    def _compile_segment(self, segment, block, program, feed_names=(),
+                         donate=True):
+        """pp-annotated segments lower through the pipeline engine
+        (parallel/pp_lowering.py); everything else takes the standard
+        whole-block emission path."""
+        if self._strategy is not None and self._strategy.pp > 1:
+            from .parallel.pp_lowering import (segment_has_pp,
+                                               build_pp_segment_fn)
+            if segment_has_pp(segment):
+                seg_fn = build_pp_segment_fn(self, segment, block, program)
+                return jax.jit(seg_fn,
+                               donate_argnums=(0,) if donate else (),
+                               **self._jit_options(segment, feed_names))
+        return super(ParallelExecutor, self)._compile_segment(
+            segment, block, program, feed_names, donate)
+
     # -- public API --------------------------------------------------------
     def _bcast_params(self):
         """Re-place startup-initialized params into the mesh's replicated

@@ -69,6 +69,10 @@ class _VarBlockInfo(object):
         self.block_idx = block_idx
         self.split_count = split_count
 
+    def __str__(self):
+        # the dispatch identity (HashName hashes this): the block name
+        return self.pname
+
 
 class DistributeTranspiler(object):
     def __init__(self, config=None):

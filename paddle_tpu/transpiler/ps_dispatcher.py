@@ -33,8 +33,11 @@ class RoundRobin(PSDispatcher):
 
 
 class HashName(PSDispatcher):
-    """Deterministic by name hash — stable across runs regardless of
-    block creation order."""
+    """Deterministic by name hash — stable across runs AND processes
+    (crc32, not Python's per-process-randomized str hash: every trainer
+    and pserver transpiles independently and must agree on placement)."""
 
     def dispatch(self, varlist):
-        return [self._eps[hash(str(v)) % len(self._eps)] for v in varlist]
+        import zlib
+        return [self._eps[zlib.crc32(str(v).encode('utf-8'))
+                          % len(self._eps)] for v in varlist]

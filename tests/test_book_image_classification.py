@@ -10,6 +10,8 @@ from paddle_tpu.models import resnet, vgg
 
 def _train(net_fn, steps=25, lr=0.01):
     prog, startup = Program(), Program()
+    # seeded: with random init the 12-step loss-drops assert is flaky
+    prog.random_seed = startup.random_seed = 42
     with program_guard(prog, startup):
         images = fluid.layers.data(name='pixel', shape=[3, 32, 32],
                                    dtype='float32')

@@ -133,3 +133,131 @@ def test_train_from_dataset_reader():
                 first = float(l)
             last = float(l)
     assert last < 0.2 * first, (first, last)
+
+
+def _pyreader_mlp(use_double_buffer):
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        rdr = fluid.layers.py_reader(
+            capacity=8, shapes=[(-1, 4), (-1, 1)],
+            dtypes=['float32', 'float32'],
+            use_double_buffer=use_double_buffer)
+        x, y = fluid.layers.read_file(rdr)
+        pred = fluid.layers.fc(input=x, size=1,
+                               param_attr=fluid.ParamAttr(
+                                   name='prw',
+                                   initializer=fluid.initializer.
+                                   Normal(scale=0.1, seed=2)))
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        fluid.optimizer.SGD(0.05).minimize(loss)
+    return main, startup, rdr, loss
+
+
+def _make_batches(n, seed=0):
+    rng = np.random.RandomState(seed)
+    w = np.arange(4).astype('float32')[:, None]
+    out = []
+    for _ in range(n):
+        x = rng.randn(16, 4).astype('float32')
+        out.append([x, x @ w])
+    return out
+
+
+def test_py_reader_trains_and_signals_eof():
+    """Train a full pass from a py_reader with NO feed dict, hit
+    EOFException at pass end, reset, and run a second pass."""
+    main, startup, rdr, loss = _pyreader_mlp(use_double_buffer=False)
+    batches = _make_batches(12)
+    rdr.decorate_tensor_provider(lambda: iter(batches))
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    losses = []
+    for _pass in range(2):
+        rdr.start()
+        while True:
+            try:
+                l, = exe.run(main, fetch_list=[loss])
+            except fluid.core.EOFException:
+                rdr.reset()
+                break
+            losses.append(float(l))
+    assert len(losses) == 24
+    assert losses[-1] < losses[0]
+
+
+def test_py_reader_double_buffer_matches_feed_path():
+    """The double-buffered reader path computes EXACTLY what explicit
+    feeding computes, and hands the step device-resident arrays."""
+    batches = _make_batches(6, seed=3)
+
+    main, startup, rdr, loss = _pyreader_mlp(use_double_buffer=True)
+    rdr.decorate_tensor_provider(lambda: iter(batches))
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope_a = fluid.core.Scope()
+    reader_losses = []
+    with fluid.scope_guard(scope_a):
+        exe.run(startup)
+        rdr.start()
+        for _ in range(len(batches)):
+            l, = exe.run(main, fetch_list=[loss])
+            reader_losses.append(float(l))
+        try:
+            exe.run(main, fetch_list=[loss])
+            assert False, 'expected EOFException'
+        except fluid.core.EOFException:
+            rdr.reset()
+
+    main2, startup2 = Program(), Program()
+    with program_guard(main2, startup2):
+        x = fluid.layers.data(name='x', shape=[4], dtype='float32')
+        y = fluid.layers.data(name='y', shape=[1], dtype='float32')
+        pred = fluid.layers.fc(input=x, size=1,
+                               param_attr=fluid.ParamAttr(
+                                   name='prw',
+                                   initializer=fluid.initializer.
+                                   Normal(scale=0.1, seed=2)))
+        loss2 = fluid.layers.mean(
+            fluid.layers.square_error_cost(pred, y))
+        fluid.optimizer.SGD(0.05).minimize(loss2)
+    exe2 = fluid.Executor(fluid.CPUPlace())
+    scope_b = fluid.core.Scope()
+    feed_losses = []
+    with fluid.scope_guard(scope_b):
+        exe2.run(startup2)
+        for xb, yb in batches:
+            l, = exe2.run(main2, feed={'x': xb, 'y': yb},
+                          fetch_list=[loss2])
+            feed_losses.append(float(l))
+    np.testing.assert_allclose(reader_losses, feed_losses, rtol=1e-6)
+
+
+def test_py_reader_paddle_reader_decoration():
+    """decorate_paddle_reader stacks per-sample tuples (the paddle.batch
+    convention) into slot arrays."""
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        rdr = fluid.layers.py_reader(
+            capacity=4, shapes=[(-1, 2), (-1, 1)],
+            dtypes=['float32', 'int64'], name='pr_batch',
+            use_double_buffer=False)
+        x, y = fluid.layers.read_file(rdr)
+        s = fluid.layers.reduce_sum(x)
+    samples = [(np.array([i, i + 1], 'float32'), np.array([i], 'int64'))
+               for i in range(8)]
+
+    def batched():
+        yield samples[:4]
+        yield samples[4:]
+    rdr.decorate_paddle_reader(batched)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    rdr.start()
+    v1, = exe.run(main, fetch_list=[s])
+    v2, = exe.run(main, fetch_list=[s])
+    assert float(v1) == sum(i + i + 1 for i in range(4))
+    assert float(v2) == sum(i + i + 1 for i in range(4, 8))
+    try:
+        exe.run(main, fetch_list=[s])
+        assert False, 'expected EOFException'
+    except fluid.core.EOFException:
+        rdr.reset()
