@@ -54,6 +54,11 @@ from . import contrib
 from . import transpiler
 from .transpiler import DistributeTranspiler, DistributeTranspilerConfig
 from . import distributed
+from . import flags
+from .flags import set_flags, get_flags
+from . import trainer
+from .trainer import (Trainer, CheckpointConfig, BeginEpochEvent,
+                      EndEpochEvent, BeginStepEvent, EndStepEvent)
 
 __version__ = '0.1.0'
 

@@ -29,7 +29,48 @@ from . import registry
 from .framework import default_main_program, Program, Variable
 
 __all__ = ['Executor', 'Scope', 'global_scope', 'scope_guard',
-           'CPUPlace', 'TPUPlace', 'XLAPlace', 'CUDAPlace', 'fetch_var']
+           'CPUPlace', 'TPUPlace', 'XLAPlace', 'CUDAPlace', 'fetch_var',
+           'OpExecutionError']
+
+
+class OpExecutionError(RuntimeError):
+    """An op failed during lowering/execution, annotated with the op's
+    identity and its declared I/O (the PADDLE_ENFORCE-style context of
+    reference platform/enforce.h:253 + operator.cc error wrapping — a
+    user with a shape bug in a 200-op program gets the offending op
+    named, not a bare JAX traceback)."""
+
+
+def _describe_op(op, block, pos=None):
+    def slot_str(mapping):
+        parts = []
+        for slot, names in mapping.items():
+            descs = []
+            for n in names:
+                try:
+                    v = block.var_recursive(n)
+                    descs.append('%s%s' % (n, list(v.shape)
+                                           if v.shape is not None else ''))
+                except KeyError:
+                    descs.append(n)
+            parts.append('%s=[%s]' % (slot, ', '.join(descs)))
+        return '; '.join(parts)
+    where = ('op #%d ' % pos) if pos is not None else 'op '
+    return ('%s%r in block %d\n  inputs:  %s\n  outputs: %s'
+            % (where, op.type, block.idx, slot_str(op.inputs),
+               slot_str(op.outputs)))
+
+
+def _passthrough_exception(e):
+    """Exceptions that are control flow, not op failures — never wrap."""
+    from .reader.pipeline import EOFException
+    return isinstance(e, (OpExecutionError, EOFException))
+
+
+def _wrap_op_error(e, op, block, pos=None):
+    return OpExecutionError(
+        'Error running %s\n  cause: %s: %s'
+        % (_describe_op(op, block, pos), type(e).__name__, e))
 
 
 # ---------------------------------------------------------------------------
@@ -432,6 +473,7 @@ class Executor(object):
             if seed == 0:
                 seed = np.random.randint(0, 2**31 - 1)
             self._base_key = jax.random.PRNGKey(seed)
+            self._realized_seed = int(seed)   # checkpointable (Trainer)
             self._seed_used = program.random_seed
         return jax.random.fold_in(self._base_key, self._step)
 
@@ -519,24 +561,28 @@ class Executor(object):
                     'startup program?' % name)
             return val
 
+        from . import flags as flags_mod
+        check_nan_inf = flags_mod.get_flag('check_nan_inf')
+
         for step in prepared.steps:
             if isinstance(step, _HostStep):
                 # sync host-visible values then run on host
                 hctx = _RunHostContext(scope, local, block)
-                registry._REGISTRY[step.op.type].emit(hctx, step.op)
+                try:
+                    registry._REGISTRY[step.op.type].emit(hctx, step.op)
+                except Exception as e:
+                    if _passthrough_exception(e):
+                        raise
+                    raise _wrap_op_error(e, step.op, block) from e
                 continue
 
-            if step.jitted is None:
-                step.jitted = self._compile_segment(
-                    step, block, program,
-                    feed_names=tuple(feed_arrays.keys()),
-                    donate=prepared.donate)
             donated = {}
             const = {}
             out_set = set(step.out_names)
             for name in step.in_names:
                 val = read_var(name)
-                if name in out_set and name not in feed_arrays:
+                if name in out_set and name not in feed_arrays \
+                        and not check_nan_inf:
                     donated[name] = val
                 else:
                     const[name] = val
@@ -544,7 +590,20 @@ class Executor(object):
                 rng_key = self._rng_key(program)
             key_arg = rng_key if step.needs_rng \
                 else jnp.zeros((2,), dtype=jnp.uint32)
-            outs = step.jitted(donated, const, key_arg)
+            if check_nan_inf:
+                # debug mode: ops run eagerly one by one, every output
+                # scanned for NaN/Inf (reference operator.cc:749
+                # FLAGS_check_nan_inf semantics; unfused and slow).
+                # Nothing is donated: buffers stay valid for inspection.
+                outs = self._run_segment_checked(step, block, program,
+                                                 const, key_arg)
+            else:
+                if step.jitted is None:
+                    step.jitted = self._compile_segment(
+                        step, block, program,
+                        feed_names=tuple(feed_arrays.keys()),
+                        donate=prepared.donate)
+                outs = step.jitted(donated, const, key_arg)
             for name, val in zip(step.out_names, outs):
                 local[name] = val
                 var = block.vars.get(name)
@@ -570,6 +629,41 @@ class Executor(object):
                     raise KeyError('fetch var %r was not produced' % name)
                 results.append(val)
         return results
+
+    def _run_segment_checked(self, segment, block, program, env_in,
+                             rng_key):
+        """check_nan_inf mode: emit ops eagerly, scan every op's outputs
+        for non-finite values, and name the offending op+var."""
+        from .selected_rows import SelectedRows
+        env = dict(env_in)
+        ctx = EmitContext(env, block, rng_key, program._is_test,
+                          amp=getattr(program, '_use_bf16', False))
+        ctx.mesh = self._emit_mesh()
+        for op, off in zip(segment.ops, segment.op_offsets):
+            ctx._op_index = off
+            ctx._block_pos = off
+            try:
+                registry._REGISTRY[op.type].emit(ctx, op)
+            except Exception as e:
+                if _passthrough_exception(e):
+                    raise
+                raise _wrap_op_error(e, op, block, pos=off) from e
+            for name in op.output_arg_names():
+                val = env.get(name)
+                if val is None:
+                    continue
+                if isinstance(val, SelectedRows):
+                    val = val.values
+                # jnp.issubdtype, not np: bfloat16 (the AMP activation
+                # dtype) is not a subtype of np.floating and would be
+                # silently skipped
+                dt = getattr(val, 'dtype', None) or np.asarray(val).dtype
+                if jnp.issubdtype(dt, jnp.floating) and \
+                        not bool(jnp.isfinite(jnp.asarray(val)).all()):
+                    raise OpExecutionError(
+                        'NaN/Inf detected in output %r of %s'
+                        % (name, _describe_op(op, block, pos=off)))
+        return tuple(env[n] for n in segment.out_names)
 
     def _put_feed(self, name, arr):
         """Hook: place one feed array; ParallelExecutor overrides this to
@@ -626,7 +720,12 @@ class Executor(object):
             for op, off in zip(ops, offsets):
                 ctx._op_index = off
                 ctx._block_pos = off
-                registry._REGISTRY[op.type].emit(ctx, op)
+                try:
+                    registry._REGISTRY[op.type].emit(ctx, op)
+                except Exception as e:
+                    if _passthrough_exception(e):
+                        raise
+                    raise _wrap_op_error(e, op, block, pos=off) from e
             return tuple(env[n] for n in out_names)
 
         return jax.jit(seg_fn, donate_argnums=(0,) if donate else (),

@@ -1,0 +1,77 @@
+"""Global flag registry + env bootstrap.
+
+Capability analog of the reference's gflags plumbing
+(python/paddle/fluid/__init__.py:92-146 __bootstrap__ reads FLAGS_* from
+the environment into core; platform/init.cc consumes them). Flags here
+control host-side framework behavior; device behavior belongs to XLA
+flags (XLA_FLAGS), which this registry deliberately does not wrap.
+
+Known flags:
+  check_nan_inf          per-op NaN/Inf scan in the Executor (debug mode:
+                         ops run eagerly, unfused — reference
+                         operator.cc:749 semantics)
+  benchmark              reserved (reference profiler cadence knob)
+  eager_delete_scope     accepted for script compat (scope GC is
+                         automatic here)
+  fraction_of_gpu_memory_to_use / init_allocated_mem / use_pinned_memory
+                         accepted for script compat (PJRT owns memory)
+"""
+from __future__ import annotations
+
+import os
+
+__all__ = ['set_flags', 'get_flag', 'get_flags']
+
+_DEFAULTS = {
+    'check_nan_inf': False,
+    'benchmark': False,
+    'eager_delete_scope': True,
+    'fraction_of_gpu_memory_to_use': 0.92,
+    'init_allocated_mem': False,
+    'use_pinned_memory': True,
+}
+
+_FLAGS = dict(_DEFAULTS)
+
+
+def _coerce(name, value):
+    default = _DEFAULTS.get(name)
+    if isinstance(default, bool):
+        if isinstance(value, str):
+            return value.lower() in ('1', 'true', 'yes', 'on')
+        return bool(value)
+    if isinstance(default, float):
+        return float(value)
+    if isinstance(default, int):
+        return int(value)
+    return value
+
+
+def set_flags(flags):
+    """set_flags({'FLAGS_check_nan_inf': True}) — with or without the
+    FLAGS_ prefix. Unknown names are stored as-is (scripts set custom
+    flags; the reference's gflags tolerates registration order too)."""
+    for name, value in flags.items():
+        key = name[len('FLAGS_'):] if name.startswith('FLAGS_') else name
+        _FLAGS[key] = _coerce(key, value)
+
+
+def get_flag(name, default=None):
+    key = name[len('FLAGS_'):] if name.startswith('FLAGS_') else name
+    return _FLAGS.get(key, default)
+
+
+def get_flags(names=None):
+    if names is None:
+        return dict(_FLAGS)
+    return {n: get_flag(n) for n in names}
+
+
+def _bootstrap_from_env():
+    """Read FLAGS_* env vars once at import (reference __bootstrap__)."""
+    for key, value in os.environ.items():
+        if key.startswith('FLAGS_'):
+            set_flags({key: value})
+
+
+_bootstrap_from_env()

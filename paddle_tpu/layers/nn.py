@@ -26,7 +26,8 @@ __all__ = [
     'pad', 'label_smooth', 'flatten', 'stack', 'expand', 'squeeze',
     'unsqueeze', 'gather', 'scatter', 'slice', 'shape', 'autoincreased_step_counter',
     'logical_and', 'logical_or', 'logical_xor', 'logical_not', 'where_select',
-    'causal_mask_bias', 'position_embedding',
+    'causal_mask_bias', 'position_embedding', 'beam_search',
+    'beam_search_decode',
 ]
 
 
@@ -788,3 +789,44 @@ def position_embedding(x, max_len, param_attr=None, name=None):
                      inputs={'X': [x], 'Pos': [pos]},
                      outputs={'Out': [out]})
     return out
+
+
+def beam_search(pre_ids, pre_scores, scores, beam_size, end_id=0,
+                name=None):
+    """One beam expansion step (reference layers/nn.py:2706 beam_search ->
+    beam_search_op.cc), static-shape: the full [batch, beam] lattice is
+    kept every step; finished beams re-emit end_id with frozen scores.
+
+    pre_ids/pre_scores: [B, beam]; scores: [B, beam, V] log-probs.
+    Returns (selected_ids [B, beam], selected_scores [B, beam],
+    parent_idx [B, beam]). For the FIRST step feed pre_scores
+    [0, -inf, ...] so identical start beams don't duplicate.
+    """
+    helper = LayerHelper('beam_search', name=name)
+    ids = helper.create_variable_for_type_inference(pre_ids.dtype)
+    sel_scores = helper.create_variable_for_type_inference('float32')
+    parents = helper.create_variable_for_type_inference('int32')
+    helper.append_op(
+        type='beam_search',
+        inputs={'PreIds': [pre_ids], 'PreScores': [pre_scores],
+                'Scores': [scores]},
+        outputs={'SelectedIds': [ids], 'SelectedScores': [sel_scores],
+                 'ParentIdx': [parents]},
+        attrs={'beam_size': beam_size, 'end_id': end_id})
+    return ids, sel_scores, parents
+
+
+def beam_search_decode(ids, parent_idx, scores, name=None):
+    """Backtrack stacked per-step beams into sequences (reference
+    beam_search_decode_op.cc). ids/parent_idx: [T, B, beam]; scores:
+    [B, beam] final cumulative scores. Returns (sentence_ids [B, beam, T],
+    sentence_scores [B, beam])."""
+    helper = LayerHelper('beam_search_decode', name=name)
+    sent = helper.create_variable_for_type_inference(ids.dtype)
+    sent_scores = helper.create_variable_for_type_inference('float32')
+    helper.append_op(
+        type='beam_search_decode',
+        inputs={'Ids': [ids], 'ParentIdx': [parent_idx],
+                'Scores': [scores]},
+        outputs={'SentenceIds': [sent], 'SentenceScores': [sent_scores]})
+    return sent, sent_scores

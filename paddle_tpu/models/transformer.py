@@ -116,9 +116,8 @@ def _blocks(x, cfg):
     return x
 
 
-def language_model(tokens, cfg):
-    """tokens: [B, T, 1] int64 ids (no lod: fixed T). Returns softmax
-    probabilities [B, T, vocab]."""
+def _trunk(tokens, cfg):
+    """Shared embed + position + blocks + final norm."""
     if cfg.use_tp:
         emb = vocab_parallel_embedding(tokens, [cfg.vocab, cfg.dim])
     else:
@@ -126,26 +125,22 @@ def language_model(tokens, cfg):
     pos = L.position_embedding(emb, cfg.max_len)
     x = L.elementwise_add(emb, pos)
     x = _blocks(x, cfg)
-    x = L.layer_norm(x, begin_norm_axis=2)
-    logits = L.fc(input=x, size=cfg.vocab, num_flatten_dims=2,
-                  act='softmax')
-    return logits
+    return L.layer_norm(x, begin_norm_axis=2)
+
+
+def language_model(tokens, cfg):
+    """tokens: [B, T, 1] int64 ids (no lod: fixed T). Returns softmax
+    probabilities [B, T, vocab]."""
+    return L.fc(input=_trunk(tokens, cfg), size=cfg.vocab,
+                num_flatten_dims=2, act='softmax')
 
 
 def language_model_logits(tokens, cfg):
     """Like language_model but returns raw logits [B, T, vocab] — pair
     with softmax_with_cross_entropy so XLA fuses the softmax into the
     loss (the MXU-dense benchmark path)."""
-    if cfg.use_tp:
-        emb = vocab_parallel_embedding(tokens, [cfg.vocab, cfg.dim])
-    else:
-        emb = L.embedding(tokens, size=[cfg.vocab, cfg.dim])
-    pos = L.position_embedding(emb, cfg.max_len)
-    x = L.elementwise_add(emb, pos)
-    x = _blocks(x, cfg)
-    x = L.layer_norm(x, begin_norm_axis=2)
-    return L.fc(input=x, size=cfg.vocab, num_flatten_dims=2,
-                name='lm_head')
+    return L.fc(input=_trunk(tokens, cfg), size=cfg.vocab,
+                num_flatten_dims=2, name='lm_head')
 
 
 def train_network(tokens, labels, cfg):

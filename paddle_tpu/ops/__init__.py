@@ -11,3 +11,4 @@ from . import array_ops    # noqa: F401
 from . import sequence_ops  # noqa: F401
 from . import moe_ops       # noqa: F401
 from . import dist_ops      # noqa: F401
+from . import beam_search_ops  # noqa: F401

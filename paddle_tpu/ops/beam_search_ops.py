@@ -1,0 +1,108 @@
+"""Beam search ops (reference paddle/fluid/operators/beam_search_op.cc,
+beam_search_decode_op.cc).
+
+TPU-native formulation: STATIC shapes throughout. The reference grows
+LoD tensors per step and prunes finished hypotheses out of the batch
+(dynamic shapes); here every step keeps the full [batch, beam] lattice —
+finished beams are masked to re-emit end_id with frozen scores — so the
+whole decode compiles to one XLA program (unrolled or inside
+lax.while_loop). beam_search_decode backtracks the parent lattice with
+a trace-time loop over the (static) time axis.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..registry import register_op, op_emitter
+
+NEG_INF = -1e9
+
+
+@op_emitter('beam_search')
+def _beam_search_emit(ctx, op):
+    """One expansion step.
+
+    inputs:  PreIds [B, beam] int, PreScores [B, beam] float (cumulative
+             log-prob), Scores [B, beam, V] float (this step's log-probs)
+    attrs:   beam_size, end_id
+    outputs: SelectedIds [B, beam], SelectedScores [B, beam],
+             ParentIdx [B, beam] (which source beam each winner extends)
+    """
+    pre_ids = ctx.get(op.single_input('PreIds'))
+    pre_scores = ctx.get(op.single_input('PreScores'))
+    logprobs = ctx.get(op.single_input('Scores'))
+    beam = int(op.attr('beam_size'))
+    end_id = int(op.attr('end_id'))
+    B, K, V = logprobs.shape
+
+    finished = (pre_ids == end_id)                      # [B, K]
+    # finished beams may only extend with end_id at zero added cost;
+    # live beams add this step's log-probs
+    only_end = jnp.full((V,), NEG_INF,
+                        logprobs.dtype).at[end_id].set(0.0)
+    step = jnp.where(finished[..., None], only_end[None, None, :],
+                     logprobs)
+    total = pre_scores[..., None] + step                # [B, K, V]
+    flat = total.reshape(B, K * V)
+    top_scores, top_idx = jax.lax.top_k(flat, beam)
+    parent = (top_idx // V).astype(jnp.int32)
+    ids = (top_idx % V).astype(pre_ids.dtype)
+    ctx.set(op.single_output('SelectedIds'), ids)
+    ctx.set(op.single_output('SelectedScores'), top_scores)
+    ctx.set(op.single_output('ParentIdx'), parent)
+
+
+def _beam_search_infer(op, block):
+    pre = block.var_recursive(op.single_input('PreIds'))
+    for slot, dtype in (('SelectedIds', pre.dtype),
+                        ('SelectedScores', 'float32'),
+                        ('ParentIdx', 'int32')):
+        v = block.var_recursive(op.single_output(slot))
+        v.shape = pre.shape
+        v.dtype = dtype
+
+
+register_op('beam_search', infer_shape=_beam_search_infer, no_grad=True)
+
+
+@op_emitter('beam_search_decode')
+def _beam_search_decode_emit(ctx, op):
+    """Backtrack the per-step (ids, parents) lattice into full sequences.
+
+    inputs:  Ids [T, B, beam], ParentIdx [T, B, beam],
+             Scores [B, beam] (final cumulative scores)
+    outputs: SentenceIds [B, beam, T], SentenceScores [B, beam]
+    """
+    ids = ctx.get(op.single_input('Ids'))
+    parents = ctx.get(op.single_input('ParentIdx'))
+    scores = ctx.get(op.single_input('Scores'))
+    T, B, K = ids.shape
+    batch_ix = jnp.arange(B)[:, None]
+    # walk backwards: beam slot k at the END owns one path through the
+    # lattice; T is static at trace time, so a Python loop unrolls
+    seq = [None] * T
+    cursor = jnp.tile(jnp.arange(K)[None, :], (B, 1))    # [B, K]
+    for t in range(T - 1, -1, -1):
+        seq[t] = ids[t][batch_ix, cursor]
+        cursor = parents[t][batch_ix, cursor]
+    out = jnp.stack(seq, axis=-1)                        # [B, K, T]
+    ctx.set(op.single_output('SentenceIds'), out)
+    ctx.set(op.single_output('SentenceScores'), scores)
+
+
+def _beam_search_decode_infer(op, block):
+    ids = block.var_recursive(op.single_input('Ids'))
+    out = block.var_recursive(op.single_output('SentenceIds'))
+    if ids.shape is not None and len(ids.shape) == 3:
+        T, B, K = ids.shape
+        out.shape = (B, K, T)
+    out.dtype = ids.dtype
+    sc = block.var_recursive(op.single_output('SentenceScores'))
+    in_sc = block.var_recursive(op.single_input('Scores'))
+    sc.shape = in_sc.shape
+    sc.dtype = in_sc.dtype
+
+
+register_op('beam_search_decode', infer_shape=_beam_search_decode_infer,
+            no_grad=True)

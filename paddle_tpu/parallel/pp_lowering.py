@@ -159,11 +159,24 @@ def build_pp_segment_fn(pe, segment, block, program):
     region_out = infos[-1]['x_out']
     region_in = infos[0]['x_in']
 
-    # param -> grad var name, from the optimizer ops
+    # param -> grad var name, from the optimizer ops. Any grad
+    # POST-PROCESSING (clipping, weight decay) renames the optimizer's
+    # Grad input away from the raw autodiff name — seg_fn would write
+    # the raw gradient under that name and silently drop the transform,
+    # so refuse instead.
+    from ..framework import grad_var_name
     grad_of = {}
     for op, _ in opt:
         if op.input('Param'):
-            grad_of[op.single_input('Param')] = op.single_input('Grad')
+            p = op.single_input('Param')
+            g = op.single_input('Grad')
+            if g != grad_var_name(p):
+                raise NotImplementedError(
+                    'pipeline parallelism: optimizer consumes a '
+                    'transformed gradient %r for param %r (gradient '
+                    'clipping / regularization are not supported under '
+                    'pp — grads come from whole-graph autodiff)' % (g, p))
+            grad_of[p] = g
 
     is_test = program._is_test
     amp = getattr(program, '_use_bf16', False)

@@ -117,15 +117,23 @@ class PyReader(object):
         if self._started:
             raise RuntimeError('py_reader %r already started (reset() '
                                'after EOFException)' % self.name)
-        self._stop.clear()
+        # threads capture THEIR pass's queues AND stop event as
+        # arguments: a stale thread from a timed-out mid-pass reset
+        # (blocked inside the user generator) can only ever touch its
+        # own dead queues, and its own stop event stays set so it exits
+        # instead of busy-polling for the lifetime of the next pass
+        self._stop = threading.Event()
         self._host_q = queue.Queue(maxsize=self.capacity)
         self._threads = [threading.Thread(target=self._feed_loop,
+                                          args=(self._host_q, self._stop),
                                           daemon=True)]
         if self.use_double_buffer:
             # depth 2: one batch in flight to device, one ready
             self._dev_q = queue.Queue(maxsize=2)
-            self._threads.append(threading.Thread(target=self._place_loop,
-                                                  daemon=True))
+            self._threads.append(threading.Thread(
+                target=self._place_loop,
+                args=(self._host_q, self._dev_q, self._stop),
+                daemon=True))
         for t in self._threads:
             t.start()
         self._started = True
@@ -142,7 +150,6 @@ class PyReader(object):
         for t in self._threads:
             t.join(timeout=10.0)
         self._threads = []
-        self._stop.clear()
         self._started = False
 
     # -- step-side ---------------------------------------------------------
@@ -167,41 +174,46 @@ class PyReader(object):
         return item
 
     # -- threads -----------------------------------------------------------
-    def _feed_loop(self):
+    def _feed_loop(self, host_q, stop):
         # a generator failure must surface at the consuming step, NOT
         # masquerade as a clean pass end (silent data truncation)
         tail = _EOF
         try:
             for batch in self._source():
-                if self._stop.is_set():
+                if stop.is_set():
                     return
-                self._put_interruptible(self._host_q, batch)
+                self._put_interruptible(host_q, batch, stop)
         except Exception as e:         # noqa: BLE001 — re-raised in read()
             tail = _SourceError(e)
         finally:
-            self._put_interruptible(self._host_q, tail)
+            self._put_interruptible(host_q, tail, stop)
 
-    def _place_loop(self):
+    def _place_loop(self, host_q, dev_q, stop):
         import jax
-        import queue as _q
         dev = self.device or jax.devices()[0]
         while True:
             # poll with a timeout so a mid-pass reset() (stop set while
             # the feeder is blocked elsewhere) cannot strand this thread
-            if self._stop.is_set():
+            if stop.is_set():
                 return
             try:
-                item = self._host_q.get(timeout=0.2)
-            except _q.Empty:
+                item = host_q.get(timeout=0.2)
+            except queue.Empty:
                 continue
             if item is _EOF or isinstance(item, _SourceError):
-                self._put_interruptible(self._dev_q, item)
+                self._put_interruptible(dev_q, item, stop)
                 return
-            placed = [jax.device_put(a, dev) for a in item]
-            self._put_interruptible(self._dev_q, placed)
+            try:
+                placed = [jax.device_put(a, dev) for a in item]
+            except Exception as e:     # noqa: BLE001 — re-raised in read()
+                # a placement failure (bad dtype, device OOM) must reach
+                # the consuming step, not kill this thread and hang read()
+                self._put_interruptible(dev_q, _SourceError(e), stop)
+                return
+            self._put_interruptible(dev_q, placed, stop)
 
-    def _put_interruptible(self, q, item):
-        while not self._stop.is_set():
+    def _put_interruptible(self, q, item, stop):
+        while not stop.is_set():
             try:
                 q.put(item, timeout=0.2)
                 return

@@ -1,0 +1,127 @@
+"""Executor error context + check_nan_inf debug mode (reference
+platform/enforce.h:253 annotated errors; operator.cc:749
+FLAGS_check_nan_inf)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.executor import OpExecutionError
+from paddle_tpu.framework import Program, program_guard
+
+
+def test_misshaped_program_names_the_op():
+    """A shape bug fails with the offending op named, not a bare JAX
+    traceback."""
+    prog, startup = Program(), Program()
+    with program_guard(prog, startup):
+        a = fluid.layers.data(name='a', shape=[4], dtype='float32')
+        b = fluid.layers.data(name='b', shape=[5], dtype='float32')
+        # matmul [B,4] x [B,5]: inner dims mismatch at runtime
+        c = fluid.layers.matmul(a, b)
+        s = fluid.layers.reduce_sum(c)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with pytest.raises(OpExecutionError) as ei:
+        exe.run(prog, feed={'a': np.ones((2, 4), 'float32'),
+                            'b': np.ones((2, 5), 'float32')},
+                fetch_list=[s])
+    msg = str(ei.value)
+    assert "'matmul'" in msg and 'inputs' in msg
+    assert 'a[' in msg and 'b[' in msg
+
+
+def test_missing_producer_names_the_op():
+    prog, startup = Program(), Program()
+    with program_guard(prog, startup):
+        x = fluid.layers.data(name='x', shape=[4], dtype='float32')
+        y = fluid.layers.relu(x)
+    # sabotage: rename the relu input to a var nobody produces
+    relu_op = [op for op in prog.global_block().ops
+               if op.type == 'relu'][0]
+    relu_op.rename_input('x', 'ghost_var')
+    exe = fluid.Executor(fluid.CPUPlace())
+    with pytest.raises((OpExecutionError, RuntimeError)) as ei:
+        exe.run(prog, feed={'x': np.ones((2, 4), 'float32')},
+                fetch_list=[y])
+    assert 'ghost_var' in str(ei.value)
+
+
+def test_check_nan_inf_trips_on_injected_nan():
+    """With FLAGS_check_nan_inf the executor runs per-op and names the op
+    + output var that first produced a non-finite value."""
+    prog, startup = Program(), Program()
+    with program_guard(prog, startup):
+        x = fluid.layers.data(name='x', shape=[3], dtype='float32')
+        logx = fluid.layers.log(x)        # log(-1) -> NaN
+        out = fluid.layers.reduce_sum(logx)
+    exe = fluid.Executor(fluid.CPUPlace())
+    fluid.set_flags({'FLAGS_check_nan_inf': True})
+    try:
+        with pytest.raises(OpExecutionError) as ei:
+            exe.run(prog, feed={'x': -np.ones((2, 3), 'float32')},
+                    fetch_list=[out])
+        msg = str(ei.value)
+        assert 'NaN/Inf' in msg and "'log'" in msg
+    finally:
+        fluid.set_flags({'FLAGS_check_nan_inf': False})
+    # same program runs clean without the flag (NaNs flow through)
+    v, = exe.run(prog, feed={'x': -np.ones((2, 3), 'float32')},
+                 fetch_list=[out])
+    assert np.isnan(v).any() or np.isnan(float(np.asarray(v)))
+
+
+def test_check_nan_inf_catches_bf16_nan():
+    """bfloat16 outputs (the AMP activation dtype) must not slip past the
+    scanner: np.issubdtype(bfloat16, np.floating) is False, so the check
+    uses jnp dtype lattice."""
+    prog, startup = Program(), Program()
+    with program_guard(prog, startup):
+        x = fluid.layers.data(name='x', shape=[3], dtype='float32')
+        xb = fluid.layers.cast(x, 'bfloat16')
+        logx = fluid.layers.log(xb)       # bf16 NaN
+        out = fluid.layers.reduce_sum(fluid.layers.cast(logx, 'float32'))
+    exe = fluid.Executor(fluid.CPUPlace())
+    fluid.set_flags({'FLAGS_check_nan_inf': True})
+    try:
+        with pytest.raises(OpExecutionError) as ei:
+            exe.run(prog, feed={'x': -np.ones((2, 3), 'float32')},
+                    fetch_list=[out])
+        assert 'NaN/Inf' in str(ei.value) and "'log'" in str(ei.value)
+    finally:
+        fluid.set_flags({'FLAGS_check_nan_inf': False})
+
+
+def test_check_nan_inf_clean_run_matches_jitted():
+    prog, startup = Program(), Program()
+    prog.random_seed = startup.random_seed = 3
+    with program_guard(prog, startup):
+        x = fluid.layers.data(name='x', shape=[4], dtype='float32')
+        y = fluid.layers.data(name='y', shape=[1], dtype='float32')
+        pred = fluid.layers.fc(input=x, size=1)
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        fluid.optimizer.SGD(0.1).minimize(loss)
+    feed = {'x': np.random.RandomState(0).rand(4, 4).astype('float32'),
+            'y': np.ones((4, 1), 'float32')}
+
+    def run_once(flag):
+        scope = fluid.core.Scope()
+        exe = fluid.Executor(fluid.CPUPlace())
+        fluid.set_flags({'FLAGS_check_nan_inf': flag})
+        try:
+            with fluid.scope_guard(scope):
+                exe.run(startup)
+                vals = [float(exe.run(prog, feed=feed,
+                                      fetch_list=[loss])[0])
+                        for _ in range(3)]
+        finally:
+            fluid.set_flags({'FLAGS_check_nan_inf': False})
+        return vals
+
+    np.testing.assert_allclose(run_once(False), run_once(True), rtol=1e-5)
+
+
+def test_flags_env_bootstrap_and_api():
+    assert fluid.get_flags(['check_nan_inf'])['check_nan_inf'] is False
+    fluid.set_flags({'FLAGS_benchmark': '1'})
+    assert fluid.flags.get_flag('benchmark') is True
+    fluid.set_flags({'benchmark': False})
+    assert fluid.flags.get_flag('FLAGS_benchmark') is False
