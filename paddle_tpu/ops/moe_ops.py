@@ -1,14 +1,28 @@
-"""Mixture-of-experts FFN op (no reference analog -- the reference's
-nearest precursor is the distributed lookup table, SURVEY.md §2.11; this is
-the modern EP capability the framework adds).
+"""Mixture-of-experts FFN ops (no reference analog -- the reference's
+nearest precursor is the distributed lookup table, SURVEY.md §2.11; this
+is the modern EP capability the framework adds).
 
-Dense dispatch formulation: every token is combined with every expert via
-einsum and weighted by the (top-k masked) gate. With the expert dimension
-of WUp/WDown sharded over the 'ep' mesh axis, GSPMD gives each device its
-local experts and inserts the psum combine over ICI -- no hand-written
-all-to-all. Exact (no capacity dropping); compute is dense over experts,
-the standard trade for small expert counts."""
+Two dispatch formulations:
+
+- ``topk`` (default): GShard/Switch-style token routing. Each token's
+  top-k experts are selected, tokens claim slots in a per-expert
+  capacity buffer in slot-major priority order, and overflow tokens are
+  dropped (their combine weight is zero, so they pass through with zero
+  expert contribution). Dispatch and combine are one-hot einsums over a
+  static [S, E, C] lattice -- with the expert dimension sharded over the
+  'ep' mesh axis GSPMD lowers the dispatch einsum to an all-to-all over
+  ICI. Expert compute is E*C*D*H with E*C = k*S*capacity_factor:
+  **independent of the expert count** at fixed k (the property that
+  makes EP scale; asserted in tests/test_moe_dispatch.py).
+
+- ``dense``: every token is combined with every expert via einsum and
+  weighted by the (top-k masked) gate. Exact (no capacity dropping) but
+  compute grows linearly in E -- the small-E fallback and the numeric
+  reference for the topk parity test.
+"""
 from __future__ import annotations
+
+import math
 
 import jax
 import jax.numpy as jnp
@@ -19,6 +33,47 @@ _ACT = {'gelu': jax.nn.gelu, 'relu': jax.nn.relu, 'tanh': jnp.tanh,
         'sigmoid': jax.nn.sigmoid, '': lambda v: v, None: lambda v: v}
 
 
+def _topk_route(gate, k):
+    """Top-k mask, renormalized; gradient flows through the gate probs."""
+    E = gate.shape[-1]
+    if k >= E:
+        return gate
+    thresh = jnp.sort(gate, axis=-1)[..., E - k][..., None]
+    mask = (gate >= thresh).astype(gate.dtype)
+    route = gate * mask
+    return route / jnp.maximum(
+        jnp.sum(route, axis=-1, keepdims=True), 1e-9)
+
+
+def _dispatch_combine(route, k, capacity):
+    """Build the [S, E, C] dispatch (0/1) and combine (weighted) tensors
+    from renormalized routing probs [S, E].
+
+    Slot-major priority: all tokens' first choices claim capacity before
+    any second choice does (the GShard ordering), so overflow drops a
+    token's weakest expert first.
+    """
+    S, E = route.shape
+    top_w, top_i = jax.lax.top_k(route, k)            # [S, k]
+    # slot-major flattening: choice order = (k-slot, token)
+    flat_e = top_i.T.reshape(-1)                      # [k*S] int
+    flat_w = top_w.T.reshape(-1)                      # [k*S]
+    e_oh = jax.nn.one_hot(flat_e, E, dtype=route.dtype)      # [kS, E]
+    # position within the expert = how many earlier choices picked it.
+    # int32 cumsum regardless of route.dtype: in bf16 (AMP) counts above
+    # ~256 round, making tokens collide onto one capacity slot
+    e_cnt = e_oh.astype(jnp.int32)
+    pos = jnp.sum((jnp.cumsum(e_cnt, axis=0) - e_cnt) * e_cnt, axis=-1)
+    keep = (pos < capacity).astype(route.dtype)       # [kS]
+    c_oh = jax.nn.one_hot(pos, capacity, dtype=route.dtype) \
+        * keep[:, None]                               # [kS, C]
+    choice = e_oh[:, :, None] * c_oh[:, None, :]      # [kS, E, C] 0/1
+    dispatch = choice.reshape(k, S, E, capacity).sum(0)
+    combine = (choice * flat_w[:, None, None]) \
+        .reshape(k, S, E, capacity).sum(0)
+    return dispatch, combine
+
+
 @op_emitter('moe_ffn')
 def _moe_ffn_emit(ctx, op):
     x = ctx.get(op.single_input('X'))          # [..., D]
@@ -27,22 +82,30 @@ def _moe_ffn_emit(ctx, op):
     w_down = ctx.get(op.single_input('WDown'))  # [E, H, D]
     act = _ACT[op.attr('act', 'gelu')]
     k = op.attr('k', 1)
+    mode = op.attr('dispatch', 'topk')
     E = gate.shape[-1]
+    route = _topk_route(gate, k)
 
-    if k >= E:
-        route = gate
+    if mode == 'dense':
+        h = jnp.einsum('...d,edh->...eh', x, w_up)
+        h = act(h)
+        y = jnp.einsum('...eh,ehd->...ed', h, w_down)
+        out = jnp.einsum('...ed,...e->...d', y, route)
     else:
-        # top-k mask, renormalized; gradient flows through the gate probs
-        thresh = jnp.sort(gate, axis=-1)[..., E - k][..., None]
-        mask = (gate >= thresh).astype(gate.dtype)
-        route = gate * mask
-        route = route / jnp.maximum(
-            jnp.sum(route, axis=-1, keepdims=True), 1e-9)
-
-    h = jnp.einsum('...d,edh->...eh', x, w_up)
-    h = act(h)
-    y = jnp.einsum('...eh,ehd->...ed', h, w_down)
-    out = jnp.einsum('...ed,...e->...d', y, route)
+        D = x.shape[-1]
+        lead = x.shape[:-1]
+        S = int(math.prod(lead))
+        cf = float(op.attr('capacity_factor', 2.0))
+        C = max(1, int(math.ceil(S * min(k, E) * cf / E)))
+        xf = x.reshape(S, D)
+        dispatch, combine = _dispatch_combine(route.reshape(S, E),
+                                              min(k, E), C)
+        # expert inputs [E, C, D]: with w_up/w_down sharded over 'ep'
+        # this einsum IS the all-to-all
+        ein = jnp.einsum('sec,sd->ecd', dispatch, xf)
+        h = act(jnp.einsum('ecd,edh->ech', ein, w_up))
+        y = jnp.einsum('ech,ehd->ecd', h, w_down)
+        out = jnp.einsum('sec,ecd->sd', combine, y).reshape(x.shape)
     ctx.set(op.single_output('Out'), out)
 
 
@@ -56,3 +119,29 @@ def _moe_infer(op, block):
 
 register_op('moe_ffn', infer_shape=_moe_infer)
 register_vjp_grad('moe_ffn', in_slots=('X', 'Gate', 'WUp', 'WDown'))
+
+
+@op_emitter('moe_aux_loss')
+def _moe_aux_loss_emit(ctx, op):
+    """Load-balance auxiliary loss (Shazeer/GShard): E * sum_e(f_e * P_e)
+    where f_e = fraction of tokens whose TOP choice is expert e (hard,
+    non-differentiable) and P_e = mean gate probability (the gradient
+    path). Minimized (=1) at a uniform expert distribution."""
+    gate = ctx.get(op.single_input('Gate'))    # [..., E]
+    E = gate.shape[-1]
+    flat = gate.reshape(-1, E)
+    top1 = jax.nn.one_hot(jnp.argmax(flat, axis=-1), E, dtype=gate.dtype)
+    f = jnp.mean(top1, axis=0)
+    p = jnp.mean(flat, axis=0)
+    ctx.set(op.single_output('Out'), E * jnp.sum(f * p))
+
+
+def _aux_infer(op, block):
+    out = block.var_recursive(op.single_output('Out'))
+    out.shape = []
+    out.dtype = block.var_recursive(op.single_input('Gate')).dtype
+    out.lod_level = 0
+
+
+register_op('moe_aux_loss', infer_shape=_aux_infer)
+register_vjp_grad('moe_aux_loss', in_slots=('Gate',))

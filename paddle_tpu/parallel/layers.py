@@ -85,13 +85,20 @@ def sequence_parallel_scope(x, axis='sp'):
 
 
 def moe_layer(input, num_experts, hidden_size, act='gelu', k=1,
+              dispatch='topk', capacity_factor=2.0, aux_loss=False,
               param_attr=None, axis='ep', name=None):
-    """Expert-parallel MoE FFN (top-1 switch routing).
+    """Expert-parallel MoE FFN.
 
     Experts' weights are stacked [E, D, H]/[E, H, D] and sharded over the
-    'ep' axis; tokens are dispatched by a dense one-hot combine (einsum
-    formulation -- XLA turns the dispatch into an all-to-all over ep).
-    Capacity is implicit (dense dispatch): exact, no token dropping."""
+    'ep' axis. dispatch='topk' (default) is GShard-style capacity-bounded
+    routing: per-expert buffers hold ceil(S*k*capacity_factor/E) tokens,
+    overflow tokens are dropped, and expert compute is independent of
+    num_experts at fixed k. dispatch='dense' combines every token with
+    every expert (exact, O(E) compute -- small-E fallback). See
+    ops/moe_ops.py.
+
+    aux_loss=True additionally returns the GShard load-balance loss
+    scalar (add it to the training objective, typically weighted 1e-2)."""
     helper = LayerHelper('moe', param_attr=param_attr, name=name)
     D = input.shape[-1]
     dtype = input.dtype
@@ -115,6 +122,12 @@ def moe_layer(input, num_experts, hidden_size, act='gelu', k=1,
         inputs={'X': [input], 'Gate': [gate], 'WUp': [w_up],
                 'WDown': [w_down]},
         outputs={'Out': [out]},
-        attrs={'act': act, 'k': k})
+        attrs={'act': act, 'k': k, 'dispatch': dispatch,
+               'capacity_factor': capacity_factor})
     out.lod_level = input.lod_level
-    return out
+    if not aux_loss:
+        return out
+    aux = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(type='moe_aux_loss', inputs={'Gate': [gate]},
+                     outputs={'Out': [aux]})
+    return out, aux

@@ -25,16 +25,25 @@ GLOBAL_BATCH = 32
 STEPS = 5
 
 
-def build():
+def build(mode):
     prog, startup = Program(), Program()
     prog.random_seed = startup.random_seed = 11
     with program_guard(prog, startup):
         x = fluid.layers.data(name='x', shape=[8], dtype='float32')
         y = fluid.layers.data(name='y', shape=[1], dtype='float32')
-        h = fluid.layers.fc(input=x, size=16, act='relu')
-        pred = fluid.layers.fc(input=h, size=1)
+        if mode == 'tp':
+            # Megatron pair: the psum completing the row-parallel matmul
+            # rides the tp axis ACROSS the trainer boundary
+            from paddle_tpu.parallel.layers import (column_parallel_fc,
+                                                    row_parallel_fc)
+            h = column_parallel_fc(x, 16, act='relu')
+            pred = row_parallel_fc(h, 1)
+        else:
+            h = fluid.layers.fc(input=x, size=16, act='relu')
+            pred = fluid.layers.fc(input=h, size=1)
         loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
-        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+        # Adam: ZeRO-1 shards its moments; SGD has no state to shard
+        fluid.optimizer.Adam(learning_rate=0.1).minimize(loss)
     return prog, startup, loss
 
 
@@ -49,15 +58,26 @@ def batches():
 def main():
     num_trainers = int(os.environ.get('PADDLE_TRAINERS_NUM', 1))
     trainer_id = int(os.environ.get('PADDLE_TRAINER_ID', 0))
+    mode = os.environ.get('DIST_TEST_MODE', 'dp')
 
-    prog, startup, loss = build()
+    prog, startup, loss = build(mode)
     scope = fluid.Scope()
     exe = fluid.Executor(fluid.CPUPlace())
+
+    kwargs = {}
+    if mode == 'zero1':
+        bs = fluid.BuildStrategy()
+        bs.reduce_strategy = fluid.BuildStrategy.ReduceStrategy.Reduce
+        kwargs['build_strategy'] = bs
+    elif mode == 'tp':
+        from paddle_tpu.parallel import DistributedStrategy
+        n_dev = 4 * max(num_trainers, 1)   # 4 forced local devices each
+        kwargs['strategy'] = DistributedStrategy(dp=n_dev // 2, tp=2)
 
     pe = fluid.ParallelExecutor(use_cuda=False, loss_name=loss.name,
                                 main_program=prog, scope=scope,
                                 num_trainers=num_trainers,
-                                trainer_id=trainer_id)
+                                trainer_id=trainer_id, **kwargs)
     with fluid.scope_guard(scope):
         exe.run(startup, scope=scope)
 

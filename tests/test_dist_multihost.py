@@ -24,7 +24,7 @@ def _free_port():
     return port
 
 
-def _run_workers(n):
+def _run_workers(n, mode='dp'):
     port = _free_port()
     eps = ','.join('127.0.0.1:%d' % (port + i) for i in range(n))
     procs = []
@@ -36,6 +36,7 @@ def _run_workers(n):
             'PADDLE_TRAINERS_NUM': str(n),
             'PADDLE_TRAINER_ID': str(i),
             'PADDLE_TRAINER_ENDPOINTS': eps,
+            'DIST_TEST_MODE': mode,
         })
         procs.append(subprocess.Popen(
             [sys.executable, _WORKER], env=env,
@@ -66,3 +67,29 @@ def test_two_trainers_match_single():
     np.testing.assert_allclose(single, two[0], rtol=1e-4)
     # training progressed
     assert two[0][-1] < two[0][0]
+
+
+@pytest.mark.timeout(600)
+def test_four_trainers_zero1_match_single():
+    """Multi-host x ZeRO-1: 4 trainers with BuildStrategy.Reduce (Adam
+    moments sharded over the cross-host dp axis) must train to the same
+    losses as one plain process."""
+    single = _run_workers(1)[0]
+    four = _run_workers(4, mode='zero1')
+    for other in four[1:]:
+        np.testing.assert_allclose(four[0], other, rtol=1e-6)
+    np.testing.assert_allclose(single, four[0], rtol=1e-4)
+    assert four[0][-1] < four[0][0]
+
+
+@pytest.mark.timeout(600)
+def test_four_trainers_tp_match_single():
+    """Multi-host x tensor parallelism: dp(8) x tp(2) mesh over 4
+    processes x 4 local devices; the Megatron row-parallel psum crosses
+    the process boundary."""
+    single = _run_workers(1, mode='tp')[0]
+    four = _run_workers(4, mode='tp')
+    for other in four[1:]:
+        np.testing.assert_allclose(four[0], other, rtol=1e-6)
+    np.testing.assert_allclose(single, four[0], rtol=1e-4)
+    assert four[0][-1] < four[0][0]
