@@ -56,6 +56,10 @@ from .transpiler import DistributeTranspiler, DistributeTranspilerConfig
 from . import distributed
 from . import flags
 from .flags import set_flags, get_flags
+from . import recordio
+from .recordio import (convert_reader_to_recordio_file,
+                       convert_reader_to_recordio_files)
+from . import memory
 from . import trainer
 from .trainer import (Trainer, CheckpointConfig, BeginEpochEvent,
                       EndEpochEvent, BeginStepEvent, EndStepEvent)

@@ -16,7 +16,10 @@ from . import sentiment  # noqa: F401
 from . import wmt14  # noqa: F401
 from . import wmt16  # noqa: F401
 from . import flowers  # noqa: F401
+from . import voc2012  # noqa: F401
+from . import mq2007  # noqa: F401
 from . import uci_housing as housing  # noqa: F401
 
 __all__ = ['common', 'uci_housing', 'mnist', 'cifar', 'imdb', 'imikolov',
-           'movielens', 'conll05', 'sentiment', 'wmt14', 'wmt16', 'flowers']
+           'movielens', 'conll05', 'sentiment', 'wmt14', 'wmt16', 'flowers',
+           'voc2012', 'mq2007']

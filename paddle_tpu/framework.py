@@ -452,16 +452,20 @@ class Program(object):
         p._bump_version()
         return p
 
-    def _prune(self, targets):
+    def _prune(self, targets, feeds=()):
         """Return a new program keeping only ops needed to compute targets
-        (reference prune.h / io.py save_inference_model pruning)."""
+        (reference prune.h / io.py save_inference_model pruning). Vars in
+        `feeds` are graph BOUNDARIES: their producer ops (e.g. a py_reader
+        'read' op) are cut, since the caller will feed them directly."""
         target_names = set()
         for t in targets:
             target_names.add(t.name if isinstance(t, Variable) else t)
+        feed_names = {f.name if isinstance(f, Variable) else f
+                      for f in feeds}
         p = copy.deepcopy(self)
         p._uid = next(Program._uid_counter)
         block = p.global_block()
-        needed = set(target_names)
+        needed = set(target_names) - feed_names
         kept = []
         for op in reversed(block.ops):
             if op.type == 'fetch':
@@ -469,6 +473,7 @@ class Program(object):
             if set(op.output_arg_names()) & needed:
                 kept.append(op)
                 needed.update(op.input_arg_names())
+                needed -= feed_names
         kept.reverse()
         block.ops[:] = kept
         used = set()

@@ -1,0 +1,100 @@
+"""Inference engine: the Predictor ABI over saved inference models.
+
+Capability analog of the reference inference API —
+paddle/fluid/inference/api/paddle_inference_api.h (PaddlePredictor,
+NativeConfig, CreatePaddlePredictor) — redesigned for the XLA execution
+model: a Predictor owns a private Scope with the loaded weights resident
+on device, the pruned inference Program compiles ONCE per fed batch
+shape through the executor's whole-block jit cache, and clone() shares
+the weight scope between predictors (the reference's
+PaddlePredictor::Clone contract) so serving threads don't duplicate HBM.
+
+The reference's TensorRT/analysis sub-engines are N/A by design: XLA is
+the graph optimizer here.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from . import io as io_mod
+from .executor import Executor, Scope, TPUPlace, scope_guard
+
+__all__ = ['Config', 'Predictor', 'create_predictor',
+           'create_paddle_predictor']
+
+
+class Config(object):
+    """(reference NativeConfig) model_dir holds a save_inference_model
+    artifact; model_filename/params_filename follow io.py's layout."""
+
+    def __init__(self, model_dir, model_filename=None,
+                 params_filename=None, place=None):
+        self.model_dir = model_dir
+        self.model_filename = model_filename
+        self.params_filename = params_filename
+        self.place = place
+
+
+class Predictor(object):
+    def __init__(self, config, _clone_of=None):
+        self._config = config
+        self._place = config.place if config.place is not None \
+            else TPUPlace()
+        self._exe = Executor(self._place)
+        if _clone_of is not None:
+            # clone from memory (reference PaddlePredictor::Clone is
+            # independent of the model directory): share the weight
+            # scope, copy the program so compile caches stay per-clone
+            self._scope = _clone_of._scope
+            self._program = _clone_of._program.clone(for_test=True)
+            self._feed_names = list(_clone_of._feed_names)
+            self._fetch_vars = [
+                self._program.global_block().var(v.name)
+                for v in _clone_of._fetch_vars]
+        else:
+            self._scope = Scope()
+            with scope_guard(self._scope):
+                (self._program, self._feed_names,
+                 self._fetch_vars) = io_mod.load_inference_model(
+                    config.model_dir, self._exe,
+                    model_filename=config.model_filename,
+                    params_filename=config.params_filename)
+        self._program._is_test = True
+
+    # -- reference PaddlePredictor surface ---------------------------------
+    def get_input_names(self):
+        return list(self._feed_names)
+
+    def get_output_names(self):
+        return [v.name for v in self._fetch_vars]
+
+    def run(self, inputs):
+        """inputs: dict name->array, or list matching get_input_names()
+        order. Returns list of np.ndarray outputs."""
+        if not isinstance(inputs, dict):
+            if len(inputs) != len(self._feed_names):
+                raise ValueError(
+                    'predictor expects %d inputs %s, got %d'
+                    % (len(self._feed_names), self._feed_names,
+                       len(inputs)))
+            inputs = dict(zip(self._feed_names, inputs))
+        # scope= kwarg, NOT scope_guard: run() must be safe from serving
+        # threads, and the guard swaps a process-global
+        outs = self._exe.run(self._program, feed=inputs,
+                             fetch_list=self._fetch_vars,
+                             scope=self._scope)
+        return [np.asarray(o) for o in outs]
+
+    def clone(self):
+        """A predictor sharing this one's weights (device arrays are
+        shared through the common Scope; programs/compile caches are
+        per-clone). Works from memory — the model dir may be gone."""
+        return Predictor(self._config, _clone_of=self)
+
+
+def create_predictor(config):
+    return Predictor(config)
+
+
+# reference CreatePaddlePredictor spelling
+create_paddle_predictor = create_predictor

@@ -110,9 +110,7 @@ def save_inference_model(dirname, feeded_var_names, target_vars, executor,
     os.makedirs(dirname, exist_ok=True)
 
     pruned = main_program.clone(for_test=True)
-    pruned = pruned._prune(target_vars)
-    pruned._fetch_targets = [v.name for v in target_vars]
-    pruned._feed_names = list(feeded_var_names)
+    pruned = pruned._prune(target_vars, feeds=feeded_var_names)
 
     model_path = os.path.join(dirname,
                               model_filename or _MODEL_FILENAME)
@@ -128,14 +126,17 @@ def save_inference_model(dirname, feeded_var_names, target_vars, executor,
 
 
 def load_inference_model(dirname, executor, model_filename=None,
-                         params_filename=None):
-    """Returns (program, feed_names, fetch_vars) (reference io.py:677)."""
+                         params_filename=None, load_params=True):
+    """Returns (program, feed_names, fetch_vars) (reference io.py:677).
+    load_params=False skips reading weights — for Predictor.clone(),
+    whose shared scope already holds them on device."""
     import json
     model_path = os.path.join(dirname, model_filename or _MODEL_FILENAME)
     with open(model_path) as f:
         d = json.loads(f.read())
     program = Program.from_json(d['program'])
-    load_persistables(executor, dirname, program, params_filename)
+    if load_params:
+        load_persistables(executor, dirname, program, params_filename)
     fetch_vars = [program.global_block().var(n) for n in d['fetch_names']]
     return program, d['feed_names'], fetch_vars
 

@@ -1,0 +1,115 @@
+"""Device (HBM) memory introspection.
+
+Capability analog of the reference memory subsystem's user-visible
+surface — BuddyAllocator statistics and FLAGS_fraction_of_gpu_memory
+accounting (paddle/fluid/memory/detail/buddy_allocator.h, memory/
+malloc.cc) — mapped to the TPU runtime: allocation itself is owned by
+PJRT/XLA (the design decision of SURVEY §2.4 — no reimplemented
+allocator can beat the compiler's static planning), so this module is
+the STATS half: live/peak HBM from the PJRT allocator, plus an analytic
+pre-run estimator so OOMs can be predicted before compiling a Program.
+
+On backends whose PJRT client exposes no allocator stats (CPU tests),
+the live stats degrade to the framework-tracked persistable footprint.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ['memory_stats', 'memory_allocated', 'max_memory_allocated',
+           'memory_limit', 'scope_footprint', 'estimate_program_memory']
+
+_DTYPE_BYTES = {
+    'float64': 8, 'int64': 8, 'uint64': 8,
+    'float32': 4, 'int32': 4, 'uint32': 4,
+    'bfloat16': 2, 'float16': 2, 'int16': 2, 'uint16': 2,
+    'int8': 1, 'uint8': 1, 'bool': 1,
+}
+
+
+def _device(device=None):
+    import jax
+    return device if device is not None else jax.devices()[0]
+
+
+def memory_stats(device=None):
+    """Raw PJRT allocator stats dict (bytes_in_use, peak_bytes_in_use,
+    bytes_limit, ...) or None where the backend exposes none."""
+    return _device(device).memory_stats()
+
+
+def memory_allocated(device=None):
+    """Live bytes on the device. Falls back to the global scope's
+    device-array footprint when the backend has no allocator stats."""
+    stats = memory_stats(device)
+    if stats and 'bytes_in_use' in stats:
+        return int(stats['bytes_in_use'])
+    return scope_footprint()
+
+
+def max_memory_allocated(device=None):
+    stats = memory_stats(device)
+    if stats and 'peak_bytes_in_use' in stats:
+        return int(stats['peak_bytes_in_use'])
+    return scope_footprint()
+
+
+def memory_limit(device=None):
+    """Total usable device memory, or None if unknown."""
+    stats = memory_stats(device)
+    if stats and 'bytes_limit' in stats:
+        return int(stats['bytes_limit'])
+    return None
+
+
+def scope_footprint(scope=None):
+    """Bytes held by device arrays reachable from a Scope (default the
+    global scope) — the framework's own view of persistable state."""
+    import jax
+    from .executor import global_scope
+    scope = scope if scope is not None else global_scope()
+    total = 0
+    for val in scope._vars.values():
+        if isinstance(val, jax.Array):
+            total += val.size * val.dtype.itemsize
+        elif isinstance(val, np.ndarray):
+            total += val.nbytes
+    return total
+
+
+def _var_bytes(var):
+    if var.shape is None:
+        return 0
+    n = 1
+    for d in var.shape:
+        n *= max(int(d), 1)   # batch dim -1 counted as 1 per sample
+    return n * _DTYPE_BYTES.get(str(var.dtype), 4)
+
+
+def estimate_program_memory(program, batch_size=1):
+    """Analytic HBM estimate for one run of `program`: persistable
+    parameters + peak of the non-persistable activations under XLA's
+    whole-block liveness (approximated as the sum of all activation
+    outputs — an upper bound; XLA's buffer reuse only improves on it).
+    Returns a dict with 'params', 'activations', 'total' in bytes.
+
+    The TPU-native replacement for the reference's memory-optimize
+    transpiler planning questions ('will this fit?'), answerable before
+    paying a compile."""
+    params = 0
+    acts = 0
+    seen = set()
+    for block in program.blocks:
+        for var in block.vars.values():
+            if var.name in seen:
+                continue
+            seen.add(var.name)
+            b = _var_bytes(var)
+            if getattr(var, 'persistable', False):
+                params += b
+            else:
+                # non-persistables scale with the fed batch
+                has_batch = var.shape and int(var.shape[0]) in (-1, 0)
+                acts += b * (batch_size if has_batch else 1)
+    return {'params': params, 'activations': acts,
+            'total': params + acts}

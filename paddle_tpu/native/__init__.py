@@ -1,0 +1,51 @@
+"""Native (C++) runtime pieces, built lazily with the system toolchain.
+
+The compute path is JAX/XLA; the runtime around it — here the RecordIO
+chunk engine (framing, CRC, compression) — is C++ like the reference's
+(paddle/fluid/recordio/), bound via ctypes (no pybind11 in this image).
+
+Libraries are compiled on first use with g++ into a cache directory
+keyed by a hash of the source, so editing a .cc transparently rebuilds
+and shipping wheels is not required.
+"""
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import tempfile
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+
+_EXTRA_LIBS = {'recordio': ['-lz']}
+
+_loaded = {}
+
+
+def load_library(name):
+    """Compile (if needed) and dlopen native/<name>.cc; returns CDLL."""
+    if name in _loaded:
+        return _loaded[name]
+    src = os.path.join(_DIR, name + '.cc')
+    with open(src, 'rb') as f:
+        digest = hashlib.sha256(f.read()).hexdigest()[:16]
+    cache_dir = os.environ.get(
+        'PADDLE_TPU_NATIVE_CACHE',
+        os.path.join(tempfile.gettempdir(),
+                     'paddle_tpu_native_%d' % os.getuid()))
+    os.makedirs(cache_dir, exist_ok=True)
+    so_path = os.path.join(cache_dir, '%s_%s.so' % (name, digest))
+    if not os.path.exists(so_path):
+        tmp = so_path + '.%d.tmp' % os.getpid()
+        cmd = ['g++', '-O2', '-shared', '-fPIC', '-std=c++17', src,
+               '-o', tmp] + _EXTRA_LIBS.get(name, [])
+        try:
+            subprocess.run(cmd, check=True, capture_output=True, text=True)
+        except subprocess.CalledProcessError as e:
+            raise RuntimeError(
+                'building native library %r failed:\n%s' % (name, e.stderr))
+        os.replace(tmp, so_path)   # atomic: concurrent builders race safely
+    lib = ctypes.CDLL(so_path)
+    _loaded[name] = lib
+    return lib

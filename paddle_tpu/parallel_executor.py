@@ -118,6 +118,10 @@ class ParallelExecutor(Executor):
             self.mesh, P('dp' if 'dp' in self.mesh.axis_names else None))
         self._params_placed = False
         self._run_count = 0
+        if self._build_strategy.debug_graphviz_path:
+            from .debugger import program_to_dot
+            with open(self._build_strategy.debug_graphviz_path, 'w') as f:
+                f.write(program_to_dot(self._main_program))
 
     @property
     def device_count(self):

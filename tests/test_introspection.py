@@ -1,0 +1,169 @@
+"""Memory stats, Predictor inference ABI, graphviz debugger, new
+datasets (reference memory/ stats surface, inference/api/
+paddle_inference_api.h, debugger.py, dataset/{voc2012,mq2007}.py)."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.framework import Program, program_guard
+
+
+def _train_and_save(tmp_path):
+    prog, startup = Program(), Program()
+    prog.random_seed = startup.random_seed = 7
+    with program_guard(prog, startup):
+        x = fluid.layers.data(name='x', shape=[6], dtype='float32')
+        pred = fluid.layers.fc(input=x, size=3, act='softmax',
+                               param_attr=fluid.ParamAttr(name='pw'))
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        model_dir = str(tmp_path / 'model')
+        fluid.io.save_inference_model(model_dir, ['x'], [pred], exe,
+                                      main_program=prog)
+        w = np.asarray(scope.find_var('pw'))
+    return model_dir, w
+
+
+def test_predictor_runs_and_matches_direct(tmp_path):
+    from paddle_tpu.inference import Config, create_predictor
+    model_dir, w = _train_and_save(tmp_path)
+    pred = create_predictor(Config(model_dir, place=fluid.CPUPlace()))
+    assert pred.get_input_names() == ['x']
+    xv = np.random.RandomState(0).rand(4, 6).astype('float32')
+    out, = pred.run({'x': xv})
+    # softmax(x @ w) computed directly
+    logits = xv @ w
+    ref = np.exp(logits) / np.exp(logits).sum(-1, keepdims=True)
+    np.testing.assert_allclose(out, ref, rtol=1e-4)
+    # positional input form
+    out2, = pred.run([xv])
+    np.testing.assert_allclose(out2, out)
+
+
+def test_predictor_clone_shares_weights(tmp_path):
+    from paddle_tpu.inference import Config, create_predictor
+    model_dir, _ = _train_and_save(tmp_path)
+    p1 = create_predictor(Config(model_dir, place=fluid.CPUPlace()))
+    p2 = p1.clone()
+    assert p1._scope is p2._scope
+    xv = np.random.RandomState(1).rand(2, 6).astype('float32')
+    np.testing.assert_allclose(p1.run([xv])[0], p2.run([xv])[0])
+
+
+def test_save_inference_model_prunes_reader_ops(tmp_path):
+    """Saving with a reader-produced feed var must cut the 'read' op
+    (feeds are graph boundaries in _prune) so the Predictor can feed it
+    directly without a live py_reader."""
+    prog, startup = Program(), Program()
+    with program_guard(prog, startup):
+        rd = fluid.layers.py_reader(capacity=2, shapes=[[-1, 6]],
+                                    dtypes=['float32'], name='prune_r',
+                                    use_double_buffer=False)
+        x = fluid.layers.read_file(rd)
+        pred = fluid.layers.fc(input=x, size=2)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        model_dir = str(tmp_path / 'm')
+        fluid.io.save_inference_model(model_dir, [x.name], [pred], exe,
+                                      main_program=prog)
+    from paddle_tpu.inference import Config, create_predictor
+    p = create_predictor(Config(model_dir, place=fluid.CPUPlace()))
+    assert all(op.type != 'read'
+               for op in p._program.global_block().ops)
+    out, = p.run([np.ones((3, 6), 'float32')])
+    assert out.shape == (3, 2)
+
+
+def test_memory_stats_and_estimate():
+    stats = fluid.memory.memory_stats()
+    assert stats is None or isinstance(stats, dict)
+    assert fluid.memory.memory_allocated() >= 0
+    prog, startup = Program(), Program()
+    with program_guard(prog, startup):
+        x = fluid.layers.data(name='x', shape=[16], dtype='float32')
+        y = fluid.layers.fc(input=x, size=32)
+    est = fluid.memory.estimate_program_memory(prog, batch_size=8)
+    # fc weight 16x32 fp32 + bias 32 = 2176 bytes of params
+    assert est['params'] == 16 * 32 * 4 + 32 * 4
+    assert est['activations'] > 0
+    assert est['total'] == est['params'] + est['activations']
+
+
+def test_scope_footprint_counts_persistables():
+    prog, startup = Program(), Program()
+    with program_guard(prog, startup):
+        x = fluid.layers.data(name='x', shape=[4], dtype='float32')
+        fluid.layers.fc(input=x, size=4,
+                        param_attr=fluid.ParamAttr(name='fw'),
+                        bias_attr=False)
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+    assert fluid.memory.scope_footprint(scope) >= 4 * 4 * 4
+
+
+def test_graphviz_dump(tmp_path):
+    from paddle_tpu.debugger import draw_block_graphviz, program_to_dot
+    prog, startup = Program(), Program()
+    with program_guard(prog, startup):
+        x = fluid.layers.data(name='x', shape=[4], dtype='float32')
+        h = fluid.layers.fc(input=x, size=8, act='relu')
+        loss = fluid.layers.mean(h)
+        fluid.optimizer.SGD(0.1).minimize(loss)
+    path = str(tmp_path / 'g.dot')
+    draw_block_graphviz(prog.global_block(), path)
+    dot = open(path).read()
+    assert dot.startswith('digraph')
+    assert 'matmul' in dot or 'mul' in dot
+    assert 'relu' in dot and '->' in dot
+    full = program_to_dot(prog)
+    assert 'cluster_block_0' in full
+
+
+def test_build_strategy_graphviz_knob(tmp_path):
+    import jax
+    prog, startup = Program(), Program()
+    with program_guard(prog, startup):
+        x = fluid.layers.data(name='x', shape=[4], dtype='float32')
+        loss = fluid.layers.mean(fluid.layers.fc(input=x, size=2))
+        fluid.optimizer.SGD(0.1).minimize(loss)
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup, scope=scope)
+    bs = fluid.BuildStrategy()
+    path = str(tmp_path / 'pe.dot')
+    bs.debug_graphviz_path = path
+    fluid.ParallelExecutor(use_cuda=False, loss_name=loss.name,
+                           main_program=prog, scope=scope,
+                           build_strategy=bs,
+                           devices=jax.devices()[:1])
+    assert os.path.exists(path) and 'digraph' in open(path).read()
+
+
+def test_voc2012_contract():
+    samples = list(fluid.dataset.voc2012.train()())[:4]
+    for img, label in samples:
+        assert img.shape == (3, 64, 64) and img.dtype == np.float32
+        assert label.shape == (64, 64) and label.dtype == np.int32
+        classes = set(np.unique(label)) - {255}
+        assert classes <= set(range(21))
+
+
+def test_mq2007_contract():
+    pw = list(fluid.dataset.mq2007.train(format='pairwise')())[:50]
+    for hi, lo, f1, f2 in pw:
+        assert hi > lo
+        assert f1.shape == (46,) and f2.shape == (46,)
+    lw = list(fluid.dataset.mq2007.train(format='listwise')())[:3]
+    for labels, feats in lw:
+        assert len(labels) == len(feats)
+    pt = list(fluid.dataset.mq2007.test(format='pointwise')())[:10]
+    for f, l in pt:
+        assert f.shape == (46,) and l in (0, 1, 2)

@@ -1,0 +1,126 @@
+"""RecordIO chunk engine (native/recordio.cc via ctypes) + tensor serde
+(reference recordio/{writer,scanner,chunk}, recordio_writer.py)."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import recordio
+
+
+def _samples(n=25):
+    rng = np.random.RandomState(0)
+    for i in range(n):
+        yield (rng.rand(4, 3).astype('float32'),
+               np.asarray([i], 'int64'))
+
+
+def test_write_scan_roundtrip(tmp_path):
+    path = str(tmp_path / 'data.recordio')
+    n = recordio.convert_reader_to_recordio_file(
+        path, lambda: _samples(), max_num_records=10)  # several chunks
+    assert n == 25
+    got = list(recordio.reader(path)())
+    want = list(_samples())
+    assert len(got) == 25
+    for (gx, gi), (wx, wi) in zip(got, want):
+        np.testing.assert_array_equal(gx, wx)
+        np.testing.assert_array_equal(gi, wi)
+        assert gx.dtype == wx.dtype and gi.dtype == wi.dtype
+
+
+def test_no_compress_and_deflate_agree(tmp_path):
+    p0 = str(tmp_path / 'raw.recordio')
+    p1 = str(tmp_path / 'defl.recordio')
+    recordio.convert_reader_to_recordio_file(
+        p0, lambda: _samples(8), compressor=recordio.Compressor.NoCompress)
+    recordio.convert_reader_to_recordio_file(
+        p1, lambda: _samples(8), compressor=recordio.Compressor.Deflate)
+    for a, b in zip(recordio.reader(p0)(), recordio.reader(p1)()):
+        np.testing.assert_array_equal(a[0], b[0])
+
+
+def test_compression_shrinks_compressible_data(tmp_path):
+    p0 = str(tmp_path / 'raw.recordio')
+    p1 = str(tmp_path / 'defl.recordio')
+
+    def zeros():
+        for _ in range(20):
+            yield (np.zeros((64, 64), 'float32'),)
+    recordio.convert_reader_to_recordio_file(
+        p0, zeros, compressor=recordio.Compressor.NoCompress)
+    recordio.convert_reader_to_recordio_file(
+        p1, zeros, compressor=recordio.Compressor.Deflate)
+    assert os.path.getsize(p1) < os.path.getsize(p0) / 10
+
+
+def test_corruption_detected(tmp_path):
+    path = str(tmp_path / 'data.recordio')
+    recordio.convert_reader_to_recordio_file(
+        path, lambda: _samples(5),
+        compressor=recordio.Compressor.NoCompress)
+    blob = bytearray(open(path, 'rb').read())
+    blob[40] ^= 0xFF            # flip a payload byte past the header
+    open(path, 'wb').write(bytes(blob))
+    with pytest.raises(IOError, match='crc|corrupt|inflate'):
+        list(recordio.reader(path)())
+
+
+def test_not_a_recordio_file(tmp_path):
+    path = str(tmp_path / 'junk')
+    open(path, 'wb').write(b'this is not a recordio file at all')
+    with pytest.raises(IOError, match='magic'):
+        list(recordio.reader(path)())
+
+
+def test_sharded_files_and_glob(tmp_path):
+    base = str(tmp_path / 'shard.recordio')
+    counts = recordio.convert_reader_to_recordio_files(
+        base, 10, lambda: _samples(25))
+    assert counts == [10, 10, 5]
+    got = list(recordio.reader(base + '-*')())
+    assert len(got) == 25
+    idx = [int(s[1][0]) for s in got]
+    assert idx == list(range(25))     # order preserved across shards
+
+
+def test_recordio_feeds_py_reader_training(tmp_path):
+    """The full loop: dataset -> recordio file -> reader -> py_reader
+    double-buffer -> train. The recordio reader is a first-class member
+    of the data stack."""
+    from paddle_tpu.framework import Program, program_guard
+    path = str(tmp_path / 'train.recordio')
+    rng = np.random.RandomState(1)
+    w = rng.randn(8, 1).astype('float32')
+
+    def samples():
+        for _ in range(48):
+            x = rng.randn(8).astype('float32')
+            yield (x, (x @ w).astype('float32'))
+    recordio.convert_reader_to_recordio_file(path, samples)
+
+    prog, startup = Program(), Program()
+    with program_guard(prog, startup):
+        rd = fluid.layers.py_reader(capacity=4, shapes=[[-1, 8], [-1, 1]],
+                                    dtypes=['float32', 'float32'],
+                                    name='rio_r', use_double_buffer=True)
+        x, y = fluid.layers.read_file(rd)
+        pred = fluid.layers.fc(input=x, size=1)
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        fluid.optimizer.SGD(0.1).minimize(loss)
+    batched = fluid.batch(recordio.reader(path), batch_size=16)
+    rd.decorate_paddle_reader(batched)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    losses = []
+    for _ in range(12):
+        rd.start()
+        while True:
+            try:
+                l, = exe.run(prog, fetch_list=[loss])
+                losses.append(float(np.asarray(l)))
+            except fluid.reader.pipeline.EOFException:
+                rd.reset()
+                break
+    assert losses[-1] < 0.5 * losses[0], (losses[0], losses[-1])
