@@ -60,6 +60,8 @@ from . import recordio
 from .recordio import (convert_reader_to_recordio_file,
                        convert_reader_to_recordio_files)
 from . import memory
+from . import channels
+from .channels import make_channel
 from . import trainer
 from .trainer import (Trainer, CheckpointConfig, BeginEpochEvent,
                       EndEpochEvent, BeginStepEvent, EndStepEvent)

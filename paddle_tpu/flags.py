@@ -15,6 +15,10 @@ Known flags:
                          automatic here)
   fraction_of_gpu_memory_to_use / init_allocated_mem / use_pinned_memory
                          accepted for script compat (PJRT owns memory)
+  use_pallas_fused_ops   route eligible op patterns (1x1 conv+BN) through
+                         the Pallas fused kernels (paddle_tpu/pallas/)
+  pallas_interpret       run Pallas kernels in interpreter mode off-TPU
+                         (numerics tests on CPU)
 """
 from __future__ import annotations
 
@@ -29,6 +33,8 @@ _DEFAULTS = {
     'fraction_of_gpu_memory_to_use': 0.92,
     'init_allocated_mem': False,
     'use_pinned_memory': True,
+    'use_pallas_fused_ops': False,
+    'pallas_interpret': False,
 }
 
 _FLAGS = dict(_DEFAULTS)

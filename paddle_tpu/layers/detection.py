@@ -1,0 +1,95 @@
+"""Detection layer API (reference python/paddle/fluid/layers/
+detection.py: prior_box :801, box_coder, iou_similarity,
+multiclass_nms, detection_output :186). Static-shape TPU formulation —
+see ops/detection_ops.py for the design notes (fixed [B, keep_top_k, 6]
+NMS output + valid counts instead of LoD results)."""
+from __future__ import annotations
+
+from ..layer_helper import LayerHelper
+
+__all__ = ['prior_box', 'box_coder', 'iou_similarity', 'multiclass_nms',
+           'detection_output']
+
+
+def prior_box(input, image, min_sizes, max_sizes=None,
+              aspect_ratios=(1.0,), variance=(0.1, 0.1, 0.2, 0.2),
+              flip=False, clip=False, steps=(0.0, 0.0), offset=0.5,
+              name=None):
+    helper = LayerHelper('prior_box', name=name)
+    boxes = helper.create_variable_for_type_inference('float32')
+    var = helper.create_variable_for_type_inference('float32')
+    helper.append_op(
+        type='prior_box',
+        inputs={'Input': [input], 'Image': [image]},
+        outputs={'Boxes': [boxes], 'Variances': [var]},
+        attrs={'min_sizes': list(min_sizes),
+               'max_sizes': list(max_sizes or []),
+               'aspect_ratios': list(aspect_ratios),
+               'variances': list(variance), 'flip': flip, 'clip': clip,
+               'step_w': steps[0], 'step_h': steps[1], 'offset': offset})
+    boxes.stop_gradient = True
+    var.stop_gradient = True
+    return boxes, var
+
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type='encode_center_size', box_normalized=True,
+              name=None):
+    helper = LayerHelper('box_coder', name=name)
+    out = helper.create_variable_for_type_inference(target_box.dtype)
+    inputs = {'PriorBox': [prior_box], 'TargetBox': [target_box]}
+    if prior_box_var is not None:
+        inputs['PriorBoxVar'] = [prior_box_var]
+    helper.append_op(type='box_coder', inputs=inputs,
+                     outputs={'OutputBox': [out]},
+                     attrs={'code_type': code_type,
+                            'box_normalized': box_normalized})
+    return out
+
+
+def iou_similarity(x, y, box_normalized=True, name=None):
+    helper = LayerHelper('iou_similarity', name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type='iou_similarity',
+                     inputs={'X': [x], 'Y': [y]},
+                     outputs={'Out': [out]},
+                     attrs={'box_normalized': box_normalized})
+    return out
+
+
+def multiclass_nms(bboxes, scores, score_threshold, nms_top_k,
+                   keep_top_k, nms_threshold=0.3, normalized=True,
+                   background_label=0, return_index=False, name=None):
+    """bboxes [B, N, 4], scores [B, C, N] -> ([B, keep_top_k, 6]
+    (label, score, x1, y1, x2, y2; empty slots label=-1),
+    valid_count [B])."""
+    helper = LayerHelper('multiclass_nms', name=name)
+    out = helper.create_variable_for_type_inference(bboxes.dtype)
+    count = helper.create_variable_for_type_inference('int32')
+    helper.append_op(
+        type='multiclass_nms',
+        inputs={'BBoxes': [bboxes], 'Scores': [scores]},
+        outputs={'Out': [out], 'ValidCount': [count]},
+        attrs={'score_threshold': score_threshold,
+               'nms_threshold': nms_threshold, 'nms_top_k': nms_top_k,
+               'keep_top_k': keep_top_k, 'normalized': normalized,
+               'background_label': background_label})
+    out.stop_gradient = True
+    count.stop_gradient = True
+    return out, count
+
+
+def detection_output(loc, scores, prior_box, prior_box_var,
+                     background_label=0, nms_threshold=0.3,
+                     nms_top_k=400, keep_top_k=200,
+                     score_threshold=0.01, name=None):
+    """(reference detection.py:186) decode predicted offsets against the
+    priors, then batched multiclass NMS. loc: [B, M, 4] deltas; scores:
+    [B, C, M] class probabilities (already softmaxed)."""
+    dec = box_coder(prior_box, prior_box_var, loc,
+                    code_type='decode_center_size')
+    out, count = multiclass_nms(
+        dec, scores, score_threshold=score_threshold,
+        nms_top_k=nms_top_k, keep_top_k=keep_top_k,
+        nms_threshold=nms_threshold, background_label=background_label)
+    return out, count

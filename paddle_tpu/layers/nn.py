@@ -13,8 +13,14 @@ from ..layer_helper import LayerHelper
 from ..initializer import Constant, Normal
 from ..param_attr import ParamAttr
 
+
+def _pair(v):
+    """int -> [v, v]; sequences pass through as 2-lists."""
+    return [v, v] if isinstance(v, int) else list(v)
+
 __all__ = [
-    'fc', 'embedding', 'conv2d', 'pool2d', 'batch_norm', 'layer_norm',
+    'fc', 'embedding', 'conv2d', 'pool2d', 'batch_norm', 'conv_bn',
+    'layer_norm',
     'dropout', 'cross_entropy', 'square_error_cost', 'accuracy', 'softmax',
     'softmax_with_cross_entropy', 'sigmoid_cross_entropy_with_logits',
     'mean', 'mul', 'elementwise_add', 'elementwise_sub', 'elementwise_mul',
@@ -99,8 +105,6 @@ def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
     if num_channels % groups != 0:
         raise ValueError('num_channels must be divisible by groups')
 
-    def _pair(x):
-        return [x, x] if isinstance(x, int) else list(x)
 
     filter_size = _pair(filter_size)
     stride = _pair(stride)
@@ -147,8 +151,6 @@ def conv2d_transpose(input, num_filters, output_size=None, filter_size=None,
     num_channels = input.shape[1]
     groups = groups or 1
 
-    def _pair(x):
-        return [x, x] if isinstance(x, int) else list(x)
 
     stride = _pair(stride)
     padding = _pair(padding)
@@ -189,8 +191,6 @@ def pool2d(input, pool_size=-1, pool_type='max', pool_stride=1,
     dtype = input.dtype
     out = helper.create_variable_for_type_inference(dtype)
 
-    def _pair(x):
-        return [x, x] if isinstance(x, int) else list(x)
 
     helper.append_op(
         type='pool2d', inputs={'X': [input]}, outputs={'Out': [out]},
@@ -198,6 +198,60 @@ def pool2d(input, pool_size=-1, pool_type='max', pool_stride=1,
                'global_pooling': global_pooling, 'strides': _pair(pool_stride),
                'paddings': _pair(pool_padding), 'ceil_mode': ceil_mode,
                'exclusive': exclusive})
+    return out
+
+
+def conv_bn(input, num_filters, filter_size, stride=1, padding=0,
+            act=None, momentum=0.9, epsilon=1e-5, param_attr=None,
+            bn_param_attr=None, bn_bias_attr=None, is_test=False,
+            name=None):
+    """Fused conv2d + batch_norm + activation as ONE op (ops/
+    fused_ops.py). The tpu-first composition of the reference's
+    conv2d->batch_norm layer pair: for 1x1 convs the emitter can lower
+    through the Pallas matmul+BN-stats kernel
+    (FLAGS_use_pallas_fused_ops); numerics match the unfused pair either
+    way. No conv bias — BN's shift makes it redundant (standard)."""
+    helper = LayerHelper('conv_bn', param_attr=param_attr, name=name)
+    dtype = input.dtype
+    num_channels = input.shape[1]
+
+
+    filter_size = _pair(filter_size)
+    stride = _pair(stride)
+    padding = _pair(padding)
+    filter_shape = [num_filters, num_channels] + filter_size
+    std = (2.0 / (filter_size[0] * filter_size[1] * num_channels)) ** 0.5
+    w = helper.create_parameter(
+        attr=helper.param_attr, shape=filter_shape, dtype=dtype,
+        default_initializer=Normal(0.0, std))
+    scale = helper.create_parameter(
+        attr=bn_param_attr, shape=[num_filters], dtype=dtype,
+        default_initializer=Constant(1.0))
+    bias = helper.create_parameter(
+        attr=bn_bias_attr, shape=[num_filters], dtype=dtype, is_bias=True)
+    mean = helper.create_or_get_global_variable(
+        name=helper.name + '.mean', dtype='float32',
+        shape=[num_filters], persistable=True)
+    helper.set_variable_initializer(mean, Constant(0.0))
+    variance = helper.create_or_get_global_variable(
+        name=helper.name + '.variance', dtype='float32',
+        shape=[num_filters], persistable=True)
+    helper.set_variable_initializer(variance, Constant(1.0))
+    saved_mean = helper.create_variable_for_type_inference(
+        dtype='float32', stop_gradient=True)
+    saved_variance = helper.create_variable_for_type_inference(
+        dtype='float32', stop_gradient=True)
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        type='conv2d_bn',
+        inputs={'Input': [input], 'Filter': [w], 'Scale': [scale],
+                'Bias': [bias], 'Mean': [mean], 'Variance': [variance]},
+        outputs={'Y': [out], 'MeanOut': [mean], 'VarianceOut': [variance],
+                 'SavedMean': [saved_mean],
+                 'SavedVariance': [saved_variance]},
+        attrs={'strides': stride, 'paddings': padding,
+               'momentum': momentum, 'epsilon': epsilon, 'act': act,
+               'is_test': is_test})
     return out
 
 

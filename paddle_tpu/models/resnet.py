@@ -11,6 +11,13 @@ from .. import layers
 
 def conv_bn_layer(input, ch_out, filter_size, stride, padding, act='relu',
                   is_test=False):
+    from ..flags import get_flag
+    if get_flag('use_pallas_fused_ops'):
+        # single fused op: 1x1 convs lower through the Pallas
+        # matmul+BN-stats kernel (ops/fused_ops.py)
+        return layers.conv_bn(input, num_filters=ch_out,
+                              filter_size=filter_size, stride=stride,
+                              padding=padding, act=act, is_test=is_test)
     conv = layers.conv2d(input=input, num_filters=ch_out,
                          filter_size=filter_size, stride=stride,
                          padding=padding, act=None, bias_attr=False)

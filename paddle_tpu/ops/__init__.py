@@ -12,3 +12,5 @@ from . import sequence_ops  # noqa: F401
 from . import moe_ops       # noqa: F401
 from . import dist_ops      # noqa: F401
 from . import beam_search_ops  # noqa: F401
+from . import fused_ops     # noqa: F401
+from . import detection_ops  # noqa: F401

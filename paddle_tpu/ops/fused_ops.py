@@ -1,0 +1,133 @@
+"""Fused op emitters backed by Pallas kernels (paddle_tpu/pallas/).
+
+conv2d_bn: convolution + batch normalization + activation as ONE op.
+The reference expresses this as separate conv/BN ops and relies on
+cuDNN's fused BN kernels; here the op IS the fusion boundary — for 1x1
+convolutions (the FLOP majority of ResNet bottlenecks) the emitter
+lowers through pallas.matmul_bn_stats, which accumulates BN's batch
+statistics inside the matmul epilogue (the reduction pass stock XLA
+re-reads the conv output for — PERF.md's named ceiling). General k×k
+convs take the composite XLA path under the same op semantics.
+
+The Pallas route engages when FLAGS_use_pallas_fused_ops is set (see
+flags.py); numerics parity with the unfused conv2d+batch_norm pair is
+asserted in tests/test_pallas_fused.py either way. Flag note: flip it
+BEFORE the first run of a program — compiled segments are cached.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..registry import (register_op, op_emitter, register_vjp_grad,
+                        amp_cast)
+from ..pallas.conv_bn import matmul_bn_stats
+
+
+@op_emitter('conv2d_bn')
+def _conv2d_bn_emit(ctx, op):
+    x = ctx.get(op.single_input('Input'))      # NCHW
+    w = ctx.get(op.single_input('Filter'))     # OIHW
+    scale = ctx.get(op.single_input('Scale'))
+    bias = ctx.get(op.single_input('Bias'))
+    mean = ctx.get(op.single_input('Mean'))
+    var = ctx.get(op.single_input('Variance'))
+    x, w = amp_cast(ctx, x, w)
+    strides = op.attr('strides', [1, 1])
+    paddings = op.attr('paddings', [0, 0])
+    eps = op.attr('epsilon', 1e-5)
+    momentum = op.attr('momentum', 0.9)
+    act = op.attr('act', None)
+    is_test = op.attr('is_test', False) or ctx.is_test
+    out_dtype = x.dtype
+
+    O, I, kh, kw = w.shape
+    one_by_one = (kh == 1 and kw == 1 and paddings == [0, 0])
+
+    if one_by_one:
+        xs = x[:, :, ::strides[0], ::strides[1]]
+        N, C, Ho, Wo = xs.shape
+        M = N * Ho * Wo
+        x2d = xs.transpose(0, 2, 3, 1).reshape(M, C)
+        w2d = w.reshape(O, I).T
+        if is_test:
+            y2d = jnp.dot(x2d, w2d, preferred_element_type=jnp.float32)
+            use_mean, use_var = mean, var
+        else:
+            y2d, s, q = matmul_bn_stats(x2d, w2d)
+            y2d = y2d.astype(jnp.float32)
+            use_mean = s / M
+            use_var = q / M - use_mean * use_mean
+        yn = (y2d - use_mean) * jax.lax.rsqrt(
+            use_var.astype(jnp.float32) + eps)
+        yn = yn * scale.astype(jnp.float32) + bias.astype(jnp.float32)
+        y = yn.reshape(N, Ho, Wo, O).transpose(0, 3, 1, 2)
+    else:
+        # general conv: composite path, same op semantics. Off-TPU bf16
+        # has no hardware f32-accumulation guarantee (see nn_ops.py).
+        cx, cw = x, w
+        if x.dtype == jnp.bfloat16 and jax.default_backend() != 'tpu':
+            cx, cw = x.astype(jnp.float32), w.astype(jnp.float32)
+        conv = jax.lax.conv_general_dilated(
+            cx, cw, window_strides=tuple(strides),
+            padding=[(paddings[0], paddings[0]),
+                     (paddings[1], paddings[1])],
+            dimension_numbers=('NCHW', 'OIHW', 'NCHW'))
+        cf = conv.astype(jnp.float32)
+        if is_test:
+            use_mean, use_var = mean, var
+        else:
+            use_mean = jnp.mean(cf, axis=(0, 2, 3))
+            use_var = jnp.var(cf, axis=(0, 2, 3))
+        ch = [1, -1, 1, 1]
+        y = ((cf - use_mean.reshape(ch))
+             * jax.lax.rsqrt(use_var.astype(jnp.float32) + eps)
+             .reshape(ch)
+             * scale.astype(jnp.float32).reshape(ch)
+             + bias.astype(jnp.float32).reshape(ch))
+
+    if act == 'relu':
+        y = jax.nn.relu(y)
+    elif act:
+        y = getattr(jax.nn, act)(y)
+    ctx.set(op.single_output('Y'), y.astype(out_dtype))
+
+    if is_test:
+        mean_out, var_out = mean, var
+        saved_mean, saved_var = mean, var
+    else:
+        use_mean = use_mean.astype(jnp.float32)
+        use_var = use_var.astype(jnp.float32)
+        mean_out = mean * momentum + use_mean * (1 - momentum)
+        var_out = var * momentum + use_var * (1 - momentum)
+        saved_mean, saved_var = use_mean, use_var
+    for slot, val in (('MeanOut', mean_out), ('VarianceOut', var_out),
+                      ('SavedMean', saved_mean),
+                      ('SavedVariance', saved_var)):
+        if op.output(slot):
+            ctx.set(op.single_output(slot), val)
+
+
+def _conv2d_bn_infer(op, block):
+    from .nn_ops import _conv_out_size
+    x = block.var_recursive(op.single_input('Input'))
+    w = block.var_recursive(op.single_input('Filter'))
+    strides = op.attr('strides', [1, 1])
+    paddings = op.attr('paddings', [0, 0])
+    n, _, h, wd = x.shape
+    o, _, kh, kw = w.shape
+    y = block.var_recursive(op.single_output('Y'))
+    y.shape = [n, o, _conv_out_size(h, kh, paddings[0], strides[0], 1),
+               _conv_out_size(wd, kw, paddings[1], strides[1], 1)]
+    y.dtype = x.dtype
+    for slot in ('MeanOut', 'VarianceOut', 'SavedMean', 'SavedVariance'):
+        if op.output(slot):
+            v = block.var_recursive(op.single_output(slot))
+            v.shape = (o,)
+            v.dtype = 'float32'
+
+
+register_op('conv2d_bn', infer_shape=_conv2d_bn_infer)
+register_vjp_grad('conv2d_bn',
+                  in_slots=('Input', 'Filter', 'Scale', 'Bias'),
+                  out_slots=('Y',))

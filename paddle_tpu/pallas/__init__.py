@@ -1,0 +1,10 @@
+"""Pallas TPU kernels for the fusions XLA won't do on its own.
+
+The framework's compute path is whole-block XLA; these kernels slot in
+underneath individual op emitters, behind FLAGS_use_pallas_fused_ops
+(flags.py), for the cases PERF.md identifies as XLA ceilings — first:
+the conv+BN epilogue (BN's batch statistics force XLA into extra
+reduction passes over the conv output; the Pallas kernel accumulates
+them while the matmul tiles are still in VMEM).
+"""
+from .conv_bn import matmul_bn_stats  # noqa: F401

@@ -1,0 +1,147 @@
+"""Fused matmul + batch-norm statistics epilogue.
+
+The XLA ceiling this attacks (PERF.md): conv + BN training means XLA
+writes the conv output to HBM, then launches a separate fusion that
+READS IT BACK to reduce per-channel sum/sum-of-squares, then a third
+pass normalizes. The reduction read is pure HBM bandwidth — on
+bandwidth-bound layers (ResNet's early stages) it is the difference
+between one and two full passes over the activation tensor.
+
+`matmul_bn_stats(x, w)` returns `(y, colsum, colsumsq)` where the
+statistics are accumulated INSIDE the matmul epilogue while each output
+tile is still in VMEM (Pallas grid iterates m fastest for a fixed
+n-tile, so the f32 accumulators for that column block stay resident).
+1x1 convolutions — the FLOP majority of ResNet bottlenecks — are
+exactly this matmul; the op emitter (ops/fused_ops.py) reshapes them
+through here.
+
+Differentiation: wrapped in jax.custom_vjp (y = x@w, s = Σy, q = Σy²
+⇒ dy_total = ḡy + s̄ + 2·y·q̄, then standard matmul transposes), so the
+framework's vjp-derived op grads compose through it unchanged.
+
+Numerics: f32 accumulation for both the dot and the statistics
+regardless of input dtype (bf16 in AMP); checked against the unfused
+XLA path in tests/test_pallas_fused.py.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+__all__ = ['matmul_bn_stats']
+
+
+def _kernel(x_ref, w_ref, y_ref, s_ref, q_ref):
+    m = pl.program_id(1)
+
+    @pl.when(m == 0)
+    def _init():
+        s_ref[:] = jnp.zeros_like(s_ref)
+        q_ref[:] = jnp.zeros_like(q_ref)
+
+    y = jnp.dot(x_ref[:], w_ref[:], preferred_element_type=jnp.float32)
+    y_ref[:] = y.astype(y_ref.dtype)
+    # stats while the tile is in VMEM — the fusion XLA can't derive
+    s_ref[:] += jnp.sum(y, axis=0, keepdims=True)
+    q_ref[:] += jnp.sum(y * y, axis=0, keepdims=True)
+
+
+def _round_up(v, m):
+    return (v + m - 1) // m * m
+
+
+@functools.partial(jax.jit, static_argnames=('tile_m', 'tile_n',
+                                             'interpret'))
+def _pallas_impl(x, w, tile_m=512, tile_n=256, interpret=False):
+    M, K = x.shape
+    _, N = w.shape
+    # pad to tile multiples; zero rows/cols contribute 0 to y AND to the
+    # statistics, so slicing back is exact
+    Mp, Np = _round_up(M, tile_m), _round_up(N, tile_n)
+    Kp = _round_up(K, 128)
+    if (Mp, Kp) != (M, K):
+        x = jnp.pad(x, ((0, Mp - M), (0, Kp - K)))
+    if (Kp, Np) != (K, N):
+        w = jnp.pad(w, ((0, Kp - K), (0, Np - N)))
+    gm, gn = Mp // tile_m, Np // tile_n
+    y, s, q = pl.pallas_call(
+        _kernel,
+        # n outer / m inner: the (1, tile_n) stat blocks are revisited
+        # across the whole m sweep and stay VMEM-resident
+        grid=(gn, gm),
+        in_specs=[
+            pl.BlockSpec((tile_m, Kp), lambda n, m: (m, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((Kp, tile_n), lambda n, m: (0, n),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((tile_m, tile_n), lambda n, m: (m, n),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, tile_n), lambda n, m: (0, n),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, tile_n), lambda n, m: (0, n),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((Mp, Np), x.dtype),
+            jax.ShapeDtypeStruct((1, Np), jnp.float32),
+            jax.ShapeDtypeStruct((1, Np), jnp.float32),
+        ],
+        interpret=interpret,
+    )(x, w)
+    return y[:M, :N], s[0, :N], q[0, :N]
+
+
+def _xla_impl(x, w):
+    y = jnp.dot(x, w, preferred_element_type=jnp.float32)
+    s = jnp.sum(y, axis=0)
+    q = jnp.sum(y * y, axis=0)
+    return y.astype(x.dtype), s, q
+
+
+def _use_pallas():
+    from ..flags import get_flag
+    if not get_flag('use_pallas_fused_ops'):
+        return False
+    return jax.default_backend() == 'tpu' or \
+        bool(get_flag('pallas_interpret'))
+
+
+def _impl(x, w):
+    if _use_pallas():
+        return _pallas_impl(
+            x, w, interpret=jax.default_backend() != 'tpu')
+    return _xla_impl(x, w)
+
+
+@jax.custom_vjp
+def matmul_bn_stats(x, w):
+    """y = x @ w (f32 accumulate, y in x.dtype), colsum = Σ_m y (f32),
+    colsumsq = Σ_m y² (f32) — one pass over the output."""
+    return _impl(x, w)
+
+
+def _fwd(x, w):
+    y, s, q = _impl(x, w)
+    return (y, s, q), (x, w, y)
+
+
+def _bwd(res, cots):
+    x, w, y = res
+    gy, gs, gq = cots
+    # s = Σ_m y, q = Σ_m y²: their cotangents fold into y's
+    dy = gy.astype(jnp.float32) + gs[None, :] \
+        + 2.0 * y.astype(jnp.float32) * gq[None, :]
+    dx = jnp.dot(dy, w.T.astype(jnp.float32),
+                 preferred_element_type=jnp.float32).astype(x.dtype)
+    dw = jnp.dot(x.T.astype(jnp.float32), dy,
+                 preferred_element_type=jnp.float32).astype(w.dtype)
+    return dx, dw
+
+
+matmul_bn_stats.defvjp(_fwd, _bwd)

@@ -258,8 +258,12 @@ class ParallelExecutor(Executor):
                 self._scope.set_var(name, dist.host_value_to_global(
                     np.asarray(val), self.mesh, target.spec))
             else:
-                self._scope.set_var(
-                    name, jax.device_put(np.asarray(val), target))
+                # device-resident values reshard on device; np.asarray
+                # here would round-trip every parameter through the host
+                # (GBs over a remoted-PJRT link for billion-param models)
+                if not isinstance(val, jax.Array):
+                    val = np.asarray(val)
+                self._scope.set_var(name, jax.device_put(val, target))
         self._params_placed = True
 
     def _to_numpy(self, value):

@@ -1,0 +1,158 @@
+"""CSP channels (paddle_tpu/channels.py; reference concurrency ops) —
+buffered/unbuffered semantics, close contract, Select, and a
+producer/consumer pipeline around Executor.run."""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.channels import Channel, ChannelClosed, Select
+
+
+def test_buffered_send_recv_order():
+    ch = fluid.make_channel(capacity=4)
+    for i in range(4):
+        ch.send(i)
+    assert [ch.recv() for _ in range(4)] == [0, 1, 2, 3]
+
+
+def test_unbuffered_rendezvous():
+    ch = Channel(capacity=0)
+    got = []
+
+    def sender():
+        ch.send('x')
+        got.append('sent')
+    t = threading.Thread(target=sender)
+    t.start()
+    time.sleep(0.1)
+    assert not got            # send blocks until a receiver arrives
+    assert ch.recv() == 'x'
+    t.join(timeout=5)
+    assert got == ['sent']
+
+
+def test_close_drains_then_raises():
+    ch = Channel(capacity=3)
+    ch.send(1)
+    ch.send(2)
+    ch.close()
+    assert ch.recv() == 1 and ch.recv() == 2
+    with pytest.raises(ChannelClosed):
+        ch.recv()
+    with pytest.raises(ChannelClosed):
+        ch.send(3)
+
+
+def test_range_iteration():
+    ch = Channel(capacity=8)
+    for i in range(5):
+        ch.send(i)
+    ch.close()
+    assert list(ch) == [0, 1, 2, 3, 4]
+
+
+def test_select_commits_to_one_ready_case():
+    a, b = Channel(capacity=1), Channel(capacity=1)
+    b.send('from_b')
+    fired = []
+    with Select() as sel:
+        sel.case_recv(a, lambda v: fired.append(('a', v)))
+        sel.case_recv(b, lambda v: fired.append(('b', v)))
+    assert fired == [('b', 'from_b')]
+    # a untouched
+    ok, _ = a.poll()
+    assert not ok
+
+
+def test_select_default():
+    a = Channel(capacity=1)
+    fired = []
+    with Select() as sel:
+        sel.case_recv(a, lambda v: fired.append(v))
+        sel.default(lambda: fired.append('none'))
+    assert fired == ['none']
+
+
+def test_close_on_full_buffer_does_not_block():
+    ch = fluid.make_channel(capacity=1)
+    ch.send(1)
+    t = threading.Thread(target=ch.close)
+    t.start()
+    t.join(timeout=2)
+    assert not t.is_alive()          # close() must never block
+    assert ch.recv() == 1            # buffered value still drains
+    with pytest.raises(ChannelClosed):
+        ch.recv()
+
+
+def test_timed_out_recv_leaves_no_stale_ticket():
+    ch = Channel(capacity=0)
+    with pytest.raises(TimeoutError):
+        ch.recv(timeout=0.1)
+    # a later send must still block (no phantom receiver)
+    with pytest.raises(TimeoutError):
+        ch.send('x', timeout=0.2)
+
+
+def test_all_blocked_senders_wake_on_close():
+    ch = Channel(capacity=0)
+    errs = []
+
+    def sender():
+        try:
+            ch.send('v')
+        except ChannelClosed:
+            errs.append('closed')
+    threads = [threading.Thread(target=sender) for _ in range(3)]
+    for t in threads:
+        t.start()
+    time.sleep(0.2)
+    ch.close()
+    for t in threads:
+        t.join(timeout=2)
+    assert not any(t.is_alive() for t in threads)
+    assert errs == ['closed'] * 3
+
+
+def test_select_send_respects_rendezvous():
+    ch = Channel(capacity=0)
+    fired = []
+    with Select() as sel:
+        sel.case_send(ch, 'v', lambda: fired.append('sent'))
+        sel.default(lambda: fired.append('none'))
+    assert fired == ['none']         # no receiver -> default, not send
+
+
+def test_channel_pipeline_around_executor():
+    """The host-side role channels keep on TPU: a producer thread feeds
+    batches to a consumer driving Executor.run."""
+    from paddle_tpu.framework import Program, program_guard
+    prog, startup = Program(), Program()
+    with program_guard(prog, startup):
+        x = fluid.layers.data(name='x', shape=[4], dtype='float32')
+        y = fluid.layers.data(name='y', shape=[1], dtype='float32')
+        pred = fluid.layers.fc(input=x, size=1)
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        fluid.optimizer.SGD(0.1).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+
+    ch = Channel(capacity=2)
+    rng = np.random.RandomState(0)
+    w = rng.randn(4, 1).astype('float32')
+
+    def producer():
+        for _ in range(12):
+            xb = rng.randn(8, 4).astype('float32')
+            ch.send((xb, xb @ w))
+        ch.close()
+    t = threading.Thread(target=producer)
+    t.start()
+    losses = [float(np.asarray(exe.run(
+        prog, feed={'x': xb, 'y': yb}, fetch_list=[loss])[0]))
+        for xb, yb in ch]
+    t.join(timeout=10)
+    assert len(losses) == 12 and losses[-1] < losses[0]

@@ -1,0 +1,160 @@
+"""Detection ops (reference operators/detection/, layers/detection.py):
+IoU, prior_box lattice, box_coder encode/decode roundtrip, static-shape
+multiclass NMS, detection_output composition."""
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.framework import Program, program_guard
+
+
+def _run(build, feeds):
+    prog, startup = Program(), Program()
+    with program_guard(prog, startup):
+        fetches = build()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    return [np.asarray(v) for v in
+            exe.run(prog, feed=feeds, fetch_list=list(fetches))]
+
+
+def test_iou_similarity():
+    a = np.array([[0, 0, 2, 2], [1, 1, 3, 3]], 'float32')
+    b = np.array([[0, 0, 2, 2], [2, 2, 4, 4]], 'float32')
+
+    def build():
+        x = fluid.layers.data(name='x', shape=[4], dtype='float32',
+                              append_batch_size=False)
+        y = fluid.layers.data(name='y', shape=[4], dtype='float32',
+                              append_batch_size=False)
+        x.shape, y.shape = [2, 4], [2, 4]
+        return [fluid.layers.iou_similarity(x, y)]
+    out, = _run(build, {'x': a, 'y': b})
+    np.testing.assert_allclose(out[0, 0], 1.0, atol=1e-6)     # identical
+    np.testing.assert_allclose(out[0, 1], 0.0, atol=1e-6)     # touching
+    np.testing.assert_allclose(out[1, 0], 1.0 / 7.0, rtol=1e-5)
+    np.testing.assert_allclose(out[1, 1], 1.0 / 7.0, rtol=1e-5)
+
+
+def test_prior_box_lattice():
+    def build():
+        feat = fluid.layers.data(name='feat', shape=[8, 4, 4],
+                                 dtype='float32')
+        img = fluid.layers.data(name='img', shape=[3, 64, 64],
+                                dtype='float32')
+        boxes, var = fluid.layers.prior_box(
+            feat, img, min_sizes=[16.0], max_sizes=[32.0],
+            aspect_ratios=[1.0, 2.0], clip=True)
+        return [boxes, var]
+    boxes, var = _run(build, {
+        'feat': np.zeros((1, 8, 4, 4), 'float32'),
+        'img': np.zeros((1, 3, 64, 64), 'float32')})
+    # min_size(1) + ar=2 (1) + max_size sqrt (1) = 3 priors
+    assert boxes.shape == (4, 4, 3, 4)
+    assert var.shape == (4, 4, 3, 4)
+    assert (boxes >= 0).all() and (boxes <= 1).all()          # clipped
+    # first prior at cell (0,0): 16x16 box centered at (8, 8) px
+    np.testing.assert_allclose(boxes[0, 0, 0],
+                               [0.0, 0.0, 16 / 64, 16 / 64], atol=1e-6)
+    ctrs = (boxes[..., 0, :2] + boxes[..., 0, 2:]) / 2
+    assert ctrs[0, 0, 0] < ctrs[0, 1, 0] < ctrs[0, 2, 0]       # x grid
+
+
+def test_box_coder_roundtrip():
+    rng = np.random.RandomState(0)
+    # sort across the row axis: [x1, y1] <= [x2, y2] elementwise, so
+    # flattening gives valid [x1, y1, x2, y2] boxes
+    priors = np.sort(rng.rand(5, 2, 2), axis=1).reshape(5, 4).astype('f4')
+    pvar = np.full((5, 4), 0.1, 'float32')
+    gt = np.sort(rng.rand(3, 2, 2), axis=1).reshape(3, 4).astype('f4')
+
+    def build_enc():
+        p = fluid.layers.data(name='p', shape=[4], dtype='float32')
+        v = fluid.layers.data(name='v', shape=[4], dtype='float32')
+        t = fluid.layers.data(name='t', shape=[4], dtype='float32')
+        p.shape, v.shape, t.shape = [5, 4], [5, 4], [3, 4]
+        enc = fluid.layers.box_coder(p, v, t, 'encode_center_size')
+        dec = fluid.layers.box_coder(p, v, enc, 'decode_center_size')
+        return [enc, dec]
+    enc, dec = _run(build_enc, {'p': priors, 'v': pvar, 't': gt})
+    assert enc.shape == (3, 5, 4)
+    # decode(encode(gt)) == gt for every prior
+    for m in range(5):
+        np.testing.assert_allclose(dec[:, m], gt, rtol=1e-4, atol=1e-5)
+
+
+def test_multiclass_nms_suppresses_overlaps():
+    # 4 boxes: two heavy overlaps, one distinct, one low-score
+    boxes = np.array([[[0.0, 0.0, 0.4, 0.4],
+                       [0.01, 0.01, 0.41, 0.41],
+                       [0.6, 0.6, 0.9, 0.9],
+                       [0.0, 0.6, 0.2, 0.8]]], 'float32')
+    scores = np.zeros((1, 2, 4), 'float32')
+    scores[0, 1] = [0.9, 0.8, 0.7, 0.05]      # class 1; class 0 = bg
+
+    def build():
+        b = fluid.layers.data(name='b', shape=[4, 4], dtype='float32')
+        s = fluid.layers.data(name='s', shape=[2, 4], dtype='float32')
+        out, count = fluid.layers.multiclass_nms(
+            b, s, score_threshold=0.1, nms_top_k=4, keep_top_k=4,
+            nms_threshold=0.5)
+        return [out, count]
+    out, count = _run(build, {'b': boxes, 's': scores})
+    assert out.shape == (1, 4, 6)
+    assert count[0] == 2                       # overlap + low-score gone
+    kept = out[0][out[0, :, 0] >= 0]
+    np.testing.assert_allclose(sorted(kept[:, 1], reverse=True),
+                               [0.9, 0.7], atol=1e-6)
+    np.testing.assert_allclose(kept[0, 2:], boxes[0, 0], atol=1e-6)
+
+
+def test_multiclass_nms_pads_when_keep_exceeds_candidates():
+    """keep_top_k > C*nms_top_k must still emit the declared static
+    shape, padded with empty (-1) slots."""
+    boxes = np.array([[[0.0, 0.0, 0.4, 0.4],
+                       [0.6, 0.6, 0.9, 0.9]]], 'float32')
+    scores = np.zeros((1, 2, 2), 'float32')
+    scores[0, 1] = [0.9, 0.7]
+
+    def build():
+        b = fluid.layers.data(name='b', shape=[2, 4], dtype='float32')
+        s = fluid.layers.data(name='s', shape=[2, 2], dtype='float32')
+        out, count = fluid.layers.multiclass_nms(
+            b, s, score_threshold=0.1, nms_top_k=2, keep_top_k=16)
+        return [out, count]
+    out, count = _run(build, {'b': boxes, 's': scores})
+    assert out.shape == (1, 16, 6)
+    assert count[0] == 2
+    assert (out[0, 2:, 0] == -1).all()
+
+
+def test_detection_output_end_to_end():
+    rng = np.random.RandomState(1)
+    M = 8
+
+    def build():
+        feat = fluid.layers.data(name='feat', shape=[4, 2, 4],
+                                 dtype='float32')
+        img = fluid.layers.data(name='img', shape=[3, 32, 32],
+                                dtype='float32')
+        boxes, var = fluid.layers.prior_box(feat, img, min_sizes=[8.0],
+                                            clip=True)
+        loc = fluid.layers.data(name='loc', shape=[M, 4],
+                                dtype='float32')
+        scores = fluid.layers.data(name='scores', shape=[3, M],
+                                   dtype='float32')
+        out, count = fluid.layers.detection_output(
+            loc, scores, boxes, var, score_threshold=0.2,
+            nms_top_k=8, keep_top_k=4)
+        return [out, count]
+    out, count = _run(build, {
+        'feat': np.zeros((2, 4, 2, 4), 'float32'),
+        'img': np.zeros((2, 3, 32, 32), 'float32'),
+        'loc': rng.randn(2, M, 4).astype('float32') * 0.1,
+        'scores': rng.dirichlet([1, 1, 1], (2, M)).transpose(0, 2, 1)
+        .astype('float32')})
+    assert out.shape == (2, 4, 6)
+    assert (count >= 0).all() and (count <= 4).all()
+    for b in range(2):
+        kept = out[b][out[b, :, 0] >= 0]
+        assert len(kept) == count[b]
+        assert ((kept[:, 1] >= 0.2) | (kept[:, 1] == -1)).all()

@@ -1,0 +1,179 @@
+"""Pallas fused conv+BN (paddle_tpu/pallas/conv_bn.py, ops/fused_ops.py):
+kernel numerics vs the unfused XLA path (interpret mode on CPU), op-level
+parity with the conv2d+batch_norm pair, gradients, and training."""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.framework import Program, program_guard
+
+import jax
+import jax.numpy as jnp
+
+
+@pytest.fixture
+def pallas_interpret():
+    fluid.set_flags({'use_pallas_fused_ops': True,
+                     'pallas_interpret': True})
+    yield
+    fluid.set_flags({'use_pallas_fused_ops': False,
+                     'pallas_interpret': False})
+
+
+def test_matmul_bn_stats_kernel_numerics(pallas_interpret):
+    from paddle_tpu.pallas.conv_bn import _pallas_impl, _xla_impl
+    rng = np.random.RandomState(0)
+    # deliberately non-tile-multiple M/K/N exercise the padding path
+    x = jnp.asarray(rng.randn(300, 70).astype('float32'))
+    w = jnp.asarray(rng.randn(70, 130).astype('float32'))
+    y1, s1, q1 = _pallas_impl(x, w, tile_m=128, tile_n=128,
+                              interpret=True)
+    y2, s2, q2 = _xla_impl(x, w)
+    np.testing.assert_allclose(np.asarray(y1), np.asarray(y2),
+                               rtol=1e-5, atol=1e-4)
+    # f32 accumulation order differs between tiled and flat reductions
+    np.testing.assert_allclose(np.asarray(s1), np.asarray(s2),
+                               rtol=1e-4, atol=1e-3)
+    np.testing.assert_allclose(np.asarray(q1), np.asarray(q2),
+                               rtol=1e-4, atol=1e-3)
+
+
+def test_matmul_bn_stats_grad_matches_plain():
+    from paddle_tpu.pallas.conv_bn import matmul_bn_stats
+    rng = np.random.RandomState(1)
+    x = jnp.asarray(rng.randn(40, 16).astype('float32'))
+    w = jnp.asarray(rng.randn(16, 24).astype('float32'))
+
+    def f_custom(x, w):
+        y, s, q = matmul_bn_stats(x, w)
+        m = s / x.shape[0]
+        v = q / x.shape[0] - m * m
+        yh = (y.astype(jnp.float32) - m) * jax.lax.rsqrt(v + 1e-5)
+        return jnp.sum(jax.nn.relu(yh + 0.3) ** 2)
+
+    def f_plain(x, w):
+        y = x @ w
+        m, v = y.mean(0), y.var(0)
+        yh = (y - m) * jax.lax.rsqrt(v + 1e-5)
+        return jnp.sum(jax.nn.relu(yh + 0.3) ** 2)
+
+    gc = jax.grad(f_custom, argnums=(0, 1))(x, w)
+    gp = jax.grad(f_plain, argnums=(0, 1))(x, w)
+    for a, b in zip(gc, gp):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-4)
+
+
+def _build_pair(fused, act='relu', filter_size=1, stride=1, padding=0,
+                seed=3):
+    prog, startup = Program(), Program()
+    prog.random_seed = startup.random_seed = seed
+    with program_guard(prog, startup):
+        x = fluid.layers.data(name='x', shape=[8, 10, 10],
+                              dtype='float32')
+        if fused:
+            y = fluid.layers.conv_bn(
+                x, num_filters=12, filter_size=filter_size,
+                stride=stride, padding=padding, act=act,
+                param_attr=fluid.ParamAttr(name='cw'),
+                bn_param_attr=fluid.ParamAttr(name='bs'),
+                bn_bias_attr=fluid.ParamAttr(name='bb'))
+        else:
+            c = fluid.layers.conv2d(
+                x, num_filters=12, filter_size=filter_size,
+                stride=stride, padding=padding, bias_attr=False,
+                param_attr=fluid.ParamAttr(name='cw'))
+            y = fluid.layers.batch_norm(
+                c, act=act, param_attr=fluid.ParamAttr(name='bs'),
+                bias_attr=fluid.ParamAttr(name='bb'))
+        loss = fluid.layers.mean(y)
+        fluid.optimizer.SGD(0.1).minimize(loss)
+    return prog, startup, y, loss
+
+
+@pytest.mark.parametrize('fs,stride,pad', [(1, 1, 0), (1, 2, 0),
+                                           (3, 1, 1)])
+def test_conv_bn_op_matches_unfused_pair(fs, stride, pad):
+    """Same init (shared param names + seed): the fused op must produce
+    the same outputs AND the same post-step losses as conv2d+batch_norm."""
+    xv = np.random.RandomState(0).rand(4, 8, 10, 10).astype('float32')
+    results = {}
+    for fused in (False, True):
+        prog, startup, y, loss = _build_pair(fused, filter_size=fs,
+                                             stride=stride, padding=pad)
+        scope = fluid.Scope()
+        exe = fluid.Executor(fluid.CPUPlace())
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            vals = []
+            for _ in range(3):
+                yv, lv = exe.run(prog, feed={'x': xv},
+                                 fetch_list=[y, loss])
+                vals.append((np.asarray(yv), float(np.asarray(lv))))
+        results[fused] = vals
+    for (y0, l0), (y1, l1) in zip(results[False], results[True]):
+        np.testing.assert_allclose(y1, y0, rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(l1, l0, rtol=1e-5)
+
+
+def test_conv_bn_pallas_path_matches_unfused(pallas_interpret):
+    """1x1 path through the actual Pallas kernel (interpret mode)."""
+    xv = np.random.RandomState(0).rand(2, 8, 6, 6).astype('float32')
+    outs = {}
+    for fused in (False, True):
+        prog, startup, y, loss = _build_pair(fused)
+        scope = fluid.Scope()
+        exe = fluid.Executor(fluid.CPUPlace())
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            yv, lv = exe.run(prog, feed={'x': xv}, fetch_list=[y, loss])
+        outs[fused] = (np.asarray(yv), float(np.asarray(lv)))
+    np.testing.assert_allclose(outs[True][0], outs[False][0],
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_conv_bn_eval_mode_uses_running_stats():
+    prog, startup, y, loss = _build_pair(True)
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    xv = np.random.RandomState(0).rand(4, 8, 10, 10).astype('float32')
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        for _ in range(5):
+            exe.run(prog, feed={'x': xv}, fetch_list=[loss])
+        test_prog = prog.clone(for_test=True)
+        y1, = exe.run(test_prog, feed={'x': xv}, fetch_list=[y])
+        y2, = exe.run(test_prog, feed={'x': xv}, fetch_list=[y])
+    # eval is deterministic and running stats stop moving
+    np.testing.assert_allclose(np.asarray(y1), np.asarray(y2))
+
+
+def test_conv_bn_trains():
+    prog, startup = Program(), Program()
+    prog.random_seed = startup.random_seed = 5
+    with program_guard(prog, startup):
+        x = fluid.layers.data(name='x', shape=[4, 8, 8], dtype='float32')
+        label = fluid.layers.data(name='label', shape=[1], dtype='int64')
+        h = fluid.layers.conv_bn(x, num_filters=8, filter_size=3,
+                                 padding=1, act='relu')
+        h = fluid.layers.conv_bn(h, num_filters=16, filter_size=1,
+                                 act='relu')
+        h = fluid.layers.pool2d(h, pool_size=8, pool_type='avg')
+        logits = fluid.layers.fc(input=h, size=4)
+        loss = fluid.layers.mean(
+            fluid.layers.softmax_with_cross_entropy(
+                logits, label))
+        fluid.optimizer.Adam(0.01).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    rng = np.random.RandomState(0)
+    xv = rng.rand(16, 4, 8, 8).astype('float32')
+    lv = rng.randint(0, 4, (16, 1)).astype('int64')
+    first = last = None
+    for _ in range(40):
+        l, = exe.run(prog, feed={'x': xv, 'label': lv},
+                     fetch_list=[loss])
+        if first is None:
+            first = float(np.asarray(l))
+        last = float(np.asarray(l))
+    assert np.isfinite(last) and last < 0.5 * first, (first, last)

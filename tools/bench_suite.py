@@ -1,0 +1,243 @@
+"""Benchmark suite: 5 models x 3 execution modes.
+
+Breadth analog of the reference harness (benchmark/fluid/
+fluid_benchmark.py:116-312: 5 models x local/parallel/dist) for this
+framework. The driver-facing headline stays bench.py (ResNet +
+Transformer on the real chip); this suite demonstrates every model
+family running under every execution engine:
+
+  models: mnist | resnet | vgg | stacked_lstm | transformer
+  modes:  local      (Executor, 1 device)
+          parallel   (ParallelExecutor over all visible devices)
+          dist N     (N trainer processes, collective DP — subprocess
+                      localhost, the test_dist_base.py pattern)
+
+Usage:
+  python tools/bench_suite.py                     # quick sweep, tiny shapes
+  python tools/bench_suite.py --model resnet --mode parallel --steps 20
+  python tools/bench_suite.py --full              # benchmark shapes (TPU)
+
+Prints one row per (model, mode): samples/sec + final loss.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def _build(model, full):
+    import paddle_tpu as fluid
+    from paddle_tpu.models import (mnist, resnet, vgg, transformer,
+                                   stacked_lstm)
+    d = {}
+    if model == 'mnist':
+        img = fluid.layers.data(name='img', shape=[1, 28, 28],
+                                dtype='float32')
+        label = fluid.layers.data(name='label', shape=[1], dtype='int64')
+        _, loss, _ = mnist.train_network(img, label)
+        feed = lambda rng, bs: {
+            'img': rng.rand(bs, 1, 28, 28).astype('float32'),
+            'label': rng.randint(0, 10, (bs, 1)).astype('int64')}
+        bs = 64 if not full else 256
+    elif model in ('resnet', 'vgg'):
+        hw, classes = (224, 1000) if full else (32, 10)
+        img = fluid.layers.data(name='img', shape=[3, hw, hw],
+                                dtype='float32')
+        label = fluid.layers.data(name='label', shape=[1], dtype='int64')
+        mod = resnet if model == 'resnet' else vgg
+        kw = {'depth': 50} if (model == 'resnet' and full) else (
+            {'depth': 18} if model == 'resnet' else {})
+        _, loss, _ = mod.train_network(img, label, class_dim=classes,
+                                       **kw)
+        feed = lambda rng, bs: {
+            'img': rng.rand(bs, 3, hw, hw).astype('float32'),
+            'label': rng.randint(0, classes, (bs, 1)).astype('int64')}
+        bs = 8 if not full else 256
+    elif model == 'stacked_lstm':
+        T, vocab = (16, 1000) if not full else (128, 30000)
+        data = fluid.layers.data(name='words', shape=[1], dtype='int64',
+                                 lod_level=1)
+        label = fluid.layers.data(name='label', shape=[1], dtype='int64')
+        kw = {} if full else {'emb_dim': 64, 'hid_dim': 64}
+        _, loss, _ = stacked_lstm.train_network(data, label, vocab, **kw)
+
+        def feed(rng, bs):
+            ids = rng.randint(1, vocab, (bs, T, 1)).astype('int64')
+            lens = np.full((bs,), T, 'int32')
+            return {'words': (ids, lens),
+                    'label': rng.randint(0, 2, (bs, 1)).astype('int64')}
+        bs = 8 if not full else 64
+    elif model == 'transformer':
+        cfg = transformer.TransformerConfig(
+            vocab=32768 if full else 256, dim=2048 if full else 64,
+            heads=16 if full else 4, layers=12 if full else 2,
+            ffn=8192 if full else 128, max_len=512 if full else 16,
+            use_tp=False, use_sp=False)
+        tokens = fluid.layers.data(name='tokens',
+                                   shape=[cfg.max_len, 1], dtype='int64')
+        labels = fluid.layers.data(name='labels',
+                                   shape=[cfg.max_len, 1], dtype='int64')
+        _, loss = transformer.train_network(tokens, labels, cfg)
+
+        def feed(rng, bs):
+            t = rng.randint(0, cfg.vocab,
+                            (bs, cfg.max_len, 1)).astype('int64')
+            return {'tokens': t, 'labels': np.roll(t, -1, 1)}
+        bs = 2 if not full else 8
+    else:
+        raise SystemExit('unknown model %r' % model)
+    return loss, feed, bs
+
+
+def run_one(model, mode, steps, full):
+    import paddle_tpu as fluid
+    import jax
+    from paddle_tpu import unique_name
+    unique_name.switch()
+    fluid.framework.switch_main_program(fluid.framework.Program())
+    fluid.framework.switch_startup_program(fluid.framework.Program())
+    with fluid.program_guard(fluid.default_main_program(),
+                             fluid.default_startup_program()):
+        loss, feed_fn, bs = _build(model, full)
+        fluid.optimizer.Adam(1e-3).minimize(loss)
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.TPUPlace() if full else fluid.CPUPlace())
+    with fluid.scope_guard(scope):
+        exe.run(fluid.default_startup_program())
+    rng = np.random.RandomState(0)
+    if mode == 'parallel':
+        runner = fluid.ParallelExecutor(
+            use_cuda=full, loss_name=loss.name,
+            main_program=fluid.default_main_program(), scope=scope)
+        bs *= max(len(jax.devices()), 1)
+        run = lambda f: runner.run(fetch_list=[loss.name], feed=f)
+    else:
+        run = lambda f: exe.run(fluid.default_main_program(), feed=f,
+                                fetch_list=[loss], scope=scope)
+    lv = run(feed_fn(rng, bs))     # warm/compile
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        lv = run(feed_fn(rng, bs))
+    dt = time.perf_counter() - t0
+    return {'model': model, 'mode': mode,
+            'samples_per_sec': round(bs * steps / dt, 2),
+            'loss': round(float(np.asarray(lv[0]).mean()), 4)}
+
+
+def run_dist(model, n, steps, full):
+    """N-trainer collective DP via subprocess localhost."""
+    import socket
+    s = socket.socket()
+    s.bind(('127.0.0.1', 0))
+    port = s.getsockname()[1]
+    s.close()
+    eps = ','.join('127.0.0.1:%d' % (port + i) for i in range(n))
+    procs = []
+    for i in range(n):
+        env = dict(os.environ)
+        env.update({'PADDLE_TRAINERS_NUM': str(n),
+                    'PADDLE_TRAINER_ID': str(i),
+                    'PADDLE_TRAINER_ENDPOINTS': eps,
+                    'BENCH_SUITE_WORKER': '1',
+                    'BENCH_SUITE_MODEL': model,
+                    'BENCH_SUITE_STEPS': str(steps)})
+        procs.append(subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__)], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
+    outs = [p.communicate(timeout=600)[0] for p in procs]
+    for p, out in zip(procs, outs):
+        if p.returncode != 0:
+            raise RuntimeError('dist worker failed:\n' + out[-2000:])
+    row = json.loads([ln for ln in outs[0].splitlines()
+                      if ln.startswith('{')][-1])
+    row['mode'] = 'dist%d' % n
+    return row
+
+
+def _dist_worker():
+    os.environ['XLA_FLAGS'] = (os.environ.get('XLA_FLAGS', '')
+                               + ' --xla_force_host_platform_device_count=2')
+    import jax
+    jax.config.update('jax_platforms', 'cpu')
+    model = os.environ['BENCH_SUITE_MODEL']
+    steps = int(os.environ['BENCH_SUITE_STEPS'])
+    import paddle_tpu as fluid
+    with fluid.program_guard(fluid.default_main_program(),
+                             fluid.default_startup_program()):
+        loss, feed_fn, bs = _build(model, False)
+        fluid.optimizer.Adam(1e-3).minimize(loss)
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    pe = fluid.ParallelExecutor(
+        use_cuda=False, loss_name=loss.name,
+        main_program=fluid.default_main_program(), scope=scope,
+        num_trainers=int(os.environ['PADDLE_TRAINERS_NUM']),
+        trainer_id=int(os.environ['PADDLE_TRAINER_ID']))
+    with fluid.scope_guard(scope):
+        exe.run(fluid.default_startup_program(), scope=scope)
+    rng = np.random.RandomState(0)
+    lv = pe.run(fetch_list=[loss.name], feed=feed_fn(rng, bs))
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        lv = pe.run(fetch_list=[loss.name], feed=feed_fn(rng, bs))
+    dt = time.perf_counter() - t0
+    n = int(os.environ['PADDLE_TRAINERS_NUM'])
+    print(json.dumps({'model': model,
+                      'samples_per_sec': round(bs * steps * n / dt, 2),
+                      'loss': round(float(np.asarray(lv[0]).mean()), 4)}),
+          flush=True)
+
+
+MODELS = ['mnist', 'resnet', 'vgg', 'stacked_lstm', 'transformer']
+
+
+def main():
+    if os.environ.get('BENCH_SUITE_WORKER'):
+        _dist_worker()
+        return
+    ap = argparse.ArgumentParser()
+    ap.add_argument('--model', choices=MODELS + ['all'], default='all')
+    ap.add_argument('--mode', choices=['local', 'parallel', 'dist',
+                                       'all'], default='all')
+    ap.add_argument('--dist-trainers', type=int, default=2)
+    ap.add_argument('--steps', type=int, default=5)
+    ap.add_argument('--full', action='store_true',
+                    help='benchmark shapes (needs a real accelerator)')
+    args = ap.parse_args()
+    if not args.full:
+        os.environ.setdefault(
+            'XLA_FLAGS', '--xla_force_host_platform_device_count=8')
+        import jax
+        jax.config.update('jax_platforms', 'cpu')
+    models = MODELS if args.model == 'all' else [args.model]
+    modes = (['local', 'parallel', 'dist'] if args.mode == 'all'
+             else [args.mode])
+    rows = []
+    for model in models:
+        for mode in modes:
+            try:
+                if mode == 'dist':
+                    row = run_dist(model, args.dist_trainers, args.steps,
+                                   args.full)
+                else:
+                    row = run_one(model, mode, args.steps, args.full)
+            except Exception as e:   # noqa: BLE001 — suite keeps going
+                row = {'model': model, 'mode': mode,
+                       'error': str(e)[:120]}
+            rows.append(row)
+            print(json.dumps(row), flush=True)
+    ok = sum('error' not in r for r in rows)
+    print('# %d/%d configurations ran' % (ok, len(rows)))
+
+
+if __name__ == '__main__':
+    main()
