@@ -26,7 +26,7 @@ from ..parallel.api import sharding_constraint, pipeline_stage_guard
 class TransformerConfig(object):
     def __init__(self, vocab=1000, dim=64, heads=4, layers=2, ffn=128,
                  max_len=64, moe_experts=0, use_tp=True, use_sp=True,
-                 pp_stages=0):
+                 pp_stages=0, ring_attention=False):
         self.vocab, self.dim, self.heads = vocab, dim, heads
         self.layers, self.ffn, self.max_len = layers, ffn, max_len
         self.moe_experts = moe_experts
@@ -34,6 +34,10 @@ class TransformerConfig(object):
         # pp_stages > 0: annotate blocks with pipeline stages (layers
         # must divide evenly); consumed by DistributedStrategy(pp=...)
         self.pp_stages = pp_stages
+        # long-context: attention over the sp-sharded sequence via the
+        # ppermute ring (parallel/ring_attention.py) — O(T/n) per-device
+        # score memory instead of materializing [B, H, T, T]
+        self.ring_attention = ring_attention
 
 
 def _attention(x, cfg, prefix):
@@ -53,14 +57,22 @@ def _attention(x, cfg, prefix):
         part = L.reshape(part, shape=[-1, T, H, dh])
         part = L.transpose(part, perm=[0, 2, 1, 3])        # [B, H, T, dh]
         if cfg.use_tp:
-            part = sharding_constraint(part, ('dp', 'tp', None, None))
+            # under ring attention keep T sharded over sp: replicating
+            # it here would gather full-length Q/K/V per device, undoing
+            # the ring's O(T/n) memory
+            t_ax = 'sp' if (cfg.ring_attention and cfg.use_sp) else None
+            part = sharding_constraint(part, ('dp', 'tp', t_ax, None))
         return part
 
     q, k, v = heads(0, D), heads(D, 2 * D), heads(2 * D, 3 * D)
-    scores = L.matmul(q, k, transpose_y=True, alpha=1.0 / np.sqrt(dh))
-    causal = L.causal_mask_bias(scores)                    # [B, H, T, T]
-    probs = L.softmax(causal)
-    ctx = L.matmul(probs, v)                               # [B, H, T, dh]
+    if cfg.ring_attention:
+        from ..parallel.layers import ring_attention
+        ctx = ring_attention(q, k, v, causal=True)         # [B, H, T, dh]
+    else:
+        scores = L.matmul(q, k, transpose_y=True, alpha=1.0 / np.sqrt(dh))
+        causal = L.causal_mask_bias(scores)                # [B, H, T, T]
+        probs = L.softmax(causal)
+        ctx = L.matmul(probs, v)                           # [B, H, T, dh]
     ctx = L.transpose(ctx, perm=[0, 2, 1, 3])
     ctx = L.reshape(ctx, shape=[-1, T, D])
     if cfg.use_tp:

@@ -14,3 +14,4 @@ from . import dist_ops      # noqa: F401
 from . import beam_search_ops  # noqa: F401
 from . import fused_ops     # noqa: F401
 from . import detection_ops  # noqa: F401
+from . import attention_ops  # noqa: F401

@@ -1,0 +1,34 @@
+"""Attention ops. ring_attention: context-parallel attention over the
+'sp' mesh axis (parallel/ring_attention.py design notes). Under a plain
+single-device Executor (no mesh) it lowers to ordinary fused attention,
+so programs are portable between local debugging and sp meshes."""
+from __future__ import annotations
+
+from ..registry import register_op, op_emitter, register_vjp_grad, \
+    amp_cast
+
+
+@op_emitter('ring_attention')
+def _ring_attention_emit(ctx, op):
+    from ..parallel.ring_attention import ring_attention_global
+    q = ctx.get(op.single_input('Q'))
+    k = ctx.get(op.single_input('K'))
+    v = ctx.get(op.single_input('V'))
+    q, k, v = amp_cast(ctx, q, k, v)
+    causal = op.attr('causal', True)
+    sm_scale = op.attr('sm_scale', None)
+    out = ring_attention_global(q, k, v, getattr(ctx, 'mesh', None),
+                                causal=causal, sm_scale=sm_scale)
+    ctx.set(op.single_output('Out'), out)
+
+
+def _ring_infer(op, block):
+    q = block.var_recursive(op.single_input('Q'))
+    out = block.var_recursive(op.single_output('Out'))
+    out.shape = q.shape
+    out.dtype = q.dtype
+    out.lod_level = q.lod_level
+
+
+register_op('ring_attention', infer_shape=_ring_infer)
+register_vjp_grad('ring_attention', in_slots=('Q', 'K', 'V'))

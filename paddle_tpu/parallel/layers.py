@@ -20,7 +20,24 @@ from .api import shard_tensor, sharding_constraint
 
 __all__ = ['column_parallel_fc', 'row_parallel_fc',
            'vocab_parallel_embedding', 'sequence_parallel_scope',
-           'moe_layer']
+           'moe_layer', 'ring_attention']
+
+
+def ring_attention(q, k, v, causal=True, sm_scale=None, name=None):
+    """Context-parallel attention (parallel/ring_attention.py): q/k/v
+    [B, H, T, dh] with T sharded over 'sp'; K/V blocks rotate the ring
+    via ppermute with online-softmax accumulation. Exactly equals full
+    softmax attention; O(T/n) per-device memory. Falls back to plain
+    fused attention off-mesh."""
+    helper = LayerHelper('ring_attention', name=name)
+    out = helper.create_variable_for_type_inference(q.dtype)
+    helper.append_op(
+        type='ring_attention',
+        inputs={'Q': [q], 'K': [k], 'V': [v]},
+        outputs={'Out': [out]},
+        attrs={'causal': causal, 'sm_scale': sm_scale})
+    out.lod_level = q.lod_level
+    return out
 
 
 def _fc(input, size, param_spec, act=None, param_attr=None, bias_attr=None,

@@ -1,0 +1,155 @@
+"""Ring attention: context parallelism for long sequences.
+
+No reference analog — the reference caps sequence length at what one
+GPU's memory holds (its Transformer configs top out at T=256,
+ref:benchmark/fluid/models/transformer.py). This is the TPU-native
+long-context mechanism the SURVEY's scale goals require: the sequence
+axis is sharded over the 'sp' mesh axis, every device keeps only its
+own Q/K/V blocks, and K/V blocks rotate around the ring via
+`lax.ppermute` over ICI while each device folds one block per step into
+an online-softmax accumulator (the flash-attention recurrence, applied
+ring-step-wise). Peak per-device score memory drops from O(T²) to
+O(T²/n²) and K/V memory to O(T/n) — sequence length scales linearly
+with ring size at constant memory — while the ppermute traffic
+overlaps compute on the ICI torus.
+
+Causality is handled at block granularity: a key block strictly ahead
+of the query block contributes nothing (its scores are fully masked,
+and the online-softmax max is guarded so all-masked steps are exact
+no-ops, not NaNs); the diagonal block gets the elementwise triangular
+mask.
+
+`ring_attention(...)` is the inside-shard_map recurrence;
+`ring_attention_global(...)` wraps it in `shard_map` over the current
+mesh so op emitters (ops/attention_ops.py 'ring_attention') can call it
+on GSPMD-global arrays.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+try:
+    from jax import shard_map
+    _SHARD_MAP_KW = {}
+except ImportError:                      # older jax
+    from jax.experimental.shard_map import shard_map
+    _SHARD_MAP_KW = {'check_rep': False}
+from jax.sharding import PartitionSpec as P
+
+__all__ = ['ring_attention', 'ring_attention_global']
+
+_NEG_INF = -1e30
+
+
+def ring_attention(q, k, v, axis_name='sp', causal=True, sm_scale=None):
+    """Inside-shard_map ring attention.
+
+    q, k, v: [B, H, Tl, dh] — this device's sequence block (Tl = T/n).
+    Returns [B, H, Tl, dh], exactly softmax(QK^T·scale [+mask]) V over
+    the FULL sequence.
+    """
+    n = jax.lax.psum(1, axis_name)
+    my = jax.lax.axis_index(axis_name)
+    B, H, Tl, dh = q.shape
+    scale = sm_scale if sm_scale is not None else dh ** -0.5
+    # keep operands in their own dtype (bf16 under AMP runs the MXU at
+    # full rate); accumulate in fp32 via preferred_element_type
+    qs = q * jnp.asarray(scale, q.dtype)
+
+    q_pos = my * Tl + jnp.arange(Tl)                 # global query rows
+
+    # remat: without it, scan saves every step's [Tl, Tl] probability
+    # block for backward — O(Tl·T) residents, re-creating the memory
+    # wall ring attention exists to remove. Recomputing the fold in the
+    # backward pass keeps residuals at O(Tl·dh) per step (the standard
+    # flash/ring backward trade).
+    @jax.checkpoint
+    def fold(acc, kb, vb, src):
+        """One online-softmax update of acc=(o, m, l) with block src."""
+        o, m, l = acc
+        s = jnp.einsum('bhqd,bhkd->bhqk', qs, kb,
+                       preferred_element_type=jnp.float32)  # [B,H,Tl,Tl]
+        if causal:
+            k_pos = src * Tl + jnp.arange(Tl)
+            mask = q_pos[:, None] >= k_pos[None, :]
+            s = jnp.where(mask[None, None], s, _NEG_INF)
+        blk_max = jnp.max(s, axis=-1)                # [B,H,Tl]
+        m_new = jnp.maximum(m, blk_max)
+        # all-masked step: m_new stays _NEG_INF; freeze it so the
+        # correction exp(m - m_new) is exp(0), an exact no-op
+        safe_m = jnp.where(m_new <= _NEG_INF / 2, 0.0, m_new)
+        corr = jnp.exp(jnp.where(m <= _NEG_INF / 2, safe_m, m) - safe_m)
+        p = jnp.exp(s - safe_m[..., None])
+        if causal:
+            p = jnp.where(mask[None, None], p, 0.0)
+        l_new = l * corr + jnp.sum(p, axis=-1)
+        o_new = o * corr[..., None] + jnp.einsum(
+            'bhqk,bhkd->bhqd', p.astype(v.dtype), vb,
+            preferred_element_type=jnp.float32)
+        return o_new, m_new, l_new
+
+    perm = [(j, (j - 1) % n) for j in range(n)]
+
+    def step(carry, i):
+        o, m, l, kb, vb = carry
+        # rotate FIRST (blocks arrive from the next ring neighbour), so
+        # the scan runs n-1 rotations instead of n — the local block is
+        # folded in before the scan and a final rotation would be
+        # discarded (XLA cannot DCE a collective inside scan)
+        kb = jax.lax.ppermute(kb, axis_name, perm)
+        vb = jax.lax.ppermute(vb, axis_name, perm)
+        o, m, l = fold((o, m, l), kb, vb, (my + i) % n)
+        return (o, m, l, kb, vb), None
+
+    # derive initial carries FROM q so they inherit its varying-manual-
+    # axes type: newer shard_map rejects scan carries whose input is a
+    # plain constant but whose output varies over mesh axes
+    zq = qs.astype(jnp.float32) * 0.0
+    acc0 = fold((zq, zq[..., 0] + _NEG_INF, zq[..., 0]), k, v, my)
+    (o, m, l, _, _), _ = jax.lax.scan(
+        step, acc0 + (k, v), jnp.arange(1, n))
+    out = o / jnp.maximum(l, 1e-30)[..., None]
+    return out.astype(q.dtype)
+
+
+def ring_attention_global(q, k, v, mesh, causal=True, sm_scale=None,
+                          seq_axis='sp', batch_axis='dp',
+                          head_axis='tp'):
+    """GSPMD-global entry: q/k/v are [B, H, T, dh] global arrays; the
+    sequence axis is sharded over `seq_axis`, batch over `batch_axis`,
+    heads over `head_axis` (each only if present in the mesh).
+    mesh=None (no mesh in scope) lowers to plain fused attention; so do
+    meshes whose sp size does not divide T (shard_map cannot pad the way
+    GSPMD constraints can)."""
+    def _divisible_axis(name, dim):
+        # map a mesh axis into the shard_map spec only when it exists,
+        # is >1, and divides the dim — otherwise replicate that dim
+        # (GSPMD pads non-divisible dims; shard_map hard-errors)
+        if name and mesh is not None and name in mesh.axis_names \
+                and mesh.shape[name] > 1 and dim % mesh.shape[name] == 0:
+            return name
+        return None
+
+    if mesh is None or \
+            _divisible_axis(seq_axis, q.shape[2]) is None:
+        # no ring: plain attention, operand dtype preserved (bf16 under
+        # AMP runs the MXU at full rate), fp32 accumulation
+        scale = sm_scale if sm_scale is not None else q.shape[-1] ** -0.5
+        s = jnp.einsum('bhqd,bhkd->bhqk', q, k,
+                       preferred_element_type=jnp.float32) * scale
+        if causal:
+            T = q.shape[2]
+            mask = jnp.tril(jnp.ones((T, T), bool))
+            s = jnp.where(mask[None, None], s, _NEG_INF)
+        p = jax.nn.softmax(s, axis=-1)
+        return jnp.einsum('bhqk,bhkd->bhqd', p.astype(v.dtype), v,
+                          preferred_element_type=jnp.float32
+                          ).astype(q.dtype)
+    spec = P(_divisible_axis(batch_axis, q.shape[0]),
+             _divisible_axis(head_axis, q.shape[1]), seq_axis, None)
+    fn = functools.partial(ring_attention, axis_name=seq_axis,
+                           causal=causal, sm_scale=sm_scale)
+    return shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                     out_specs=spec, **_SHARD_MAP_KW)(q, k, v)

@@ -177,6 +177,13 @@ class _SandboxCtx(object):
     def amp(self):
         return getattr(self.parent, 'amp', False)
 
+    @property
+    def mesh(self):
+        # mesh-aware emitters (ring_attention, sharded ops) must see the
+        # same mesh when re-traced for gradients, or they silently take
+        # their no-mesh fallback in the backward pass
+        return getattr(self.parent, 'mesh', None)
+
 
 def register_vjp_grad(fwd_type, in_slots=('X',), out_slots=('Out',),
                       nondiff_slots=()):
