@@ -33,7 +33,8 @@ __all__ = [
     'unsqueeze', 'gather', 'scatter', 'slice', 'shape', 'autoincreased_step_counter',
     'logical_and', 'logical_or', 'logical_xor', 'logical_not', 'where_select',
     'causal_mask_bias', 'position_embedding', 'beam_search',
-    'beam_search_decode',
+    'beam_search_decode', 'hinge_loss', 'log_loss', 'margin_rank_loss',
+    'squared_l2_distance', 'maxout', 'sampling_id', 'nce', 'hsigmoid',
 ]
 
 
@@ -884,3 +885,120 @@ def beam_search_decode(ids, parent_idx, scores, name=None):
                 'Scores': [scores]},
         outputs={'SentenceIds': [sent], 'SentenceScores': [sent_scores]})
     return sent, sent_scores
+
+
+def hinge_loss(input, label, name=None):
+    """(reference layers/nn.py hinge_loss -> hinge_loss_op)"""
+    helper = LayerHelper('hinge_loss', name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(type='hinge_loss',
+                     inputs={'Logits': [input], 'Labels': [label]},
+                     outputs={'Loss': [out]})
+    return out
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):
+    helper = LayerHelper('log_loss', name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(type='log_loss',
+                     inputs={'Predicted': [input], 'Labels': [label]},
+                     outputs={'Loss': [out]},
+                     attrs={'epsilon': epsilon})
+    return out
+
+
+def margin_rank_loss(label, left, right, margin=0.1, name=None):
+    helper = LayerHelper('margin_rank_loss', name=name)
+    out = helper.create_variable_for_type_inference(left.dtype)
+    act = helper.create_variable_for_type_inference(left.dtype)
+    helper.append_op(type='margin_rank_loss',
+                     inputs={'X1': [left], 'X2': [right],
+                             'Label': [label]},
+                     outputs={'Out': [out], 'Activated': [act]},
+                     attrs={'margin': margin})
+    return out
+
+
+def squared_l2_distance(x, y, name=None):
+    helper = LayerHelper('squared_l2_distance', name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    sub = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type='squared_l2_distance',
+                     inputs={'X': [x], 'Y': [y]},
+                     outputs={'Out': [out], 'sub_result': [sub]})
+    return out
+
+
+def maxout(x, groups, name=None):
+    helper = LayerHelper('maxout', name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type='maxout', inputs={'X': [x]},
+                     outputs={'Out': [out]}, attrs={'groups': groups})
+    return out
+
+
+def sampling_id(x, min=0.0, max=1.0, seed=0, dtype='int64', name=None):
+    """Categorical draw per row of probabilities (reference
+    sampling_id_op; min/max/seed accepted for API parity — randomness
+    comes from the executor's per-step PRNG stream)."""
+    helper = LayerHelper('sampling_id', name=name)
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(type='sampling_id', inputs={'X': [x]},
+                     outputs={'Out': [out]})
+    return out
+
+
+def nce(input, label, num_total_classes, sample_weight=None,
+        param_attr=None, bias_attr=None, num_neg_samples=10, name=None):
+    """Noise-contrastive estimation loss (reference layers/nn.py nce ->
+    nce_op): uniform negative sampling from the executor PRNG stream;
+    per-example cost [B, 1]."""
+    helper = LayerHelper('nce', param_attr=param_attr,
+                         bias_attr=bias_attr, name=name)
+    dim = input.shape[-1]
+    w = helper.create_parameter(attr=helper.param_attr,
+                                shape=[num_total_classes, dim],
+                                dtype=input.dtype)
+    inputs = {'Input': [input], 'Label': [label], 'Weight': [w]}
+    if bias_attr is not False:
+        b = helper.create_parameter(attr=helper.bias_attr,
+                                    shape=[num_total_classes],
+                                    dtype=input.dtype, is_bias=True)
+        inputs['Bias'] = [b]
+    if sample_weight is not None:
+        inputs['SampleWeight'] = [sample_weight]
+    cost = helper.create_variable_for_type_inference(input.dtype)
+    import zlib
+    helper.append_op(type='nce', inputs=inputs,
+                     outputs={'Cost': [cost]},
+                     attrs={'num_total_classes': num_total_classes,
+                            'num_neg_samples': num_neg_samples,
+                            # stable per-op randomness tag: forward and
+                            # its vjp re-trace must sample the SAME
+                            # negatives (ops/loss_ops.py)
+                            'rng_tag': zlib.crc32(cost.name.encode())
+                            & 0x7FFFFFFF})
+    return cost
+
+
+def hsigmoid(input, label, num_classes, param_attr=None, bias_attr=None,
+             name=None):
+    """Hierarchical sigmoid over a complete binary code tree (reference
+    layers/nn.py hsigmoid -> hierarchical_sigmoid_op)."""
+    helper = LayerHelper('hierarchical_sigmoid', param_attr=param_attr,
+                         bias_attr=bias_attr, name=name)
+    dim = input.shape[-1]
+    w = helper.create_parameter(attr=helper.param_attr,
+                                shape=[num_classes - 1, dim],
+                                dtype=input.dtype)
+    inputs = {'X': [input], 'Label': [label], 'W': [w]}
+    if bias_attr is not False:
+        b = helper.create_parameter(attr=helper.bias_attr,
+                                    shape=[num_classes - 1],
+                                    dtype=input.dtype, is_bias=True)
+        inputs['Bias'] = [b]
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(type='hierarchical_sigmoid', inputs=inputs,
+                     outputs={'Out': [out]},
+                     attrs={'num_classes': num_classes})
+    return out

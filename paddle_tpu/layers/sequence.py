@@ -14,7 +14,9 @@ __all__ = [
     'dynamic_lstm', 'dynamic_gru', 'sequence_conv', 'sequence_pool',
     'sequence_softmax', 'sequence_expand', 'sequence_first_step',
     'sequence_last_step', 'sequence_concat', 'cos_sim',
-    'linear_chain_crf', 'crf_decoding',
+    'linear_chain_crf', 'crf_decoding', 'sequence_mask', 'sequence_pad',
+    'sequence_unpad', 'sequence_erase', 'sequence_reshape',
+    'sequence_slice', 'row_conv', 'im2sequence', 'edit_distance',
 ]
 
 
@@ -222,3 +224,142 @@ def crf_decoding(input, param_attr, label=None):
                      inputs=_seq_inputs(inputs, input),
                      outputs={'ViterbiPath': [viterbi_path]})
     return _propagate_lens(input, viterbi_path)
+
+
+def sequence_mask(x, maxlen, dtype='int64', name=None):
+    """Lengths -> [B, maxlen] validity mask (reference sequence_mask)."""
+    helper = LayerHelper('sequence_mask', name=name)
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(type='sequence_mask', inputs={'X': [x]},
+                     outputs={'Y': [out]},
+                     attrs={'maxlen': maxlen, 'out_dtype': dtype})
+    return out
+
+
+def sequence_pad(x, pad_value, maxlen=None, name=None):
+    """(reference sequence_pad_op) Returns (padded, lengths)."""
+    helper = LayerHelper('sequence_pad', name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    length = helper.create_variable_for_type_inference('int64')
+    inputs = _seq_inputs({'X': [x], 'PadValue': [pad_value]}, x)
+    helper.append_op(type='sequence_pad', inputs=inputs,
+                     outputs={'Out': [out], 'Length': [length]},
+                     attrs={'padded_length': maxlen or -1})
+    out.lod_level = 0
+    length.stop_gradient = True
+    return out, length
+
+
+def sequence_unpad(x, length, name=None):
+    """(reference sequence_unpad_op) Re-attach lengths to a padded
+    tensor; positions beyond each length are zeroed."""
+    helper = LayerHelper('sequence_unpad', name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type='sequence_unpad',
+                     inputs={'X': [x], 'Length': [length]},
+                     outputs={'Out': [out]})
+    out.lod_level = 1
+    out.seq_lens = length
+    return out
+
+
+def _lens_output(helper, out, x):
+    """Create the OutLens companion and attach it to out."""
+    lens = helper.create_variable_for_type_inference('int32')
+    lens.stop_gradient = True
+    out.seq_lens = lens
+    out.lod_level = max(1, getattr(x, 'lod_level', 1))
+    return lens
+
+
+def sequence_erase(x, tokens, name=None):
+    """Drop listed token ids, left-shift survivors, shrink lengths."""
+    helper = LayerHelper('sequence_erase', name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    lens = _lens_output(helper, out, x)
+    helper.append_op(type='sequence_erase',
+                     inputs=_seq_inputs({'X': [x]}, x),
+                     outputs={'Out': [out], 'OutLens': [lens]},
+                     attrs={'tokens': list(tokens)})
+    return out
+
+
+def sequence_reshape(input, new_dim, name=None):
+    helper = LayerHelper('sequence_reshape', name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    lens = _lens_output(helper, out, input)
+    helper.append_op(type='sequence_reshape',
+                     inputs=_seq_inputs({'X': [input]}, input),
+                     outputs={'Out': [out], 'OutLens': [lens]},
+                     attrs={'new_dim': new_dim})
+    return out
+
+
+def sequence_slice(input, offset, length, name=None):
+    helper = LayerHelper('sequence_slice', name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    lens = _lens_output(helper, out, input)
+    helper.append_op(type='sequence_slice',
+                     inputs=_seq_inputs({'X': [input],
+                                         'Offset': [offset],
+                                         'Length': [length]}, input),
+                     outputs={'Out': [out], 'OutLens': [lens]})
+    return out
+
+
+def row_conv(input, future_context_size, param_attr=None, act=None,
+             name=None):
+    """Lookahead convolution (reference layers/nn.py row_conv)."""
+    from ..initializer import Constant
+    helper = LayerHelper('row_conv', param_attr=param_attr, name=name)
+    d = input.shape[-1]
+    w = helper.create_parameter(attr=helper.param_attr,
+                                shape=[future_context_size, d],
+                                dtype=input.dtype,
+                                default_initializer=Constant(0.0))
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(type='row_conv',
+                     inputs=_seq_inputs({'X': [input], 'Filter': [w]},
+                                        input),
+                     outputs={'Out': [out]})
+    _propagate_lens(input, out)
+    return out
+
+
+def im2sequence(input, filter_size, stride=1, padding=0, name=None):
+    """Image patches as a sequence (reference im2sequence_op)."""
+    helper = LayerHelper('im2sequence', name=name)
+
+    def _pair(v):
+        return [v, v] if isinstance(v, int) else list(v)
+    pad = _pair(padding)
+    if len(pad) == 2:
+        pad = pad + pad
+    out = helper.create_variable_for_type_inference(input.dtype)
+    lens = helper.create_variable_for_type_inference('int32')
+    lens.stop_gradient = True
+    out.seq_lens = lens
+    out.lod_level = 1
+    helper.append_op(type='im2sequence', inputs={'X': [input]},
+                     outputs={'Out': [out], 'OutLens': [lens]},
+                     attrs={'kernels': _pair(filter_size),
+                            'strides': _pair(stride), 'paddings': pad})
+    return out
+
+
+def edit_distance(input, label, normalized=True, name=None):
+    """Batched Levenshtein distance (reference edit_distance_op).
+    Returns (distances [B, 1], sequence_num scalar)."""
+    helper = LayerHelper('edit_distance', name=name)
+    out = helper.create_variable_for_type_inference('float32')
+    seq_num = helper.create_variable_for_type_inference('int64')
+    inputs = {'Hyps': [input], 'Refs': [label]}
+    if getattr(input, 'seq_lens', None) is not None:
+        inputs['HypLens'] = [input.seq_lens]
+    if getattr(label, 'seq_lens', None) is not None:
+        inputs['RefLens'] = [label.seq_lens]
+    helper.append_op(type='edit_distance', inputs=inputs,
+                     outputs={'Out': [out], 'SequenceNum': [seq_num]},
+                     attrs={'normalized': normalized})
+    out.stop_gradient = True
+    return out, seq_num

@@ -15,3 +15,4 @@ from . import beam_search_ops  # noqa: F401
 from . import fused_ops     # noqa: F401
 from . import detection_ops  # noqa: F401
 from . import attention_ops  # noqa: F401
+from . import loss_ops      # noqa: F401

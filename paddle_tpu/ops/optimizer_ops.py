@@ -296,3 +296,47 @@ register_op('ftrl', emit=_ftrl_emit, no_grad=True,
                 [('Param', 'ParamOut'),
                  ('SquaredAccumulator', 'SquaredAccumOut'),
                  ('LinearAccumulator', 'LinearAccumOut')]))
+
+
+def _soft_threshold(prox, step, l1, l2):
+    """FOBOS soft-threshold shared by the proximal optimizers
+    (reference proximal_gd_op.h / proximal_adagrad_op.h)."""
+    shrunk = jnp.sign(prox) * jnp.maximum(jnp.abs(prox) - step * l1, 0.0)
+    return shrunk / (1.0 + step * l2)
+
+
+def _proximal_gd_emit(ctx, op):
+    p = ctx.get(op.single_input('Param'))
+    g = _densify(ctx.get(op.single_input('Grad')))
+    lr = ctx.get(op.single_input('LearningRate'))
+    l1 = op.attr('l1', 0.0)
+    l2 = op.attr('l2', 0.0)
+    prox = p - lr * g.astype(p.dtype)
+    ctx.set(op.single_output('ParamOut'),
+            _soft_threshold(prox, lr, l1, l2))
+
+
+register_op('proximal_gd', emit=_proximal_gd_emit, no_grad=True,
+            infer_shape=_passthrough_infer([('Param', 'ParamOut')]))
+
+
+def _proximal_adagrad_emit(ctx, op):
+    p = ctx.get(op.single_input('Param'))
+    g = _densify(ctx.get(op.single_input('Grad'))).astype(p.dtype)
+    m = ctx.get(op.single_input('Moment'))
+    lr = ctx.get(op.single_input('LearningRate'))
+    l1 = op.attr('l1', 0.0)
+    l2 = op.attr('l2', 0.0)
+    m_new = m + jnp.square(g)
+    prox = p - (lr / jnp.sqrt(m_new + 1e-10)) * g
+    # reference proximal_adagrad_op.h thresholds with the PLAIN lr, not
+    # the per-element adaptive step
+    ctx.set(op.single_output('ParamOut'),
+            _soft_threshold(prox, lr, l1, l2))
+    ctx.set(op.single_output('MomentOut'), m_new)
+
+
+register_op('proximal_adagrad', emit=_proximal_adagrad_emit,
+            no_grad=True,
+            infer_shape=_passthrough_infer(
+                [('Param', 'ParamOut'), ('Moment', 'MomentOut')]))

@@ -616,3 +616,398 @@ def _seq_last_emit(ctx, op):
     out = jnp.take_along_axis(
         x, idx.reshape((B, 1) + (1,) * (x.ndim - 2)), axis=1)
     ctx.set(op.single_output('Out'), jnp.squeeze(out, axis=1))
+
+
+# ---------------------------------------------------------------------------
+# sequence_mask (reference sequence_mask_op.cc): lengths -> [B, maxlen]
+# ---------------------------------------------------------------------------
+
+@op_emitter('sequence_mask')
+def _sequence_mask_emit(ctx, op):
+    lens = ctx.get(op.single_input('X'))
+    maxlen = op.attr('maxlen', -1)
+    if maxlen <= 0:
+        raise ValueError('sequence_mask on TPU needs a static maxlen '
+                         '(dynamic output shapes cannot compile)')
+    dtype = {'int64': jnp.int64, 'int32': jnp.int32,
+             'float32': jnp.float32, 'bool': jnp.bool_}[
+        op.attr('out_dtype', 'int64')]
+    mask = jnp.arange(maxlen)[None, :] < lens.reshape(-1)[:, None]
+    ctx.set(op.single_output('Y'), mask.astype(dtype))
+
+
+def _sequence_mask_infer(op, block):
+    x = block.var_recursive(op.single_input('X'))
+    y = block.var_recursive(op.single_output('Y'))
+    y.shape = [x.shape[0], op.attr('maxlen', -1)]
+    y.dtype = op.attr('out_dtype', 'int64')
+
+
+register_op('sequence_mask', infer_shape=_sequence_mask_infer,
+            no_grad=True)
+
+
+# ---------------------------------------------------------------------------
+# sequence_pad / sequence_unpad (reference sequence_pad_op.cc): in the
+# padded-LoD contract "pad" = apply the pad value beyond each row's
+# length and surface the length vector; "unpad" = re-attach lengths
+# ---------------------------------------------------------------------------
+
+@op_emitter('sequence_pad')
+def _sequence_pad_emit(ctx, op):
+    x = ctx.get(op.single_input('X'))
+    pad_value = ctx.get(op.single_input('PadValue'))
+    B, T = x.shape[0], x.shape[1]
+    lens = _lens(ctx, op, T, B)
+    padded_len = op.attr('padded_length', -1)
+    if padded_len > 0 and padded_len != T:
+        if padded_len > T:
+            widths = [(0, 0), (0, padded_len - T)] + \
+                [(0, 0)] * (x.ndim - 2)
+            x = jnp.pad(x, widths)
+        else:
+            x = x[:, :padded_len]
+        T = padded_len
+    mask = _time_mask(lens, T, extra_dims=x.ndim - 2)
+    out = jnp.where(mask, x, jnp.asarray(pad_value, x.dtype))
+    ctx.set(op.single_output('Out'), out)
+    ctx.set(op.single_output('Length'), lens.astype(jnp.int64))
+
+
+def _sequence_pad_infer(op, block):
+    x = block.var_recursive(op.single_input('X'))
+    out = block.var_recursive(op.single_output('Out'))
+    padded = op.attr('padded_length', -1)
+    shape = list(x.shape)
+    if padded > 0 and len(shape) >= 3:
+        shape[1] = padded
+    out.shape = shape
+    out.dtype = x.dtype
+    ln = block.var_recursive(op.single_output('Length'))
+    ln.shape = [x.shape[0]]
+    ln.dtype = 'int64'
+
+
+register_op('sequence_pad', infer_shape=_sequence_pad_infer)
+register_vjp_grad('sequence_pad', in_slots=('X',), out_slots=('Out',),
+                  nondiff_slots=('PadValue', 'SeqLens'))
+
+
+@op_emitter('sequence_unpad')
+def _sequence_unpad_emit(ctx, op):
+    x = ctx.get(op.single_input('X'))
+    lens = ctx.get(op.single_input('Length'))
+    # padded-LoD contract: the tensor stays padded; positions beyond the
+    # length are zeroed and the lengths ride along as @SEQ_LEN
+    mask = _time_mask(lens.reshape(-1).astype(jnp.int32), x.shape[1],
+                      extra_dims=x.ndim - 2)
+    ctx.set(op.single_output('Out'), jnp.where(mask, x, 0))
+
+
+register_op('sequence_unpad',
+            infer_shape=lambda op, block: _copy_shape(op, block))
+
+
+def _copy_shape(op, block):
+    x = block.var_recursive(op.single_input('X'))
+    out = block.var_recursive(op.single_output('Out'))
+    out.shape = x.shape
+    out.dtype = x.dtype
+    out.lod_level = 1
+
+
+register_vjp_grad('sequence_unpad', in_slots=('X',),
+                  nondiff_slots=('Length',))
+
+
+# ---------------------------------------------------------------------------
+# sequence_erase (reference sequence_erase_op.cc): drop listed tokens,
+# shift the survivors left, shrink lengths
+# ---------------------------------------------------------------------------
+
+@op_emitter('sequence_erase')
+def _sequence_erase_emit(ctx, op):
+    x = ctx.get(op.single_input('X'))            # [B, T] or [B, T, 1]
+    tokens = op.attr('tokens', [])
+    squeeze = x.ndim == 3
+    ids = x[..., 0] if squeeze else x
+    B, T = ids.shape
+    lens = _lens(ctx, op, T, B)
+    valid = jnp.arange(T)[None, :] < lens[:, None]
+    keep = valid
+    for t in tokens:
+        keep = keep & (ids != t)
+    # stable left-shift of kept tokens: order by (dropped, position)
+    order = jnp.argsort(jnp.where(keep, jnp.arange(T)[None, :], T + 1),
+                        axis=1)
+    shifted = jnp.take_along_axis(ids, order, axis=1)
+    new_lens = jnp.sum(keep, axis=1).astype(jnp.int32)
+    shifted = jnp.where(jnp.arange(T)[None, :] < new_lens[:, None],
+                        shifted, 0)
+    out = shifted[..., None] if squeeze else shifted
+    ctx.set(op.single_output('Out'), out)
+    if op.output('OutLens'):
+        ctx.set(op.single_output('OutLens'), new_lens)
+
+
+def _sequence_erase_infer(op, block):
+    x = block.var_recursive(op.single_input('X'))
+    out = block.var_recursive(op.single_output('Out'))
+    out.shape = x.shape
+    out.dtype = x.dtype
+    out.lod_level = 1
+    if op.output('OutLens'):
+        ln = block.var_recursive(op.single_output('OutLens'))
+        ln.shape = [x.shape[0]]
+        ln.dtype = 'int32'
+
+
+register_op('sequence_erase', infer_shape=_sequence_erase_infer,
+            no_grad=True)
+
+
+# ---------------------------------------------------------------------------
+# sequence_reshape (reference sequence_reshape_op.cc): refold the time
+# axis so the trailing dim becomes new_dim
+# ---------------------------------------------------------------------------
+
+@op_emitter('sequence_reshape')
+def _sequence_reshape_emit(ctx, op):
+    x = ctx.get(op.single_input('X'))            # [B, T, D]
+    new_dim = op.attr('new_dim')
+    B, T, D = x.shape
+    out = x.reshape(B, T * D // new_dim, new_dim)
+    ctx.set(op.single_output('Out'), out)
+    lens = _lens(ctx, op, T, B)
+    if op.output('OutLens'):
+        ctx.set(op.single_output('OutLens'),
+                (lens * D // new_dim).astype(jnp.int32))
+
+
+def _sequence_reshape_infer(op, block):
+    x = block.var_recursive(op.single_input('X'))
+    new_dim = op.attr('new_dim')
+    out = block.var_recursive(op.single_output('Out'))
+    if len(x.shape) >= 3:
+        out.shape = [x.shape[0], x.shape[1] * x.shape[2] // new_dim,
+                     new_dim]
+    else:
+        # declared lod shape [B?, D]: the padded time axis exists only
+        # at runtime, so only the feature dim is known here
+        out.shape = list(x.shape[:-1]) + [new_dim]
+    out.dtype = x.dtype
+    out.lod_level = 1
+    if op.output('OutLens'):
+        ln = block.var_recursive(op.single_output('OutLens'))
+        ln.shape = [x.shape[0]]
+        ln.dtype = 'int32'
+
+
+register_op('sequence_reshape', infer_shape=_sequence_reshape_infer)
+register_vjp_grad('sequence_reshape', in_slots=('X',),
+                  nondiff_slots=('SeqLens',))
+
+
+# ---------------------------------------------------------------------------
+# sequence_slice (reference sequence_slice_op.cc): per-sequence
+# [offset, offset+length) windows
+# ---------------------------------------------------------------------------
+
+@op_emitter('sequence_slice')
+def _sequence_slice_emit(ctx, op):
+    x = ctx.get(op.single_input('X'))            # [B, T, ...]
+    offset = ctx.get(op.single_input('Offset')).reshape(-1)
+    length = ctx.get(op.single_input('Length')).reshape(-1)
+    B, T = x.shape[0], x.shape[1]
+    pos = offset[:, None] + jnp.arange(T)[None, :]
+    gather = jnp.clip(pos, 0, T - 1)
+    out = jnp.take_along_axis(
+        x, gather.reshape((B, T) + (1,) * (x.ndim - 2)), axis=1)
+    mask = _time_mask(length.astype(jnp.int32), T,
+                      extra_dims=x.ndim - 2)
+    ctx.set(op.single_output('Out'), jnp.where(mask, out, 0))
+    if op.output('OutLens'):
+        ctx.set(op.single_output('OutLens'),
+                length.astype(jnp.int32))
+
+
+def _sequence_slice_infer(op, block):
+    x = block.var_recursive(op.single_input('X'))
+    out = block.var_recursive(op.single_output('Out'))
+    out.shape = x.shape
+    out.dtype = x.dtype
+    out.lod_level = 1
+    if op.output('OutLens'):
+        ln = block.var_recursive(op.single_output('OutLens'))
+        ln.shape = [x.shape[0]]
+        ln.dtype = 'int32'
+
+
+register_op('sequence_slice', infer_shape=_sequence_slice_infer)
+register_vjp_grad('sequence_slice', in_slots=('X',),
+                  nondiff_slots=('Offset', 'Length', 'SeqLens'))
+
+
+# ---------------------------------------------------------------------------
+# row_conv (reference row_conv_op.cc): lookahead convolution
+# out[b, t, d] = sum_k x[b, t+k, d] * W[k, d], zero past the row's end
+# ---------------------------------------------------------------------------
+
+@op_emitter('row_conv')
+def _row_conv_emit(ctx, op):
+    x = ctx.get(op.single_input('X'))            # [B, T, D]
+    w = ctx.get(op.single_input('Filter'))       # [K, D]
+    B, T, D = x.shape
+    K = w.shape[0]
+    lens = _lens(ctx, op, T, B)
+    mask = _time_mask(lens, T, extra_dims=1)
+    xm = jnp.where(mask, x, 0)
+    padded = jnp.pad(xm, ((0, 0), (0, K - 1), (0, 0)))
+    out = jnp.zeros_like(x)
+    for k in range(K):                           # K is small and static
+        out = out + padded[:, k:k + T, :] * w[k][None, None, :]
+    ctx.set(op.single_output('Out'), jnp.where(mask, out, 0))
+
+
+def _row_conv_infer(op, block):
+    x = block.var_recursive(op.single_input('X'))
+    out = block.var_recursive(op.single_output('Out'))
+    out.shape = x.shape
+    out.dtype = x.dtype
+    out.lod_level = 1
+
+
+register_op('row_conv', infer_shape=_row_conv_infer)
+register_vjp_grad('row_conv', in_slots=('X', 'Filter'),
+                  nondiff_slots=('SeqLens',))
+
+
+# ---------------------------------------------------------------------------
+# im2sequence (reference im2sequence_op.cc): image -> patch sequence
+# [N, C, H, W] -> [N, out_h*out_w, C*kh*kw]
+# ---------------------------------------------------------------------------
+
+@op_emitter('im2sequence')
+def _im2sequence_emit(ctx, op):
+    x = ctx.get(op.single_input('X'))
+    kernels = op.attr('kernels')
+    strides = op.attr('strides', [1, 1])
+    paddings = op.attr('paddings', [0, 0, 0, 0])
+    patches = jax.lax.conv_general_dilated_patches(
+        x, filter_shape=tuple(kernels),
+        window_strides=tuple(strides),
+        padding=[(paddings[0], paddings[2]), (paddings[1], paddings[3])],
+        dimension_numbers=('NCHW', 'OIHW', 'NCHW'))
+    N, CK, OH, OW = patches.shape
+    out = patches.reshape(N, CK, OH * OW).transpose(0, 2, 1)
+    ctx.set(op.single_output('Out'), out)
+    if op.output('OutLens'):
+        ctx.set(op.single_output('OutLens'),
+                jnp.full((N,), OH * OW, jnp.int32))
+
+
+def _im2seq_out_hw(in_size, k, p0, p1, s):
+    return (in_size + p0 + p1 - k) // s + 1
+
+
+def _im2sequence_infer(op, block):
+    x = block.var_recursive(op.single_input('X'))
+    kernels = op.attr('kernels')
+    strides = op.attr('strides', [1, 1])
+    paddings = op.attr('paddings', [0, 0, 0, 0])
+    n, c, h, w = x.shape
+    oh = _im2seq_out_hw(h, kernels[0], paddings[0], paddings[2],
+                        strides[0])
+    ow = _im2seq_out_hw(w, kernels[1], paddings[1], paddings[3],
+                        strides[1])
+    out = block.var_recursive(op.single_output('Out'))
+    out.shape = [n, oh * ow, c * kernels[0] * kernels[1]]
+    out.dtype = x.dtype
+    out.lod_level = 1
+    if op.output('OutLens'):
+        ln = block.var_recursive(op.single_output('OutLens'))
+        ln.shape = [n]
+        ln.dtype = 'int32'
+
+
+register_op('im2sequence', infer_shape=_im2sequence_infer)
+register_vjp_grad('im2sequence', in_slots=('X',))
+
+
+# ---------------------------------------------------------------------------
+# edit_distance (reference edit_distance_op.cc): batched Levenshtein
+# between hypothesis and reference token sequences
+# ---------------------------------------------------------------------------
+
+@op_emitter('edit_distance')
+def _edit_distance_emit(ctx, op):
+    hyp = ctx.get(op.single_input('Hyps'))
+    ref = ctx.get(op.single_input('Refs'))
+    hyp = hyp[..., 0] if hyp.ndim == 3 else hyp        # [B, T1]
+    ref = ref[..., 0] if ref.ndim == 3 else ref        # [B, T2]
+    B, T1 = hyp.shape
+    T2 = ref.shape[1]
+    hyp_lens = (ctx.get(op.single_input('HypLens')).reshape(-1)
+                if op.input('HypLens')
+                else jnp.full((B,), T1, jnp.int32))
+    ref_lens = (ctx.get(op.single_input('RefLens')).reshape(-1)
+                if op.input('RefLens')
+                else jnp.full((B,), T2, jnp.int32))
+    normalized = op.attr('normalized', False)
+
+    big = jnp.asarray(10 ** 6, jnp.int32)
+
+    def per_row(h, hl, r, rl):
+        # DP row over ref prefix lengths; scan over hyp tokens. Out-of-
+        # range hyp rows are frozen by masking.
+        row0 = jnp.arange(T2 + 1, dtype=jnp.int32)
+        row0 = jnp.where(jnp.arange(T2 + 1) <= rl, row0, big)
+
+        def step(prev, it):
+            i, tok = it
+            sub_cost = (r != tok).astype(jnp.int32)
+            # new[j] = min(prev[j] + 1, new[j-1] + 1, prev[j-1] + sub)
+            # the new[j-1] dependency is a prefix-scan: use the
+            # standard associative trick new[j] = min_k ( base[k] +
+            # (j - k) ) with base from prev; implemented via lax scan
+            # over T2 (T2 static, small for token sequences)
+            def inner(carry, jv):
+                j, pj, pjm1, subc = jv
+                val = jnp.minimum(jnp.minimum(pj + 1, carry + 1),
+                                  pjm1 + subc)
+                return val, val
+            init = prev[0] + 1
+            _, rest = jax.lax.scan(
+                inner, init,
+                (jnp.arange(1, T2 + 1), prev[1:], prev[:-1], sub_cost))
+            new = jnp.concatenate([jnp.asarray([init]), rest])
+            new = jnp.where(i < hl, new, prev)
+            return new, None
+
+        final, _ = jax.lax.scan(step, row0,
+                                (jnp.arange(T1), h))
+        d = final[jnp.clip(rl, 0, T2)].astype(jnp.float32)
+        if normalized:
+            d = d / jnp.maximum(rl.astype(jnp.float32), 1.0)
+        return d
+
+    out = jax.vmap(per_row)(hyp, hyp_lens, ref, ref_lens)
+    ctx.set(op.single_output('Out'), out[:, None])
+    if op.output('SequenceNum'):
+        ctx.set(op.single_output('SequenceNum'),
+                jnp.asarray(B, jnp.int32))
+
+
+def _edit_distance_infer(op, block):
+    h = block.var_recursive(op.single_input('Hyps'))
+    out = block.var_recursive(op.single_output('Out'))
+    out.shape = [h.shape[0], 1]
+    out.dtype = 'float32'
+    if op.output('SequenceNum'):
+        sn = block.var_recursive(op.single_output('SequenceNum'))
+        sn.shape = []
+        sn.dtype = 'int32'
+
+
+register_op('edit_distance', infer_shape=_edit_distance_infer,
+            no_grad=True)

@@ -20,7 +20,7 @@ from . import clip as clip_mod
 from . import regularizer as regularizer_mod
 
 __all__ = ['SGD', 'Momentum', 'Adagrad', 'Adam', 'Adamax', 'DecayedAdagrad',
-           'Adadelta', 'RMSProp', 'Ftrl',
+           'Adadelta', 'RMSProp', 'Ftrl', 'ProximalGD', 'ProximalAdagrad',
            'SGDOptimizer', 'MomentumOptimizer', 'AdagradOptimizer',
            'AdamOptimizer', 'AdamaxOptimizer', 'DecayedAdagradOptimizer',
            'AdadeltaOptimizer', 'RMSPropOptimizer', 'FtrlOptimizer',
@@ -423,6 +423,53 @@ class ModelAverage(Optimizer):
         self.max_average_window = max_average_window
 
 
+class ProximalGD(Optimizer):
+    """(reference optimizer.py ProximalGDOptimizer -> proximal_gd_op)"""
+
+    def __init__(self, learning_rate, l1_regularization_strength=0.0,
+                 l2_regularization_strength=0.0, **kwargs):
+        super(ProximalGD, self).__init__(learning_rate, **kwargs)
+        self.type = 'proximal_gd'
+        self._l1 = l1_regularization_strength
+        self._l2 = l2_regularization_strength
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p = param_and_grad[0]
+        return block.append_op(
+            type='proximal_gd',
+            inputs={'Param': [p], 'Grad': [param_and_grad[1]],
+                    'LearningRate': [self._create_param_lr(param_and_grad)]},
+            outputs={'ParamOut': [p]},
+            attrs={'l1': self._l1, 'l2': self._l2})
+
+
+class ProximalAdagrad(Optimizer):
+    """(reference ProximalAdagradOptimizer -> proximal_adagrad_op)"""
+    _moment_acc_str = 'moment'
+
+    def __init__(self, learning_rate, l1_regularization_strength=0.0,
+                 l2_regularization_strength=0.0, **kwargs):
+        super(ProximalAdagrad, self).__init__(learning_rate, **kwargs)
+        self.type = 'proximal_adagrad'
+        self._l1 = l1_regularization_strength
+        self._l2 = l2_regularization_strength
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator(self._moment_acc_str, p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p = param_and_grad[0]
+        moment = self._get_accumulator(self._moment_acc_str, p)
+        return block.append_op(
+            type='proximal_adagrad',
+            inputs={'Param': [p], 'Grad': [param_and_grad[1]],
+                    'Moment': [moment],
+                    'LearningRate': [self._create_param_lr(param_and_grad)]},
+            outputs={'ParamOut': [p], 'MomentOut': [moment]},
+            attrs={'l1': self._l1, 'l2': self._l2})
+
+
 # reference-compatible aliases (fluid.optimizer.SGDOptimizer etc.)
 SGDOptimizer = SGD
 MomentumOptimizer = Momentum
@@ -433,3 +480,5 @@ DecayedAdagradOptimizer = DecayedAdagrad
 AdadeltaOptimizer = Adadelta
 RMSPropOptimizer = RMSProp
 FtrlOptimizer = Ftrl
+ProximalGDOptimizer = ProximalGD
+ProximalAdagradOptimizer = ProximalAdagrad

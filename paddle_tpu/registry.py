@@ -184,6 +184,12 @@ class _SandboxCtx(object):
         # their no-mesh fallback in the backward pass
         return getattr(self.parent, 'mesh', None)
 
+    @property
+    def rng_key(self):
+        # emitters that key randomness on a stable per-op tag (nce) must
+        # draw from the same segment key in the grad re-trace
+        return self.parent.rng_key
+
 
 def register_vjp_grad(fwd_type, in_slots=('X',), out_slots=('Out',),
                       nondiff_slots=()):
