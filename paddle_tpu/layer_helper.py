@@ -118,7 +118,12 @@ class LayerHelper(object):
                          default_initializer=None):
         """Create the Parameter var in the main program's global block AND
         append its init op to the startup program (reference
-        layer_helper.py:293)."""
+        layer_helper.py:293). WeightNormParamAttr reparameterizes as
+        w = g * v / ||v|| (reference LayerHelper._create_weight_normalize)."""
+        from .param_attr import WeightNormParamAttr
+        if isinstance(attr, WeightNormParamAttr):
+            return self._create_weight_normalized(
+                attr, shape, dtype, default_initializer)
         attr = copy.deepcopy(attr) if attr is not None else ParamAttr()
         if attr is False:
             return None
@@ -145,6 +150,58 @@ class LayerHelper(object):
         return main_block.create_parameter(
             name=attr.name, shape=shape, dtype=dtype,
             **{k: v for k, v in attr.to_kwargs().items() if k != 'name'})
+
+    def _create_weight_normalized(self, attr, shape, dtype,
+                                  default_initializer):
+        """Weight normalization (Salimans & Kingma): the trainable
+        params are direction v (param shape) and magnitude g (per-dim
+        slice); the layer consumes the computed w = g * v / ||v||_dim.
+        The reference builds this from elementwise ops
+        (layer_helper.py __weight_normalize); here too — autodiff flows
+        into both g and v through the op graph."""
+        from .param_attr import ParamAttr
+        base = attr.name or unique_name.generate(
+            '.'.join([self.name, 'w']))
+        dim = attr.dim
+        if dim is not None and dim < 0:
+            dim = dim % len(shape)   # negative dims: same math, not silence
+        v = self.create_parameter(
+            ParamAttr(name=base + '.wn.v',
+                      initializer=attr.initializer,
+                      learning_rate=attr.learning_rate,
+                      regularizer=attr.regularizer,
+                      trainable=attr.trainable,
+                      gradient_clip=attr.gradient_clip),
+            shape, dtype, default_initializer=default_initializer)
+        # ||v|| reduced over every axis EXCEPT `dim` (dim=None: full
+        # tensor norm -> g is a scalar)
+        if dim is None:
+            g_shape = [1]
+        else:
+            g_shape = [shape[dim]]
+        g = self.create_parameter(
+            ParamAttr(name=base + '.wn.g',
+                      learning_rate=attr.learning_rate,
+                      trainable=attr.trainable,
+                      initializer=Constant(1.0)),
+            g_shape, dtype)
+        from . import layers as L
+        sq = L.elementwise_mul(v, v)
+        if dim is None:
+            norm_sq = L.reduce_sum(sq, dim=None, keep_dim=False)
+        else:
+            axes = [i for i in range(len(shape)) if i != dim]
+            norm_sq = L.reduce_sum(sq, dim=axes, keep_dim=False)
+        norm = L.sqrt(norm_sq)
+        eps = 1e-12
+        scale = L.elementwise_div(
+            g, L.scale(norm, scale=1.0, bias=eps))
+        if dim is None:
+            w = L.elementwise_mul(v, scale)
+        else:
+            # broadcast the per-dim scale along `dim`
+            w = L.elementwise_mul(v, scale, axis=dim)
+        return w
 
     def get_parameter(self, name):
         param = self.main_program.global_block().var(name)

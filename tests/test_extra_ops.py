@@ -367,3 +367,41 @@ def test_proximal_optimizers_l1_shrinks_weights():
         # sample correlation keeps some irrelevant weights alive; plain
         # SGD/Adagrad would leave none exactly zero)
         assert (w[2:] == 0.0).sum() >= 1, w.ravel()
+
+
+def test_weight_norm_param_attr():
+    """WeightNormParamAttr reparameterizes fc's weight as g*v/||v||:
+    after a step BOTH v and g moved, and at init the effective weight's
+    per-dim norms equal g (=1)."""
+    rng = np.random.RandomState(0)
+    xv = rng.rand(8, 4).astype('float32')
+    yv = xv.sum(1, keepdims=True)
+    prog, startup = Program(), Program()
+    prog.random_seed = startup.random_seed = 3
+    with program_guard(prog, startup):
+        x = fluid.layers.data(name='x', shape=[4], dtype='float32')
+        y = fluid.layers.data(name='y', shape=[1], dtype='float32')
+        pred = fluid.layers.fc(
+            input=x, size=3,
+            param_attr=fluid.WeightNormParamAttr(dim=1, name='wn'))
+        loss = fluid.layers.mean(
+            fluid.layers.square_error_cost(pred, y))
+        fluid.optimizer.SGD(0.1).minimize(loss)
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        v0 = np.asarray(scope.find_var('wn.wn.v')).copy()
+        g0 = np.asarray(scope.find_var('wn.wn.g')).copy()
+        np.testing.assert_allclose(g0, 1.0)
+        l0 = None
+        for _ in range(30):
+            l, = exe.run(prog, feed={'x': xv, 'y': yv},
+                         fetch_list=[loss])
+            if l0 is None:
+                l0 = float(np.asarray(l))
+        v1 = np.asarray(scope.find_var('wn.wn.v'))
+        g1 = np.asarray(scope.find_var('wn.wn.g'))
+    assert float(np.asarray(l)) < 0.2 * l0
+    assert not np.allclose(v1, v0)      # both halves trained
+    assert not np.allclose(g1, g0)
