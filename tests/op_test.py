@@ -81,9 +81,11 @@ class OpTest(object):
 
     def check_grad(self, inputs_to_check, output_names=None,
                    max_relative_error=0.005, numeric_delta=5e-3,
-                   no_grad_set=None):
-        """Analytic grads (via backward ops) vs central finite differences of
-        a scalar objective sum(outputs)."""
+                   no_grad_set=None, objective='sum'):
+        """Analytic grads (via backward ops) vs central finite differences
+        of a scalar objective over the outputs. objective='sum' (default)
+        or 'sumsq' — sumsq for ops whose output-sum is degenerate (batch
+        norm: the normalized values sum to a constant)."""
         if output_names is None:
             output_names = []
             for slot, value in self.outputs.items():
@@ -97,8 +99,18 @@ class OpTest(object):
             # scalar objective: sum over every checked output
             partials = []
             for n in output_names:
-                s = block.create_var(name=n + '@SUM', dtype='float32')
-                block.append_op(type='reduce_sum', inputs={'X': [n]},
+                src_name = n
+                if objective == 'sumsq':
+                    block.create_var(name=n + '@SQ', dtype='float32')
+                    block.append_op(
+                        type='elementwise_mul',
+                        inputs={'X': [n], 'Y': [n]},
+                        outputs={'Out': [n + '@SQ']},
+                        attrs={'axis': -1})
+                    src_name = n + '@SQ'
+                block.create_var(name=n + '@SUM', dtype='float32')
+                block.append_op(type='reduce_sum',
+                                inputs={'X': [src_name]},
                                 outputs={'Out': [n + '@SUM']},
                                 attrs={'reduce_all': True, 'dim': [0],
                                        'keep_dim': False})
