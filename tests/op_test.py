@@ -93,6 +93,7 @@ class OpTest(object):
         elif isinstance(output_names, str):
             output_names = [output_names]
 
+        assert objective in ('sum', 'sumsq'), objective
         prog, startup, feed, op_in, _op_out = self._build()
         with program_guard(prog, startup):
             block = prog.global_block()

@@ -1,0 +1,49 @@
+"""Profiler (paddle_tpu/profiler.py; reference platform/profiler.cc
+RecordEvent + tools/timeline.py chrome trace): scoped events captured
+around Executor runs, summary aggregation, chrome://tracing JSON out."""
+import json
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import profiler
+from paddle_tpu.framework import Program, program_guard
+
+
+def test_profiler_records_and_writes_chrome_trace(tmp_path):
+    prog, startup = Program(), Program()
+    with program_guard(prog, startup):
+        x = fluid.layers.data(name='x', shape=[8], dtype='float32')
+        loss = fluid.layers.mean(fluid.layers.fc(input=x, size=4))
+        fluid.optimizer.SGD(0.1).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    path = str(tmp_path / 'trace')
+    xv = np.random.RandomState(0).rand(4, 8).astype('float32')
+    with profiler.profiler(state='All', profile_path=path):
+        for _ in range(3):
+            with profiler.RecordEvent('train_step'):
+                exe.run(prog, feed={'x': xv}, fetch_list=[loss])
+    trace = json.load(open(path))
+    events = trace['traceEvents'] if isinstance(trace, dict) else trace
+    names = {e.get('name') for e in events if isinstance(e, dict)}
+    assert 'train_step' in names
+    durs = [e for e in events if isinstance(e, dict)
+            and e.get('name') == 'train_step' and e.get('ph') == 'X']
+    assert len(durs) == 3
+    assert all(e['dur'] >= 0 for e in durs)
+
+
+def test_record_event_nesting_and_reset():
+    profiler.reset_profiler()
+    profiler.start_profiler('All')
+    try:
+        with profiler.RecordEvent('outer'):
+            with profiler.RecordEvent('inner'):
+                pass
+        names = [e[0] for e in profiler._events]
+        assert 'outer' in names and 'inner' in names
+        profiler.reset_profiler()
+        assert not profiler._events
+    finally:
+        profiler._enabled = False
