@@ -38,6 +38,15 @@ def build(mode):
                                                     row_parallel_fc)
             h = column_parallel_fc(x, 16, act='relu')
             pred = row_parallel_fc(h, 1)
+        elif mode == 'sp':
+            # ring attention with the sp axis spanning processes: the
+            # K/V ppermute ring crosses the trainer boundary every step
+            from paddle_tpu.parallel.layers import ring_attention
+            h = fluid.layers.fc(input=x, size=16, act='relu')
+            q = fluid.layers.reshape(h, shape=[-1, 1, 8, 2])  # [B,1,T=8,2]
+            att = ring_attention(q, q, q, causal=True)
+            flat = fluid.layers.reshape(att, shape=[-1, 16])
+            pred = fluid.layers.fc(input=flat, size=1)
         else:
             h = fluid.layers.fc(input=x, size=16, act='relu')
             pred = fluid.layers.fc(input=h, size=1)
@@ -73,6 +82,11 @@ def main():
         from paddle_tpu.parallel import DistributedStrategy
         n_dev = 4 * max(num_trainers, 1)   # 4 forced local devices each
         kwargs['strategy'] = DistributedStrategy(dp=n_dev // 2, tp=2)
+    elif mode == 'sp':
+        from paddle_tpu.parallel import DistributedStrategy
+        n_dev = 4 * max(num_trainers, 1)
+        sp = min(n_dev, 8)                 # T=8 must divide by sp
+        kwargs['strategy'] = DistributedStrategy(dp=n_dev // sp, sp=sp)
 
     pe = fluid.ParallelExecutor(use_cuda=False, loss_name=loss.name,
                                 main_program=prog, scope=scope,
@@ -82,11 +96,20 @@ def main():
         exe.run(startup, scope=scope)
 
     losses = []
-    per = GLOBAL_BATCH // num_trainers
+    from paddle_tpu.parallel import distributed as dist
     for xv, yv in batches():
-        lo, hi = trainer_id * per, (trainer_id + 1) * per
-        l, = pe.run(fetch_list=[loss.name],
-                    feed={'x': xv[lo:hi], 'y': yv[lo:hi]})
+        if num_trainers > 1 and 'dp' in pe.mesh.axis_names:
+            # this process's rows of the global batch, derived from the
+            # mesh's device->process mapping along 'dp' (NOT trainer_id
+            # arithmetic: under dp x sp meshes several trainers share a
+            # dp row and must feed identical rows)
+            xl = dist.shard_rows_for_process(xv, pe.mesh, 'dp')
+            yl = dist.shard_rows_for_process(yv, pe.mesh, 'dp')
+        else:
+            # dp==1 (dropped from the mesh): batch fully replicated,
+            # every trainer feeds the whole global batch
+            xl, yl = xv, yv
+        l, = pe.run(fetch_list=[loss.name], feed={'x': xl, 'y': yl})
         losses.append(float(np.asarray(l)))
     print('LOSSES ' + json.dumps(losses), flush=True)
 

@@ -83,6 +83,20 @@ def test_four_trainers_zero1_match_single():
 
 
 @pytest.mark.timeout(600)
+def test_four_trainers_ring_attention_match_single():
+    """Multi-host x sequence parallelism: ring attention with the sp
+    axis spanning 4 processes — the K/V ppermute collective crosses the
+    trainer boundary on every ring step. Exact attention => losses match
+    the single-process run."""
+    single = _run_workers(1, mode='sp')[0]
+    four = _run_workers(4, mode='sp')
+    for other in four[1:]:
+        np.testing.assert_allclose(four[0], other, rtol=1e-6)
+    np.testing.assert_allclose(single, four[0], rtol=1e-4)
+    assert four[0][-1] < four[0][0]
+
+
+@pytest.mark.timeout(600)
 def test_four_trainers_tp_match_single():
     """Multi-host x tensor parallelism: dp(8) x tp(2) mesh over 4
     processes x 4 local devices; the Megatron row-parallel psum crosses
