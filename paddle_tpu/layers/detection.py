@@ -8,7 +8,8 @@ from __future__ import annotations
 from ..layer_helper import LayerHelper
 
 __all__ = ['prior_box', 'box_coder', 'iou_similarity', 'multiclass_nms',
-           'detection_output']
+           'detection_output', 'bipartite_match', 'target_assign',
+           'anchor_generator', 'ssd_loss']
 
 
 def prior_box(input, image, min_sizes, max_sizes=None,
@@ -93,3 +94,82 @@ def detection_output(loc, scores, prior_box, prior_box_var,
         nms_top_k=nms_top_k, keep_top_k=keep_top_k,
         nms_threshold=nms_threshold, background_label=background_label)
     return out, count
+
+
+def bipartite_match(dist_matrix, match_type='bipartite',
+                    dist_threshold=0.5, name=None):
+    """(reference detection.py:392) Greedy max matching of rows (ground
+    truths) to columns (priors); -1 for unmatched columns."""
+    helper = LayerHelper('bipartite_match', name=name)
+    idx = helper.create_variable_for_type_inference('int32')
+    dist = helper.create_variable_for_type_inference(dist_matrix.dtype)
+    helper.append_op(type='bipartite_match',
+                     inputs={'DistMat': [dist_matrix]},
+                     outputs={'ColToRowMatchIndices': [idx],
+                              'ColToRowMatchDist': [dist]},
+                     attrs={'match_type': match_type or 'bipartite',
+                            'dist_threshold': dist_threshold})
+    idx.stop_gradient = True
+    dist.stop_gradient = True
+    return idx, dist
+
+
+def target_assign(input, matched_indices, mismatch_value=0, name=None):
+    """(reference target_assign_op) Gather per-prior targets by match
+    indices; weight 0 where unmatched."""
+    helper = LayerHelper('target_assign', name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    w = helper.create_variable_for_type_inference('float32')
+    helper.append_op(type='target_assign',
+                     inputs={'X': [input],
+                             'MatchIndices': [matched_indices]},
+                     outputs={'Out': [out], 'OutWeight': [w]},
+                     attrs={'mismatch_value': mismatch_value})
+    return out, w
+
+
+def anchor_generator(input, anchor_sizes, aspect_ratios,
+                     variance=(0.1, 0.1, 0.2, 0.2), stride=(16.0, 16.0),
+                     offset=0.5, name=None):
+    """(reference anchor_generator_op) Absolute-pixel anchors."""
+    helper = LayerHelper('anchor_generator', name=name)
+    anchors = helper.create_variable_for_type_inference('float32')
+    var = helper.create_variable_for_type_inference('float32')
+    helper.append_op(type='anchor_generator', inputs={'Input': [input]},
+                     outputs={'Anchors': [anchors], 'Variances': [var]},
+                     attrs={'anchor_sizes': list(anchor_sizes),
+                            'aspect_ratios': list(aspect_ratios),
+                            'variances': list(variance),
+                            'stride': list(stride), 'offset': offset})
+    anchors.stop_gradient = True
+    var.stop_gradient = True
+    return anchors, var
+
+
+def ssd_loss(location, confidence, gt_box, gt_label, prior_box,
+             prior_box_var=None, background_label=0,
+             overlap_threshold=0.5, neg_pos_ratio=3.0,
+             loc_loss_weight=1.0, conf_loss_weight=1.0, normalize=True,
+             name=None):
+    """(reference detection.py:563) SSD multibox loss: bipartite +
+    per-prediction matching, hard negative mining at neg_pos_ratio,
+    smooth-l1 localization + softmax confidence losses, normalized by
+    the matched count. Static-shape contract: gt_box [B, G, 4] and
+    gt_label [B, G] padded with label -1 (the LoD gt lists of the
+    reference become fixed-G padded batches). Returns [B, 1]."""
+    helper = LayerHelper('ssd_loss', name=name)
+    out = helper.create_variable_for_type_inference('float32')
+    inputs = {'Location': [location], 'Confidence': [confidence],
+              'GtBox': [gt_box], 'GtLabel': [gt_label],
+              'PriorBox': [prior_box]}
+    if prior_box_var is not None:
+        inputs['PriorBoxVar'] = [prior_box_var]
+    helper.append_op(type='ssd_loss', inputs=inputs,
+                     outputs={'Loss': [out]},
+                     attrs={'background_label': background_label,
+                            'overlap_threshold': overlap_threshold,
+                            'neg_pos_ratio': neg_pos_ratio,
+                            'loc_loss_weight': loc_loss_weight,
+                            'conf_loss_weight': conf_loss_weight,
+                            'normalize': normalize})
+    return out

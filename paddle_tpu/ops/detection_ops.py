@@ -296,3 +296,274 @@ def _nms_infer(op, block):
 
 
 register_op('multiclass_nms', infer_shape=_nms_infer)
+
+
+# ---------------------------------------------------------------------------
+# bipartite_match (reference bipartite_match_op.cc): greedy max matching
+# rows (ground truths) to columns (priors)
+# ---------------------------------------------------------------------------
+
+_MATCH_NEG = -1e9
+
+
+def _per_prediction_topup(d, c2r, cdist, thresh):
+    """Columns still unmatched take their argmax row if above the
+    threshold (SSD's per-prediction matching); rows masked to
+    _MATCH_NEG never win."""
+    best_row = jnp.argmax(d, axis=0).astype(jnp.int32)
+    best_val = jnp.max(d, axis=0)
+    extra = (c2r < 0) & (best_val >= thresh)
+    return (jnp.where(extra, best_row, c2r),
+            jnp.where(extra, best_val, cdist))
+
+
+def _bipartite_match_single(dist):
+    """dist: [N, M] (N ground truths x M priors). Returns
+    (col_to_row [M] int32, col_dist [M]); unmatched columns -1/0.
+
+    Phase 1 (bipartite): N greedy rounds pick the global argmax entry,
+    then retire its row and column — the reference's matching.
+    Phase 2 (per_prediction top-up, applied by the caller via
+    dist_threshold): every still-unmatched column takes its argmax row
+    if above threshold.
+    """
+    N, M = dist.shape
+    NEG = _MATCH_NEG
+
+    def body(_, state):
+        d, c2r, cdist = state
+        flat = jnp.argmax(d)
+        r, c = flat // M, flat % M
+        best = d[r, c]
+        take = best > NEG / 2
+        c2r = c2r.at[c].set(jnp.where(take, r, c2r[c]))
+        cdist = cdist.at[c].set(jnp.where(take, best, cdist[c]))
+        d = jnp.where(take, d.at[r, :].set(NEG).at[:, c].set(NEG), d)
+        return d, c2r, cdist
+
+    c2r0 = jnp.full((M,), -1, jnp.int32)
+    cd0 = jnp.zeros((M,), dist.dtype)
+    _, c2r, cdist = jax.lax.fori_loop(
+        0, N, body, (dist.astype(jnp.float32), c2r0, cd0))
+    return c2r, cdist
+
+
+@op_emitter('bipartite_match')
+def _bipartite_match_emit(ctx, op):
+    dist = ctx.get(op.single_input('DistMat'))     # [B, N, M] or [N, M]
+    match_type = op.attr('match_type', 'bipartite')
+    thresh = op.attr('dist_threshold', 0.5)
+    batched = dist.ndim == 3
+    d3 = dist if batched else dist[None]
+
+    def one(d):
+        c2r, cdist = _bipartite_match_single(d)
+        if match_type == 'per_prediction':
+            c2r, cdist = _per_prediction_topup(d, c2r, cdist, thresh)
+        return c2r, cdist
+
+    c2r, cdist = jax.vmap(one)(d3)
+    if not batched:
+        c2r, cdist = c2r[0], cdist[0]
+    ctx.set(op.single_output('ColToRowMatchIndices'), c2r)
+    ctx.set(op.single_output('ColToRowMatchDist'), cdist)
+
+
+def _bipartite_infer(op, block):
+    d = block.var_recursive(op.single_input('DistMat'))
+    shape = [d.shape[0], d.shape[-1]] if len(d.shape) == 3 \
+        else [d.shape[-1]]
+    idx = block.var_recursive(op.single_output('ColToRowMatchIndices'))
+    idx.shape = shape
+    idx.dtype = 'int32'
+    dv = block.var_recursive(op.single_output('ColToRowMatchDist'))
+    dv.shape = shape
+    dv.dtype = d.dtype
+
+
+register_op('bipartite_match', infer_shape=_bipartite_infer,
+            no_grad=True)
+
+
+# ---------------------------------------------------------------------------
+# target_assign (reference target_assign_op.cc): gather per-prior targets
+# by match indices, weight 0 where unmatched
+# ---------------------------------------------------------------------------
+
+@op_emitter('target_assign')
+def _target_assign_emit(ctx, op):
+    x = ctx.get(op.single_input('X'))              # [B, N, K] row data
+    match = ctx.get(op.single_input('MatchIndices'))  # [B, M]
+    mismatch_value = op.attr('mismatch_value', 0)
+    gathered = jnp.take_along_axis(
+        x, jnp.maximum(match, 0)[..., None], axis=1)
+    matched = (match >= 0)[..., None]
+    out = jnp.where(matched, gathered,
+                    jnp.asarray(mismatch_value, x.dtype))
+    ctx.set(op.single_output('Out'), out)
+    ctx.set(op.single_output('OutWeight'),
+            matched.astype(jnp.float32))
+
+
+def _target_assign_infer(op, block):
+    x = block.var_recursive(op.single_input('X'))
+    m = block.var_recursive(op.single_input('MatchIndices'))
+    out = block.var_recursive(op.single_output('Out'))
+    out.shape = [m.shape[0], m.shape[1], x.shape[-1]]
+    out.dtype = x.dtype
+    w = block.var_recursive(op.single_output('OutWeight'))
+    w.shape = [m.shape[0], m.shape[1], 1]
+    w.dtype = 'float32'
+
+
+register_op('target_assign', infer_shape=_target_assign_infer,
+            no_grad=True)
+
+
+# ---------------------------------------------------------------------------
+# anchor_generator (reference anchor_generator_op.cc): absolute-pixel
+# anchors from sizes x ratios at each feature cell
+# ---------------------------------------------------------------------------
+
+def _anchors_np(h, w, sizes, ratios, stride, offset):
+    whs = []
+    for r in ratios:
+        for s in sizes:
+            area = s * s
+            bw = np.sqrt(area / r)
+            bh = bw * r
+            whs.append((bw, bh))
+    cx = (np.arange(w) + offset) * stride[0]
+    cy = (np.arange(h) + offset) * stride[1]
+    cxg, cyg = np.meshgrid(cx, cy)
+    out = np.zeros((h, w, len(whs), 4), np.float32)
+    for k, (bw, bh) in enumerate(whs):
+        out[:, :, k] = np.stack([cxg - bw / 2., cyg - bh / 2.,
+                                 cxg + bw / 2., cyg + bh / 2.], -1)
+    return out
+
+
+@op_emitter('anchor_generator')
+def _anchor_generator_emit(ctx, op):
+    feat = ctx.get(op.single_input('Input'))
+    h, w = feat.shape[2], feat.shape[3]
+    anchors = _anchors_np(h, w, op.attr('anchor_sizes'),
+                          op.attr('aspect_ratios'),
+                          op.attr('stride'), op.attr('offset', 0.5))
+    var = np.tile(np.asarray(op.attr('variances',
+                                     [0.1, 0.1, 0.2, 0.2]), np.float32),
+                  anchors.shape[:3] + (1,))
+    ctx.set(op.single_output('Anchors'), jnp.asarray(anchors))
+    ctx.set(op.single_output('Variances'), jnp.asarray(var))
+
+
+def _anchor_generator_infer(op, block):
+    feat = block.var_recursive(op.single_input('Input'))
+    n = len(op.attr('anchor_sizes')) * len(op.attr('aspect_ratios'))
+    for slot in ('Anchors', 'Variances'):
+        v = block.var_recursive(op.single_output(slot))
+        v.shape = [feat.shape[2], feat.shape[3], n, 4]
+        v.dtype = 'float32'
+
+
+register_op('anchor_generator', infer_shape=_anchor_generator_infer)
+
+
+# ---------------------------------------------------------------------------
+# ssd_loss (reference detection.py:563 composite + mine_hard_examples_op):
+# match -> targets -> hard negative mining -> smooth-l1 + softmax CE
+# ---------------------------------------------------------------------------
+
+@op_emitter('ssd_loss')
+def _ssd_loss_emit(ctx, op):
+    loc = ctx.get(op.single_input('Location'))       # [B, M, 4]
+    conf = ctx.get(op.single_input('Confidence'))    # [B, M, C]
+    gt_box = ctx.get(op.single_input('GtBox'))       # [B, G, 4]
+    gt_label = ctx.get(op.single_input('GtLabel'))   # [B, G] (-1 pad)
+    prior = ctx.get(op.single_input('PriorBox')).reshape(-1, 4)
+    pvar = None
+    if op.input('PriorBoxVar'):
+        pvar = ctx.get(op.single_input('PriorBoxVar')).reshape(-1, 4)
+    background = op.attr('background_label', 0)
+    overlap_t = op.attr('overlap_threshold', 0.5)
+    neg_ratio = op.attr('neg_pos_ratio', 3.0)
+    loc_w = op.attr('loc_loss_weight', 1.0)
+    conf_w = op.attr('conf_loss_weight', 1.0)
+    normalize = op.attr('normalize', True)
+    M = prior.shape[0]
+    gt_label = gt_label.reshape(gt_label.shape[0], -1)
+
+    if pvar is None:
+        pvar = jnp.full_like(prior, 1.0)
+    pw = prior[:, 2] - prior[:, 0]
+    ph = prior[:, 3] - prior[:, 1]
+    pcx = prior[:, 0] + pw * 0.5
+    pcy = prior[:, 1] + ph * 0.5
+
+    def per_image(loc_i, conf_i, gts, labels):
+        valid_gt = labels >= 0
+        iou = _iou_matrix(gts, prior)                # [G, M]
+        # padded gt rows masked to the match sentinel: -1.0 would still
+        # win the greedy loop and turn padding into spurious positives
+        iou = jnp.where(valid_gt[:, None], iou, _MATCH_NEG)
+        c2r, cdist = _bipartite_match_single(iou)
+        c2r, _ = _per_prediction_topup(iou, c2r, cdist, overlap_t)
+        matched = c2r >= 0
+        safe = jnp.maximum(c2r, 0)
+
+        # conf targets + CE loss
+        tgt_label = jnp.where(matched, labels[safe], background)
+        logp = jax.nn.log_softmax(conf_i.astype(jnp.float32), axis=-1)
+        ce = -jnp.take_along_axis(logp, tgt_label[:, None],
+                                  axis=1)[:, 0]     # [M]
+
+        # hard negative mining: keep the neg_ratio*npos worst negatives
+        npos = jnp.sum(matched)
+        n_neg = jnp.minimum((neg_ratio * npos).astype(jnp.int32),
+                            M - npos)
+        neg_ce = jnp.where(matched, -jnp.inf, ce)
+        order = jnp.argsort(-neg_ce)
+        rank = jnp.zeros((M,), jnp.int32).at[order].set(jnp.arange(M))
+        neg_keep = (~matched) & (rank < n_neg)
+        conf_loss = jnp.sum(jnp.where(matched | neg_keep, ce, 0.0))
+
+        # loc targets: encode matched gts against priors, smooth-l1
+        g = gts[safe]
+        gw = g[:, 2] - g[:, 0]
+        gh = g[:, 3] - g[:, 1]
+        gcx = g[:, 0] + gw * 0.5
+        gcy = g[:, 1] + gh * 0.5
+        eps = 1e-8
+        tgt = jnp.stack([
+            (gcx - pcx) / jnp.maximum(pw, eps) / pvar[:, 0],
+            (gcy - pcy) / jnp.maximum(ph, eps) / pvar[:, 1],
+            jnp.log(jnp.maximum(gw, eps)
+                    / jnp.maximum(pw, eps)) / pvar[:, 2],
+            jnp.log(jnp.maximum(gh, eps)
+                    / jnp.maximum(ph, eps)) / pvar[:, 3]], axis=-1)
+        d = loc_i.astype(jnp.float32) - tgt
+        ad = jnp.abs(d)
+        sl1 = jnp.where(ad < 1.0, 0.5 * d * d, ad - 0.5).sum(-1)
+        loc_loss = jnp.sum(jnp.where(matched, sl1, 0.0))
+
+        total = loc_w * loc_loss + conf_w * conf_loss
+        if normalize:
+            total = total / jnp.maximum(npos.astype(jnp.float32), 1.0)
+        return total
+
+    loss = jax.vmap(per_image)(loc, conf, gt_box, gt_label)
+    ctx.set(op.single_output('Loss'), loss[:, None])
+
+
+def _ssd_loss_infer(op, block):
+    loc = block.var_recursive(op.single_input('Location'))
+    out = block.var_recursive(op.single_output('Loss'))
+    out.shape = [loc.shape[0], 1]
+    out.dtype = 'float32'
+
+
+register_op('ssd_loss', infer_shape=_ssd_loss_infer)
+register_vjp_grad('ssd_loss', in_slots=('Location', 'Confidence'),
+                  out_slots=('Loss',),
+                  nondiff_slots=('GtBox', 'GtLabel', 'PriorBox',
+                                 'PriorBoxVar'))

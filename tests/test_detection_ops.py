@@ -158,3 +158,160 @@ def test_detection_output_end_to_end():
         kept = out[b][out[b, :, 0] >= 0]
         assert len(kept) == count[b]
         assert ((kept[:, 1] >= 0.2) | (kept[:, 1] == -1)).all()
+
+
+def test_bipartite_match_greedy():
+    # gt0 prefers prior1, gt1's best remaining is prior0
+    dist = np.array([[[0.2, 0.9, 0.1],
+                      [0.6, 0.8, 0.05]]], 'float32')
+
+    def build():
+        d = fluid.layers.data(name='d', shape=[2, 3], dtype='float32')
+        idx, dv = fluid.layers.bipartite_match(d)
+        return [idx, dv]
+    idx, dv = _run(build, {'d': dist})
+    # gt0 takes prior1 (0.9 global max), gt1 takes prior0 (0.6)
+    np.testing.assert_array_equal(idx[0], [1, 0, -1])
+    np.testing.assert_allclose(dv[0], [0.6, 0.9, 0.0], atol=1e-6)
+
+
+def test_bipartite_match_per_prediction_topup():
+    dist = np.array([[[0.9, 0.7, 0.2]]], 'float32')   # one gt, 3 priors
+
+    def build():
+        d = fluid.layers.data(name='d', shape=[1, 3], dtype='float32')
+        idx, _ = fluid.layers.bipartite_match(
+            d, match_type='per_prediction', dist_threshold=0.5)
+        return [idx]
+    idx, = _run(build, {'d': dist})
+    # bipartite assigns prior0; per-prediction tops up prior1 (0.7>=0.5)
+    np.testing.assert_array_equal(idx[0], [0, 0, -1])
+
+
+def test_target_assign():
+    x = np.arange(12, dtype='float32').reshape(1, 3, 4)   # 3 gt rows
+    match = np.array([[1, -1, 0, 2]], 'int32')            # 4 priors
+
+    def build():
+        xv = fluid.layers.data(name='x', shape=[3, 4], dtype='float32')
+        mv = fluid.layers.data(name='m', shape=[4], dtype='int32')
+        out, w = fluid.layers.target_assign(xv, mv, mismatch_value=-7)
+        return [out, w]
+    out, w = _run(build, {'x': x, 'm': match})
+    np.testing.assert_allclose(out[0, 0], x[0, 1])
+    np.testing.assert_allclose(out[0, 1], -7.0)
+    np.testing.assert_allclose(out[0, 3], x[0, 2])
+    np.testing.assert_array_equal(w[0, :, 0], [1, 0, 1, 1])
+
+
+def test_anchor_generator():
+    def build():
+        feat = fluid.layers.data(name='f', shape=[8, 2, 2],
+                                 dtype='float32')
+        anchors, var = fluid.layers.anchor_generator(
+            feat, anchor_sizes=[32.0, 64.0], aspect_ratios=[1.0, 2.0],
+            stride=[16.0, 16.0])
+        return [anchors, var]
+    a, v = _run(build, {'f': np.zeros((1, 8, 2, 2), 'float32')})
+    assert a.shape == (2, 2, 4, 4) and v.shape == a.shape
+    # ratio-1 size-32 anchor at cell (0,0): centered (8,8), 32x32
+    np.testing.assert_allclose(a[0, 0, 0], [-8, -8, 24, 24], atol=1e-4)
+    # areas ~ size^2 for every anchor
+    ws, hs = a[..., 2] - a[..., 0], a[..., 3] - a[..., 1]
+    np.testing.assert_allclose(
+        np.sort(np.unique((ws * hs).round(1))), [1024.0, 4096.0])
+
+
+def test_ssd_loss_trains_detection_head():
+    """A tiny SSD head on synthetic scenes: one fixed-position object
+    per image; ssd_loss must train loc+conf to recover it through
+    detection_output."""
+    from paddle_tpu.framework import Program, program_guard
+    rng = np.random.RandomState(0)
+    B, M, C = 8, 16, 3
+    # priors: a 4x4 grid of 0.25-sized boxes
+    gx, gy = np.meshgrid(np.arange(4), np.arange(4))
+    p0 = np.stack([gx.ravel() * 0.25, gy.ravel() * 0.25,
+                   gx.ravel() * 0.25 + 0.25, gy.ravel() * 0.25 + 0.25],
+                  -1).astype('float32')
+    pvar = np.full((M, 4), 0.1, 'float32')
+
+    def scene(rs):
+        cell = rs.randint(0, M)
+        label = rs.randint(1, C)
+        box = p0[cell] + rs.uniform(-0.02, 0.02, 4).astype('float32')
+        feat = np.zeros((M,), 'float32')
+        feat[cell] = label                     # trivially learnable cue
+        return feat, box, label
+
+    feats = np.zeros((64, M), 'float32')
+    gtb = np.zeros((64, 1, 4), 'float32')
+    gtl = np.zeros((64, 1), 'int64')
+    for i in range(64):
+        feats[i], gtb[i, 0], gtl[i, 0] = scene(rng)
+
+    prog, startup = Program(), Program()
+    prog.random_seed = startup.random_seed = 3
+    with program_guard(prog, startup):
+        f = fluid.layers.data(name='f', shape=[M], dtype='float32')
+        gb = fluid.layers.data(name='gb', shape=[1, 4], dtype='float32')
+        gl = fluid.layers.data(name='gl', shape=[1], dtype='int64')
+        h = fluid.layers.fc(input=f, size=64, act='relu')
+        loc = fluid.layers.reshape(
+            fluid.layers.fc(input=h, size=M * 4), shape=[-1, M, 4])
+        conf = fluid.layers.reshape(
+            fluid.layers.fc(input=h, size=M * C), shape=[-1, M, C])
+        pb = fluid.layers.assign(p0)
+        pv = fluid.layers.assign(pvar)
+        loss = fluid.layers.mean(fluid.layers.ssd_loss(
+            loc, conf, gb, gl, pb, pv))
+        fluid.optimizer.Adam(0.005).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    first = last = None
+    for i in range(150):
+        s = slice((i * B) % 64, (i * B) % 64 + B)
+        l, = exe.run(prog, feed={'f': feats[s], 'gb': gtb[s],
+                                 'gl': gtl[s]}, fetch_list=[loss])
+        if first is None:
+            first = float(np.asarray(l))
+        last = float(np.asarray(l))
+    assert np.isfinite(last) and last < 0.35 * first, (first, last)
+
+
+def test_ssd_loss_ignores_padded_gt_rows():
+    """Padded gt rows (label -1) must NOT match priors: a batch where
+    image 0 has one object (+ padding) and image 1 has none must yield
+    finite loss with no spurious positives (loss of the empty image is
+    0: no positives, no mined negatives)."""
+    M, C, G = 4, 3, 3
+    p0 = np.array([[0, 0, .5, .5], [.5, 0, 1, .5],
+                   [0, .5, .5, 1], [.5, .5, 1, 1]], 'float32')
+    gtb = np.zeros((2, G, 4), 'float32')
+    gtl = np.full((2, G), -1, 'int64')
+    gtb[0, 0] = [0.02, 0.02, 0.48, 0.49]
+    gtl[0, 0] = 1
+
+    prog, startup = Program(), Program()
+    with program_guard(prog, startup):
+        loc = fluid.layers.data(name='loc', shape=[M, 4],
+                                dtype='float32')
+        conf = fluid.layers.data(name='conf', shape=[M, C],
+                                 dtype='float32')
+        gb = fluid.layers.data(name='gb', shape=[G, 4], dtype='float32')
+        gl = fluid.layers.data(name='gl', shape=[G], dtype='int64')
+        pb = fluid.layers.assign(p0)
+        loss = fluid.layers.ssd_loss(loc, conf, gb, gl, pb,
+                                     neg_pos_ratio=0.0)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    # confidence: uniform logits -> each matched prior costs log(C)
+    l, = exe.run(prog, feed={'loc': np.zeros((2, M, 4), 'float32'),
+                             'conf': np.zeros((2, M, C), 'float32'),
+                             'gb': gtb, 'gl': gtl},
+                 fetch_list=[loss])
+    l = np.asarray(l).ravel()
+    # image 0: exactly ONE matched prior -> conf cost log(3) + tiny loc
+    assert abs(l[0] - np.log(3)) < 0.1, l
+    # image 1: no objects -> zero loss (padding contributed nothing)
+    assert l[1] == 0.0, l
