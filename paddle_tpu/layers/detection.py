@@ -9,7 +9,7 @@ from ..layer_helper import LayerHelper
 
 __all__ = ['prior_box', 'box_coder', 'iou_similarity', 'multiclass_nms',
            'detection_output', 'bipartite_match', 'target_assign',
-           'anchor_generator', 'ssd_loss']
+           'anchor_generator', 'ssd_loss', 'roi_align', 'roi_pool']
 
 
 def prior_box(input, image, min_sizes, max_sizes=None,
@@ -173,3 +173,38 @@ def ssd_loss(location, confidence, gt_box, gt_label, prior_box,
                             'conf_loss_weight': conf_loss_weight,
                             'normalize': normalize})
     return out
+
+
+def _roi_layer(op_type, input, rois, pooled_height, pooled_width,
+               spatial_scale, sampling_ratio, rois_batch_idx, name):
+    helper = LayerHelper(op_type, name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    inputs = {'X': [input], 'ROIs': [rois]}
+    if rois_batch_idx is not None:
+        inputs['RoisBatchIdx'] = [rois_batch_idx]
+    helper.append_op(type=op_type, inputs=inputs,
+                     outputs={'Out': [out]},
+                     attrs={'pooled_height': pooled_height,
+                            'pooled_width': pooled_width,
+                            'spatial_scale': spatial_scale,
+                            'sampling_ratio': sampling_ratio})
+    return out
+
+
+def roi_align(input, rois, pooled_height=1, pooled_width=1,
+              spatial_scale=1.0, sampling_ratio=-1,
+              rois_batch_idx=None, name=None):
+    """(reference roi_align_op) Bilinear region features [R, C, ph, pw].
+    rois: [R, 4] in input-image coordinates; rois_batch_idx: [R] image
+    index per roi (the reference's LoD roi batching, made explicit)."""
+    return _roi_layer('roi_align', input, rois, pooled_height,
+                      pooled_width, spatial_scale, sampling_ratio,
+                      rois_batch_idx, name)
+
+
+def roi_pool(input, rois, pooled_height=1, pooled_width=1,
+             spatial_scale=1.0, rois_batch_idx=None, name=None):
+    """(reference roi_pool_op) Max-pooled region features."""
+    return _roi_layer('roi_pool', input, rois, pooled_height,
+                      pooled_width, spatial_scale, 1, rois_batch_idx,
+                      name)

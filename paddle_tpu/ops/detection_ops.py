@@ -567,3 +567,128 @@ register_vjp_grad('ssd_loss', in_slots=('Location', 'Confidence'),
                   out_slots=('Loss',),
                   nondiff_slots=('GtBox', 'GtLabel', 'PriorBox',
                                  'PriorBoxVar'))
+
+
+# ---------------------------------------------------------------------------
+# roi_pool / roi_align (reference roi_pool_op.cc, roi_align_op.cc):
+# fixed-size region features — static-shape bilinear/max sampling
+# ---------------------------------------------------------------------------
+
+def _roi_grid(roi, pooled_h, pooled_w, samples, spatial_scale,
+              align=True):
+    """Sample coordinates for one roi [4] -> (ys, xs) of shape
+    [pooled_h*samples], [pooled_w*samples] in feature-map space."""
+    x1, y1, x2, y2 = roi[0], roi[1], roi[2], roi[3]
+    off = 0.5 if align else 0.0
+    x1, y1 = x1 * spatial_scale - off, y1 * spatial_scale - off
+    x2, y2 = x2 * spatial_scale - off, y2 * spatial_scale - off
+    rw = jnp.maximum(x2 - x1, 1.0 if not align else 1e-3)
+    rh = jnp.maximum(y2 - y1, 1.0 if not align else 1e-3)
+    bin_h, bin_w = rh / pooled_h, rw / pooled_w
+    iy = jnp.arange(pooled_h * samples)
+    ix = jnp.arange(pooled_w * samples)
+    ys = y1 + (iy + 0.5) * bin_h / samples
+    xs = x1 + (ix + 0.5) * bin_w / samples
+    return ys, xs
+
+
+def _bilinear(feat, ys, xs):
+    """feat [C, H, W]; ys [A], xs [B] -> [C, A, B] bilinear samples.
+    Reference roi_align border handling: coordinates in [-1, H] clamp to
+    the edge pixel with full weight; only samples beyond that are zero."""
+    C, H, W = feat.shape
+    out_y = (ys < -1.0) | (ys > H)
+    out_x = (xs < -1.0) | (xs > W)
+    ys = jnp.clip(ys, 0.0, H - 1.0)
+    xs = jnp.clip(xs, 0.0, W - 1.0)
+    y0 = jnp.floor(ys).astype(jnp.int32)
+    x0 = jnp.floor(xs).astype(jnp.int32)
+    wy1 = ys - y0
+    wx1 = xs - x0
+
+    def gather(yi, xi):
+        return feat[:, jnp.clip(yi, 0, H - 1)][:, :,
+                                               jnp.clip(xi, 0, W - 1)]
+
+    s = (gather(y0, x0) * ((1 - wy1)[:, None] * (1 - wx1)[None, :])
+         + gather(y0 + 1, x0) * (wy1[:, None] * (1 - wx1)[None, :])
+         + gather(y0, x0 + 1) * ((1 - wy1)[:, None] * wx1[None, :])
+         + gather(y0 + 1, x0 + 1) * (wy1[:, None] * wx1[None, :]))
+    return jnp.where(out_y[:, None] | out_x[None, :], 0.0, s)
+
+
+def _roi_emit(ctx, op, mode):
+    x = ctx.get(op.single_input('X'))            # [N, C, H, W]
+    rois = ctx.get(op.single_input('ROIs'))      # [R, 4]
+    batch_idx = (ctx.get(op.single_input('RoisBatchIdx')).reshape(-1)
+                 if op.input('RoisBatchIdx')
+                 else jnp.zeros((rois.shape[0],), jnp.int32))
+    ph = op.attr('pooled_height')
+    pw = op.attr('pooled_width')
+    scale = op.attr('spatial_scale', 1.0)
+    # reference's sampling_ratio=-1 is ADAPTIVE (ceil(bin size)); XLA
+    # needs a static count, so -1/0 maps to a fixed 2x2 per bin — a
+    # documented deviation
+    samples = max(op.attr('sampling_ratio', 2), 2) \
+        if mode == 'align' else 1
+
+    def one(roi, bi):
+        feat = x[bi]
+        if mode == 'align':
+            ys, xs = _roi_grid(roi, ph, pw, samples, scale, align=True)
+            s = _bilinear(feat.astype(jnp.float32), ys, xs)
+            s = s.reshape(feat.shape[0], ph, samples, pw, samples)
+            return s.mean(axis=(2, 4))
+        # roi_pool: exact max over each bin's integer cells (reference
+        # roi_pool_op semantics) via static membership masks over the
+        # full H/W axes — no sub-sampling, no cross-bin leakage
+        C, H, W = feat.shape
+        x1, y1, x2, y2 = (roi[k] * scale for k in range(4))
+        rw = jnp.maximum(x2 - x1, 1.0)
+        rh = jnp.maximum(y2 - y1, 1.0)
+        bins_y = y1 + rh / ph * jnp.arange(ph + 1)
+        bins_x = x1 + rw / pw * jnp.arange(pw + 1)
+        yy = jnp.arange(H, dtype=jnp.float32)
+        xx = jnp.arange(W, dtype=jnp.float32)
+        # cell y belongs to bin i iff floor(start_i) <= y < ceil(end_i)
+        my = (yy[None, :] >= jnp.floor(bins_y[:-1])[:, None]) & \
+            (yy[None, :] < jnp.ceil(bins_y[1:])[:, None])   # [ph, H]
+        mx = (xx[None, :] >= jnp.floor(bins_x[:-1])[:, None]) & \
+            (xx[None, :] < jnp.ceil(bins_x[1:])[:, None])   # [pw, W]
+        ff = feat.astype(jnp.float32)
+        neg = jnp.float32(-3.4e38)
+        t = jnp.where(my[None, :, :, None], ff[:, None, :, :], neg)
+        t = t.max(axis=2)                                   # [C, ph, W]
+        t = jnp.where(mx[None, None, :, :], t[:, :, None, :], neg)
+        t = t.max(axis=3)                                   # [C, ph, pw]
+        return jnp.where(t <= neg / 2, 0.0, t)              # empty bins
+
+    out = jax.vmap(one)(rois, batch_idx)
+    ctx.set(op.single_output('Out'), out.astype(x.dtype))
+
+
+@op_emitter('roi_align')
+def _roi_align_emit(ctx, op):
+    _roi_emit(ctx, op, 'align')
+
+
+@op_emitter('roi_pool')
+def _roi_pool_emit(ctx, op):
+    _roi_emit(ctx, op, 'pool')
+
+
+def _roi_infer(op, block):
+    x = block.var_recursive(op.single_input('X'))
+    rois = block.var_recursive(op.single_input('ROIs'))
+    out = block.var_recursive(op.single_output('Out'))
+    out.shape = [rois.shape[0], x.shape[1],
+                 op.attr('pooled_height'), op.attr('pooled_width')]
+    out.dtype = x.dtype
+
+
+register_op('roi_align', infer_shape=_roi_infer)
+register_vjp_grad('roi_align', in_slots=('X',),
+                  nondiff_slots=('ROIs', 'RoisBatchIdx'))
+register_op('roi_pool', infer_shape=_roi_infer)
+register_vjp_grad('roi_pool', in_slots=('X',),
+                  nondiff_slots=('ROIs', 'RoisBatchIdx'))

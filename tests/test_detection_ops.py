@@ -315,3 +315,117 @@ def test_ssd_loss_ignores_padded_gt_rows():
     assert abs(l[0] - np.log(3)) < 0.1, l
     # image 1: no objects -> zero loss (padding contributed nothing)
     assert l[1] == 0.0, l
+
+
+def test_roi_align_constant_and_gradient_region():
+    """On a constant feature map roi_align returns the constant; on a
+    linear ramp it returns each bin's center value."""
+    H = W = 8
+    ramp = np.broadcast_to(np.arange(W, dtype='float32'),
+                           (1, 1, H, W)).copy()
+    rois = np.array([[0.0, 0.0, 8.0, 8.0],
+                     [2.0, 2.0, 6.0, 6.0]], 'float32')
+
+    def build():
+        x = fluid.layers.data(name='x', shape=[1, H, W],
+                              dtype='float32')
+        r = fluid.layers.data(name='r', shape=[4], dtype='float32')
+        r.shape = [2, 4]
+        out = fluid.layers.roi_align(x, r, pooled_height=2,
+                                     pooled_width=2, sampling_ratio=2)
+        return [out]
+    out, = _run(build, {'x': ramp, 'r': rois})
+    assert out.shape == (2, 1, 2, 2)
+    # x-ramp: each pooled column equals the mean x-coordinate of its
+    # bin's sample points (minus the 0.5 align offset)
+    np.testing.assert_allclose(out[0, 0, 0], [1.5, 5.5], atol=1e-4)
+    np.testing.assert_allclose(out[1, 0, 0], [2.5, 4.5], atol=1e-4)
+    # rows identical (no y dependence)
+    np.testing.assert_allclose(out[:, :, 0], out[:, :, 1], atol=1e-5)
+
+
+def test_roi_pool_takes_bin_max():
+    feat = np.zeros((1, 1, 4, 4), 'float32')
+    feat[0, 0, 0, 1] = 5.0           # in the top-left bin
+    feat[0, 0, 3, 3] = 7.0           # in the bottom-right bin
+    rois = np.array([[0.0, 0.0, 4.0, 4.0]], 'float32')
+
+    def build():
+        x = fluid.layers.data(name='x', shape=[1, 4, 4],
+                              dtype='float32')
+        r = fluid.layers.data(name='r', shape=[4], dtype='float32')
+        r.shape = [1, 4]
+        return [fluid.layers.roi_pool(x, r, pooled_height=2,
+                                      pooled_width=2)]
+    out, = _run(build, {'x': feat, 'r': rois})
+    assert out.shape == (1, 1, 2, 2)
+    assert out[0, 0, 0, 0] == 5.0    # exact max of the top-left bin
+    assert out[0, 0, 1, 1] == 7.0    # exact max of the bottom-right bin
+    assert out[0, 0, 0, 1] < 1.0 and out[0, 0, 1, 0] < 1.0
+
+
+def test_roi_align_batch_indices():
+    feat = np.zeros((2, 1, 4, 4), 'float32')
+    feat[0] = 1.0
+    feat[1] = 9.0
+    rois = np.array([[0, 0, 4, 4], [0, 0, 4, 4]], 'float32')
+    bidx = np.array([0, 1], 'int32')
+
+    def build():
+        x = fluid.layers.data(name='x', shape=[1, 4, 4],
+                              dtype='float32')
+        r = fluid.layers.data(name='r', shape=[4], dtype='float32')
+        b = fluid.layers.data(name='b', shape=[2], dtype='int32',
+                              append_batch_size=False)
+        r.shape = [2, 4]
+        return [fluid.layers.roi_align(x, r, 1, 1, rois_batch_idx=b)]
+    out, = _run(build, {'x': feat, 'r': rois, 'b': bidx})
+    np.testing.assert_allclose(out.ravel(), [1.0, 9.0], atol=1e-5)
+
+
+def test_roi_pool_exact_bins_wide_rois():
+    """Review repro cases: (a) a spike at (0,0) in an 8-px-wide bin must
+    be found (no sub-sampling misses); (b) a value in the right bin must
+    not leak into the left bin's max."""
+    feat = np.zeros((1, 1, 16, 16), 'float32')
+    feat[0, 0, 0, 0] = 100.0
+    rois = np.array([[0.0, 0.0, 16.0, 16.0]], 'float32')
+
+    def build_a():
+        x = fluid.layers.data(name='x', shape=[1, 16, 16],
+                              dtype='float32')
+        r = fluid.layers.data(name='r', shape=[4], dtype='float32')
+        r.shape = [1, 4]
+        return [fluid.layers.roi_pool(x, r, 2, 2)]
+    out, = _run(build_a, {'x': feat, 'r': rois})
+    assert out[0, 0, 0, 0] == 100.0          # spike found
+
+    feat2 = np.zeros((1, 1, 4, 4), 'float32')
+    feat2[0, 0, :, 2] = 9.0                  # column 2 = RIGHT bin
+
+    def build_b():
+        x = fluid.layers.data(name='x', shape=[1, 4, 4],
+                              dtype='float32')
+        r = fluid.layers.data(name='r', shape=[4], dtype='float32')
+        r.shape = [1, 4]
+        return [fluid.layers.roi_pool(x, r, 2, 2)]
+    out2, = _run(build_b, {'x': feat2,
+                           'r': np.array([[0, 0, 4, 4]], 'float32')})
+    assert out2[0, 0, 0, 0] == 0.0           # no cross-bin leak
+    assert out2[0, 0, 0, 1] == 9.0
+
+
+def test_roi_align_border_clamps_not_fades():
+    """Constant map + whole-image roi: every bin must read exactly the
+    constant (border samples clamp to the edge pixel, not fade to 0)."""
+    feat = np.ones((1, 1, 8, 8), 'float32')
+
+    def build():
+        x = fluid.layers.data(name='x', shape=[1, 8, 8],
+                              dtype='float32')
+        r = fluid.layers.data(name='r', shape=[4], dtype='float32')
+        r.shape = [1, 4]
+        return [fluid.layers.roi_align(x, r, 8, 8, sampling_ratio=2)]
+    out, = _run(build, {'x': feat,
+                        'r': np.array([[0, 0, 8, 8]], 'float32')})
+    np.testing.assert_allclose(np.asarray(out), 1.0, atol=1e-6)
