@@ -9,7 +9,8 @@ from ..layer_helper import LayerHelper
 
 __all__ = ['prior_box', 'box_coder', 'iou_similarity', 'multiclass_nms',
            'detection_output', 'bipartite_match', 'target_assign',
-           'anchor_generator', 'ssd_loss', 'roi_align', 'roi_pool']
+           'anchor_generator', 'ssd_loss', 'roi_align', 'roi_pool',
+           'generate_proposals', 'rpn_target_assign']
 
 
 def prior_box(input, image, min_sizes, max_sizes=None,
@@ -208,3 +209,56 @@ def roi_pool(input, rois, pooled_height=1, pooled_width=1,
     return _roi_layer('roi_pool', input, rois, pooled_height,
                       pooled_width, spatial_scale, 1, rois_batch_idx,
                       name)
+
+
+def generate_proposals(scores, bbox_deltas, im_info, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, name=None):
+    """(reference generate_proposals_op; fluid API default
+    nms_thresh=0.5) RPN proposals: decode the per-anchor deltas (clamped
+    at log(1000/16) like the reference), clip to the image, drop boxes
+    smaller than min_size * im_info scale, NMS, keep post_nms_top_n.
+    `scores` must be post-sigmoid probabilities in [0, 1]. Static shape:
+    ([N, post_n, 4], [N, post_n], counts)."""
+    helper = LayerHelper('generate_proposals', name=name)
+    rois = helper.create_variable_for_type_inference('float32')
+    probs = helper.create_variable_for_type_inference('float32')
+    num = helper.create_variable_for_type_inference('int32')
+    helper.append_op(
+        type='generate_proposals',
+        inputs={'Scores': [scores], 'BboxDeltas': [bbox_deltas],
+                'ImInfo': [im_info], 'Anchors': [anchors],
+                'Variances': [variances]},
+        outputs={'RpnRois': [rois], 'RpnRoiProbs': [probs],
+                 'RpnRoisNum': [num]},
+        attrs={'pre_nms_topN': pre_nms_top_n,
+               'post_nms_topN': post_nms_top_n,
+               'nms_thresh': nms_thresh, 'min_size': min_size})
+    for v in (rois, probs, num):
+        v.stop_gradient = True
+    return rois, probs, num
+
+
+def rpn_target_assign(anchor_box, gt_boxes, gt_valid=None,
+                      rpn_batch_size_per_im=256, rpn_fg_fraction=0.5,
+                      rpn_positive_overlap=0.7,
+                      rpn_negative_overlap=0.3, name=None):
+    """(reference rpn_target_assign_op) Label anchors fg(1)/bg(0)/
+    ignore(-1) by IoU against the gts and randomly subsample a fixed
+    minibatch; returns (labels [N, M], target_boxes [N, M, 4])."""
+    helper = LayerHelper('rpn_target_assign', name=name)
+    labels = helper.create_variable_for_type_inference('int32')
+    tgt = helper.create_variable_for_type_inference('float32')
+    inputs = {'Anchor': [anchor_box], 'GtBoxes': [gt_boxes]}
+    if gt_valid is not None:
+        inputs['GtValid'] = [gt_valid]
+    helper.append_op(
+        type='rpn_target_assign', inputs=inputs,
+        outputs={'Labels': [labels], 'TargetBBox': [tgt]},
+        attrs={'rpn_batch_size_per_im': rpn_batch_size_per_im,
+               'rpn_fg_fraction': rpn_fg_fraction,
+               'rpn_positive_overlap': rpn_positive_overlap,
+               'rpn_negative_overlap': rpn_negative_overlap})
+    labels.stop_gradient = True
+    tgt.stop_gradient = True
+    return labels, tgt

@@ -692,3 +692,165 @@ register_vjp_grad('roi_align', in_slots=('X',),
 register_op('roi_pool', infer_shape=_roi_infer)
 register_vjp_grad('roi_pool', in_slots=('X',),
                   nondiff_slots=('ROIs', 'RoisBatchIdx'))
+
+
+# ---------------------------------------------------------------------------
+# generate_proposals (reference generate_proposals_op.cc): RPN head ->
+# decoded, clipped, size-filtered, NMS'd proposal boxes (static shape)
+# ---------------------------------------------------------------------------
+
+@op_emitter('generate_proposals')
+def _generate_proposals_emit(ctx, op):
+    # Scores are PROBABILITIES in [0, 1] (post-sigmoid, the reference's
+    # contract): internal sentinels live below 0, so raw logits would
+    # be silently mis-filtered
+    scores = ctx.get(op.single_input('Scores'))       # [N, A, H, W]
+    deltas = ctx.get(op.single_input('BboxDeltas'))   # [N, 4A, H, W]
+    im_info = ctx.get(op.single_input('ImInfo'))      # [N, 3] (h, w, scale)
+    anchors = ctx.get(op.single_input('Anchors')).reshape(-1, 4)
+    variances = ctx.get(op.single_input('Variances')).reshape(-1, 4)
+    pre_n = op.attr('pre_nms_topN', 6000)
+    post_n = op.attr('post_nms_topN', 1000)
+    nms_thresh = op.attr('nms_thresh', 0.7)
+    min_size = op.attr('min_size', 0.0)
+    N, A, H, W = scores.shape
+    M = A * H * W
+    pre_n = min(pre_n, M)
+
+    aw = anchors[:, 2] - anchors[:, 0]
+    ah = anchors[:, 3] - anchors[:, 1]
+    acx = anchors[:, 0] + aw * 0.5
+    acy = anchors[:, 1] + ah * 0.5
+
+    def per_image(sc, dl, info):
+        s = sc.transpose(1, 2, 0).reshape(M)          # HWA order
+        d = dl.reshape(A, 4, H, W).transpose(2, 3, 0, 1).reshape(M, 4)
+        top_s, top_i = jax.lax.top_k(s, pre_n)
+        d = d[top_i]
+        dcx = d[:, 0] * variances[top_i, 0] * aw[top_i] + acx[top_i]
+        dcy = d[:, 1] * variances[top_i, 1] * ah[top_i] + acy[top_i]
+        # clamp like the reference's kBBoxClipDefault = log(1000/16):
+        # untrained RPN heads emit huge deltas and exp() would overflow
+        clip_v = float(np.log(1000.0 / 16.0))
+        dw = jnp.exp(jnp.minimum(d[:, 2] * variances[top_i, 2],
+                                 clip_v)) * aw[top_i]
+        dh = jnp.exp(jnp.minimum(d[:, 3] * variances[top_i, 3],
+                                 clip_v)) * ah[top_i]
+        boxes = jnp.stack([dcx - dw / 2, dcy - dh / 2,
+                           dcx + dw / 2, dcy + dh / 2], -1)
+        # clip to image
+        boxes = jnp.clip(boxes,
+                         jnp.zeros((4,)),
+                         jnp.stack([info[1], info[0],
+                                    info[1], info[0]]))
+        # reference filters at min_size * im_info scale
+        ms = min_size * info[2]
+        keep = ((boxes[:, 2] - boxes[:, 0]) >= ms) & \
+            ((boxes[:, 3] - boxes[:, 1]) >= ms)
+        masked = jnp.where(keep, top_s, -1.0)
+        ks, ki = _nms_single_class(boxes, masked, -0.5, nms_thresh,
+                                   post_n, True)
+        out_boxes = boxes[jnp.maximum(ki, 0)]
+        out_boxes = jnp.where((ks > -1.0)[:, None], out_boxes, 0.0)
+        return out_boxes, jnp.maximum(ks, 0.0), \
+            jnp.sum(ks > -1.0).astype(jnp.int32)
+
+    boxes, probs, counts = jax.vmap(per_image)(scores, deltas, im_info)
+    ctx.set(op.single_output('RpnRois'), boxes)        # [N, post_n, 4]
+    ctx.set(op.single_output('RpnRoiProbs'), probs)    # [N, post_n]
+    if op.output('RpnRoisNum'):
+        ctx.set(op.single_output('RpnRoisNum'), counts)
+
+
+def _generate_proposals_infer(op, block):
+    s = block.var_recursive(op.single_input('Scores'))
+    post_n = op.attr('post_nms_topN', 1000)
+    rois = block.var_recursive(op.single_output('RpnRois'))
+    rois.shape = [s.shape[0], post_n, 4]
+    rois.dtype = 'float32'
+    probs = block.var_recursive(op.single_output('RpnRoiProbs'))
+    probs.shape = [s.shape[0], post_n]
+    probs.dtype = 'float32'
+    if op.output('RpnRoisNum'):
+        n = block.var_recursive(op.single_output('RpnRoisNum'))
+        n.shape = [s.shape[0]]
+        n.dtype = 'int32'
+
+
+register_op('generate_proposals', infer_shape=_generate_proposals_infer,
+            no_grad=True)
+
+
+# ---------------------------------------------------------------------------
+# rpn_target_assign (reference rpn_target_assign_op.cc): label anchors
+# as fg/bg by IoU against gt, subsample to a fixed minibatch
+# ---------------------------------------------------------------------------
+
+@op_emitter('rpn_target_assign', stateful=True)
+def _rpn_target_assign_emit(ctx, op):
+    anchors = ctx.get(op.single_input('Anchor')).reshape(-1, 4)
+    gt_boxes = ctx.get(op.single_input('GtBoxes'))    # [N, G, 4]
+    gt_valid = None
+    if op.input('GtValid'):
+        gt_valid = ctx.get(op.single_input('GtValid'))  # [N, G] 0/1
+    batch_per_im = op.attr('rpn_batch_size_per_im', 256)
+    fg_frac = op.attr('rpn_fg_fraction', 0.5)
+    pos_t = op.attr('rpn_positive_overlap', 0.7)
+    neg_t = op.attr('rpn_negative_overlap', 0.3)
+    M = anchors.shape[0]
+    n_fg = int(batch_per_im * fg_frac)
+    key = ctx.rng(op)
+
+    def per_image(gts, valid, k):
+        iou = _iou_matrix(gts, anchors)               # [G, M]
+        iou = jnp.where(valid[:, None] > 0, iou, _MATCH_NEG)
+        best_gt = jnp.argmax(iou, axis=0)             # per anchor
+        best_iou = jnp.max(iou, axis=0)
+        # positives: IoU >= pos_t, plus each gt's argmax anchor
+        fg = best_iou >= pos_t
+        gt_best_anchor = jnp.argmax(iou, axis=1)      # [G]
+        gt_ok = (jnp.max(iou, axis=1) > 0)
+        fg = fg.at[gt_best_anchor].max(gt_ok)
+        # anchors with no valid-gt overlap (incl. object-free images,
+        # best_iou == _MATCH_NEG) are background, not ignored
+        bg = (best_iou < neg_t) & ~fg
+        # random subsample to the fixed minibatch: priority = noise,
+        # masked classes sink
+        k1, k2 = jax.random.split(k)
+        noise = jax.random.uniform(k1, (M,))
+        fg_rank = jnp.argsort(jnp.argsort(
+            jnp.where(fg, noise, 2.0)))               # ranks of fg first
+        fg_keep = fg & (fg_rank < n_fg)
+        n_bg = batch_per_im - jnp.sum(fg_keep)
+        noise2 = jax.random.uniform(k2, (M,))
+        bg_rank = jnp.argsort(jnp.argsort(
+            jnp.where(bg, noise2, 2.0)))
+        bg_keep = bg & (bg_rank < n_bg)
+        labels = jnp.where(fg_keep, 1,
+                           jnp.where(bg_keep, 0, -1)).astype(jnp.int32)
+        tgt = gts[best_gt]                            # [M, 4]
+        return labels, tgt
+
+    N = gt_boxes.shape[0]
+    keys = jax.random.split(key, N)
+    valid = gt_valid if gt_valid is not None else \
+        jnp.ones(gt_boxes.shape[:2], jnp.float32)
+    labels, tgt = jax.vmap(per_image)(gt_boxes, valid, keys)
+    ctx.set(op.single_output('Labels'), labels)        # [N, M]
+    ctx.set(op.single_output('TargetBBox'), tgt)       # [N, M, 4]
+
+
+def _rpn_target_assign_infer(op, block):
+    a = block.var_recursive(op.single_input('Anchor'))
+    g = block.var_recursive(op.single_input('GtBoxes'))
+    M = int(np.prod(a.shape)) // 4
+    lab = block.var_recursive(op.single_output('Labels'))
+    lab.shape = [g.shape[0], M]
+    lab.dtype = 'int32'
+    t = block.var_recursive(op.single_output('TargetBBox'))
+    t.shape = [g.shape[0], M, 4]
+    t.dtype = 'float32'
+
+
+register_op('rpn_target_assign', infer_shape=_rpn_target_assign_infer,
+            no_grad=True)

@@ -429,3 +429,84 @@ def test_roi_align_border_clamps_not_fades():
     out, = _run(build, {'x': feat,
                         'r': np.array([[0, 0, 8, 8]], 'float32')})
     np.testing.assert_allclose(np.asarray(out), 1.0, atol=1e-6)
+
+
+def test_generate_proposals():
+    """A strong-scoring anchor with small deltas must survive as the top
+    proposal; overlapping weaker anchors are NMS'd; boxes clip to the
+    image."""
+    A, H, W = 2, 2, 2
+    anchors = np.zeros((H, W, A, 4), 'float32')
+    for y in range(H):
+        for x in range(W):
+            base = [x * 8.0, y * 8.0, x * 8.0 + 8, y * 8.0 + 8]
+            anchors[y, x, 0] = base
+            anchors[y, x, 1] = [b + 0.5 for b in base]   # near-dup
+    var = np.full((H, W, A, 4), 1.0, 'float32')
+    scores = np.full((1, A, H, W), 0.1, 'float32')
+    scores[0, 0, 0, 0] = 0.9
+    scores[0, 1, 0, 0] = 0.8          # heavy overlap with the winner
+    deltas = np.zeros((1, 4 * A, H, W), 'float32')
+    im_info = np.array([[16.0, 16.0, 1.0]], 'float32')
+
+    def build():
+        s = fluid.layers.data(name='s', shape=[A, H, W],
+                              dtype='float32')
+        d = fluid.layers.data(name='d', shape=[4 * A, H, W],
+                              dtype='float32')
+        info = fluid.layers.data(name='i', shape=[3], dtype='float32')
+        a = fluid.layers.assign(anchors)
+        v = fluid.layers.assign(var)
+        rois, probs, num = fluid.layers.generate_proposals(
+            s, d, info, a, v, pre_nms_top_n=8, post_nms_top_n=4,
+            nms_thresh=0.5, min_size=1.0)
+        return [rois, probs, num]
+    rois, probs, num = _run(build, {'s': scores, 'd': deltas,
+                                    'i': im_info})
+    assert rois.shape == (1, 4, 4)
+    assert probs[0, 0] == np.float32(0.9)          # winner first
+    np.testing.assert_allclose(rois[0, 0], [0, 0, 8, 8], atol=1e-5)
+    # the 0.8 near-duplicate was suppressed (IoU > 0.5)
+    assert not np.any(np.isclose(probs[0, 1:], 0.8))
+    assert (rois[0, :, 2] <= 16.0).all() and (rois[0] >= 0).all()
+
+
+def test_rpn_target_assign():
+    anchors = np.array([[0, 0, 8, 8], [8, 0, 16, 8],
+                        [0, 8, 8, 16], [100, 100, 108, 108]],
+                       'float32').reshape(2, 2, 1, 4)
+    gts = np.array([[[0.5, 0.5, 8.2, 8.3]]], 'float32')   # matches a0
+
+    def build():
+        a = fluid.layers.assign(anchors)
+        g = fluid.layers.data(name='g', shape=[1, 4], dtype='float32')
+        labels, tgt = fluid.layers.rpn_target_assign(
+            a, g, rpn_batch_size_per_im=4, rpn_fg_fraction=0.5,
+            rpn_positive_overlap=0.7, rpn_negative_overlap=0.3)
+        return [labels, tgt]
+    labels, tgt = _run(build, {'g': gts})
+    assert labels.shape == (1, 4)
+    assert labels[0, 0] == 1                      # best-overlap anchor fg
+    assert (labels[0, 1:] <= 0).all()             # others bg or ignore
+    assert (labels[0] == 0).sum() >= 1            # some negatives sampled
+    np.testing.assert_allclose(tgt[0, 0], gts[0, 0], atol=1e-5)
+
+
+def test_rpn_target_assign_empty_image_samples_background():
+    """An image with zero valid gts must yield an all-background
+    minibatch (the RPN still needs negatives), not all-ignore."""
+    anchors = np.array([[0, 0, 8, 8], [8, 0, 16, 8],
+                        [0, 8, 8, 16], [8, 8, 16, 16]],
+                       'float32').reshape(2, 2, 1, 4)
+
+    def build():
+        a = fluid.layers.assign(anchors)
+        g = fluid.layers.data(name='g', shape=[1, 4], dtype='float32')
+        gv = fluid.layers.data(name='gv', shape=[1], dtype='float32')
+        labels, _ = fluid.layers.rpn_target_assign(
+            a, g, gt_valid=gv, rpn_batch_size_per_im=4)
+        return [labels]
+    labels, = _run(build, {'g': np.zeros((1, 1, 4), 'float32'),
+                           'gv': np.zeros((1, 1), 'float32')})
+    assert (labels[0] == 0).sum() == 4      # all sampled as background
+    assert (labels[0] == 1).sum() == 0
