@@ -1,0 +1,292 @@
+"""CTC ops and single-step/projected RNN units: warpctc, ctc_align,
+lstm_unit, gru_unit, lstmp.
+
+TPU-native re-design of reference paddle/fluid/operators/{warpctc_op.cc,
+ctc_align_op.cc, lstm_unit_op.cc, gru_unit_op.cc, lstmp_op.cc}.
+
+- warpctc: the reference dlopens Baidu's warp-ctc CUDA library
+  (platform/dynload/warpctc.h); here the CTC forward-backward recursion
+  is the standard log-space dynamic program over the padded label
+  alphabet, expressed as lax.scan over time so the whole loss jits into
+  the training step (implemented by optax.ctc_loss, fully on-device).
+- ctc_align (greedy CTC decode post-process): merge-repeats + drop
+  blanks with a static-shape cumsum compaction instead of per-row
+  variable-length output.
+- lstmp: LSTM with a recurrent projection layer (Sak et al.), a scan
+  whose carried hidden state is the projected r_t.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..registry import register_op, op_emitter, register_vjp_grad
+from .sequence_ops import _lens, _time_mask, _ACT
+
+
+# ---------------------------------------------------------------------------
+# warpctc
+# ---------------------------------------------------------------------------
+
+@op_emitter('warpctc')
+def _warpctc_emit(ctx, op):
+    import optax
+    logits = ctx.get(op.single_input('Logits'))   # [B, T, K] padded
+    labels = ctx.get(op.single_input('Label'))    # [B, L] padded int
+    if labels.ndim == 3:
+        labels = labels[:, :, 0]
+    B, T, _K = logits.shape
+    L = labels.shape[1]
+    lens = _lens(ctx, op, T, B)
+    if op.input('LabelLens'):
+        label_lens = ctx.get(op.single_input('LabelLens')).reshape(-1)
+    else:
+        label_lens = jnp.full((B,), L, jnp.int32)
+    blank = op.attr('blank', 0)
+    logit_pad = 1.0 - _time_mask(lens, T).astype(jnp.float32)
+    label_pad = 1.0 - _time_mask(label_lens, L).astype(jnp.float32)
+    loss = optax.ctc_loss(logits.astype(jnp.float32), logit_pad,
+                          labels.astype(jnp.int32), label_pad,
+                          blank_id=blank)
+    if op.attr('norm_by_times', False):
+        loss = loss / jnp.maximum(lens, 1).astype(loss.dtype)
+    ctx.set(op.single_output('Loss'), loss[:, None].astype(logits.dtype))
+
+
+def _warpctc_infer(op, block):
+    x = block.var_recursive(op.single_input('Logits'))
+    out = block.var_recursive(op.single_output('Loss'))
+    out.shape = (x.shape[0], 1)
+    out.dtype = x.dtype
+
+
+register_op('warpctc', infer_shape=_warpctc_infer)
+register_vjp_grad('warpctc', in_slots=('Logits',),
+                  out_slots=('Loss',),
+                  nondiff_slots=('Label', 'SeqLens', 'LabelLens'))
+
+
+@op_emitter('ctc_align')
+def _ctc_align_emit(ctx, op):
+    """Greedy CTC alignment (reference ctc_align_op.cc): collapse repeats,
+    drop blanks. Kept positions are compacted left with a cumsum-indexed
+    scatter; the tail pads with `padding_value` and OutLens carries the
+    decoded lengths."""
+    x = ctx.get(op.single_input('Input'))         # [B, T] int token ids
+    if x.ndim == 3:
+        x = x[:, :, 0]
+    B, T = x.shape
+    lens = _lens(ctx, op, T, B)
+    blank = op.attr('blank', 0)
+    pad_val = op.attr('padding_value', 0)
+    valid = _time_mask(lens, T)
+    prev = jnp.concatenate([jnp.full((B, 1), -1, x.dtype), x[:, :-1]],
+                           axis=1)
+    keep = (x != blank) & (x != prev) & valid
+    pos = jnp.cumsum(keep.astype(jnp.int32), axis=1) - 1   # target slot
+    out = jnp.full((B, T), pad_val, x.dtype)
+    rows = jnp.broadcast_to(jnp.arange(B)[:, None], (B, T))
+    # inactive cells write to a scratch column beyond the output
+    safe_pos = jnp.where(keep, pos, T)
+    out = jnp.concatenate([out, jnp.zeros((B, 1), x.dtype)], axis=1)
+    out = out.at[rows, safe_pos].set(jnp.where(keep, x, 0))[:, :T]
+    out_lens = jnp.sum(keep.astype(jnp.int32), axis=1)
+    ctx.set(op.single_output('Output'), out)
+    if op.output('OutLens'):
+        ctx.set(op.single_output('OutLens'), out_lens)
+
+
+def _ctc_align_infer(op, block):
+    x = block.var_recursive(op.single_input('Input'))
+    out = block.var_recursive(op.single_output('Output'))
+    out.shape = x.shape[:2]
+    out.dtype = x.dtype
+    out.lod_level = 1
+    if op.output('OutLens'):
+        ol = block.var_recursive(op.single_output('OutLens'))
+        ol.shape = (x.shape[0],)
+        ol.dtype = 'int32'
+
+
+register_op('ctc_align', infer_shape=_ctc_align_infer, no_grad=True)
+
+
+# ---------------------------------------------------------------------------
+# lstm_unit / gru_unit: one recurrence step as a plain op
+# ---------------------------------------------------------------------------
+
+@op_emitter('lstm_unit')
+def _lstm_unit_emit(ctx, op):
+    """One LSTM step (reference lstm_unit_op.cc): X carries the four
+    pre-activation gates [B, 4D] in (i, g, f, o) order; C_prev [B, D]."""
+    x = ctx.get(op.single_input('X'))
+    c_prev = ctx.get(op.single_input('C_prev'))
+    forget_bias = op.attr('forget_bias', 0.0)
+    i, g, f, o = jnp.split(x, 4, axis=-1)
+    c = c_prev * jax.nn.sigmoid(f + forget_bias) + \
+        jax.nn.sigmoid(i) * jnp.tanh(g)
+    h = jnp.tanh(c) * jax.nn.sigmoid(o)
+    ctx.set(op.single_output('C'), c)
+    ctx.set(op.single_output('H'), h)
+
+
+def _lstm_unit_infer(op, block):
+    c_prev = block.var_recursive(op.single_input('C_prev'))
+    for slot in ('C', 'H'):
+        v = block.var_recursive(op.single_output(slot))
+        v.shape = c_prev.shape
+        v.dtype = c_prev.dtype
+
+
+register_op('lstm_unit', infer_shape=_lstm_unit_infer)
+register_vjp_grad('lstm_unit', in_slots=('X', 'C_prev'),
+                  out_slots=('C', 'H'))
+
+
+@op_emitter('gru_unit')
+def _gru_unit_emit(ctx, op):
+    """One GRU step (reference gru_unit_op.h:96-116): Input [B, 3D] is
+    the pre-projected x contribution in (update | reset | candidate)
+    order; HiddenPrev [B, D]; Weight [D, 3D] = [W_u | W_r | W_c].
+    u = σ(x_u + h·W_u), r = σ(x_r + h·W_r),
+    c = act(x_c + (r*h)·W_c), h' = u*(c - h_prev) + h_prev — the same
+    gate convention as this repo's gru scan (sequence_ops.py)."""
+    x = ctx.get(op.single_input('Input'))
+    h_prev = ctx.get(op.single_input('HiddenPrev'))
+    w = ctx.get(op.single_input('Weight'))       # [D, 3D]
+    D = h_prev.shape[-1]
+    gates_x = x
+    if op.input('Bias'):
+        gates_x = gates_x + ctx.get(op.single_input('Bias'))
+    act = _ACT[op.attr('activation', 'tanh')]
+    gate_act = _ACT[op.attr('gate_activation', 'sigmoid')]
+    ur = gates_x[:, :2 * D] + jnp.matmul(h_prev, w[:, :2 * D],
+                                         preferred_element_type=x.dtype)
+    u, r = jnp.split(gate_act(ur), 2, axis=-1)
+    r_h_prev = r * h_prev
+    c = act(gates_x[:, 2 * D:] + jnp.matmul(r_h_prev, w[:, 2 * D:],
+                                            preferred_element_type=x.dtype))
+    h = u * (c - h_prev) + h_prev
+    ctx.set(op.single_output('Hidden'), h)
+    if op.output('Gate'):
+        ctx.set(op.single_output('Gate'),
+                jnp.concatenate([u, r, c], axis=-1))
+    if op.output('ResetHiddenPrev'):
+        ctx.set(op.single_output('ResetHiddenPrev'), r_h_prev)
+
+
+def _gru_unit_infer(op, block):
+    h_prev = block.var_recursive(op.single_input('HiddenPrev'))
+    out = block.var_recursive(op.single_output('Hidden'))
+    out.shape = h_prev.shape
+    out.dtype = h_prev.dtype
+    if op.output('Gate'):
+        g = block.var_recursive(op.single_output('Gate'))
+        g.shape = (h_prev.shape[0], 3 * h_prev.shape[1])
+        g.dtype = h_prev.dtype
+    if op.output('ResetHiddenPrev'):
+        r = block.var_recursive(op.single_output('ResetHiddenPrev'))
+        r.shape = h_prev.shape
+        r.dtype = h_prev.dtype
+
+
+register_op('gru_unit', infer_shape=_gru_unit_infer)
+register_vjp_grad('gru_unit', in_slots=('Input', 'HiddenPrev', 'Weight',
+                                        'Bias'), out_slots=('Hidden',))
+
+
+# ---------------------------------------------------------------------------
+# lstmp: LSTM with recurrent projection (reference lstmp_op.cc)
+# ---------------------------------------------------------------------------
+
+@op_emitter('lstmp')
+def _lstmp_emit(ctx, op):
+    x = ctx.get(op.single_input('Input'))        # [B, T, 4H]
+    w = ctx.get(op.single_input('Weight'))       # [P, 4H] recurrent
+    proj = ctx.get(op.single_input('ProjWeight'))  # [H, P]
+    b = ctx.get(op.single_input('Bias'))         # [1, 4H] or [1, 7H]
+    B, T, H4 = x.shape
+    H = H4 // 4
+    P = proj.shape[1]
+    lens = _lens(ctx, op, T, B)
+    use_peepholes = op.attr('use_peepholes', False)
+    is_reverse = op.attr('is_reverse', False)
+    act_g = _ACT[op.attr('gate_activation', 'sigmoid')]
+    act_c = _ACT[op.attr('cell_activation', 'tanh')]
+    act_h = _ACT[op.attr('candidate_activation', 'tanh')]
+    act_p = _ACT[op.attr('proj_activation', 'identity')]
+
+    gate_b = b[:, :4 * H]
+    if use_peepholes:
+        w_ic, w_fc, w_oc = (b[:, 4 * H:5 * H], b[:, 5 * H:6 * H],
+                            b[:, 6 * H:7 * H])
+
+    r0 = jnp.zeros((B, P), x.dtype)
+    c0 = jnp.zeros((B, H), x.dtype)
+    if op.input('H0'):
+        # initial hidden enters through the projection, like the reference
+        r0 = jnp.matmul(ctx.get(op.single_input('H0')), proj,
+                        preferred_element_type=x.dtype)
+    if op.input('C0'):
+        c0 = ctx.get(op.single_input('C0'))
+
+    xs = jnp.swapaxes(x, 0, 1)
+    ts = jnp.arange(T)
+    steps = T - 1 - ts if is_reverse else ts
+    if is_reverse:
+        xs = jnp.flip(xs, axis=0)
+
+    def step(carry, inp):
+        r_prev, c_prev = carry
+        xt, t = inp
+        gates = xt + jnp.matmul(r_prev, w,
+                                preferred_element_type=x.dtype) + gate_b
+        gc, gi, gf, go = jnp.split(gates, 4, axis=-1)
+        if use_peepholes:
+            gi = gi + c_prev * w_ic
+            gf = gf + c_prev * w_fc
+        i, f, cand = act_g(gi), act_g(gf), act_c(gc)
+        c = f * c_prev + i * cand
+        if use_peepholes:
+            go = go + c * w_oc
+        o = act_g(go)
+        h = o * act_h(c)
+        r = act_p(jnp.matmul(h, proj, preferred_element_type=x.dtype))
+        active = (t < lens)[:, None]
+        r = jnp.where(active, r, r_prev)
+        c = jnp.where(active, c, c_prev)
+        return (r, c), (r, c)
+
+    (_, _), (rs, cs) = jax.lax.scan(step, (r0, c0), (xs, steps))
+    if is_reverse:
+        rs, cs = jnp.flip(rs, axis=0), jnp.flip(cs, axis=0)
+    projection = jnp.swapaxes(rs, 0, 1)
+    cell = jnp.swapaxes(cs, 0, 1)
+    mask_p = _time_mask(lens, T, 1)
+    ctx.set(op.single_output('Projection'),
+            jnp.where(mask_p, projection, 0))
+    ctx.set(op.single_output('Cell'), jnp.where(mask_p, cell, 0))
+
+
+def _lstmp_infer(op, block):
+    x = block.var_recursive(op.single_input('Input'))
+    proj = block.var_recursive(op.single_input('ProjWeight'))
+    H = x.shape[-1] // 4
+    P = proj.shape[1]
+    out = block.var_recursive(op.single_output('Projection'))
+    out.shape = tuple(x.shape[:-1]) + (P,)
+    out.dtype = x.dtype
+    out.lod_level = max(1, x.lod_level)
+    cell = block.var_recursive(op.single_output('Cell'))
+    cell.shape = tuple(x.shape[:-1]) + (H,)
+    cell.dtype = x.dtype
+    cell.lod_level = max(1, x.lod_level)
+
+
+register_op('lstmp', infer_shape=_lstmp_infer)
+register_vjp_grad('lstmp',
+                  in_slots=('Input', 'Weight', 'ProjWeight', 'Bias',
+                            'H0', 'C0'),
+                  out_slots=('Projection', 'Cell'),
+                  nondiff_slots=('SeqLens',))

@@ -21,7 +21,8 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from ..registry import register_op, op_emitter, register_vjp_grad
+from ..registry import (register_op, op_emitter, register_vjp_grad,
+                        same_shape_infer)
 
 
 # ---------------------------------------------------------------------------
@@ -854,3 +855,173 @@ def _rpn_target_assign_infer(op, block):
 
 register_op('rpn_target_assign', infer_shape=_rpn_target_assign_infer,
             no_grad=True)
+
+
+# ---------------------------------------------------------------------------
+# polygon_box_transform (reference detection/polygon_box_transform_op.cc):
+# even geometry channels become w_index - value, odd become h_index - value
+# (EAST-style quad geometry decoding). Pure broadcast arithmetic.
+# ---------------------------------------------------------------------------
+
+@op_emitter('polygon_box_transform')
+def _polygon_box_transform_emit(ctx, op):
+    x = ctx.get(op.single_input('Input'))        # [N, G, H, W]
+    n, g, h, w = x.shape
+    wi = jnp.arange(w, dtype=x.dtype)[None, None, None, :]
+    hi = jnp.arange(h, dtype=x.dtype)[None, None, :, None]
+    even = (jnp.arange(g) % 2 == 0)[None, :, None, None]
+    ctx.set(op.single_output('Output'), jnp.where(even, wi - x, hi - x))
+
+
+register_op('polygon_box_transform',
+            infer_shape=same_shape_infer('Input', 'Output'), no_grad=True)
+
+
+# ---------------------------------------------------------------------------
+# mine_hard_examples (reference detection/mine_hard_examples_op.cc) —
+# static-shape OHEM: instead of LoD NegIndices, emits a [B, P] 0/1
+# negative-selection mask plus UpdatedMatchIndices with a three-way
+# contract consumers can branch on without the LoD list: positives keep
+# their gt index, mined negatives stay -1, and UNSELECTED negatives are
+# forced to -2 (ignore) — the information the reference encodes by
+# listing selected negatives in NegIndices.
+# ---------------------------------------------------------------------------
+
+@op_emitter('mine_hard_examples')
+def _mine_hard_examples_emit(ctx, op):
+    cls_loss = ctx.get(op.single_input('ClsLoss'))          # [B, P]
+    match_indices = ctx.get(op.single_input('MatchIndices'))  # [B, P]
+    loss = cls_loss
+    if op.input('LocLoss'):
+        loss = loss + ctx.get(op.single_input('LocLoss'))
+    neg_pos_ratio = op.attr('neg_pos_ratio', 3.0)
+    neg_dist_threshold = op.attr('neg_dist_threshold', 0.5)
+    sample_size = op.attr('sample_size', 0)
+    mining_type = op.attr('mining_type', 'max_negative')
+    B, P = loss.shape
+    is_neg = match_indices < 0
+    if op.input('MatchDist'):
+        dist = ctx.get(op.single_input('MatchDist'))
+        is_neg = is_neg & (dist < neg_dist_threshold)
+    num_pos = jnp.sum((match_indices >= 0).astype(jnp.int32), axis=1)
+    if mining_type == 'hard_example' and sample_size:
+        budget = jnp.full((B,), int(sample_size), jnp.int32)
+    else:
+        budget = (num_pos.astype(jnp.float32) * neg_pos_ratio)
+        budget = budget.astype(jnp.int32)
+    neg_loss = jnp.where(is_neg, loss, -jnp.inf)
+    order = jnp.argsort(-neg_loss, axis=1)                  # hardest first
+    rank = jnp.argsort(order, axis=1)                       # rank per prior
+    selected = (rank < budget[:, None]) & is_neg
+    ctx.set(op.single_output('NegMask'), selected.astype(jnp.int32))
+    if op.output('UpdatedMatchIndices'):
+        ignored = (match_indices < 0) & ~selected
+        upd = jnp.where(ignored, -2, match_indices)
+        ctx.set(op.single_output('UpdatedMatchIndices'), upd)
+
+
+def _mine_hard_examples_infer(op, block):
+    cls = block.var_recursive(op.single_input('ClsLoss'))
+    m = block.var_recursive(op.single_output('NegMask'))
+    m.shape = cls.shape
+    m.dtype = 'int32'
+    if op.output('UpdatedMatchIndices'):
+        u = block.var_recursive(op.single_output('UpdatedMatchIndices'))
+        u.shape = cls.shape
+        u.dtype = 'int32'
+
+
+register_op('mine_hard_examples', infer_shape=_mine_hard_examples_infer,
+            no_grad=True)
+
+
+# ---------------------------------------------------------------------------
+# detection_map (reference detection/detection_map_op.cc) — per-batch mAP
+# over padded detections/ground truth. The reference accumulates
+# AccumPosCount state across batches on the host; here the op is
+# stateless per batch (metrics.DetectionMAP does the cross-batch
+# averaging) and fully on-device: per-class score sort + greedy IoU
+# matching with static shapes.
+# ---------------------------------------------------------------------------
+
+@op_emitter('detection_map')
+def _detection_map_emit(ctx, op):
+    det = ctx.get(op.single_input('DetectRes'))   # [B, K, 6] (label,score,box)
+    gt = ctx.get(op.single_input('Label'))        # [B, M, 5] (label, box)
+    class_num = int(op.attr('class_num'))
+    iou_threshold = op.attr('overlap_threshold', 0.5)
+    ap_type = op.attr('ap_type', 'integral')
+    B, K, _ = det.shape
+    M = gt.shape[1]
+
+    det_label = det[:, :, 0].astype(jnp.int32)
+    det_score = det[:, :, 1]
+    det_box = det[:, :, 2:6]
+    det_valid = det_label >= 0
+    gt_label = gt[:, :, 0].astype(jnp.int32)
+    gt_box = gt[:, :, 1:5]
+    gt_valid = jnp.sum(jnp.abs(gt_box), axis=2) > 0
+
+    iou = jax.vmap(_iou_matrix)(det_box, gt_box)   # [B, K, M]
+
+    def per_class(c):
+        d_mask = det_valid & (det_label == c)
+        g_mask = gt_valid & (gt_label == c)
+        npos = jnp.sum(g_mask.astype(jnp.int32))
+        # greedy match in score order within each image: a detection is TP
+        # if its best same-class IoU >= thr with an unclaimed gt. Static
+        # approximation: claim = best-iou gt index; duplicates resolved by
+        # keeping the highest-scored detection per gt.
+        iou_c = jnp.where(g_mask[:, None, :], iou, 0.0)
+        best_iou = jnp.max(iou_c, axis=2, initial=0.0)
+        best_gt = jnp.argmax(iou_c, axis=2)
+        cand_tp = d_mask & (best_iou >= iou_threshold)
+        # rank detections per (image, gt): highest score wins the gt
+        score_masked = jnp.where(cand_tp, det_score, -jnp.inf)
+        onehot = jax.nn.one_hot(best_gt, M) * cand_tp[:, :, None]
+        # -inf * 0 would be NaN: select, don't multiply
+        best_per_gt = jnp.max(
+            jnp.where(onehot > 0, score_masked[:, :, None], -jnp.inf),
+            axis=1, initial=-jnp.inf)                     # [B, M]
+        is_tp = cand_tp & (score_masked >=
+                           jnp.take_along_axis(best_per_gt, best_gt,
+                                               axis=1) - 1e-12)
+        is_fp = d_mask & ~is_tp
+        # global sort by score over flattened detections
+        flat_score = jnp.where(d_mask, det_score, -jnp.inf).reshape(-1)
+        order = jnp.argsort(-flat_score)
+        tp_sorted = is_tp.reshape(-1)[order].astype(jnp.float32)
+        fp_sorted = is_fp.reshape(-1)[order].astype(jnp.float32)
+        tp_cum = jnp.cumsum(tp_sorted)
+        fp_cum = jnp.cumsum(fp_sorted)
+        denom = jnp.maximum(tp_cum + fp_cum, 1e-12)
+        precision = tp_cum / denom
+        recall = tp_cum / jnp.maximum(npos.astype(jnp.float32), 1e-12)
+        in_list = (tp_sorted + fp_sorted) > 0
+        if ap_type == '11point':
+            pts = jnp.linspace(0.0, 1.0, 11)
+            pmax = jax.vmap(
+                lambda r: jnp.max(jnp.where(in_list & (recall >= r),
+                                            precision, 0.0),
+                                  initial=0.0))(pts)
+            ap = jnp.mean(pmax)
+        else:
+            prev_recall = jnp.concatenate([jnp.zeros(1), recall[:-1]])
+            ap = jnp.sum(jnp.where(in_list,
+                                   precision * (recall - prev_recall), 0.0))
+        has_gt = npos > 0
+        return jnp.where(has_gt, ap, 0.0), has_gt.astype(jnp.float32)
+
+    classes = jnp.arange(1, class_num)   # 0 is background
+    aps, valid = jax.vmap(per_class)(classes)
+    m_ap = jnp.sum(aps) / jnp.maximum(jnp.sum(valid), 1.0)
+    ctx.set(op.single_output('MAP'), m_ap.reshape((1,)))
+
+
+def _detection_map_infer(op, block):
+    out = block.var_recursive(op.single_output('MAP'))
+    out.shape = (1,)
+    out.dtype = 'float32'
+
+
+register_op('detection_map', infer_shape=_detection_map_infer, no_grad=True)

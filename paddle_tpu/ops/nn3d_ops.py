@@ -1,0 +1,458 @@
+"""Volumetric and indexed pooling/conv ops: conv3d, conv3d_transpose,
+depthwise_conv2d_transpose, pool3d, max_pool2d_with_index,
+max_pool3d_with_index, unpool, spp, conv_shift.
+
+TPU-native re-design of reference paddle/fluid/operators/{conv_op.cc (3d
+registrations), conv_transpose_op.cc, pool_op.cc (pool3d),
+pool_with_index_op.cc, unpool_op.cc, spp_op.cc, conv_shift_op.cc}.
+
+Design notes:
+- 3D convs go straight to lax.conv_general_dilated with NCDHW dimension
+  numbers — the MXU sees them as big matmuls after XLA's im2col-style
+  tiling, same as 2D.
+- *_with_index pooling avoids data-dependent control flow: windows are
+  materialized with lax.conv_general_dilated_patches, argmax runs over
+  the static window axis, and the flat input index is reconstructed
+  arithmetically. unpool inverts it with one scatter.
+- spp concatenates bin-wise reduce_windows per pyramid level (static
+  bin grid per level, like the reference's per-level pooling loop).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..registry import (register_op, op_emitter, register_vjp_grad,
+                        amp_cast)
+from .nn_ops import _conv_out_size, conv_transpose_nd
+
+
+# ---------------------------------------------------------------------------
+# conv3d / conv3d_transpose / depthwise_conv2d_transpose
+# ---------------------------------------------------------------------------
+
+@op_emitter('conv3d')
+def _conv3d_emit(ctx, op):
+    x = ctx.get(op.single_input('Input'))
+    w = ctx.get(op.single_input('Filter'))
+    x, w = amp_cast(ctx, x, w)
+    strides = op.attr('strides', [1, 1, 1])
+    paddings = op.attr('paddings', [0, 0, 0])
+    dilations = op.attr('dilations', [1, 1, 1])
+    groups = op.attr('groups', 1) or 1
+    out_dtype = x.dtype
+    if x.dtype == jnp.bfloat16 and jax.default_backend() != 'tpu':
+        x = x.astype(jnp.float32)
+        w = w.astype(jnp.float32)
+    out = jax.lax.conv_general_dilated(
+        x, w,
+        window_strides=tuple(strides),
+        padding=[(p, p) for p in paddings],
+        rhs_dilation=tuple(dilations),
+        dimension_numbers=('NCDHW', 'OIDHW', 'NCDHW'),
+        feature_group_count=groups)
+    ctx.set(op.single_output('Output'), out.astype(out_dtype))
+
+
+def _conv3d_infer(op, block):
+    x = block.var_recursive(op.single_input('Input'))
+    w = block.var_recursive(op.single_input('Filter'))
+    strides = op.attr('strides', [1, 1, 1])
+    paddings = op.attr('paddings', [0, 0, 0])
+    dilations = op.attr('dilations', [1, 1, 1])
+    n = x.shape[0]
+    oc = w.shape[0]
+    spatial = [_conv_out_size(x.shape[2 + i], w.shape[2 + i], paddings[i],
+                              strides[i], dilations[i]) for i in range(3)]
+    out = block.var_recursive(op.single_output('Output'))
+    out.shape = (n, oc) + tuple(spatial)
+    out.dtype = x.dtype
+
+
+register_op('conv3d', infer_shape=_conv3d_infer)
+register_vjp_grad('conv3d', in_slots=('Input', 'Filter'),
+                  out_slots=('Output',))
+
+
+@op_emitter('conv3d_transpose')
+def _conv3d_transpose_emit(ctx, op):
+    x = ctx.get(op.single_input('Input'))
+    w = ctx.get(op.single_input('Filter'))   # [in_c, out_c/g, kd, kh, kw]
+    x, w = amp_cast(ctx, x, w)
+    out = conv_transpose_nd(x, w, op.attr('strides', [1, 1, 1]),
+                            op.attr('paddings', [0, 0, 0]),
+                            op.attr('dilations', [1, 1, 1]),
+                            op.attr('groups', 1) or 1, 3)
+    ctx.set(op.single_output('Output'), out)
+
+
+def _conv3d_transpose_infer(op, block):
+    x = block.var_recursive(op.single_input('Input'))
+    w = block.var_recursive(op.single_input('Filter'))
+    strides = op.attr('strides', [1, 1, 1])
+    paddings = op.attr('paddings', [0, 0, 0])
+    dilations = op.attr('dilations', [1, 1, 1])
+
+    def osz(i, k, p, s, d):
+        return -1 if i < 0 else (i - 1) * s - 2 * p + d * (k - 1) + 1
+    spatial = [osz(x.shape[2 + i], w.shape[2 + i], paddings[i], strides[i],
+                   dilations[i]) for i in range(3)]
+    out = block.var_recursive(op.single_output('Output'))
+    out.shape = (x.shape[0], w.shape[1]) + tuple(spatial)
+    out.dtype = x.dtype
+
+
+register_op('conv3d_transpose', infer_shape=_conv3d_transpose_infer)
+register_vjp_grad('conv3d_transpose', in_slots=('Input', 'Filter'),
+                  out_slots=('Output',))
+
+
+@op_emitter('depthwise_conv2d_transpose')
+def _depthwise_conv2d_transpose_emit(ctx, op):
+    """Depthwise transpose conv: groups = channels through the shared
+    lhs-dilated formulation."""
+    x = ctx.get(op.single_input('Input'))
+    w = ctx.get(op.single_input('Filter'))   # [C, 1, kh, kw]
+    x, w = amp_cast(ctx, x, w)
+    out = conv_transpose_nd(x, w, op.attr('strides', [1, 1]),
+                            op.attr('paddings', [0, 0]),
+                            op.attr('dilations', [1, 1]), x.shape[1], 2)
+    ctx.set(op.single_output('Output'), out)
+
+
+def _dw_conv2d_transpose_infer(op, block):
+    x = block.var_recursive(op.single_input('Input'))
+    w = block.var_recursive(op.single_input('Filter'))
+    strides = op.attr('strides', [1, 1])
+    paddings = op.attr('paddings', [0, 0])
+    dilations = op.attr('dilations', [1, 1])
+
+    def osz(i, k, p, s, d):
+        return -1 if i < 0 else (i - 1) * s - 2 * p + d * (k - 1) + 1
+    out = block.var_recursive(op.single_output('Output'))
+    out.shape = (x.shape[0], x.shape[1],
+                 osz(x.shape[2], w.shape[2], paddings[0], strides[0],
+                     dilations[0]),
+                 osz(x.shape[3], w.shape[3], paddings[1], strides[1],
+                     dilations[1]))
+    out.dtype = x.dtype
+
+
+register_op('depthwise_conv2d_transpose',
+            infer_shape=_dw_conv2d_transpose_infer)
+register_vjp_grad('depthwise_conv2d_transpose',
+                  in_slots=('Input', 'Filter'), out_slots=('Output',))
+
+
+# ---------------------------------------------------------------------------
+# pool3d
+# ---------------------------------------------------------------------------
+
+@op_emitter('pool3d')
+def _pool3d_emit(ctx, op):
+    x = ctx.get(op.single_input('X'))
+    ptype = op.attr('pooling_type', 'max')
+    ksize = list(op.attr('ksize'))
+    strides = list(op.attr('strides', [1, 1, 1]))
+    paddings = list(op.attr('paddings', [0, 0, 0]))
+    if op.attr('global_pooling', False):
+        ksize = list(x.shape[2:])
+        strides = [1, 1, 1]
+        paddings = [0, 0, 0]
+    window = (1, 1) + tuple(ksize)
+    strides5 = (1, 1) + tuple(strides)
+    from .nn_ops import _pool_spatial_pads
+    sp = _pool_spatial_pads(list(x.shape[2:]), ksize, strides, paddings,
+                            op.attr('ceil_mode', False))
+    pads = ((0, 0), (0, 0)) + tuple(sp)
+    padded = any(lo or hi for lo, hi in sp)
+    if ptype == 'max':
+        out = jax.lax.reduce_window(x, -jnp.inf, jax.lax.max, window,
+                                    strides5, pads)
+    else:
+        summed = jax.lax.reduce_window(x, 0.0, jax.lax.add, window,
+                                       strides5, pads)
+        if op.attr('exclusive', True) and padded:
+            counts = jax.lax.reduce_window(jnp.ones_like(x), 0.0,
+                                           jax.lax.add, window, strides5,
+                                           pads)
+            out = summed / counts
+        else:
+            out = summed / float(np.prod(ksize))
+    ctx.set(op.single_output('Out'), out.astype(x.dtype))
+
+
+def _pool3d_infer(op, block):
+    x = block.var_recursive(op.single_input('X'))
+    out = block.var_recursive(op.single_output('Out'))
+    if op.attr('global_pooling', False):
+        out.shape = x.shape[:2] + (1, 1, 1)
+    else:
+        ksize = op.attr('ksize')
+        strides = op.attr('strides', [1, 1, 1])
+        paddings = op.attr('paddings', [0, 0, 0])
+
+        def osz(i, k, p, s):
+            if i < 0:
+                return -1
+            if op.attr('ceil_mode', False):
+                return (i - k + 2 * p + s - 1) // s + 1
+            return (i - k + 2 * p) // s + 1
+        out.shape = x.shape[:2] + tuple(
+            osz(x.shape[2 + i], ksize[i], paddings[i], strides[i])
+            for i in range(3))
+    out.dtype = x.dtype
+
+
+register_op('pool3d', infer_shape=_pool3d_infer)
+register_vjp_grad('pool3d')
+
+
+# ---------------------------------------------------------------------------
+# max pooling with index + unpool
+# ---------------------------------------------------------------------------
+
+def _pool_with_index(x, ksize, strides, paddings):
+    """Max pool over 2D windows returning (values, flat spatial indices).
+    Patch extraction keeps everything static-shape; out-of-bounds window
+    cells are masked to -inf so padding never wins the argmax."""
+    n, c, h, w = x.shape
+    kh, kw = ksize
+    patches = jax.lax.conv_general_dilated_patches(
+        x, filter_shape=(kh, kw), window_strides=tuple(strides),
+        padding=[(paddings[0], paddings[0]), (paddings[1], paddings[1])],
+        dimension_numbers=('NCHW', 'OIHW', 'NCHW'))
+    oh, ow = patches.shape[2], patches.shape[3]
+    patches = patches.reshape(n, c, kh * kw, oh, ow)
+    # coordinates of each window cell in the (unpadded) input
+    dy, dx = np.meshgrid(np.arange(kh), np.arange(kw), indexing='ij')
+    dy = jnp.asarray(dy.reshape(-1))           # [kh*kw]
+    dx = jnp.asarray(dx.reshape(-1))
+    oy = jnp.arange(oh) * strides[0] - paddings[0]
+    ox = jnp.arange(ow) * strides[1] - paddings[1]
+    yy = oy[None, :, None] + dy[:, None, None]   # [k, oh, 1]
+    xx = ox[None, None, :] + dx[:, None, None]   # [k, 1, ow]
+    valid = (yy >= 0) & (yy < h) & (xx >= 0) & (xx < w)  # [k, oh, ow]
+    neg = jnp.asarray(-jnp.inf, patches.dtype)
+    masked = jnp.where(valid[None, None], patches, neg)
+    win_idx = jnp.argmax(masked, axis=2)         # [n, c, oh, ow]
+    vals = jnp.max(masked, axis=2)
+    flat = (yy * w + xx)                          # [k, oh, ow]
+    idx = jnp.take_along_axis(
+        jnp.broadcast_to(flat[None, None], (n, c) + flat.shape),
+        win_idx[:, :, None], axis=2)[:, :, 0]
+    return vals, idx.astype(jnp.int32)
+
+
+@op_emitter('max_pool2d_with_index')
+def _max_pool2d_with_index_emit(ctx, op):
+    x = ctx.get(op.single_input('X'))
+    ksize = list(op.attr('ksize'))
+    if op.attr('global_pooling', False):
+        ksize = [x.shape[2], x.shape[3]]
+    vals, idx = _pool_with_index(x, ksize, op.attr('strides', [1, 1]),
+                                 op.attr('paddings', [0, 0]))
+    ctx.set(op.single_output('Out'), vals)
+    ctx.set(op.single_output('Mask'), idx)
+
+
+def _max_pool2d_with_index_infer(op, block):
+    x = block.var_recursive(op.single_input('X'))
+    n, c, h, w = x.shape
+    if op.attr('global_pooling', False):
+        oshape = (n, c, 1, 1)
+    else:
+        ksize = op.attr('ksize')
+        strides = op.attr('strides', [1, 1])
+        paddings = op.attr('paddings', [0, 0])
+        oshape = (n, c,
+                  (h - ksize[0] + 2 * paddings[0]) // strides[0] + 1,
+                  (w - ksize[1] + 2 * paddings[1]) // strides[1] + 1)
+    out = block.var_recursive(op.single_output('Out'))
+    out.shape = oshape
+    out.dtype = x.dtype
+    mask = block.var_recursive(op.single_output('Mask'))
+    mask.shape = oshape
+    mask.dtype = 'int32'
+
+
+register_op('max_pool2d_with_index',
+            infer_shape=_max_pool2d_with_index_infer)
+register_vjp_grad('max_pool2d_with_index', in_slots=('X',),
+                  out_slots=('Out',))
+
+
+@op_emitter('max_pool3d_with_index')
+def _max_pool3d_with_index_emit(ctx, op):
+    """3D variant: fold depth into batch for the 2D patch machinery when
+    kd == 1, otherwise extract 3D patches directly."""
+    x = ctx.get(op.single_input('X'))
+    ksize = list(op.attr('ksize'))
+    strides = list(op.attr('strides', [1, 1, 1]))
+    paddings = list(op.attr('paddings', [0, 0, 0]))
+    if op.attr('global_pooling', False):
+        ksize = list(x.shape[2:])
+        strides = [1, 1, 1]
+        paddings = [0, 0, 0]
+    n, c, d, h, w = x.shape
+    patches = jax.lax.conv_general_dilated_patches(
+        x, filter_shape=tuple(ksize), window_strides=tuple(strides),
+        padding=[(p, p) for p in paddings],
+        dimension_numbers=('NCDHW', 'OIDHW', 'NCDHW'))
+    od, oh, ow = patches.shape[2:]
+    k = int(np.prod(ksize))
+    patches = patches.reshape(n, c, k, od, oh, ow)
+    dz, dy, dx = np.meshgrid(*[np.arange(s) for s in ksize], indexing='ij')
+    dz, dy, dx = (jnp.asarray(a.reshape(-1)) for a in (dz, dy, dx))
+    oz = jnp.arange(od) * strides[0] - paddings[0]
+    oy = jnp.arange(oh) * strides[1] - paddings[1]
+    ox = jnp.arange(ow) * strides[2] - paddings[2]
+    zz = oz[None, :, None, None] + dz[:, None, None, None]
+    yy = oy[None, None, :, None] + dy[:, None, None, None]
+    xx = ox[None, None, None, :] + dx[:, None, None, None]
+    valid = ((zz >= 0) & (zz < d) & (yy >= 0) & (yy < h) &
+             (xx >= 0) & (xx < w))
+    neg = jnp.asarray(-jnp.inf, patches.dtype)
+    masked = jnp.where(valid[None, None], patches, neg)
+    win_idx = jnp.argmax(masked, axis=2)
+    vals = jnp.max(masked, axis=2)
+    flat = (zz * h + yy) * w + xx
+    idx = jnp.take_along_axis(
+        jnp.broadcast_to(flat[None, None], (n, c) + flat.shape),
+        win_idx[:, :, None], axis=2)[:, :, 0]
+    ctx.set(op.single_output('Out'), vals)
+    ctx.set(op.single_output('Mask'), idx.astype(jnp.int32))
+
+
+def _max_pool3d_with_index_infer(op, block):
+    x = block.var_recursive(op.single_input('X'))
+    if op.attr('global_pooling', False):
+        oshape = x.shape[:2] + (1, 1, 1)
+    else:
+        ksize = op.attr('ksize')
+        strides = op.attr('strides', [1, 1, 1])
+        paddings = op.attr('paddings', [0, 0, 0])
+        oshape = x.shape[:2] + tuple(
+            (x.shape[2 + i] - ksize[i] + 2 * paddings[i]) // strides[i] + 1
+            for i in range(3))
+    out = block.var_recursive(op.single_output('Out'))
+    out.shape = oshape
+    out.dtype = x.dtype
+    mask = block.var_recursive(op.single_output('Mask'))
+    mask.shape = oshape
+    mask.dtype = 'int32'
+
+
+register_op('max_pool3d_with_index',
+            infer_shape=_max_pool3d_with_index_infer)
+register_vjp_grad('max_pool3d_with_index', in_slots=('X',),
+                  out_slots=('Out',))
+
+
+@op_emitter('unpool')
+def _unpool_emit(ctx, op):
+    """Max-unpool (reference unpool_op.cc): scatter pooled values back to
+    the argmax positions recorded in Indices. One XLA scatter-add over
+    the flattened spatial plane."""
+    x = ctx.get(op.single_input('X'))           # [N, C, oh, ow]
+    idx = ctx.get(op.single_input('Indices'))   # [N, C, oh, ow] flat h*w
+    out_h, out_w = op.attr('unpooled_height'), op.attr('unpooled_width')
+    n, c = x.shape[0], x.shape[1]
+    flat = jnp.zeros((n, c, out_h * out_w), x.dtype)
+    flat = flat.at[
+        jnp.arange(n)[:, None, None],
+        jnp.arange(c)[None, :, None],
+        idx.reshape(n, c, -1)].add(x.reshape(n, c, -1))
+    ctx.set(op.single_output('Out'), flat.reshape(n, c, out_h, out_w))
+
+
+def _unpool_infer(op, block):
+    x = block.var_recursive(op.single_input('X'))
+    out = block.var_recursive(op.single_output('Out'))
+    out.shape = (x.shape[0], x.shape[1], op.attr('unpooled_height'),
+                 op.attr('unpooled_width'))
+    out.dtype = x.dtype
+
+
+register_op('unpool', infer_shape=_unpool_infer)
+register_vjp_grad('unpool', in_slots=('X',), nondiff_slots=('Indices',))
+
+
+# ---------------------------------------------------------------------------
+# spp: spatial pyramid pooling (reference spp_op.cc)
+# ---------------------------------------------------------------------------
+
+@op_emitter('spp')
+def _spp_emit(ctx, op):
+    x = ctx.get(op.single_input('X'))
+    levels = op.attr('pyramid_height')
+    ptype = op.attr('pooling_type', 'max')
+    n, c, h, w = x.shape
+    outs = []
+    for lv in range(levels):
+        bins = 2 ** lv
+        kh = int(np.ceil(h / bins))
+        kw = int(np.ceil(w / bins))
+        ph = (kh * bins - h + 1) // 2
+        pw = (kw * bins - w + 1) // 2
+        window = (1, 1, kh, kw)
+        strides = (1, 1, kh, kw)
+        pads = ((0, 0), (0, 0), (ph, kh * bins - h - ph),
+                (pw, kw * bins - w - pw))
+        if ptype == 'max':
+            pooled = jax.lax.reduce_window(x, -jnp.inf, jax.lax.max,
+                                           window, strides, pads)
+        else:
+            s = jax.lax.reduce_window(x, 0.0, jax.lax.add, window,
+                                      strides, pads)
+            pooled = s / float(kh * kw)
+        outs.append(pooled.reshape(n, -1))
+    ctx.set(op.single_output('Out'),
+            jnp.concatenate(outs, axis=1).astype(x.dtype))
+
+
+def _spp_infer(op, block):
+    x = block.var_recursive(op.single_input('X'))
+    levels = op.attr('pyramid_height')
+    c = x.shape[1]
+    total = sum(c * (2 ** lv) ** 2 for lv in range(levels))
+    out = block.var_recursive(op.single_output('Out'))
+    out.shape = (x.shape[0], total)
+    out.dtype = x.dtype
+
+
+register_op('spp', infer_shape=_spp_infer)
+register_vjp_grad('spp')
+
+
+# ---------------------------------------------------------------------------
+# conv_shift: circular correlation (reference conv_shift_op.cc)
+# ---------------------------------------------------------------------------
+
+@op_emitter('conv_shift')
+def _conv_shift_emit(ctx, op):
+    """Out[i, j] = sum_k X[i, (j + k - M//2) mod W] * Y[i, k] — a small
+    gather + einsum; W and M are static so the index table is a
+    compile-time constant."""
+    x = ctx.get(op.single_input('X'))   # [B, W]
+    y = ctx.get(op.single_input('Y'))   # [B, M], M odd, M <= W
+    wdim = x.shape[1]
+    m = y.shape[1]
+    j = np.arange(wdim)[:, None]
+    k = np.arange(m)[None, :]
+    idx = jnp.asarray((j + k - m // 2) % wdim)    # [W, M]
+    gathered = x[:, idx]                          # [B, W, M]
+    ctx.set(op.single_output('Out'),
+            jnp.einsum('bwm,bm->bw', gathered, y))
+
+
+def _conv_shift_infer(op, block):
+    x = block.var_recursive(op.single_input('X'))
+    out = block.var_recursive(op.single_output('Out'))
+    out.shape = x.shape
+    out.dtype = x.dtype
+
+
+register_op('conv_shift', infer_shape=_conv_shift_infer)
+register_vjp_grad('conv_shift', in_slots=('X', 'Y'))

@@ -81,6 +81,30 @@ for _conv_type in ('conv2d', 'depthwise_conv2d'):
                       out_slots=('Output',))
 
 
+def conv_transpose_nd(x, w, strides, paddings, dilations, groups, nd):
+    """Transpose conv as an lhs-dilated forward conv — the formulation XLA
+    itself uses for conv input-gradients, with exact control of the
+    reference's output-size contract out = (i-1)*s - 2p + d*(k-1) + 1.
+
+    w comes in the reference/torch transpose-conv layout [in_c, out_c/g,
+    k...]; it is regrouped to a forward kernel [out_c, in_c/g, k...] and
+    spatially flipped.
+    """
+    in_c = x.shape[1]
+    ws = jnp.reshape(w, (groups, in_c // groups) + w.shape[1:])
+    ws = jnp.swapaxes(ws, 1, 2)                    # [g, oc/g, in/g, k...]
+    ws = jnp.reshape(ws, (-1,) + ws.shape[2:])     # [out_c, in/g, k...]
+    ws = jnp.flip(ws, axis=tuple(range(2, 2 + nd)))
+    pads = [(dilations[i] * (w.shape[2 + i] - 1) - paddings[i],) * 2
+            for i in range(nd)]
+    dn = (('NCHW', 'OIHW', 'NCHW') if nd == 2
+          else ('NCDHW', 'OIDHW', 'NCDHW'))
+    return jax.lax.conv_general_dilated(
+        x, ws, window_strides=(1,) * nd, padding=pads,
+        lhs_dilation=tuple(strides), rhs_dilation=tuple(dilations),
+        dimension_numbers=dn, feature_group_count=groups)
+
+
 @op_emitter('conv2d_transpose')
 def _conv2d_transpose_emit(ctx, op):
     x = ctx.get(op.single_input('Input'))
@@ -90,13 +114,7 @@ def _conv2d_transpose_emit(ctx, op):
     paddings = op.attr('paddings', [0, 0])
     dilations = op.attr('dilations', [1, 1])
     groups = op.attr('groups', 1) or 1
-    out = jax.lax.conv_transpose(
-        x, jnp.swapaxes(w, 0, 1) if groups == 1 else w,
-        strides=tuple(strides),
-        padding=[(paddings[0], paddings[0]), (paddings[1], paddings[1])],
-        rhs_dilation=tuple(dilations),
-        dimension_numbers=('NCHW', 'OIHW', 'NCHW'),
-        transpose_kernel=True)
+    out = conv_transpose_nd(x, w, strides, paddings, dilations, groups, 2)
     ctx.set(op.single_output('Output'), out)
 
 
@@ -128,6 +146,22 @@ register_vjp_grad('conv2d_transpose', in_slots=('Input', 'Filter'),
 # pool2d (reference pool_op.cc)
 # ---------------------------------------------------------------------------
 
+def _pool_spatial_pads(in_sizes, ksize, strides, paddings, ceil_mode):
+    """(lo, hi) pads per spatial dim; ceil_mode adds asymmetric right
+    padding so reduce_window produces the ceil-formula output size the
+    shape inference promises (reference pool_op.cc ceil semantics)."""
+    pads = []
+    for i, n in enumerate(in_sizes):
+        if ceil_mode:
+            out = (n - ksize[i] + 2 * paddings[i] + strides[i] - 1) \
+                // strides[i] + 1
+        else:
+            out = (n - ksize[i] + 2 * paddings[i]) // strides[i] + 1
+        extra = (out - 1) * strides[i] + ksize[i] - (n + 2 * paddings[i])
+        pads.append((paddings[i], paddings[i] + max(extra, 0)))
+    return pads
+
+
 @op_emitter('pool2d')
 def _pool2d_emit(ctx, op):
     x = ctx.get(op.single_input('X'))
@@ -141,14 +175,16 @@ def _pool2d_emit(ctx, op):
         paddings = [0, 0]
     window = (1, 1, ksize[0], ksize[1])
     strides4 = (1, 1, strides[0], strides[1])
-    pads = ((0, 0), (0, 0), (paddings[0], paddings[0]),
-            (paddings[1], paddings[1]))
+    sp = _pool_spatial_pads([x.shape[2], x.shape[3]], ksize, strides,
+                            paddings, op.attr('ceil_mode', False))
+    pads = ((0, 0), (0, 0)) + tuple(sp)
+    padded = any(lo or hi for lo, hi in sp)
     if ptype == 'max':
         init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
         out = jax.lax.reduce_window(x, init, jax.lax.max, window, strides4, pads)
     else:
         summed = jax.lax.reduce_window(x, 0.0, jax.lax.add, window, strides4, pads)
-        if op.attr('exclusive', True) and (paddings[0] or paddings[1]):
+        if op.attr('exclusive', True) and padded:
             ones = jnp.ones_like(x)
             counts = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window,
                                            strides4, pads)

@@ -1,0 +1,115 @@
+"""Quantization-aware-training ops: fake_quantize, fake_dequantize_max_abs.
+
+TPU-native re-design of reference paddle/fluid/operators/{fake_quantize_op.cc,
+fake_dequantize_op.cc}. The fake-quantize round-trip (quantize to
+bit_length-bit integers, keep the float container) runs inside the jitted
+step; the straight-through-estimator gradient (dOut/dX = 1 within range)
+comes from a custom grad maker rather than differentiating the round().
+
+quantize_type:
+- abs_max:                scale = max(|x|) of the current batch
+- range_abs_max:          scale = max(batch abs_max, moving scale window);
+                          OutMovingScale is written back like batch_norm's
+                          running stats (functional state, executor writes
+                          the persistable var)
+- moving_average_abs_max: scale = 0.9*prev + 0.1*batch abs_max
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework import grad_var_name
+from ..registry import register_op, op_emitter, same_shape_infer
+
+
+@op_emitter('fake_quantize')
+def _fake_quantize_emit(ctx, op):
+    x = ctx.get(op.single_input('X'))
+    bits = op.attr('bit_length', 8)
+    qmax = float((1 << (bits - 1)) - 1)
+    qtype = op.attr('quantize_type', 'abs_max')
+    batch_scale = jnp.max(jnp.abs(x))
+    if qtype == 'abs_max' or not op.input('InMovingScale'):
+        scale = batch_scale
+    else:
+        prev = ctx.get(op.single_input('InMovingScale')).reshape(())
+        if qtype == 'range_abs_max':
+            scale = jnp.maximum(batch_scale, prev)
+        else:   # moving_average_abs_max
+            scale = 0.9 * prev + 0.1 * batch_scale
+    if ctx.is_test and op.input('InMovingScale'):
+        scale = ctx.get(op.single_input('InMovingScale')).reshape(())
+    safe = jnp.where(scale > 0, scale, 1.0)
+    q = jnp.round(jnp.clip(x / safe, -1.0, 1.0) * qmax)
+    ctx.set(op.single_output('Out'), q * safe / qmax)
+    if op.output('OutMovingScale'):
+        ctx.set(op.single_output('OutMovingScale'),
+                scale.reshape((1,)).astype(x.dtype))
+
+
+def _fake_quantize_infer(op, block):
+    x = block.var_recursive(op.single_input('X'))
+    out = block.var_recursive(op.single_output('Out'))
+    out.shape = x.shape
+    out.dtype = x.dtype
+    if op.output('OutMovingScale'):
+        ms = block.var_recursive(op.single_output('OutMovingScale'))
+        ms.shape = (1,)
+        ms.dtype = x.dtype
+
+
+def _fake_quantize_grad_maker(op, block):
+    """Straight-through estimator: X@GRAD = Out@GRAD masked to the range
+    the forward pass did NOT clip, |x| <= scale — where scale is the
+    same quantity the forward used (moving scale for the range/moving
+    types, batch abs-max for abs_max)."""
+    inputs = {'X': list(op.input('X')),
+              'Out@GRAD': [grad_var_name(n) for n in op.output('Out')]}
+    if op.input('InMovingScale'):
+        inputs['InMovingScale'] = list(op.input('InMovingScale'))
+    return [dict(type='fake_quantize_grad',
+                 inputs=inputs,
+                 outputs={'X@GRAD': [grad_var_name(n)
+                                     for n in op.input('X')]},
+                 attrs=dict(op.attrs))]
+
+
+def _forward_scale(ctx, op, x):
+    """Recompute the scale exactly as the forward emitter chose it."""
+    qtype = op.attr('quantize_type', 'abs_max')
+    batch_scale = jnp.max(jnp.abs(x))
+    if qtype == 'abs_max' or not op.input('InMovingScale'):
+        return batch_scale
+    prev = ctx.get(op.single_input('InMovingScale')).reshape(())
+    if qtype == 'range_abs_max':
+        return jnp.maximum(batch_scale, prev)
+    return 0.9 * prev + 0.1 * batch_scale
+
+
+@op_emitter('fake_quantize_grad')
+def _fake_quantize_grad_emit(ctx, op):
+    x = ctx.get(op.single_input('X'))
+    g = ctx.get(op.single_input('Out@GRAD'))
+    scale = _forward_scale(ctx, op, x)
+    safe = jnp.where(scale > 0, scale, 1.0)
+    inside = jnp.abs(x) <= safe
+    ctx.set(op.single_output('X@GRAD'),
+            jnp.where(inside, g, jnp.zeros_like(g)))
+
+
+register_op('fake_quantize', infer_shape=_fake_quantize_infer,
+            grad=_fake_quantize_grad_maker)
+register_op('fake_quantize_grad')
+
+
+@op_emitter('fake_dequantize_max_abs')
+def _fake_dequantize_emit(ctx, op):
+    x = ctx.get(op.single_input('X'))
+    scale = ctx.get(op.single_input('Scale')).reshape(())
+    max_range = op.attr('max_range')
+    ctx.set(op.single_output('Out'), x * (scale / max_range))
+
+
+register_op('fake_dequantize_max_abs', infer_shape=same_shape_infer(),
+            no_grad=True)

@@ -1,0 +1,585 @@
+"""Round-3 op-inventory sweep: the remaining reference forward ops
+(SURVEY §2.2; reference operators/*.cc) — misc math/tensor, 3D conv/pool,
+indexed pooling, CTC, RNN units, fake quantization, detection extras."""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.framework import Program, program_guard
+
+from op_test import OpTest
+
+
+def _run_op(op_type, inputs, outputs, attrs=None):
+    """Build a one-op program and return fetched outputs as numpy."""
+    t = OpTest()
+    t.op_type = op_type
+    t.inputs = inputs
+    t.outputs = outputs
+    if attrs:
+        t.attrs = attrs
+    prog, startup, feed, _i, op_out = t._build()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        names = [n for slot in outputs for n in op_out[slot]]
+        return dict(zip(names, exe.run(prog, feed=feed, fetch_list=names)))
+
+
+# ---------------------------------------------------------------------------
+# simple math / tensor ops
+# ---------------------------------------------------------------------------
+
+class TestSign(OpTest):
+    def test(self):
+        self.op_type = 'sign'
+        x = np.random.uniform(-1, 1, (4, 5)).astype('float32')
+        self.inputs = {'X': x}
+        self.outputs = {'Out': np.sign(x)}
+        self.check_output()
+
+
+class TestMinus(OpTest):
+    def test(self):
+        self.op_type = 'minus'
+        x = np.random.rand(3, 4).astype('float32')
+        y = np.random.rand(3, 4).astype('float32')
+        self.inputs = {'X': x, 'Y': y}
+        self.outputs = {'Out': x - y}
+        self.check_output()
+        self.check_grad(['X', 'Y'])
+
+
+class TestMultiplex(OpTest):
+    def test(self):
+        self.op_type = 'multiplex'
+        xs = [np.random.rand(5, 3).astype('float32') for _ in range(4)]
+        ids = np.array([[0], [3], [1], [2], [0]], 'int32')
+        want = np.stack([xs[ids[i, 0]][i] for i in range(5)])
+        self.inputs = {'X': [('x%d' % i, x) for i, x in enumerate(xs)],
+                       'Ids': ids}
+        self.outputs = {'Out': want}
+        self.check_output()
+        self.check_grad(['x0', 'x1'], no_grad_set={'Ids'})
+
+
+class TestRankLoss(OpTest):
+    def test(self):
+        self.op_type = 'rank_loss'
+        label = np.random.randint(0, 2, (6, 1)).astype('float32')
+        left = np.random.rand(6, 1).astype('float32')
+        right = np.random.rand(6, 1).astype('float32')
+        o = left - right
+        want = -label * o + np.log(1 + np.exp(o))
+        self.inputs = {'Label': label, 'Left': left, 'Right': right}
+        self.outputs = {'Out': want}
+        self.check_output(atol=1e-5)
+        self.check_grad(['Left', 'Right'], no_grad_set={'Label'})
+
+
+class TestModifiedHuberLoss(OpTest):
+    def test(self):
+        self.op_type = 'modified_huber_loss'
+        x = np.random.uniform(-2, 2, (8, 1)).astype('float32')
+        y = np.random.randint(0, 2, (8, 1)).astype('float32')
+        s = 2 * y - 1
+        z = x * s
+        want = np.where(z < -1, -4 * z, np.square(np.maximum(1 - z, 0)))
+        self.inputs = {'X': x, 'Y': y}
+        self.outputs = {'Out': want.astype('float32'),
+                        'IntermediateVal': z.astype('float32')}
+        self.check_output(no_check_set=('IntermediateVal',))
+
+
+class TestL1NormAndNorm(OpTest):
+    def test_l1(self):
+        self.op_type = 'l1_norm'
+        x = np.random.uniform(-1, 1, (4, 6)).astype('float32')
+        self.inputs = {'X': x}
+        self.outputs = {'Out': np.array([np.abs(x).sum()], 'float32')}
+        self.check_output()
+
+    def test_l2_normalize(self):
+        self.op_type = 'norm'
+        x = np.random.rand(3, 5).astype('float32') + 0.1
+        norm = np.sqrt((x ** 2).sum(axis=1, keepdims=True) + 1e-10)
+        self.inputs = {'X': x}
+        self.outputs = {'Out': x / norm, 'Norm': norm}
+        self.attrs = {'axis': 1}
+        self.check_output(atol=1e-5)
+        self.check_grad(['X'], output_names='Out')
+
+
+def test_mean_iou():
+    preds = np.array([0, 1, 1, 2, 2, 2], 'int32')
+    labels = np.array([0, 1, 2, 2, 2, 1], 'int32')
+    got = _run_op('mean_iou',
+                  {'Predictions': preds, 'Labels': labels},
+                  {'OutMeanIou': np.zeros(1, 'float32'),
+                   'OutWrong': np.zeros(3, 'int32'),
+                   'OutCorrect': np.zeros(3, 'int32')},
+                  {'num_classes': 3})
+    # class ious: 0: 1/1; 1: 1/3 (inter 1, union 2+2-1); 2: 2/4
+    want = (1.0 + 1.0 / 3.0 + 0.5) / 3.0
+    np.testing.assert_allclose(got['OutMeanIou'], [want], rtol=1e-5)
+
+
+class TestShapeOps(OpTest):
+    def test_flatten(self):
+        self.op_type = 'flatten'
+        x = np.random.rand(2, 3, 4, 5).astype('float32')
+        self.inputs = {'X': x}
+        self.outputs = {'Out': x.reshape(6, 20)}
+        self.attrs = {'axis': 2}
+        self.check_output()
+        self.check_grad(['X'])
+
+    def test_unstack(self):
+        self.op_type = 'unstack'
+        x = np.random.rand(3, 4).astype('float32')
+        self.inputs = {'X': x}
+        self.outputs = {'Y': [('y%d' % i, x[i]) for i in range(3)]}
+        self.attrs = {'axis': 0}
+        self.check_output()
+
+    def test_crop(self):
+        self.op_type = 'crop'
+        x = np.random.rand(5, 6).astype('float32')
+        self.inputs = {'X': x}
+        self.outputs = {'Out': x[1:4, 2:5]}
+        self.attrs = {'shape': [3, 3], 'offsets': [1, 2]}
+        self.check_output()
+        self.check_grad(['X'])
+
+    def test_pad_constant_like(self):
+        self.op_type = 'pad_constant_like'
+        x = np.zeros((4, 5), 'float32')
+        y = np.random.rand(2, 3).astype('float32')
+        want = np.full((4, 5), 1.5, 'float32')
+        want[:2, :3] = y
+        self.inputs = {'X': x, 'Y': y}
+        self.outputs = {'Out': want}
+        self.attrs = {'pad_value': 1.5}
+        self.check_output()
+        self.check_grad(['Y'], no_grad_set={'X'})
+
+    def test_argmin(self):
+        self.op_type = 'argmin'
+        x = np.random.rand(4, 7).astype('float32')
+        self.inputs = {'X': x}
+        self.outputs = {'Out': np.argmin(x, axis=1).astype('int32')}
+        self.attrs = {'axis': 1}
+        self.check_output()
+
+
+class TestBilinear(OpTest):
+    def test_tensor_product(self):
+        self.op_type = 'bilinear_tensor_product'
+        x = np.random.rand(4, 3).astype('float32')
+        y = np.random.rand(4, 5).astype('float32')
+        w = np.random.rand(6, 3, 5).astype('float32')
+        b = np.random.rand(1, 6).astype('float32')
+        want = np.einsum('nd,ode,ne->no', x, w, y) + b
+        self.inputs = {'X': x, 'Y': y, 'Weight': w, 'Bias': b}
+        self.outputs = {'Out': want.astype('float32')}
+        self.check_output(atol=1e-4)
+        self.check_grad(['X', 'Y', 'Weight'], max_relative_error=0.01)
+
+    def test_interp(self):
+        self.op_type = 'bilinear_interp'
+        x = np.arange(16, dtype='float32').reshape(1, 1, 4, 4)
+        # align-corners doubling: corners must be preserved
+        got = _run_op('bilinear_interp', {'X': x},
+                      {'Out': np.zeros((1, 1, 7, 7), 'float32')},
+                      {'out_h': 7, 'out_w': 7})['Out']
+        assert got.shape == (1, 1, 7, 7)
+        np.testing.assert_allclose(got[0, 0, 0, 0], 0.0, atol=1e-6)
+        np.testing.assert_allclose(got[0, 0, -1, -1], 15.0, atol=1e-5)
+        np.testing.assert_allclose(got[0, 0, 0, -1], 3.0, atol=1e-5)
+        # interior is the exact bilinear blend on the doubled grid
+        np.testing.assert_allclose(got[0, 0, 1, 1], 2.5, atol=1e-5)
+
+
+def test_fill_family():
+    got = _run_op('fill', {}, {'Out': np.zeros((2, 2), 'float32')},
+                  {'shape': [2, 2], 'value': [1.0, 2.0, 3.0, 4.0],
+                   'dtype': 'float32'})
+    np.testing.assert_allclose(got['Out'],
+                               [[1, 2], [3, 4]])
+    x = np.zeros((5, 7), 'float32')
+    got = _run_op('fill_constant_batch_size_like', {'Input': x},
+                  {'Out': np.zeros((5, 3), 'float32')},
+                  {'shape': [-1, 3], 'value': 2.5, 'dtype': 'float32'})
+    assert got['Out'].shape == (5, 3)
+    np.testing.assert_allclose(got['Out'], 2.5)
+
+
+def test_random_crop():
+    x = np.arange(2 * 8 * 8, dtype='float32').reshape(2, 8, 8)
+    got = _run_op('random_crop', {'X': x},
+                  {'Out': np.zeros((2, 3, 3), 'float32')},
+                  {'shape': [3, 3]})['Out']
+    assert got.shape == (2, 3, 3)
+    # every crop must be a contiguous window of the source
+    for b in range(2):
+        first = got[b, 0, 0]
+        r, c = divmod(int(first) - b * 64, 8)
+        np.testing.assert_allclose(got[b], x[b, r:r + 3, c:c + 3])
+
+
+def test_lod_reset():
+    prog, startup = Program(), Program()
+    with program_guard(prog, startup):
+        x = fluid.layers.data(name='x', shape=[6, 2], dtype='float32',
+                              append_batch_size=False)
+        block = prog.global_block()
+        out = block.create_var(name='out', dtype='float32')
+        lens = block.create_var(name='out_lens', dtype='int32')
+        block.append_op(type='lod_reset', inputs={'X': [x.name]},
+                        outputs={'Out': [out.name], 'OutLens': [lens.name]},
+                        attrs={'target_lod': [0, 4, 6]})
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    o, l = exe.run(prog, feed={'x': np.ones((6, 2), 'float32')},
+                   fetch_list=['out', 'out_lens'])
+    np.testing.assert_allclose(o, np.ones((6, 2)))
+    np.testing.assert_array_equal(l, [4, 2])
+
+
+# ---------------------------------------------------------------------------
+# 3D conv/pool family
+# ---------------------------------------------------------------------------
+
+class TestConv3D(OpTest):
+    atol = 1e-4
+    rtol = 1e-4
+
+    def test(self):
+        self.op_type = 'conv3d'
+        x = np.random.rand(2, 3, 5, 6, 6).astype('float32')
+        w = np.random.rand(4, 3, 2, 3, 3).astype('float32')
+        import torch
+        import torch.nn.functional as F
+        want = F.conv3d(torch.tensor(x), torch.tensor(w), stride=(1, 2, 2),
+                        padding=(0, 1, 1)).numpy()
+        self.inputs = {'Input': x, 'Filter': w}
+        self.outputs = {'Output': want}
+        self.attrs = {'strides': [1, 2, 2], 'paddings': [0, 1, 1]}
+        self.check_output()
+        self.check_grad(['Input', 'Filter'], max_relative_error=0.05)
+
+
+def test_conv3d_transpose_and_depthwise_transpose():
+    import torch
+    import torch.nn.functional as F
+    x = np.random.rand(1, 2, 3, 4, 4).astype('float32')
+    w = np.random.rand(2, 3, 2, 2, 2).astype('float32')   # [in, out, k...]
+    want = F.conv_transpose3d(torch.tensor(x), torch.tensor(w),
+                              stride=2).numpy()
+    got = _run_op('conv3d_transpose', {'Input': x, 'Filter': w},
+                  {'Output': want}, {'strides': [2, 2, 2]})['Output']
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+    x2 = np.random.rand(2, 3, 5, 5).astype('float32')
+    w2 = np.random.rand(3, 1, 3, 3).astype('float32')
+    want2 = F.conv_transpose2d(torch.tensor(x2), torch.tensor(w2),
+                               stride=2, padding=1, groups=3).numpy()
+    got2 = _run_op('depthwise_conv2d_transpose',
+                   {'Input': x2, 'Filter': w2}, {'Output': want2},
+                   {'strides': [2, 2], 'paddings': [1, 1]})['Output']
+    np.testing.assert_allclose(got2, want2, rtol=1e-4, atol=1e-4)
+
+
+def test_pool3d():
+    import torch
+    import torch.nn.functional as F
+    x = np.random.rand(2, 3, 4, 6, 6).astype('float32')
+    want = F.max_pool3d(torch.tensor(x), 2, stride=2).numpy()
+    got = _run_op('pool3d', {'X': x}, {'Out': want},
+                  {'pooling_type': 'max', 'ksize': [2, 2, 2],
+                   'strides': [2, 2, 2], 'paddings': [0, 0, 0]})['Out']
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+    want_avg = F.avg_pool3d(torch.tensor(x), 2, stride=2).numpy()
+    got_avg = _run_op('pool3d', {'X': x}, {'Out': want_avg},
+                      {'pooling_type': 'avg', 'ksize': [2, 2, 2],
+                       'strides': [2, 2, 2], 'paddings': [0, 0, 0]})['Out']
+    np.testing.assert_allclose(got_avg, want_avg, rtol=1e-5)
+
+
+def test_max_pool_with_index_and_unpool():
+    import torch
+    import torch.nn.functional as F
+    x = np.random.rand(2, 3, 6, 6).astype('float32')
+    tv, ti = F.max_pool2d(torch.tensor(x), 2, stride=2, return_indices=True)
+    got = _run_op('max_pool2d_with_index', {'X': x},
+                  {'Out': tv.numpy(), 'Mask': ti.numpy().astype('int32')},
+                  {'ksize': [2, 2], 'strides': [2, 2], 'paddings': [0, 0]})
+    np.testing.assert_allclose(got['Out'], tv.numpy(), rtol=1e-6)
+    np.testing.assert_array_equal(got['Mask'], ti.numpy())
+
+    # unpool inverts: scatter pooled values back
+    want_unpooled = F.max_unpool2d(tv, ti, 2, stride=2).numpy()
+    got_un = _run_op('unpool', {'X': tv.numpy(),
+                                'Indices': ti.numpy().astype('int32')},
+                     {'Out': want_unpooled},
+                     {'unpooled_height': 6, 'unpooled_width': 6})['Out']
+    np.testing.assert_allclose(got_un, want_unpooled, rtol=1e-6)
+
+    # 3D with-index
+    x3 = np.random.rand(1, 2, 4, 4, 4).astype('float32')
+    tv3, ti3 = F.max_pool3d(torch.tensor(x3), 2, stride=2,
+                            return_indices=True)
+    got3 = _run_op('max_pool3d_with_index', {'X': x3},
+                   {'Out': tv3.numpy(), 'Mask': ti3.numpy().astype('int32')},
+                   {'ksize': [2, 2, 2], 'strides': [2, 2, 2],
+                    'paddings': [0, 0, 0]})
+    np.testing.assert_allclose(got3['Out'], tv3.numpy(), rtol=1e-6)
+    np.testing.assert_array_equal(got3['Mask'], ti3.numpy())
+
+
+def test_spp():
+    x = np.random.rand(2, 3, 7, 9).astype('float32')
+    c = 3
+    got = _run_op('spp', {'X': x},
+                  {'Out': np.zeros((2, c * (1 + 4)), 'float32')},
+                  {'pyramid_height': 2, 'pooling_type': 'max'})['Out']
+    assert got.shape == (2, c * 5)
+    # level 0 = global max pool
+    np.testing.assert_allclose(got[:, :c], x.max(axis=(2, 3)), rtol=1e-6)
+
+
+def test_conv_shift():
+    x = np.random.rand(3, 7).astype('float32')
+    y = np.random.rand(3, 3).astype('float32')
+    want = np.zeros_like(x)
+    W, M = 7, 3
+    for b in range(3):
+        for j in range(W):
+            for k in range(M):
+                want[b, j] += x[b, (j + k - M // 2) % W] * y[b, k]
+    got = _run_op('conv_shift', {'X': x, 'Y': y}, {'Out': want})['Out']
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# CTC + RNN units
+# ---------------------------------------------------------------------------
+
+def test_warpctc_matches_torch():
+    import torch
+    import torch.nn.functional as F
+    B, T, K, L = 2, 6, 5, 3
+    rng = np.random.RandomState(0)
+    logits = rng.randn(B, T, K).astype('float32')
+    labels = rng.randint(1, K, (B, L)).astype('int32')
+    lens = np.array([6, 5], 'int32')
+    label_lens = np.array([3, 2], 'int32')
+    got = _run_op('warpctc',
+                  {'Logits': logits, 'Label': labels,
+                   'SeqLens': lens, 'LabelLens': label_lens},
+                  {'Loss': np.zeros((B, 1), 'float32')},
+                  {'blank': 0})['Loss']
+    t_logp = F.log_softmax(torch.tensor(logits).transpose(0, 1), dim=-1)
+    want = F.ctc_loss(t_logp, torch.tensor(labels.astype('int64')),
+                      torch.tensor(lens.astype('int64')),
+                      torch.tensor(label_lens.astype('int64')),
+                      blank=0, reduction='none').numpy()
+    np.testing.assert_allclose(got.ravel(), want, rtol=1e-4, atol=1e-4)
+
+
+def test_ctc_align():
+    x = np.array([[0, 1, 1, 0, 2, 2],
+                  [3, 3, 0, 0, 3, 1]], 'int32')
+    lens = np.array([6, 5], 'int32')
+    got = _run_op('ctc_align', {'Input': x, 'SeqLens': lens},
+                  {'Output': np.zeros_like(x),
+                   'OutLens': np.zeros(2, 'int32')},
+                  {'blank': 0, 'padding_value': 0})
+    np.testing.assert_array_equal(got['Output'][0, :2], [1, 2])
+    np.testing.assert_array_equal(got['OutLens'], [2, 2])
+    np.testing.assert_array_equal(got['Output'][1, :2], [3, 3])
+
+
+def test_lstm_unit_and_gru_unit():
+    B, D = 4, 5
+    rng = np.random.RandomState(1)
+    x = rng.randn(B, 4 * D).astype('float32')
+    c_prev = rng.randn(B, D).astype('float32')
+    got = _run_op('lstm_unit', {'X': x, 'C_prev': c_prev},
+                  {'C': np.zeros((B, D), 'float32'),
+                   'H': np.zeros((B, D), 'float32')},
+                  {'forget_bias': 0.5})
+
+    def sig(v):
+        return 1 / (1 + np.exp(-v))
+    i, g, f, o = np.split(x, 4, axis=1)
+    c = c_prev * sig(f + 0.5) + sig(i) * np.tanh(g)
+    h = np.tanh(c) * sig(o)
+    np.testing.assert_allclose(got['C'], c, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(got['H'], h, rtol=1e-5, atol=1e-5)
+
+    xg = rng.randn(B, 3 * D).astype('float32')
+    h_prev = rng.randn(B, D).astype('float32')
+    w = rng.randn(D, 3 * D).astype('float32')
+    got = _run_op('gru_unit',
+                  {'Input': xg, 'HiddenPrev': h_prev, 'Weight': w},
+                  {'Hidden': np.zeros((B, D), 'float32')})
+    # reference gru_unit_op.h: u=slice0, r=slice1, c=act(x_c+(r*h)W_c),
+    # h = u*(c - h_prev) + h_prev
+    ur = xg[:, :2 * D] + h_prev @ w[:, :2 * D]
+    u, r = np.split(sig(ur), 2, axis=1)
+    cand = np.tanh(xg[:, 2 * D:] + (r * h_prev) @ w[:, 2 * D:])
+    want = u * (cand - h_prev) + h_prev
+    np.testing.assert_allclose(got['Hidden'], want, rtol=1e-4, atol=1e-4)
+
+
+def test_lstmp_shapes_and_masking():
+    B, T, H, P = 3, 5, 4, 2
+    rng = np.random.RandomState(2)
+    x = rng.randn(B, T, 4 * H).astype('float32')
+    w = rng.randn(P, 4 * H).astype('float32')
+    proj = rng.randn(H, P).astype('float32')
+    b = np.zeros((1, 4 * H), 'float32')
+    lens = np.array([5, 3, 1], 'int32')
+    got = _run_op('lstmp',
+                  {'Input': x, 'Weight': w, 'ProjWeight': proj, 'Bias': b,
+                   'SeqLens': lens},
+                  {'Projection': np.zeros((B, T, P), 'float32'),
+                   'Cell': np.zeros((B, T, H), 'float32')})
+    assert got['Projection'].shape == (B, T, P)
+    # positions beyond the length are masked to zero
+    np.testing.assert_allclose(got['Projection'][1, 3:], 0.0)
+    np.testing.assert_allclose(got['Cell'][2, 1:], 0.0)
+    assert np.abs(got['Projection'][0]).sum() > 0
+
+
+# ---------------------------------------------------------------------------
+# fake quantization
+# ---------------------------------------------------------------------------
+
+def test_fake_quantize_abs_max_roundtrip():
+    x = np.random.uniform(-2, 2, (4, 6)).astype('float32')
+    got = _run_op('fake_quantize', {'X': x},
+                  {'Out': x, 'OutMovingScale': np.zeros(1, 'float32')},
+                  {'quantize_type': 'abs_max', 'bit_length': 8})
+    scale = np.abs(x).max()
+    q = np.round(np.clip(x / scale, -1, 1) * 127)
+    np.testing.assert_allclose(got['Out'], q * scale / 127, rtol=1e-5,
+                               atol=1e-6)
+    np.testing.assert_allclose(got['OutMovingScale'], [scale], rtol=1e-6)
+    # quantization error bounded by half a step
+    assert np.abs(got['Out'] - x).max() <= scale / 127
+
+def test_fake_quantize_ste_grad():
+    prog, startup = Program(), Program()
+    with program_guard(prog, startup):
+        x = fluid.layers.data(name='x', shape=[4], dtype='float32')
+        x.stop_gradient = False
+        block = prog.global_block()
+        out = block.create_var(name='q', dtype='float32')
+        ms = block.create_var(name='ms', dtype='float32')
+        block.append_op(type='fake_quantize', inputs={'X': [x.name]},
+                        outputs={'Out': ['q'], 'OutMovingScale': ['ms']},
+                        attrs={'quantize_type': 'abs_max'})
+        loss = fluid.layers.reduce_mean(block.var('q'))
+        grads = fluid.calc_gradient(loss, [x])
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    g, = exe.run(prog, feed={'x': np.array([[0.5, -0.3, 1.0, -1.0]],
+                                           'float32')},
+                 fetch_list=[grads[0]])
+    # STE: gradient passes through untouched (all inside range)
+    np.testing.assert_allclose(np.asarray(g), 0.25 * np.ones((1, 4)),
+                               rtol=1e-5)
+
+
+def test_fake_dequantize():
+    x = np.array([[127.0, -64.0]], 'float32')
+    scale = np.array([2.0], 'float32')
+    got = _run_op('fake_dequantize_max_abs', {'X': x, 'Scale': scale},
+                  {'Out': x}, {'max_range': 127.0})['Out']
+    np.testing.assert_allclose(got, x * 2.0 / 127.0, rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# detection extras
+# ---------------------------------------------------------------------------
+
+def test_polygon_box_transform():
+    x = np.random.rand(1, 4, 3, 5).astype('float32')
+    got = _run_op('polygon_box_transform', {'Input': x},
+                  {'Output': x})['Output']
+    wi = np.arange(5)[None, None, None, :]
+    hi = np.arange(3)[None, None, :, None]
+    want = np.where((np.arange(4) % 2 == 0)[None, :, None, None],
+                    wi - x, hi - x)
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_mine_hard_examples():
+    cls_loss = np.array([[5.0, 4.0, 3.0, 2.0, 1.0, 0.5]], 'float32')
+    match = np.array([[0, -1, -1, -1, 1, -1]], 'int32')
+    got = _run_op('mine_hard_examples',
+                  {'ClsLoss': cls_loss, 'MatchIndices': match},
+                  {'NegMask': match, 'UpdatedMatchIndices': match},
+                  {'neg_pos_ratio': 1.0, 'mining_type': 'max_negative'})
+    # 2 positives -> budget 2 negatives, hardest first: priors 1 and 2
+    np.testing.assert_array_equal(got['NegMask'],
+                                  [[0, 1, 1, 0, 0, 0]])
+    # positives keep gt index, mined negatives -1, unselected -> -2
+    np.testing.assert_array_equal(got['UpdatedMatchIndices'],
+                                  [[0, -1, -1, -2, 1, -2]])
+
+
+def test_detection_map_perfect_and_miss():
+    # one image, one gt of class 1, one perfect detection -> mAP 1
+    det = np.array([[[1, 0.9, 0.1, 0.1, 0.4, 0.4]]], 'float32')
+    gt = np.array([[[1, 0.1, 0.1, 0.4, 0.4]]], 'float32')
+    got = _run_op('detection_map', {'DetectRes': det, 'Label': gt},
+                  {'MAP': np.zeros(1, 'float32')},
+                  {'class_num': 2, 'overlap_threshold': 0.5})['MAP']
+    np.testing.assert_allclose(got, [1.0], atol=1e-6)
+    # detection misses (no overlap) -> AP 0
+    det2 = np.array([[[1, 0.9, 0.6, 0.6, 0.9, 0.9]]], 'float32')
+    got2 = _run_op('detection_map', {'DetectRes': det2, 'Label': gt},
+                   {'MAP': np.zeros(1, 'float32')},
+                   {'class_num': 2, 'overlap_threshold': 0.5})['MAP']
+    np.testing.assert_allclose(got2, [0.0], atol=1e-6)
+
+
+def test_detection_map_with_padded_and_fp_detections():
+    """Regression: padded (-1) and false-positive rows must not poison
+    the per-gt best-score max with NaN."""
+    det = np.array([[[1, 0.9, 0.1, 0.1, 0.4, 0.4],    # TP
+                     [1, 0.8, 0.6, 0.6, 0.9, 0.9],    # FP (no overlap)
+                     [-1, 0.0, 0.0, 0.0, 0.0, 0.0]]], 'float32')  # pad
+    gt = np.array([[[1, 0.1, 0.1, 0.4, 0.4]]], 'float32')
+    got = _run_op('detection_map', {'DetectRes': det, 'Label': gt},
+                  {'MAP': np.zeros(1, 'float32')},
+                  {'class_num': 2, 'overlap_threshold': 0.5})['MAP']
+    # integral AP: recall jumps to 1 at the first (TP) detection
+    np.testing.assert_allclose(got, [1.0], atol=1e-6)
+
+
+def test_pool_ceil_mode_matches_inference():
+    """Regression: emitter output shape must equal the inferred
+    ceil-mode shape, and match torch's ceil_mode pooling."""
+    import torch
+    import torch.nn.functional as F
+    x = np.random.rand(1, 2, 5, 5).astype('float32')
+    want = F.max_pool2d(torch.tensor(x), 2, stride=2,
+                        ceil_mode=True).numpy()
+    got = _run_op('pool2d', {'X': x}, {'Out': want},
+                  {'pooling_type': 'max', 'ksize': [2, 2],
+                   'strides': [2, 2], 'paddings': [0, 0],
+                   'ceil_mode': True})['Out']
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+
+    x3 = np.random.rand(1, 2, 5, 5, 5).astype('float32')
+    want3 = F.avg_pool3d(torch.tensor(x3), 2, stride=2, ceil_mode=True,
+                         count_include_pad=False).numpy()
+    got3 = _run_op('pool3d', {'X': x3}, {'Out': want3},
+                   {'pooling_type': 'avg', 'ksize': [2, 2, 2],
+                    'strides': [2, 2, 2], 'paddings': [0, 0, 0],
+                    'ceil_mode': True, 'exclusive': True})['Out']
+    np.testing.assert_allclose(got3, want3, rtol=1e-5)
