@@ -551,3 +551,41 @@ class DynamicRNN(object):
 
     def final_states(self):
         return self._rnn.final_states()
+
+
+def lod_rank_table(x, level=0):
+    """Batch permutation sorting rows by descending sequence length
+    (reference layers/control_flow.py lod_rank_table -> lod_rank_table_op;
+    in the padded contract a RankTable is just that permutation)."""
+    helper = LayerHelper('lod_rank_table')
+    out = helper.create_variable_for_type_inference('int32')
+    inputs = {'X': [x]}
+    lens = getattr(x, 'seq_lens', None)
+    if lens is not None:
+        inputs['SeqLens'] = [lens]
+    helper.append_op(type='lod_rank_table', inputs=inputs,
+                     outputs={'Out': [out]})
+    out.stop_gradient = True
+    return out
+
+
+def reorder_lod_tensor_by_rank(x, rank_table):
+    """Gather batch rows into rank-table order (reference
+    layers/control_flow.py reorder_lod_tensor_by_rank)."""
+    helper = LayerHelper('reorder_lod_tensor_by_rank')
+    out = helper.create_variable_for_type_inference(x.dtype)
+    inputs = {'X': [x], 'RankTable': [rank_table]}
+    outputs = {'Out': [out]}
+    lens = getattr(x, 'seq_lens', None)
+    if lens is not None:
+        out_lens = helper.create_variable_for_type_inference('int32')
+        inputs['SeqLens'] = [lens]
+        outputs['OutLens'] = [out_lens]
+        out.seq_lens = out_lens
+        out.lod_level = max(1, x.lod_level)
+    helper.append_op(type='reorder_lod_tensor_by_rank', inputs=inputs,
+                     outputs=outputs)
+    return out
+
+
+__all__ += ['lod_rank_table', 'reorder_lod_tensor_by_rank']

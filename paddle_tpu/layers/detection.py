@@ -10,7 +10,8 @@ from ..layer_helper import LayerHelper
 __all__ = ['prior_box', 'box_coder', 'iou_similarity', 'multiclass_nms',
            'detection_output', 'bipartite_match', 'target_assign',
            'anchor_generator', 'ssd_loss', 'roi_align', 'roi_pool',
-           'generate_proposals', 'rpn_target_assign']
+           'generate_proposals', 'rpn_target_assign',
+           'detection_map', 'multi_box_head']
 
 
 def prior_box(input, image, min_sizes, max_sizes=None,
@@ -262,3 +263,90 @@ def rpn_target_assign(anchor_box, gt_boxes, gt_valid=None,
     labels.stop_gradient = True
     tgt.stop_gradient = True
     return labels, tgt
+
+
+def detection_map(detect_res, label, class_num, background_label=0,
+                  overlap_threshold=0.5, evaluate_difficult=True,
+                  ap_version='integral'):
+    """Per-batch mAP (reference layers/detection.py detection_map ->
+    detection_map_op; the cross-batch accumulator state lives in
+    metrics-side averaging here, see ops/detection_ops.py)."""
+    helper = LayerHelper('detection_map')
+    m = helper.create_variable_for_type_inference('float32')
+    helper.append_op(type='detection_map',
+                     inputs={'DetectRes': [detect_res], 'Label': [label]},
+                     outputs={'MAP': [m]},
+                     attrs={'class_num': class_num,
+                            'overlap_threshold': overlap_threshold,
+                            'ap_type': ap_version,
+                            'background_label': background_label,
+                            'evaluate_difficult': evaluate_difficult})
+    return m
+
+
+def multi_box_head(inputs, image, base_size, num_classes, aspect_ratios,
+                   min_ratio=None, max_ratio=None, min_sizes=None,
+                   max_sizes=None, steps=None, step_w=None, step_h=None,
+                   offset=0.5, variance=(0.1, 0.1, 0.2, 0.2), flip=True,
+                   clip=False, kernel_size=1, pad=0, stride=1, name=None):
+    """SSD detection head over multiple feature maps (reference
+    layers/detection.py multi_box_head): per-map 3x3/1x1 convs predict
+    box offsets and class scores per prior; prior_box generates the
+    anchor grid per map; everything concatenates into
+    (mbox_locs [N, P, 4], mbox_confs [N, P, C], boxes [P, 4],
+    variances [P, 4])."""
+    from .nn import conv2d, transpose, reshape
+    from .tensor import concat
+
+    n_maps = len(inputs)
+    if min_sizes is None:
+        # the reference's ratio interpolation (detection.py multi_box_head)
+        min_sizes, max_sizes = [], []
+        step = int((max_ratio - min_ratio) / (n_maps - 2)) if n_maps > 2 \
+            else 0
+        min_sizes.append(base_size * 0.1)
+        max_sizes.append(base_size * 0.2)
+        ratio = min_ratio
+        for _ in range(n_maps - 1):
+            min_sizes.append(base_size * ratio / 100.0)
+            max_sizes.append(base_size * (ratio + step) / 100.0)
+            ratio += step
+    locs, confs, boxes_all, vars_all = [], [], [], []
+    for i, feat in enumerate(inputs):
+        mins = min_sizes[i]
+        maxs = max_sizes[i] if max_sizes else None
+        ar = aspect_ratios[i] if isinstance(aspect_ratios[i],
+                                            (list, tuple)) \
+            else [aspect_ratios[i]]
+        step_pair = (steps[i] if steps else
+                     (step_w[i] if step_w else 0.0,
+                      step_h[i] if step_h else 0.0))
+        if not isinstance(step_pair, (list, tuple)):
+            step_pair = (step_pair, step_pair)
+        boxes, var = prior_box(
+            feat, image,
+            min_sizes=mins if isinstance(mins, (list, tuple)) else [mins],
+            max_sizes=(maxs if isinstance(maxs, (list, tuple))
+                       else [maxs]) if maxs else None,
+            aspect_ratios=ar, variance=variance, flip=flip, clip=clip,
+            steps=step_pair, offset=offset)
+        # prior_box emits [H, W, P, 4]; P = priors per cell
+        p_cell = boxes.shape[2]
+        loc = conv2d(feat, num_filters=p_cell * 4,
+                     filter_size=kernel_size, padding=pad, stride=stride)
+        conf = conv2d(feat, num_filters=p_cell * num_classes,
+                      filter_size=kernel_size, padding=pad, stride=stride)
+        # NCHW -> [N, H*W*P, 4 / C]
+        loc = transpose(loc, perm=[0, 2, 3, 1])
+        conf = transpose(conf, perm=[0, 2, 3, 1])
+        locs.append(reshape(loc, shape=[0, -1, 4]))
+        confs.append(reshape(conf, shape=[0, -1, num_classes]))
+        boxes_all.append(reshape(boxes, shape=[-1, 4]))
+        vars_all.append(reshape(var, shape=[-1, 4]))
+    mbox_locs = concat(locs, axis=1) if len(locs) > 1 else locs[0]
+    mbox_confs = concat(confs, axis=1) if len(confs) > 1 else confs[0]
+    box = concat(boxes_all, axis=0) if len(boxes_all) > 1 else boxes_all[0]
+    var = concat(vars_all, axis=0) if len(vars_all) > 1 else vars_all[0]
+    box.stop_gradient = True
+    var.stop_gradient = True
+    return mbox_locs, mbox_confs, box, var

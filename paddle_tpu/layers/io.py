@@ -6,7 +6,9 @@ from ..framework import default_main_program, default_startup_program
 from ..layer_helper import LayerHelper
 from .. import unique_name
 
-__all__ = ['data', 'py_reader', 'read_file', 'double_buffer']
+__all__ = ['data', 'py_reader', 'read_file', 'double_buffer',
+           'open_recordio_file', 'open_files', 'random_data_generator',
+           'shuffle', 'batch', 'load']
 
 
 def data(name, shape, append_batch_size=True, dtype='float32', lod_level=0,
@@ -95,3 +97,129 @@ def double_buffer(reader, place=None, name=None):
     if place is not None:
         reader.device = place.jax_device()
     return reader
+
+
+# ---------------------------------------------------------------------------
+# file/random reader layers (reference layers/io.py: open_recordio_file
+# :345, open_files :724, random_data_generator, shuffle, batch) — the
+# reference builds chains of C++ reader ops (create_recordio_file_reader →
+# create_shuffle_reader → create_batch_reader → double_buffer); here the
+# chain is a sample-generator pipeline feeding the same PyReader blocking
+# queue + device prefetch machinery that py_reader uses, so every reader
+# variant gets async host→HBM staging for free.
+# ---------------------------------------------------------------------------
+
+def _file_reader(sample_gen_creator, shapes, dtypes, lod_levels, name_hint,
+                 pass_num=1):
+    from ..reader.pipeline import PyReader
+    name = unique_name.generate(name_hint)
+    block = default_main_program().global_block()
+    if not block.has_var(name):
+        block.create_var(name=name, shape=(), dtype='float32',
+                         persistable=False, stop_gradient=True)
+    r = PyReader(name, shapes, dtypes, lod_levels=lod_levels)
+    def multi_pass():
+        for _ in range(pass_num) if pass_num > 0 else iter(int, 1):
+            for s in sample_gen_creator():
+                yield s
+    r._sample_gen = multi_pass
+    # default: batch of 1 until layers.batch() re-decorates
+    _set_batched_source(r, 1)
+    return r
+
+
+def _set_batched_source(reader, batch_size, drop_last=True):
+    import numpy as np
+    reader._batch_size = batch_size
+    reader._drop_last = drop_last
+
+    def source():
+        buf = []
+        for sample in reader._sample_gen():
+            buf.append(sample)
+            if len(buf) == batch_size:
+                slots = list(zip(*buf))
+                yield [np.stack([np.asarray(s, dtype=dt) for s in slot])
+                       for slot, dt in zip(slots, reader.dtypes)]
+                buf = []
+        if buf and not drop_last:
+            slots = list(zip(*buf))
+            yield [np.stack([np.asarray(s, dtype=dt) for s in slot])
+                   for slot, dt in zip(slots, reader.dtypes)]
+    reader._source = source
+
+
+def open_recordio_file(filename, shapes, dtypes, lod_levels=None,
+                       pass_num=1, for_parallel=None):
+    """Reader over a RecordIO file (reference layers/io.py:345)."""
+    from .. import recordio as _recordio
+    return _file_reader(_recordio.reader(filename), shapes, dtypes,
+                        lod_levels, 'recordio_reader', pass_num)
+
+
+def open_files(filenames, shapes, dtypes, lod_levels=None, pass_num=1,
+               thread_num=1, buffer_size=None, for_parallel=None):
+    """Reader over many RecordIO files (reference layers/io.py:724 —
+    multithreaded there; file-sequential here, the async device staging
+    happens in the PyReader queue threads)."""
+    from .. import recordio as _recordio
+    return _file_reader(_recordio.reader(list(filenames)), shapes, dtypes,
+                        lod_levels, 'multi_file_reader', pass_num)
+
+
+def random_data_generator(low, high, shapes, lod_levels=None, for_parallel=None):
+    """Uniform-random sample reader (reference
+    create_random_data_generator_op) — test fixture reader."""
+    import numpy as np
+    dtypes = ['float32'] * len(shapes)
+
+    def gen():
+        while True:
+            yield tuple(np.random.uniform(low, high, s).astype('float32')
+                        for s in shapes)
+    return _file_reader(gen, shapes, dtypes, lod_levels,
+                        'random_data_reader', pass_num=1)
+
+
+def shuffle(reader, buffer_size):
+    """Shuffle-buffer decorator on a file reader (reference
+    layers/io.py shuffle -> create_shuffle_reader_op)."""
+    import random as _random
+    inner = reader._sample_gen
+
+    def gen():
+        buf = []
+        for s in inner():
+            buf.append(s)
+            if len(buf) >= buffer_size:
+                _random.shuffle(buf)
+                for b in buf:
+                    yield b
+                buf = []
+        _random.shuffle(buf)
+        for b in buf:
+            yield b
+    reader._sample_gen = gen
+    # re-derive the batched source, preserving any earlier batch() setting
+    _set_batched_source(reader, getattr(reader, '_batch_size', 1),
+                        getattr(reader, '_drop_last', True))
+    return reader
+
+
+def batch(reader, batch_size, drop_last=True):
+    """Batch decorator on a file reader (reference layers/io.py batch ->
+    create_batch_reader_op)."""
+    _set_batched_source(reader, batch_size, drop_last)
+    return reader
+
+
+def load(out, file_path, load_as_fp16=None):
+    """Append a load op restoring `out` from a tensor file (reference
+    layers/io.py load -> load_op)."""
+    helper = LayerHelper('load')
+    attrs = {'file_path': file_path}
+    if load_as_fp16 is not None:
+        attrs['load_as_fp16'] = bool(load_as_fp16)
+    helper.append_op(type='load', inputs={}, outputs={'Out': [out]},
+                     attrs=attrs)
+    return out

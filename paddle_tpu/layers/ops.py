@@ -77,3 +77,49 @@ def increment(x, value=1.0, in_place=True):
     helper.append_op(type='increment', inputs={'X': [x]},
                      outputs={'Out': [out]}, attrs={'step': float(value)})
     return out
+
+
+def gaussian_random(shape, mean=0.0, std=1.0, seed=0, dtype='float32'):
+    helper = LayerHelper('gaussian_random')
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(type='gaussian_random', inputs={},
+                     outputs={'Out': [out]},
+                     attrs={'shape': list(shape), 'mean': mean, 'std': std,
+                            'dtype': dtype})
+    return out
+
+
+def _random_batch_size_like(op_type):
+    def layer(input, shape, input_dim_idx=0, output_dim_idx=0,
+              dtype='float32', **kwargs):
+        helper = LayerHelper(op_type)
+        out = helper.create_variable_for_type_inference(dtype)
+        attrs = {'shape': list(shape), 'input_dim_idx': input_dim_idx,
+                 'output_dim_idx': output_dim_idx, 'dtype': dtype}
+        attrs.update(kwargs)
+        helper.append_op(type=op_type, inputs={'Input': [input]},
+                         outputs={'Out': [out]}, attrs=attrs)
+        return out
+    layer.__name__ = op_type
+    return layer
+
+
+uniform_random_batch_size_like = _random_batch_size_like(
+    'uniform_random_batch_size_like')
+gaussian_random_batch_size_like = _random_batch_size_like(
+    'gaussian_random_batch_size_like')
+
+
+def sum(x):
+    """Elementwise sum of a list of tensors (reference layers/ops.py sum
+    -> sum_op; also the op backward.py uses for fan-out grads)."""
+    helper = LayerHelper('sum')
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    out = helper.create_variable_for_type_inference(xs[0].dtype)
+    helper.append_op(type='sum', inputs={'X': list(xs)},
+                     outputs={'Out': [out]})
+    return out
+
+
+__all__ += ['gaussian_random', 'uniform_random_batch_size_like',
+            'gaussian_random_batch_size_like', 'sum']

@@ -142,3 +142,30 @@ def argsort(x, axis=-1, name=None):
                      outputs={'Out': [out], 'Indices': [ids]},
                      attrs={'axis': axis})
     return out, ids
+
+
+def fill_constant_batch_size_like(input, shape, dtype, value,
+                                  input_dim_idx=0, output_dim_idx=0):
+    """fill_constant with one dim copied from input's runtime batch size
+    (reference layers/tensor.py fill_constant_batch_size_like) — seeds
+    decoder states whose batch follows the fed batch."""
+    helper = LayerHelper('fill_constant_batch_size_like')
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(type='fill_constant_batch_size_like',
+                     inputs={'Input': [input]}, outputs={'Out': [out]},
+                     attrs={'shape': list(shape), 'dtype': dtype,
+                            'value': float(value),
+                            'input_dim_idx': input_dim_idx,
+                            'output_dim_idx': output_dim_idx})
+    return out
+
+
+def argmin(x, axis=0):
+    helper = LayerHelper('argmin')
+    out = helper.create_variable_for_type_inference('int32')
+    helper.append_op(type='argmin', inputs={'X': [x]},
+                     outputs={'Out': [out]}, attrs={'axis': axis})
+    return out
+
+
+__all__ += ['fill_constant_batch_size_like', 'argmin']

@@ -16,6 +16,7 @@ from . import fused_ops     # noqa: F401
 from . import detection_ops  # noqa: F401
 from . import attention_ops  # noqa: F401
 from . import loss_ops      # noqa: F401
+from . import eval_ops      # noqa: F401
 from . import misc_ops      # noqa: F401
 from . import nn3d_ops      # noqa: F401
 from . import ctc_rnn_ops   # noqa: F401

@@ -947,10 +947,12 @@ register_op('mine_hard_examples', infer_shape=_mine_hard_examples_infer,
 @op_emitter('detection_map')
 def _detection_map_emit(ctx, op):
     det = ctx.get(op.single_input('DetectRes'))   # [B, K, 6] (label,score,box)
-    gt = ctx.get(op.single_input('Label'))        # [B, M, 5] (label, box)
+    gt = ctx.get(op.single_input('Label'))        # [B, M, 5 or 6]
     class_num = int(op.attr('class_num'))
     iou_threshold = op.attr('overlap_threshold', 0.5)
     ap_type = op.attr('ap_type', 'integral')
+    background_label = op.attr('background_label', 0)
+    evaluate_difficult = op.attr('evaluate_difficult', True)
     B, K, _ = det.shape
     M = gt.shape[1]
 
@@ -959,15 +961,27 @@ def _detection_map_emit(ctx, op):
     det_box = det[:, :, 2:6]
     det_valid = det_label >= 0
     gt_label = gt[:, :, 0].astype(jnp.int32)
-    gt_box = gt[:, :, 1:5]
+    if gt.shape[2] == 6:
+        # [label, is_difficult, xmin, ymin, xmax, ymax] (reference LoD
+        # label layout when difficult flags are present)
+        gt_difficult = gt[:, :, 1] > 0
+        gt_box = gt[:, :, 2:6]
+    else:
+        gt_difficult = jnp.zeros(gt.shape[:2], bool)
+        gt_box = gt[:, :, 1:5]
     gt_valid = jnp.sum(jnp.abs(gt_box), axis=2) > 0
+    # with evaluate_difficult=False, difficult gt are "ignore": they are
+    # excluded from npos, and detections matched to them count neither
+    # as TP nor FP (reference detection_map_op.h CalcTrueAndFalsePositive)
+    gt_counted = gt_valid & (evaluate_difficult | ~gt_difficult)
 
     iou = jax.vmap(_iou_matrix)(det_box, gt_box)   # [B, K, M]
 
     def per_class(c):
         d_mask = det_valid & (det_label == c)
         g_mask = gt_valid & (gt_label == c)
-        npos = jnp.sum(g_mask.astype(jnp.int32))
+        g_counted = gt_counted & (gt_label == c)
+        npos = jnp.sum(g_counted.astype(jnp.int32))
         # greedy match in score order within each image: a detection is TP
         # if its best same-class IoU >= thr with an unclaimed gt. Static
         # approximation: claim = best-iou gt index; duplicates resolved by
@@ -976,6 +990,12 @@ def _detection_map_emit(ctx, op):
         best_iou = jnp.max(iou_c, axis=2, initial=0.0)
         best_gt = jnp.argmax(iou_c, axis=2)
         cand_tp = d_mask & (best_iou >= iou_threshold)
+        # detections matched to an ignored (difficult) gt count neither
+        # as TP nor FP: drop them from the ranked list entirely
+        matched_ignored = cand_tp & ~jnp.take_along_axis(
+            g_counted, best_gt, axis=1)
+        d_mask = d_mask & ~matched_ignored
+        cand_tp = cand_tp & ~matched_ignored
         # rank detections per (image, gt): highest score wins the gt
         score_masked = jnp.where(cand_tp, det_score, -jnp.inf)
         onehot = jax.nn.one_hot(best_gt, M) * cand_tp[:, :, None]
@@ -1012,7 +1032,11 @@ def _detection_map_emit(ctx, op):
         has_gt = npos > 0
         return jnp.where(has_gt, ap, 0.0), has_gt.astype(jnp.float32)
 
-    classes = jnp.arange(1, class_num)   # 0 is background
+    if 0 <= background_label < class_num:
+        classes = jnp.asarray([c for c in range(class_num)
+                               if c != background_label])
+    else:                                # -1: no background class
+        classes = jnp.arange(class_num)
     aps, valid = jax.vmap(per_class)(classes)
     m_ap = jnp.sum(aps) / jnp.maximum(jnp.sum(valid), 1.0)
     ctx.set(op.single_output('MAP'), m_ap.reshape((1,)))

@@ -1,0 +1,137 @@
+"""chunk_eval: chunk-level precision/recall/F1 for sequence labeling
+(reference paddle/fluid/operators/chunk_eval_op.{cc,h}).
+
+Host op by design: the chunk state machine (ChunkBegin/ChunkEnd over
+IOB/IOE/IOBES/plain tag schemes, chunk_eval_op.h:84-106) is inherently
+sequential per token and runs once per fetch on small int arrays — the
+reference also runs it CPU-only. Inputs are the padded [B, T] tag
+matrices + SeqLens; outputs feed metrics.ChunkEvaluator.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..registry import register_op
+
+_SCHEMES = {
+    # scheme -> (num_tag_types, tag_begin, tag_inside, tag_end, tag_single)
+    'plain': (1, -1, -1, -1, 0),
+    'IOB': (2, 0, 1, -1, -1),
+    'IOE': (2, -1, 0, 1, -1),
+    'IOBES': (4, 0, 1, 2, 3),
+}
+
+
+def _get_segments(tags, scheme, num_chunk_types, excluded):
+    """Extract (begin, end, type) chunks from one tag sequence — the
+    reference's GetSegments state machine (chunk_eval_op.h:41-80)."""
+    num_tag, t_begin, t_inside, t_end, t_single = _SCHEMES[scheme]
+    other = num_chunk_types
+
+    def chunk_end(prev_tag, prev_type, tag, type_):
+        if prev_type == other:
+            return False
+        if type_ == other:
+            return True
+        if type_ != prev_type:
+            return True
+        if prev_tag == t_begin or prev_tag == t_inside:
+            return tag == t_begin or tag == t_single
+        if prev_tag == t_end or prev_tag == t_single:
+            return True
+        return False
+
+    def chunk_begin(prev_tag, prev_type, tag, type_):
+        if prev_type == other:
+            return type_ != other
+        if type_ == other:
+            return False
+        if type_ != prev_type:
+            return True
+        if tag == t_begin or tag == t_single:
+            return True
+        if tag == t_inside or tag == t_end:
+            return prev_tag in (t_end, t_single)
+        return False
+
+    segments = []
+    in_chunk = False
+    chunk_start = 0
+    tag, type_ = -1, other
+    for i, label in enumerate(tags):
+        prev_tag, prev_type = tag, type_
+        if label == num_chunk_types * num_tag:
+            tag, type_ = -1, other
+        else:
+            tag = label % num_tag
+            type_ = label // num_tag
+        if in_chunk and chunk_end(prev_tag, prev_type, tag, type_):
+            if prev_type not in excluded:
+                segments.append((chunk_start, i - 1, prev_type))
+            in_chunk = False
+        if chunk_begin(prev_tag, prev_type, tag, type_):
+            chunk_start = i
+            in_chunk = True
+    if in_chunk and type_ not in excluded:
+        segments.append((chunk_start, len(tags) - 1, type_))
+    return segments
+
+
+def _chunk_eval_emit(ctx, op):
+    inference = np.asarray(ctx.get(op.single_input('Inference')))
+    label = np.asarray(ctx.get(op.single_input('Label')))
+    if inference.ndim == 3:
+        inference = inference[:, :, 0]
+    if label.ndim == 3:
+        label = label[:, :, 0]
+    B, T = inference.shape
+    if op.input('SeqLens'):
+        lens = np.asarray(ctx.get(op.single_input('SeqLens'))).reshape(-1)
+    else:
+        lens = np.full((B,), T, np.int64)
+    scheme = op.attr('chunk_scheme', 'IOB')
+    num_chunk_types = int(op.attr('num_chunk_types'))
+    excluded = set(op.attr('excluded_chunk_types', []) or [])
+
+    num_infer = num_label = num_correct = 0
+    for b in range(B):
+        n = int(lens[b])
+        infer_segs = _get_segments(inference[b, :n].tolist(), scheme,
+                                   num_chunk_types, excluded)
+        label_segs = _get_segments(label[b, :n].tolist(), scheme,
+                                   num_chunk_types, excluded)
+        num_infer += len(infer_segs)
+        num_label += len(label_segs)
+        label_set = set(label_segs)
+        num_correct += sum(1 for s in infer_segs if s in label_set)
+
+    precision = num_correct / num_infer if num_infer else 0.0
+    recall = num_correct / num_label if num_label else 0.0
+    f1 = (2 * precision * recall / (precision + recall)
+          if num_correct else 0.0)
+    ctx.set(op.single_output('Precision'),
+            np.asarray([precision], np.float32))
+    ctx.set(op.single_output('Recall'), np.asarray([recall], np.float32))
+    ctx.set(op.single_output('F1-Score'), np.asarray([f1], np.float32))
+    ctx.set(op.single_output('NumInferChunks'),
+            np.asarray([num_infer], np.int64))
+    ctx.set(op.single_output('NumLabelChunks'),
+            np.asarray([num_label], np.int64))
+    ctx.set(op.single_output('NumCorrectChunks'),
+            np.asarray([num_correct], np.int64))
+
+
+def _chunk_eval_infer(op, block):
+    for slot, dtype in (('Precision', 'float32'), ('Recall', 'float32'),
+                        ('F1-Score', 'float32'),
+                        ('NumInferChunks', 'int64'),
+                        ('NumLabelChunks', 'int64'),
+                        ('NumCorrectChunks', 'int64')):
+        if op.output(slot):
+            v = block.var_recursive(op.single_output(slot))
+            v.shape = (1,)
+            v.dtype = dtype
+
+
+register_op('chunk_eval', emit=_chunk_eval_emit,
+            infer_shape=_chunk_eval_infer, host=True, no_grad=True)

@@ -246,7 +246,10 @@ def _crop_emit(ctx, op):
     if op.input('Y'):
         shape = ctx.get(op.single_input('Y')).shape
     else:
-        shape = op.attr('shape')
+        off_attr = op.attr('offsets', None) or [0] * x.ndim
+        # -1 dims (batch) crop to "everything past the offset"
+        shape = [x.shape[i] - off_attr[i] if s < 0 else s
+                 for i, s in enumerate(op.attr('shape'))]
     if op.input('Offsets'):
         off = ctx.get(op.single_input('Offsets'))
         off = [off[i] for i in range(len(shape))]
@@ -505,6 +508,8 @@ def _lod_reset_emit(ctx, op):
     ctx.set(op.single_output('Out'), x)
     if op.input('TargetLens'):
         lens = ctx.get(op.single_input('TargetLens')).reshape(-1)
+        if op.attr('target_is_offsets', False):
+            lens = jnp.diff(lens)       # offsets [0, a, b, ...] -> lengths
         lens = lens.astype(jnp.int32)
     else:
         target = np.asarray(op.attr('target_lod'))
@@ -521,8 +526,12 @@ def _lod_reset_infer(op, block):
     lens = block.var_recursive(op.single_output('OutLens'))
     if op.input('TargetLens'):
         t = block.var_recursive(op.single_input('TargetLens'))
-        lens.shape = (int(np.prod([d for d in t.shape if d != 1] or [1])),) \
-            if all(d >= 0 for d in t.shape) else (-1,)
+        if all(d >= 0 for d in t.shape):
+            n = int(np.prod([d for d in t.shape if d != 1] or [1]))
+            lens.shape = (n - 1,) if op.attr('target_is_offsets',
+                                             False) else (n,)
+        else:
+            lens.shape = (-1,)
     else:
         lens.shape = (len(op.attr('target_lod')) - 1,)
     lens.dtype = 'int32'
@@ -531,3 +540,107 @@ def _lod_reset_infer(op, block):
 register_op('lod_reset', infer_shape=_lod_reset_infer)
 register_vjp_grad('lod_reset', in_slots=('X',),
                   nondiff_slots=('TargetLens',))
+
+
+# ---------------------------------------------------------------------------
+# *_batch_size_like randoms (reference uniform_random_batch_size_like_op.cc,
+# gaussian_random_batch_size_like_op.cc)
+# ---------------------------------------------------------------------------
+
+def _bsl_shape(op, x):
+    shape = list(op.attr('shape'))
+    shape[op.attr('output_dim_idx', 0)] = x.shape[op.attr('input_dim_idx', 0)]
+    return shape
+
+
+@op_emitter('uniform_random_batch_size_like', stateful=True)
+def _uniform_random_bsl_emit(ctx, op):
+    x = ctx.get(op.single_input('Input'))
+    shape = _bsl_shape(op, x)
+    dtype = op.attr('dtype', 'float32')
+    key = ctx.rng(op)
+    ctx.set(op.single_output('Out'),
+            jax.random.uniform(key, tuple(shape), dtype=jnp.float32,
+                               minval=op.attr('min', -1.0),
+                               maxval=op.attr('max', 1.0)).astype(dtype))
+
+
+@op_emitter('gaussian_random_batch_size_like', stateful=True)
+def _gaussian_random_bsl_emit(ctx, op):
+    x = ctx.get(op.single_input('Input'))
+    shape = _bsl_shape(op, x)
+    dtype = op.attr('dtype', 'float32')
+    key = ctx.rng(op)
+    out = op.attr('mean', 0.0) + op.attr('std', 1.0) * \
+        jax.random.normal(key, tuple(shape), dtype=jnp.float32)
+    ctx.set(op.single_output('Out'), out.astype(dtype))
+
+
+def _bsl_infer(op, block):
+    x = block.var_recursive(op.single_input('Input'))
+    out = block.var_recursive(op.single_output('Out'))
+    out.shape = tuple(_bsl_shape(op, x))
+    out.dtype = op.attr('dtype', 'float32')
+
+
+for _t in ('uniform_random_batch_size_like',
+           'gaussian_random_batch_size_like'):
+    register_op(_t, infer_shape=_bsl_infer, no_grad=True, stateful=True)
+
+
+# ---------------------------------------------------------------------------
+# lod_rank_table / reorder_lod_tensor_by_rank (reference lod_rank_table_op.cc,
+# reorder_lod_tensor_by_rank_op.cc). In the padded-batch contract the rank
+# table is simply the batch permutation that sorts rows by descending
+# sequence length (stable) — one argsort, fully on-device.
+# ---------------------------------------------------------------------------
+
+@op_emitter('lod_rank_table')
+def _lod_rank_table_emit(ctx, op):
+    x = ctx.get(op.single_input('X'))
+    B = x.shape[0]
+    if op.input('SeqLens'):
+        lens = ctx.get(op.single_input('SeqLens')).reshape(-1)
+    else:
+        lens = jnp.full((B,), x.shape[1] if x.ndim > 1 else 1, jnp.int32)
+    # stable sort by descending length: key = (-len, index)
+    perm = jnp.argsort(-lens.astype(jnp.int64) * B + jnp.arange(B))
+    ctx.set(op.single_output('Out'), perm.astype(jnp.int32))
+
+
+def _lod_rank_table_infer(op, block):
+    x = block.var_recursive(op.single_input('X'))
+    out = block.var_recursive(op.single_output('Out'))
+    out.shape = (x.shape[0],)
+    out.dtype = 'int32'
+
+
+register_op('lod_rank_table', infer_shape=_lod_rank_table_infer,
+            no_grad=True)
+
+
+@op_emitter('reorder_lod_tensor_by_rank')
+def _reorder_lod_tensor_by_rank_emit(ctx, op):
+    x = ctx.get(op.single_input('X'))
+    perm = ctx.get(op.single_input('RankTable')).reshape(-1)
+    ctx.set(op.single_output('Out'), x[perm])
+    if op.input('SeqLens') and op.output('OutLens'):
+        lens = ctx.get(op.single_input('SeqLens')).reshape(-1)
+        ctx.set(op.single_output('OutLens'), lens[perm])
+
+
+def _reorder_infer(op, block):
+    x = block.var_recursive(op.single_input('X'))
+    out = block.var_recursive(op.single_output('Out'))
+    out.shape = x.shape
+    out.dtype = x.dtype
+    out.lod_level = x.lod_level
+    if op.output('OutLens'):
+        ol = block.var_recursive(op.single_output('OutLens'))
+        ol.shape = (x.shape[0],)
+        ol.dtype = 'int32'
+
+
+register_op('reorder_lod_tensor_by_rank', infer_shape=_reorder_infer)
+register_vjp_grad('reorder_lod_tensor_by_rank', in_slots=('X',),
+                  nondiff_slots=('RankTable', 'SeqLens'))

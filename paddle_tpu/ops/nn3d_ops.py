@@ -249,10 +249,13 @@ def _pool_with_index(x, ksize, strides, paddings):
 def _max_pool2d_with_index_emit(ctx, op):
     x = ctx.get(op.single_input('X'))
     ksize = list(op.attr('ksize'))
+    strides = list(op.attr('strides', [1, 1]))
+    paddings = list(op.attr('paddings', [0, 0]))
     if op.attr('global_pooling', False):
         ksize = [x.shape[2], x.shape[3]]
-    vals, idx = _pool_with_index(x, ksize, op.attr('strides', [1, 1]),
-                                 op.attr('paddings', [0, 0]))
+        strides = [1, 1]
+        paddings = [0, 0]
+    vals, idx = _pool_with_index(x, ksize, strides, paddings)
     ctx.set(op.single_output('Out'), vals)
     ctx.set(op.single_output('Mask'), idx)
 
