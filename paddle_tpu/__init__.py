@@ -65,6 +65,20 @@ from .channels import make_channel
 from . import trainer
 from .trainer import (Trainer, CheckpointConfig, BeginEpochEvent,
                       EndEpochEvent, BeginStepEvent, EndStepEvent)
+from . import average
+from . import evaluator
+from . import inferencer
+from .inferencer import Inferencer
+from . import annotations
+from . import concurrency
+from .concurrency import Go
+from . import default_scope_funcs
+from . import graphviz
+from . import net_drawer
+from . import op
+from . import recordio_writer
+from .transpiler import (InferenceTranspiler, memory_optimize,
+                         release_memory)
 
 __version__ = '0.1.0'
 
@@ -78,5 +92,6 @@ __all__ = [
     'fetch_var', 'LoDTensor', 'create_lod_tensor',
     'create_random_int_lodtensor', 'io', 'nets', 'metrics', 'profiler',
     'DataFeeder', 'ParallelExecutor', 'ExecutionStrategy', 'BuildStrategy',
-    'core',
+    'core', 'average', 'evaluator', 'Inferencer', 'InferenceTranspiler',
+    'memory_optimize', 'release_memory', 'Go',
 ]

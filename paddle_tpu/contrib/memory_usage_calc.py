@@ -1,0 +1,36 @@
+"""Estimate a Program's activation/parameter memory (reference
+python/paddle/fluid/contrib/memory_usage_calc.py memory_usage:46).
+
+Sums var numel × dtype size with the batch dim substituted; on TPU the
+estimate brackets XLA's peak HBM (which additionally reuses dead
+buffers — see transpiler.memory_optimization_transpiler and
+memory.hbm_usage for the measured number)."""
+from __future__ import annotations
+
+import numpy as np
+
+from ..memory import dtype_bytes
+
+__all__ = ['memory_usage']
+
+
+def memory_usage(program, batch_size):
+    """Returns estimated bytes for one pass of `program` at the given
+    batch size (vars with a -1 leading dim count batch_size rows)."""
+    if batch_size <= 0:
+        raise ValueError('The batch size must be positive.')
+    from ..framework import Program
+    if not isinstance(program, Program):
+        raise ValueError(
+            'Calculating Memory Usage requires Program as its Parameter.')
+
+    total = 0
+    processed = set()
+    for block in program.blocks:
+        for var in block.vars.values():
+            if var.name in processed or var.shape is None:
+                continue
+            processed.add(var.name)
+            shape = [batch_size if d < 0 else d for d in var.shape]
+            total += int(np.prod(shape)) * dtype_bytes(var.dtype)
+    return total

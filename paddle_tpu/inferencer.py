@@ -1,0 +1,42 @@
+"""High-level Inferencer (reference python/paddle/fluid/inferencer.py):
+the deploy-side companion of trainer.Trainer — loads the inference
+model a Trainer saved and answers feed-dict queries."""
+from __future__ import annotations
+
+import contextlib
+
+from . import io
+from .executor import Executor, Scope, scope_guard, CPUPlace
+
+__all__ = ['Inferencer']
+
+
+class Inferencer(object):
+    """(reference inferencer.py:27) param_path holds the model saved by
+    Trainer.save_inference_model / io.save_inference_model."""
+
+    def __init__(self, infer_func=None, param_path=None, place=None,
+                 parallel=False):
+        self.param_path = param_path
+        self.scope = Scope()
+        self.place = place if place is not None else CPUPlace()
+        self.exe = Executor(self.place)
+        with self._prog_and_scope_guard():
+            (self.inference_program, self.feed_target_names,
+             self.fetch_targets) = io.load_inference_model(
+                dirname=param_path, executor=self.exe)
+
+    @contextlib.contextmanager
+    def _prog_and_scope_guard(self):
+        with scope_guard(self.scope):
+            yield
+
+    def infer(self, inputs, return_numpy=True):
+        if not isinstance(inputs, dict):
+            raise ValueError(
+                'inputs should be a map of {tensor_name: tensor}')
+        with self._prog_and_scope_guard():
+            results = self.exe.run(self.inference_program, feed=inputs,
+                                   fetch_list=self.fetch_targets,
+                                   return_numpy=return_numpy)
+        return results

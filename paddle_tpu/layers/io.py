@@ -129,7 +129,7 @@ def _file_reader(sample_gen_creator, shapes, dtypes, lod_levels, name_hint,
 
 
 def _set_batched_source(reader, batch_size, drop_last=True):
-    import numpy as np
+    from ..reader.pipeline import stack_samples
     reader._batch_size = batch_size
     reader._drop_last = drop_last
 
@@ -138,14 +138,10 @@ def _set_batched_source(reader, batch_size, drop_last=True):
         for sample in reader._sample_gen():
             buf.append(sample)
             if len(buf) == batch_size:
-                slots = list(zip(*buf))
-                yield [np.stack([np.asarray(s, dtype=dt) for s in slot])
-                       for slot, dt in zip(slots, reader.dtypes)]
+                yield stack_samples(buf, reader.dtypes)
                 buf = []
         if buf and not drop_last:
-            slots = list(zip(*buf))
-            yield [np.stack([np.asarray(s, dtype=dt) for s in slot])
-                   for slot, dt in zip(slots, reader.dtypes)]
+            yield stack_samples(buf, reader.dtypes)
     reader._source = source
 
 

@@ -27,6 +27,12 @@ _DTYPE_BYTES = {
 }
 
 
+def dtype_bytes(dtype, default=4):
+    """Bytes per element for a dtype name — the one shared size table
+    (memory stats, memory_optimize, contrib.memory_usage all use it)."""
+    return _DTYPE_BYTES.get(str(dtype), default)
+
+
 def _device(device=None):
     import jax
     return device if device is not None else jax.devices()[0]

@@ -246,10 +246,18 @@ def _crop_emit(ctx, op):
     if op.input('Y'):
         shape = ctx.get(op.single_input('Y')).shape
     else:
-        off_attr = op.attr('offsets', None) or [0] * x.ndim
-        # -1 dims (batch) crop to "everything past the offset"
-        shape = [x.shape[i] - off_attr[i] if s < 0 else s
-                 for i, s in enumerate(op.attr('shape'))]
+        shape = list(op.attr('shape'))
+        if any(s < 0 for s in shape):
+            if op.input('Offsets'):
+                raise ValueError(
+                    'crop: a -1 dim in `shape` cannot be combined with '
+                    'a runtime Offsets input (the slice size must be '
+                    'static under XLA); pass static shape dims or attr '
+                    'offsets')
+            off_attr = op.attr('offsets', None) or [0] * x.ndim
+            # -1 dims (batch) crop to "everything past the offset"
+            shape = [x.shape[i] - off_attr[i] if s < 0 else s
+                     for i, s in enumerate(shape)]
     if op.input('Offsets'):
         off = ctx.get(op.single_input('Offsets'))
         off = [off[i] for i in range(len(shape))]

@@ -53,6 +53,15 @@ def get_reader(name):
     return r
 
 
+def stack_samples(batch, dtypes):
+    """Stack a list of per-sample slot tuples into one array per slot
+    (the paddle.batch convention) — shared by decorate_paddle_reader and
+    the file-reader layers in layers/io.py."""
+    slots = list(zip(*batch))
+    return [np.stack([np.asarray(s, dtype=dt) for s in slot])
+            for slot, dt in zip(slots, dtypes)]
+
+
 class PyReader(object):
     """Runtime half of fluid.layers.py_reader. Also quacks enough like a
     Variable (name attr) for fluid.layers.read_file(reader)."""
@@ -86,9 +95,7 @@ class PyReader(object):
         one array per slot."""
         def source():
             for batch in reader():
-                slots = list(zip(*batch))
-                yield [np.stack([np.asarray(s, dtype=dt) for s in slot])
-                       for slot, dt in zip(slots, self.dtypes)]
+                yield stack_samples(batch, self.dtypes)
         self._source = source
         return self
 

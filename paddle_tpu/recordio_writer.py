@@ -1,0 +1,9 @@
+"""RecordIO writer entry points (reference python/paddle/fluid/
+recordio_writer.py). The engine lives in recordio.py (native C++ chunk
+codec); this module keeps the reference's import path working."""
+from .recordio import (convert_reader_to_recordio_file,    # noqa: F401
+                       convert_reader_to_recordio_files,   # noqa: F401
+                       RecordIOWriter, Compressor)         # noqa: F401
+
+__all__ = ['convert_reader_to_recordio_file',
+           'convert_reader_to_recordio_files']

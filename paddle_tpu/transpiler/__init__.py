@@ -1,13 +1,18 @@
 """Program transpilers (reference python/paddle/fluid/transpiler/).
 
 DistributeTranspiler rewrites a local program into trainer + pserver
-programs for parameter-server mode. The reference's memory-optimize
-transpiler has no analog here by design: XLA buffer liveness + donated
-persistables already provide in-place variable reuse.
+programs for parameter-server mode. InferenceTranspiler folds
+batch-norm into convs for deployment. The memory-optimize transpiler
+computes the reference's liveness/reuse plan while delegating actual
+buffer sharing to XLA buffer assignment (see its module docstring).
 """
 from .distribute_transpiler import (DistributeTranspiler,
                                     DistributeTranspilerConfig)
 from .ps_dispatcher import PSDispatcher, RoundRobin, HashName
+from .inference_transpiler import InferenceTranspiler
+from .memory_optimization_transpiler import (memory_optimize,
+                                             release_memory)
 
 __all__ = ['DistributeTranspiler', 'DistributeTranspilerConfig',
-           'PSDispatcher', 'RoundRobin', 'HashName']
+           'PSDispatcher', 'RoundRobin', 'HashName',
+           'InferenceTranspiler', 'memory_optimize', 'release_memory']

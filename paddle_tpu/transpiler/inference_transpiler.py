@@ -1,0 +1,99 @@
+"""Inference transpiler (reference python/paddle/fluid/transpiler/
+inference_transpiler.py): offline graph rewrites for deployment.
+
+The headline rewrite is batch-norm folding (`_fuse_batch_norm`,
+reference :172): for an inference program, conv2d → batch_norm(is_test)
+collapses into conv2d with adjusted weights plus a channel bias:
+
+    w' = w * gamma / sqrt(var + eps)        (per out-channel)
+    b' = (b - mean) * gamma / sqrt(var + eps) + beta
+
+On TPU, XLA would fuse the scale/shift arithmetic into the conv at JIT
+time anyway, but folding still wins: the BN parameters disappear from
+the program (smaller saved model, fewer vars to load) and the rewrite
+matches the reference's deployment contract. The mkldnn-specific
+relu/bias fusions of the reference are N/A by design (XLA fuses
+elementwise chains automatically).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ['InferenceTranspiler']
+
+
+class InferenceTranspiler(object):
+    def transpile(self, program, place=None, scope=None):
+        """Fold batch_norm into the preceding conv2d, in place.
+        `scope` holds the trained parameters (defaults to the global
+        scope); folded params are overwritten there."""
+        from ..executor import global_scope
+        if scope is None:
+            scope = global_scope()
+        self._fuse_batch_norm(program, scope)
+
+    # -- batch-norm folding (reference inference_transpiler.py:172) ----
+
+    def _fuse_batch_norm(self, program, scope):
+        block = program.global_block()
+        i = 0
+        while i < len(block.ops) - 1:
+            op = block.ops[i]
+            next_op = block.ops[i + 1]
+            if op.type == 'conv2d' and next_op.type == 'batch_norm' and \
+                    next_op.single_input('X') == op.single_output('Output'):
+                self._fold(block, scope, i, op, next_op)
+                # re-scan from the conv: the following op changed
+            i += 1
+        self._remove_unused_vars(program)
+
+    def _fold(self, block, scope, conv_idx, conv_op, bn_op):
+        w_name = conv_op.single_input('Filter')
+        gamma = self._param(scope, bn_op.single_input('Scale'))
+        beta = self._param(scope, bn_op.single_input('Bias'))
+        mean = self._param(scope, bn_op.single_input('Mean'))
+        var = self._param(scope, bn_op.single_input('Variance'))
+        eps = bn_op.attr('epsilon', 1e-5)
+        w = self._param(scope, w_name)
+
+        inv_std = gamma / np.sqrt(var + eps)
+        scope.set_var(w_name, (w * inv_std[:, None, None, None])
+                      .astype(w.dtype))
+        bias = (beta - mean * inv_std).astype(w.dtype)
+
+        # new channel-bias var + elementwise_add replacing the BN op
+        bias_name = w_name + '.bn_fold_bias'
+        bv = block.create_parameter(
+            name=bias_name, shape=list(bias.shape), dtype=str(bias.dtype))
+        bv.persistable = True
+        scope.set_var(bias_name, bias)
+        bn_out = bn_op.single_output('Y')
+        conv_out = conv_op.single_output('Output')
+        bn_idx = conv_idx + 1
+        block.remove_op(bn_idx)
+        block._insert_op(bn_idx, type='elementwise_add',
+                         inputs={'X': [conv_out], 'Y': [bias_name]},
+                         outputs={'Out': [bn_out]}, attrs={'axis': 1})
+
+    @staticmethod
+    def _param(scope, name):
+        v = scope.find_var(name)
+        if v is None:
+            raise ValueError(
+                'batch-norm folding needs parameter %r in the scope — '
+                'run the startup/load program first' % name)
+        return np.asarray(v)
+
+    @staticmethod
+    def _remove_unused_vars(program):
+        block = program.global_block()
+        used = set()
+        for op in block.ops:
+            for names in op.inputs.values():
+                used.update(names)
+            for names in op.outputs.values():
+                used.update(names)
+        for name in list(block.vars):
+            var = block.vars[name]
+            if name not in used and not getattr(var, 'is_data', False):
+                del block.vars[name]

@@ -1,0 +1,214 @@
+"""Round-3 module-parity fills: average, evaluator, inferencer,
+inference_transpiler (BN folding), memory_optimization_transpiler,
+memory_usage_calc, default_scope_funcs, concurrency, op factory,
+net_drawer/graphviz (reference python/paddle/fluid/*.py misc table,
+SURVEY §2.6)."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.framework import Program, program_guard
+
+
+def test_weighted_average():
+    avg = fluid.average.WeightedAverage()
+    avg.add(value=2.0, weight=1)
+    avg.add(value=4.0, weight=2)
+    assert abs(avg.eval() - 10.0 / 3) < 1e-9
+    avg.reset()
+    with pytest.raises(ValueError):
+        avg.eval()
+
+
+def test_accuracy_evaluator_accumulates():
+    prog, startup = Program(), Program()
+    with program_guard(prog, startup):
+        x = fluid.layers.data(name='x', shape=[4], dtype='float32')
+        lab = fluid.layers.data(name='lab', shape=[1], dtype='int64')
+        acc_ev = fluid.evaluator.Accuracy(input=x, label=lab)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        # batch 1: 2/3 correct; batch 2: 1/3 correct -> 3/6 total
+        b1 = np.eye(4)[[0, 1, 2]].astype('float32')
+        exe.run(prog, feed={'x': b1,
+                            'lab': np.array([[0], [1], [0]], 'int64')},
+                fetch_list=acc_ev.metrics)
+        exe.run(prog, feed={'x': b1,
+                            'lab': np.array([[0], [2], [3]], 'int64')},
+                fetch_list=acc_ev.metrics)
+        total_acc = acc_ev.eval(exe)
+        assert abs(float(total_acc) - 0.5) < 1e-6
+        # reset zeroes the states
+        acc_ev.reset(exe)
+        exe.run(prog, feed={'x': b1,
+                            'lab': np.array([[0], [1], [2]], 'int64')},
+                fetch_list=acc_ev.metrics)
+        assert abs(float(acc_ev.eval(exe)) - 1.0) < 1e-6
+
+
+def test_chunk_evaluator_graph_state():
+    prog, startup = Program(), Program()
+    with program_guard(prog, startup):
+        inf = fluid.layers.data(name='inf', shape=[1, 5], dtype='int64',
+                                append_batch_size=False)
+        lab = fluid.layers.data(name='lab', shape=[1, 5], dtype='int64',
+                                append_batch_size=False)
+        ev = fluid.evaluator.ChunkEvaluator(
+            input=inf, label=lab, chunk_scheme='IOB', num_chunk_types=1)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        feed = {'inf': np.array([[0, 1, 2, 0, 2]], 'int64'),
+                'lab': np.array([[0, 1, 2, 2, 2]], 'int64')}
+        exe.run(prog, feed=feed, fetch_list=ev.metrics)
+        exe.run(prog, feed=feed, fetch_list=ev.metrics)
+        p, r, f1 = ev.eval(exe)
+        assert abs(p - 0.5) < 1e-6 and abs(r - 1.0) < 1e-6
+
+
+def test_inference_transpiler_folds_bn():
+    prog, startup = Program(), Program()
+    with program_guard(prog, startup):
+        x = fluid.layers.data(name='x', shape=[3, 8, 8], dtype='float32')
+        c = fluid.layers.conv2d(x, num_filters=4, filter_size=3,
+                                padding=1, bias_attr=False)
+        bn = fluid.layers.batch_norm(c, is_test=True)
+        out = fluid.layers.reduce_sum(bn)
+    exe = fluid.Executor(fluid.CPUPlace())
+    xb = np.random.rand(2, 3, 8, 8).astype('float32')
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        # give BN non-trivial statistics
+        import paddle_tpu.executor as pexec
+        scope = pexec.global_scope()
+        for v in prog.global_block().vars.values():
+            if 'batch_norm' in v.name and v.persistable:
+                arr = np.asarray(scope.find_var(v.name))
+                scope.set_var(v.name,
+                              (arr + np.random.rand(*arr.shape) * 0.5 + .5)
+                              .astype('float32'))
+        before, = exe.run(prog, feed={'x': xb}, fetch_list=[out])
+
+        infer_prog = prog.clone(for_test=True)
+        n_ops_before = len(infer_prog.global_block().ops)
+        t = fluid.InferenceTranspiler()
+        t.transpile(infer_prog, fluid.CPUPlace())
+        types = [op.type for op in infer_prog.global_block().ops]
+        assert 'batch_norm' not in types
+        assert len(infer_prog.global_block().ops) <= n_ops_before
+        after, = exe.run(infer_prog, feed={'x': xb}, fetch_list=[out])
+        np.testing.assert_allclose(np.asarray(after),
+                                   np.asarray(before), rtol=2e-4,
+                                   atol=2e-4)
+
+
+def test_memory_optimize_plan_and_usage():
+    prog, startup = Program(), Program()
+    with program_guard(prog, startup):
+        x = fluid.layers.data(name='x', shape=[16], dtype='float32')
+        h1 = fluid.layers.fc(input=x, size=16, act='relu')
+        h2 = fluid.layers.fc(input=h1, size=16, act='relu')
+        h3 = fluid.layers.fc(input=h2, size=16, act='relu')
+        loss = fluid.layers.mean(h3)
+    plan = fluid.memory_optimize(prog)
+    assert isinstance(plan, dict)
+    assert prog._memory_reuse_plan is plan
+    # same-shape dead activations exist -> at least one reuse found
+    assert len(plan) >= 1
+    usage = fluid.contrib.memory_usage(prog, batch_size=32)
+    assert usage > 0
+
+
+def test_release_memory_inserts_delete_vars():
+    prog, startup = Program(), Program()
+    with program_guard(prog, startup):
+        x = fluid.layers.data(name='x', shape=[4], dtype='float32')
+        h = fluid.layers.fc(input=x, size=4)
+        loss = fluid.layers.mean(h)
+    n_before = len(prog.global_block().ops)
+    fluid.release_memory(prog)
+    types = [op.type for op in prog.global_block().ops]
+    assert types.count('delete_var') >= 1
+    assert len(prog.global_block().ops) > n_before
+
+
+def test_inferencer_roundtrip(tmp_path):
+    prog, startup = Program(), Program()
+    with program_guard(prog, startup):
+        x = fluid.layers.data(name='x', shape=[4], dtype='float32')
+        y = fluid.layers.fc(input=x, size=2)
+    exe = fluid.Executor(fluid.CPUPlace())
+    model_dir = str(tmp_path / 'model')
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        xb = np.random.rand(3, 4).astype('float32')
+        want, = exe.run(prog, feed={'x': xb}, fetch_list=[y])
+        fluid.io.save_inference_model(model_dir, ['x'], [y], exe,
+                                      main_program=prog)
+    inferencer = fluid.Inferencer(param_path=model_dir)
+    got = inferencer.infer({'x': xb})
+    np.testing.assert_allclose(np.asarray(got[0]), np.asarray(want),
+                               rtol=1e-5)
+
+
+def test_default_scope_funcs():
+    from paddle_tpu import default_scope_funcs as dsf
+    dsf.var('a')
+    dsf.get_cur_scope().set_var('a', 1)
+    assert dsf.has_var('a') and dsf.find_var('a') == 1
+    dsf.enter_local_scope()
+    dsf.var('b')
+    assert dsf.find_var('a') == 1            # parent lookup
+    assert dsf.has_var('b')
+    dsf.leave_local_scope()
+    assert not dsf.has_var('b')
+
+    ran = []
+    dsf.scoped_function(lambda: ran.append(dsf.var('c')))
+    assert len(ran) == 1
+
+
+def test_concurrency_go_channels():
+    from paddle_tpu import concurrency as conc
+    ch = conc.make_channel(capacity=2)
+    results = []
+
+    def producer():
+        for i in range(5):
+            conc.channel_send(ch, i)
+        conc.channel_close(ch)
+
+    conc.go(producer)
+    while True:
+        v, ok = conc.channel_recv(ch)
+        if not ok:
+            break
+        results.append(v)
+    assert results == [0, 1, 2, 3, 4]
+
+
+def test_op_factory():
+    from paddle_tpu.op import Operator
+    spec = Operator('scale', X='x', Out='out', scale=2.0)
+    assert spec['type'] == 'scale'
+    assert spec['inputs'] == {'X': ['x']}
+    assert spec['outputs'] == {'Out': ['out']}
+    assert spec['attrs'] == {'scale': 2.0}
+    assert 'conv2d' in Operator.types()
+    with pytest.raises(ValueError):
+        Operator('not_a_real_op')
+
+
+def test_net_drawer_and_graphviz(tmp_path):
+    prog, startup = Program(), Program()
+    with program_guard(prog, startup):
+        x = fluid.layers.data(name='x', shape=[4], dtype='float32')
+        fluid.layers.fc(input=x, size=2)
+    from paddle_tpu.net_drawer import draw_graph
+    path = str(tmp_path / 'net.dot')
+    out = draw_graph(startup, prog, path)
+    src = open(out).read()
+    assert 'digraph' in src and 'mul' in src
