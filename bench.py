@@ -90,11 +90,12 @@ def _resnet50_train_flops_per_image(image_hw, class_dim):
     return 3 * flops
 
 
-def _transformer_train_flops_per_token(cfg):
-    """Analytic fwd FLOPs per token (2*MACs), x3 for fwd+bwd."""
+def _transformer_train_flops_per_token(cfg, causal=False):
+    """Analytic fwd FLOPs per token (2*MACs), x3 for fwd+bwd. With
+    causal=True attention counts the useful T/2 per token."""
     d, f, t, v, n = cfg.dim, cfg.ffn, cfg.max_len, cfg.vocab, cfg.layers
     per_layer = 4 * d * d + 2 * d * f        # qkv+proj, ffn up+down (MACs)
-    attn = 2 * t * d                         # q@k^T + probs@v per token
+    attn = (t if causal else 2 * t) * d      # q@k^T + probs@v per token
     head = d * v                             # logits projection
     return 3 * 2 * (n * (per_layer + attn) + head)
 
@@ -233,10 +234,74 @@ def bench_transformer(on_tpu):
     return out
 
 
+def bench_long_context(on_tpu):
+    """Long-context LM step via the Pallas flash-attention kernel
+    (T=8192 on hardware — a length where the naive [T, T]-score path
+    fails to compile on this chip, measured in PERF.md). Causal
+    attention FLOPs counted at T/2 per token (the useful half)."""
+    if on_tpu:
+        cfg = tfm.TransformerConfig(vocab=32768, dim=1024, heads=8,
+                                    layers=4, ffn=4096, max_len=8192,
+                                    use_tp=False, use_sp=False,
+                                    flash_attention=True)
+        batch, warmup, iters = 2, 2, 10
+    else:
+        cfg = tfm.TransformerConfig(vocab=256, dim=64, heads=4, layers=1,
+                                    ffn=128, max_len=64, use_tp=False,
+                                    use_sp=False, flash_attention=False)
+        batch, warmup, iters = 2, 1, 2
+
+    main_prog = fluid.Program()
+    startup_prog = fluid.Program()
+    with fluid.program_guard(main_prog, startup_prog):
+        rdr = fluid.layers.py_reader(
+            capacity=4,
+            shapes=[(-1, cfg.max_len, 1), (-1, cfg.max_len, 1)],
+            dtypes=['int64', 'int64'], name='lc_reader',
+            use_double_buffer=True)
+        tokens, labels = fluid.layers.read_file(rdr)
+        emb = tfm.language_model_logits(tokens, cfg)
+        cost = fluid.layers.softmax_with_cross_entropy(emb, labels)
+        avg_cost = fluid.layers.mean(cost)
+        opt = fluid.optimizer.Momentum(learning_rate=0.001, momentum=0.9)
+        opt = fluid.contrib.mixed_precision.decorate(opt)
+        opt.minimize(avg_cost)
+
+    exe = fluid.Executor(fluid.TPUPlace())
+    exe.run(startup_prog)
+    pe = fluid.ParallelExecutor(use_cuda=True, loss_name=avg_cost.name,
+                                main_program=main_prog)
+    rng = np.random.RandomState(0)
+
+    def provider():
+        while True:
+            toks = rng.randint(0, cfg.vocab,
+                               size=(batch, cfg.max_len, 1)).astype('int64')
+            yield [toks, np.roll(toks, -1, axis=1)]
+
+    rdr.decorate_tensor_provider(provider)
+    rdr.start()
+    dt = _run_steps(pe, avg_cost.name, warmup, iters)
+    rdr.reset()
+
+    tokens_per_sec = batch * cfg.max_len * iters / dt
+    fl = _transformer_train_flops_per_token(cfg, causal=True)
+    out = {'longcontext_tokens_per_sec': round(tokens_per_sec, 1),
+           'longcontext_config': 'L%d_D%d_F%d_T%d_bs%d_flash_bf16' % (
+               cfg.layers, cfg.dim, cfg.ffn, cfg.max_len, batch)}
+    peak = _peak_flops(jax.devices()[0])
+    if peak:
+        out['longcontext_tflops_per_sec'] = round(
+            tokens_per_sec * fl / 1e12, 1)
+        out['longcontext_mfu'] = round(tokens_per_sec * fl / peak, 4)
+    return out
+
+
 def main():
     on_tpu = any(d.platform == 'tpu' for d in jax.devices())
     out = bench_resnet(on_tpu)
     out.update(bench_transformer(on_tpu))
+    out.update(bench_long_context(on_tpu))
     print(json.dumps(out))
 
 

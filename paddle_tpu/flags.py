@@ -17,6 +17,11 @@ Known flags:
                          accepted for script compat (PJRT owns memory)
   use_pallas_fused_ops   route eligible op patterns (1x1 conv+BN) through
                          the Pallas fused kernels (paddle_tpu/pallas/)
+  use_flash_attention    route eligible attention shapes (T, d lane-
+                         aligned) through the Pallas flash kernel
+                         (paddle_tpu/pallas/flash_attention.py) — the
+                         long-context memory-wall kernel; default ON,
+                         falls back to the naive contraction otherwise
   pallas_interpret       run Pallas kernels in interpreter mode off-TPU
                          (numerics tests on CPU)
 """
@@ -34,6 +39,7 @@ _DEFAULTS = {
     'init_allocated_mem': False,
     'use_pinned_memory': True,
     'use_pallas_fused_ops': False,
+    'use_flash_attention': True,
     'pallas_interpret': False,
 }
 

@@ -17,7 +17,7 @@ __all__ = [
     'image_resize', 'resize_bilinear', 'image_resize_short',
     'random_crop', 'mean_iou', 'crop', 'rank_loss', 'unstack',
     'bilinear_tensor_product', 'modified_huber_loss', 'l1_norm', 'sign',
-    'fake_quantize', 'polygon_box_transform',
+    'fake_quantize', 'polygon_box_transform', 'flash_attention',
 ]
 
 
@@ -457,4 +457,18 @@ def polygon_box_transform(input, name=None):
     helper.append_op(type='polygon_box_transform',
                      inputs={'Input': [input]},
                      outputs={'Output': [out]})
+    return out
+
+
+def flash_attention(q, k, v, causal=True, sm_scale=None, name=None):
+    """Blockwise (flash) attention over [B, H, T, dh] without the
+    [T, T] score tensor (paddle_tpu/pallas/flash_attention.py kernel;
+    beyond the reference — its 2018 ops had no fused attention). For
+    T sharded over 'sp', use parallel.layers.ring_attention instead."""
+    helper = LayerHelper('flash_attention', name=name)
+    out = helper.create_variable_for_type_inference(q.dtype)
+    helper.append_op(type='flash_attention',
+                     inputs={'Q': [q], 'K': [k], 'V': [v]},
+                     outputs={'Out': [out]},
+                     attrs={'causal': causal, 'sm_scale': sm_scale})
     return out

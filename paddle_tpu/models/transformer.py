@@ -26,7 +26,8 @@ from ..parallel.api import sharding_constraint, pipeline_stage_guard
 class TransformerConfig(object):
     def __init__(self, vocab=1000, dim=64, heads=4, layers=2, ffn=128,
                  max_len=64, moe_experts=0, use_tp=True, use_sp=True,
-                 pp_stages=0, ring_attention=False):
+                 pp_stages=0, ring_attention=False,
+                 flash_attention=False):
         self.vocab, self.dim, self.heads = vocab, dim, heads
         self.layers, self.ffn, self.max_len = layers, ffn, max_len
         self.moe_experts = moe_experts
@@ -38,6 +39,9 @@ class TransformerConfig(object):
         # ppermute ring (parallel/ring_attention.py) — O(T/n) per-device
         # score memory instead of materializing [B, H, T, T]
         self.ring_attention = ring_attention
+        # single-device long context: Pallas blockwise attention (no
+        # [T, T] scores); composable alternative to the sp ring
+        self.flash_attention = flash_attention
 
 
 def _attention(x, cfg, prefix):
@@ -68,6 +72,10 @@ def _attention(x, cfg, prefix):
     if cfg.ring_attention:
         from ..parallel.layers import ring_attention
         ctx = ring_attention(q, k, v, causal=True)         # [B, H, T, dh]
+    elif cfg.flash_attention:
+        # Pallas blockwise kernel — no [T, T] score tensor; the
+        # long-context enabler (see pallas/flash_attention.py)
+        ctx = L.flash_attention(q, k, v, causal=True)      # [B, H, T, dh]
     else:
         scores = L.matmul(q, k, transpose_y=True, alpha=1.0 / np.sqrt(dh))
         causal = L.causal_mask_bias(scores)                # [B, H, T, T]

@@ -1,0 +1,377 @@
+"""Flash attention for TPU in Pallas — the memory-wall kernel for long
+context (single-device analog of parallel/ring_attention.py; compose
+with the sp ring for multi-chip sequences).
+
+What XLA does with naive attention at sequence length T: materialize
+the [B, H, T, T] score tensor in HBM (forward AND backward), so HBM
+traffic and footprint grow as T² — at T=8k, bf16, B=8, H=16 that is a
+16 GiB intermediate, past v5e HBM. This kernel streams K/V blocks
+through VMEM with the online-softmax recurrence (Dao et al.; same fold
+as ring_attention's per-device step), keeping residency at
+O(block_q · d) and saving only (O, LSE) for the backward, which
+recomputes P blockwise. The MXU sees the same two matmuls per block;
+the win is bandwidth and memory, which is exactly what long context is
+bound by.
+
+Layout: q, k, v are [BH, T, d] (batch×heads collapsed into the leading
+grid dimension); T must divide by the block sizes (the op wrapper
+guards and falls back to XLA otherwise); d should be a lane multiple
+(128) for MXU alignment.
+
+Forward grid (bh, qi, ki), ki innermost: the (m, l, o) accumulators for
+one q block live in VMEM scratch across the ki sweep; causal q-blocks
+stop their sweep at the diagonal (pl.when skips both compute and the
+write until the final valid ki).
+
+Backward: delta = rowsum(dO·O) in plain JAX, then two kernels —
+dq (grid bh, qi, ki) and dk/dv (grid bh, ki, qi) — each recomputing
+P = exp(S − LSE) for its block pair, the standard flash backward.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+__all__ = ['flash_attention']
+
+_NEG_INF = -1e30
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
+                m_scr, l_scr, acc_scr, *, sm_scale, causal, block_q,
+                block_k, nk):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    last_ki = nk - 1
+    if causal:
+        last_ki = ((qi + 1) * block_q - 1) // block_k
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    @pl.when(ki <= last_ki)
+    def _step():
+        q = q_ref[0] * sm_scale          # [bq, d] (input dtype)
+        k = k_ref[0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)       # [bq, bk]
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        m_prev = m_scr[:]
+        blk_max = jnp.max(s, axis=1)
+        m_new = jnp.maximum(m_prev, blk_max)
+        safe_m = jnp.where(m_new <= _NEG_INF / 2, 0.0, m_new)
+        corr = jnp.exp(jnp.where(m_prev <= _NEG_INF / 2, safe_m, m_prev)
+                       - safe_m)
+        p = jnp.exp(s - safe_m[:, None])
+        if causal:
+            p = jnp.where(q_pos >= k_pos, p, 0.0)
+        l_new = l_scr[:] * corr + jnp.sum(p, axis=1)
+        acc = acc_scr[:] * corr[:, None] + jax.lax.dot_general(
+            p.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_scr[:] = m_new
+        l_scr[:] = l_new
+        acc_scr[:] = acc
+
+    @pl.when(ki == last_ki)
+    def _finalize():
+        l = l_scr[:]
+        safe_l = jnp.maximum(l, 1e-30)
+        o_ref[0] = (acc_scr[:] / safe_l[:, None]).astype(o_ref.dtype)
+        m = m_scr[:]
+        lse = jnp.where(m <= _NEG_INF / 2, _NEG_INF,
+                        m + jnp.log(safe_l))
+        lse_ref[0] = lse[:, None]
+
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+               acc_scr, *, sm_scale, causal, block_q, block_k, nk):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    last_ki = nk - 1
+    if causal:
+        last_ki = ((qi + 1) * block_q - 1) // block_k
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    @pl.when(ki <= last_ki)
+    def _step():
+        q = q_ref[0] * sm_scale
+        k = k_ref[0]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        p = jnp.exp(s - lse_ref[0])                   # [bq, bk]
+        dp = jax.lax.dot_general(
+            do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)       # [bq, bk]
+        ds = p * (dp - delta_ref[0])
+        acc_scr[:] += jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(ki == last_ki)
+    def _finalize():
+        dq_ref[0] = (acc_scr[:] * sm_scale).astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                dk_ref, dv_ref, dk_scr, dv_scr, *, sm_scale, causal,
+                block_q, block_k, nq):
+    ki = pl.program_id(1)
+    qi = pl.program_id(2)
+    first_qi = 0
+    if causal:
+        first_qi = (ki * block_k) // block_q
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    @pl.when(qi >= first_qi)
+    def _step():
+        q = q_ref[0] * sm_scale
+        k = k_ref[0]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        p = jnp.exp(s - lse_ref[0])                   # [bq, bk]
+        do = do_ref[0]
+        dv_scr[:] += jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)       # [bk, d]
+        dp = jax.lax.dot_general(do, v_ref[0], (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_ref[0])                  # [bq, bk]
+        dk_scr[:] += jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)       # [bk, d]
+
+    @pl.when(qi == pl.num_programs(2) - 1)
+    def _finalize():
+        # dk needs no extra sm_scale: the accumulation used the
+        # already-scaled q, which carries the factor
+        dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _block_sizes(T, d):
+    bq = min(512, T)
+    bk = min(512, T)
+    while T % bq:
+        bq //= 2
+    while T % bk:
+        bk //= 2
+    return max(bq, 8), max(bk, 128 if T % 128 == 0 else bk)
+
+
+@functools.partial(jax.jit, static_argnames=('causal', 'sm_scale',
+                                             'interpret'))
+def _fwd(q, k, v, causal, sm_scale, interpret=False):
+    BH, T, d = q.shape
+    bq, bk = _block_sizes(T, d)
+    nq, nk = T // bq, T // bk
+    kern = functools.partial(_fwd_kernel, sm_scale=sm_scale,
+                             causal=causal, block_q=bq, block_k=bk,
+                             nk=nk)
+    o, lse = pl.pallas_call(
+        kern,
+        grid=(BH, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, i, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, T, d), q.dtype),
+            jax.ShapeDtypeStruct((BH, T, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq,), jnp.float32),
+            pltpu.VMEM((bq,), jnp.float32),
+            pltpu.VMEM((bq, d), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=('parallel', 'parallel', 'arbitrary')),
+        interpret=interpret,
+    )(q, k, v)
+    return o, lse
+
+
+@functools.partial(jax.jit, static_argnames=('causal', 'sm_scale',
+                                             'interpret'))
+def _bwd(q, k, v, o, lse, do, causal, sm_scale, interpret=False):
+    BH, T, d = q.shape
+    bq, bk = _block_sizes(T, d)
+    nq, nk = T // bq, T // bk
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                    axis=-1, keepdims=True)            # [BH, T, 1]
+
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, sm_scale=sm_scale, causal=causal,
+                          block_q=bq, block_k=bk, nk=nk),
+        grid=(BH, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, i, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((BH, T, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=('parallel', 'parallel', 'arbitrary')),
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, sm_scale=sm_scale, causal=causal,
+                          block_q=bq, block_k=bk, nq=nq),
+        grid=(BH, nk, nq),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bq, 1), lambda b, j, i: (b, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bq, 1), lambda b, j, i: (b, i, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, T, d), k.dtype),
+            jax.ShapeDtypeStruct((BH, T, d), v.dtype),
+        ],
+        scratch_shapes=[pltpu.VMEM((bk, d), jnp.float32),
+                        pltpu.VMEM((bk, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=('parallel', 'parallel', 'arbitrary')),
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+    return dq, dk, dv
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _flash(q, k, v, causal, sm_scale, interpret):
+    o, _ = _fwd(q, k, v, causal, sm_scale, interpret)
+    return o
+
+
+def _flash_fwd(q, k, v, causal, sm_scale, interpret):
+    o, lse = _fwd(q, k, v, causal, sm_scale, interpret)
+    return o, (q, k, v, o, lse)
+
+
+def _flash_bwd(causal, sm_scale, interpret, res, g):
+    q, k, v, o, lse = res
+    dq, dk, dv = _bwd(q, k, v, o, lse, g, causal, sm_scale, interpret)
+    return dq, dk, dv
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def _supported(T, d):
+    return T % 128 == 0 and d % 128 == 0 and T >= 128
+
+
+def flash_attention(q, k, v, causal=True, sm_scale=None,
+                    force_naive=False):
+    """softmax(q·kᵀ·scale [+ causal mask])·v without materializing the
+    [T, T] scores. q, k, v: [B, H, T, d] (or [BH, T, d]). Falls back to
+    the naive XLA contraction for shapes the kernel does not tile
+    (T or d not lane-aligned), on non-TPU backends (interpret mode
+    covers CPU tests via the pallas_interpret flag), and when
+    force_naive is set (the FLAGS_use_flash_attention=false path —
+    same entry point so both flag states accept the same layouts)."""
+    squeeze = False
+    if q.ndim == 4:
+        B, H, T, d = q.shape
+        qf = q.reshape(B * H, T, d)
+        kf = k.reshape(B * H, T, d)
+        vf = v.reshape(B * H, T, d)
+    else:
+        qf, kf, vf = q, k, v
+        T, d = q.shape[-2:]
+        squeeze = True
+    scale = float(sm_scale) if sm_scale is not None else d ** -0.5
+
+    from ..flags import get_flag
+    interpret = jax.default_backend() != 'tpu'
+    use_kernel = (not force_naive) and _supported(T, d) and (
+        jax.default_backend() == 'tpu' or bool(get_flag(
+            'pallas_interpret')))
+    if use_kernel:
+        out = _flash(qf, kf, vf, causal, scale, interpret)
+    else:
+        out = _naive(qf, kf, vf, causal, scale)
+    if not squeeze:
+        out = out.reshape(q.shape)
+    return out
+
+
+def _naive(q, k, v, causal, scale):
+    s = jnp.einsum('btd,bsd->bts', q * jnp.asarray(scale, q.dtype), k,
+                   preferred_element_type=jnp.float32)
+    if causal:
+        T = q.shape[-2]
+        mask = jnp.tril(jnp.ones((T, T), bool))
+        s = jnp.where(mask, s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum('bts,bsd->btd', p.astype(v.dtype), v,
+                      preferred_element_type=jnp.float32).astype(q.dtype)

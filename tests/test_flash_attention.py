@@ -1,0 +1,131 @@
+"""Flash attention kernel (paddle_tpu/pallas/flash_attention.py):
+numerics vs the naive contraction in interpreter mode (CPU CI), plus
+the op/layer path through the executor."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as fluid
+from paddle_tpu.framework import Program, program_guard
+from paddle_tpu.pallas.flash_attention import _flash, _naive
+
+
+INTERPRET = jax.default_backend() != 'tpu'
+
+
+@pytest.mark.parametrize('causal', [False, True])
+def test_kernel_matches_naive(causal):
+    rng = np.random.RandomState(0)
+    BH, T, d = 3, 256, 128
+    q = jnp.asarray(rng.randn(BH, T, d).astype('float32')) * 0.3
+    k = jnp.asarray(rng.randn(BH, T, d).astype('float32')) * 0.3
+    v = jnp.asarray(rng.randn(BH, T, d).astype('float32'))
+    scale = d ** -0.5
+    o_k = _flash(q, k, v, causal, scale, INTERPRET)
+    o_n = _naive(q, k, v, causal, scale)
+    np.testing.assert_allclose(np.asarray(o_k), np.asarray(o_n),
+                               rtol=2e-2, atol=2e-2)
+
+
+@pytest.mark.parametrize('causal', [False, True])
+def test_kernel_grads_match_naive(causal):
+    rng = np.random.RandomState(1)
+    BH, T, d = 2, 256, 128
+    q = jnp.asarray(rng.randn(BH, T, d).astype('float32')) * 0.3
+    k = jnp.asarray(rng.randn(BH, T, d).astype('float32')) * 0.3
+    v = jnp.asarray(rng.randn(BH, T, d).astype('float32'))
+    scale = d ** -0.5
+
+    def loss_k(q, k, v):
+        return jnp.sum(_flash(q, k, v, causal, scale, INTERPRET) ** 2)
+
+    def loss_n(q, k, v):
+        return jnp.sum(_naive(q, k, v, causal, scale) ** 2)
+
+    gk = jax.grad(loss_k, argnums=(0, 1, 2))(q, k, v)
+    gn = jax.grad(loss_n, argnums=(0, 1, 2))(q, k, v)
+    for name, a, b in zip('qkv', gk, gn):
+        scale_ref = float(jnp.abs(b).max()) + 1e-9
+        rel = float(jnp.abs(a - b).max()) / scale_ref
+        assert rel < 5e-2, 'd%s rel err %.3e' % (name, rel)
+
+
+def test_flash_attention_op_through_executor():
+    fluid.set_flags({'pallas_interpret': True})
+    try:
+        rng = np.random.RandomState(2)
+        B, H, T, d = 2, 2, 256, 128
+        qv = rng.randn(B, H, T, d).astype('float32') * 0.3
+        kv = rng.randn(B, H, T, d).astype('float32') * 0.3
+        vv = rng.randn(B, H, T, d).astype('float32')
+
+        prog, startup = Program(), Program()
+        with program_guard(prog, startup):
+            q = fluid.layers.data(name='q', shape=[H, T, d],
+                                  dtype='float32')
+            k = fluid.layers.data(name='k', shape=[H, T, d],
+                                  dtype='float32')
+            v = fluid.layers.data(name='v', shape=[H, T, d],
+                                  dtype='float32')
+            out = fluid.layers.flash_attention(q, k, v, causal=True)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        got, = exe.run(prog, feed={'q': qv, 'k': kv, 'v': vv},
+                       fetch_list=[out])
+        want = _naive(jnp.asarray(qv.reshape(B * H, T, d)),
+                      jnp.asarray(kv.reshape(B * H, T, d)),
+                      jnp.asarray(vv.reshape(B * H, T, d)),
+                      True, d ** -0.5).reshape(B, H, T, d)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-2, atol=2e-2)
+    finally:
+        fluid.set_flags({'pallas_interpret': False})
+
+
+def test_unsupported_shape_falls_back():
+    # T=100 not lane-aligned: wrapper must fall back to naive, same
+    # numbers, no error
+    from paddle_tpu.pallas.flash_attention import flash_attention
+    rng = np.random.RandomState(3)
+    q = jnp.asarray(rng.randn(2, 100, 64).astype('float32'))
+    k = jnp.asarray(rng.randn(2, 100, 64).astype('float32'))
+    v = jnp.asarray(rng.randn(2, 100, 64).astype('float32'))
+    out = flash_attention(q, k, v, causal=True)
+    want = _naive(q, k, v, True, 64 ** -0.5)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_transformer_model_flash_config_trains():
+    from paddle_tpu.models.transformer import TransformerConfig, \
+        train_network
+    fluid.set_flags({'pallas_interpret': True})
+    try:
+        cfg = TransformerConfig(vocab=64, dim=128, heads=1, layers=1,
+                                ffn=128, max_len=128, use_tp=False,
+                                use_sp=False, flash_attention=True)
+        prog, startup = Program(), Program()
+        with program_guard(prog, startup):
+            tokens = fluid.layers.data(name='tokens', shape=[128, 1],
+                                       dtype='int64')
+            labels = fluid.layers.data(name='labels', shape=[128, 1],
+                                       dtype='int64')
+            _probs, loss = train_network(tokens, labels, cfg)
+            fluid.optimizer.Adam(1e-3).minimize(loss)
+        rng = np.random.RandomState(0)
+        ids = rng.randint(0, 64, (2, 128, 1)).astype('int64')
+        labs = rng.randint(0, 64, (2, 128, 1)).astype('int64')
+        exe = fluid.Executor(fluid.CPUPlace())
+        with fluid.scope_guard(fluid.Scope()):
+            exe.run(startup)
+            first = None
+            for i in range(12):
+                l, = exe.run(prog, feed={'tokens': ids, 'labels': labs},
+                             fetch_list=[loss])
+                if first is None:
+                    first = float(np.asarray(l))
+            assert float(np.asarray(l)) < first
+    finally:
+        fluid.set_flags({'pallas_interpret': False})

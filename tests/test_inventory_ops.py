@@ -256,8 +256,9 @@ class TestConv3D(OpTest):
 
     def test(self):
         self.op_type = 'conv3d'
-        x = np.random.rand(2, 3, 5, 6, 6).astype('float32')
-        w = np.random.rand(4, 3, 2, 3, 3).astype('float32')
+        rng = np.random.RandomState(7)    # seeded: fd-noise flakiness
+        x = rng.rand(2, 3, 5, 6, 6).astype('float32')
+        w = rng.rand(4, 3, 2, 3, 3).astype('float32')
         import torch
         import torch.nn.functional as F
         want = F.conv3d(torch.tensor(x), torch.tensor(w), stride=(1, 2, 2),
