@@ -174,25 +174,17 @@ def bench_resnet(on_tpu):
     return out
 
 
-def bench_transformer(on_tpu):
-    if on_tpu:
-        cfg = tfm.TransformerConfig(vocab=32768, dim=2048, heads=16,
-                                    layers=12, ffn=8192, max_len=512,
-                                    use_tp=False, use_sp=False)
-        batch, warmup, iters = 8, 3, 20
-    else:
-        cfg = tfm.TransformerConfig(vocab=256, dim=64, heads=4, layers=2,
-                                    ffn=128, max_len=32,
-                                    use_tp=False, use_sp=False)
-        batch, warmup, iters = 2, 1, 3
-
+def _bench_lm(cfg, batch, warmup, iters, prefix, causal_flops,
+              reader_name):
+    """Shared LM benchmark body: py_reader-fed AMP training step under
+    the ParallelExecutor, async timing, tokens/s + MFU emission."""
     main_prog = fluid.Program()
     startup_prog = fluid.Program()
     with fluid.program_guard(main_prog, startup_prog):
         rdr = fluid.layers.py_reader(
             capacity=4,
             shapes=[(-1, cfg.max_len, 1), (-1, cfg.max_len, 1)],
-            dtypes=['int64', 'int64'], name='tfm_reader',
+            dtypes=['int64', 'int64'], name=reader_name,
             use_double_buffer=True)
         tokens, labels = fluid.layers.read_file(rdr)
         emb = tfm.language_model_logits(tokens, cfg)
@@ -206,7 +198,6 @@ def bench_transformer(on_tpu):
     exe.run(startup_prog)
     pe = fluid.ParallelExecutor(use_cuda=True, loss_name=avg_cost.name,
                                 main_program=main_prog)
-
     rng = np.random.RandomState(0)
 
     def provider():
@@ -221,17 +212,33 @@ def bench_transformer(on_tpu):
     rdr.reset()
 
     tokens_per_sec = batch * cfg.max_len * iters / dt
-    out = {'transformer_tokens_per_sec': round(tokens_per_sec, 1),
-           'transformer_config': 'L%d_D%d_F%d_T%d_V%d_bs%d_bf16' % (
+    out = {prefix + '_tokens_per_sec': round(tokens_per_sec, 1),
+           prefix + '_config': 'L%d_D%d_F%d_T%d_V%d_bs%d_bf16' % (
                cfg.layers, cfg.dim, cfg.ffn, cfg.max_len, cfg.vocab,
                batch)}
     peak = _peak_flops(jax.devices()[0])
     if peak:
-        fl = _transformer_train_flops_per_token(cfg)
-        out['transformer_tflops_per_sec'] = round(
+        fl = _transformer_train_flops_per_token(cfg, causal=causal_flops)
+        out[prefix + '_tflops_per_sec'] = round(
             tokens_per_sec * fl / 1e12, 1)
-        out['transformer_mfu'] = round(tokens_per_sec * fl / peak, 4)
+        out[prefix + '_mfu'] = round(tokens_per_sec * fl / peak, 4)
     return out
+
+
+def bench_transformer(on_tpu):
+    if on_tpu:
+        cfg = tfm.TransformerConfig(vocab=32768, dim=2048, heads=16,
+                                    layers=12, ffn=8192, max_len=512,
+                                    use_tp=False, use_sp=False)
+        batch, warmup, iters = 8, 3, 20
+    else:
+        cfg = tfm.TransformerConfig(vocab=256, dim=64, heads=4, layers=2,
+                                    ffn=128, max_len=32,
+                                    use_tp=False, use_sp=False)
+        batch, warmup, iters = 2, 1, 3
+    # keep the r02+ metric series: full (non-causal) attention FLOPs
+    return _bench_lm(cfg, batch, warmup, iters, 'transformer',
+                     causal_flops=False, reader_name='tfm_reader')
 
 
 def bench_long_context(on_tpu):
@@ -250,51 +257,8 @@ def bench_long_context(on_tpu):
                                     ffn=128, max_len=64, use_tp=False,
                                     use_sp=False, flash_attention=False)
         batch, warmup, iters = 2, 1, 2
-
-    main_prog = fluid.Program()
-    startup_prog = fluid.Program()
-    with fluid.program_guard(main_prog, startup_prog):
-        rdr = fluid.layers.py_reader(
-            capacity=4,
-            shapes=[(-1, cfg.max_len, 1), (-1, cfg.max_len, 1)],
-            dtypes=['int64', 'int64'], name='lc_reader',
-            use_double_buffer=True)
-        tokens, labels = fluid.layers.read_file(rdr)
-        emb = tfm.language_model_logits(tokens, cfg)
-        cost = fluid.layers.softmax_with_cross_entropy(emb, labels)
-        avg_cost = fluid.layers.mean(cost)
-        opt = fluid.optimizer.Momentum(learning_rate=0.001, momentum=0.9)
-        opt = fluid.contrib.mixed_precision.decorate(opt)
-        opt.minimize(avg_cost)
-
-    exe = fluid.Executor(fluid.TPUPlace())
-    exe.run(startup_prog)
-    pe = fluid.ParallelExecutor(use_cuda=True, loss_name=avg_cost.name,
-                                main_program=main_prog)
-    rng = np.random.RandomState(0)
-
-    def provider():
-        while True:
-            toks = rng.randint(0, cfg.vocab,
-                               size=(batch, cfg.max_len, 1)).astype('int64')
-            yield [toks, np.roll(toks, -1, axis=1)]
-
-    rdr.decorate_tensor_provider(provider)
-    rdr.start()
-    dt = _run_steps(pe, avg_cost.name, warmup, iters)
-    rdr.reset()
-
-    tokens_per_sec = batch * cfg.max_len * iters / dt
-    fl = _transformer_train_flops_per_token(cfg, causal=True)
-    out = {'longcontext_tokens_per_sec': round(tokens_per_sec, 1),
-           'longcontext_config': 'L%d_D%d_F%d_T%d_bs%d_flash_bf16' % (
-               cfg.layers, cfg.dim, cfg.ffn, cfg.max_len, batch)}
-    peak = _peak_flops(jax.devices()[0])
-    if peak:
-        out['longcontext_tflops_per_sec'] = round(
-            tokens_per_sec * fl / 1e12, 1)
-        out['longcontext_mfu'] = round(tokens_per_sec * fl / peak, 4)
-    return out
+    return _bench_lm(cfg, batch, warmup, iters, 'longcontext',
+                     causal_flops=True, reader_name='lc_reader')
 
 
 def main():

@@ -1,4 +1,5 @@
 """contrib: extras mirroring reference python/paddle/fluid/contrib/."""
 from . import mixed_precision  # noqa: F401
 from . import memory_usage_calc  # noqa: F401
+from . import decoder  # noqa: F401
 from .memory_usage_calc import memory_usage  # noqa: F401

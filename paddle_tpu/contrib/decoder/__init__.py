@@ -1,0 +1,2 @@
+"""contrib.decoder (reference python/paddle/fluid/contrib/decoder/)."""
+from . import beam_search_decoder  # noqa: F401

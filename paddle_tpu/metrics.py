@@ -5,7 +5,16 @@ from __future__ import annotations
 import numpy as np
 
 __all__ = ['MetricBase', 'CompositeMetric', 'Precision', 'Recall', 'Accuracy',
-           'ChunkEvaluator', 'EditDistance', 'Auc']
+           'ChunkEvaluator', 'EditDistance', 'Auc', 'DetectionMAP']
+
+
+def _is_number_(v):
+    return isinstance(v, (int, float)) or (
+        isinstance(v, np.ndarray) and v.shape == (1,))
+
+
+def _is_number_or_matrix_(v):
+    return _is_number_(v) or isinstance(v, np.ndarray)
 
 
 class MetricBase(object):
@@ -200,3 +209,35 @@ class Auc(MetricBase):
             y = (tpr[i] + tpr[i + 1]) / 2.0
             auc += dx * y
         return auc
+
+
+class DetectionMAP(MetricBase):
+    """Mean-average-precision accumulator (reference metrics.py
+    DetectionMAP): update() takes the per-batch mAP value the
+    detection_map op computed (plus the batch's image count as weight)
+    and eval() returns the weighted mean. The reference carries the
+    accumulation inside its op's AccumPosCount state; here the op is
+    stateless per batch (ops/detection_ops.py) and the metric does the
+    cross-batch averaging — evaluator.DetectionMAP wires both ends."""
+
+    def __init__(self, name=None):
+        super(DetectionMAP, self).__init__(name)
+        self.total_map = 0.0
+        self.weight = 0.0
+
+    def update(self, value, weight=1):
+        if not _is_number_or_matrix_(value):
+            raise ValueError(
+                'The parameter value must be a number or a numpy ndarray.')
+        if not _is_number_(weight):
+            raise ValueError('The parameter weight must be a number.')
+        self.total_map += float(np.asarray(value).sum()) * weight
+        self.weight += weight
+
+    def eval(self):
+        if self.weight == 0:
+            raise ValueError(
+                'There is no data in DetectionMAP Metrics. '
+                'Please check layers.detection_map output has added to '
+                'DetectionMAP.')
+        return self.total_map / self.weight

