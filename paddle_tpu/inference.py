@@ -20,7 +20,8 @@ from . import io as io_mod
 from .executor import Executor, Scope, TPUPlace, scope_guard
 
 __all__ = ['Config', 'Predictor', 'create_predictor',
-           'create_paddle_predictor']
+           'create_paddle_predictor', 'AnalysisConfig',
+           'AnalysisPredictor', 'create_analysis_predictor']
 
 
 class Config(object):
@@ -98,3 +99,47 @@ def create_predictor(config):
 
 # reference CreatePaddlePredictor spelling
 create_paddle_predictor = create_predictor
+
+
+class AnalysisConfig(Config):
+    """(reference contrib AnalysisConfig / analysis_predictor.cc) —
+    Config plus IR-optimization switches consumed by
+    AnalysisPredictor."""
+
+    def __init__(self, model_dir, model_filename=None,
+                 params_filename=None, place=None, ir_optim=True):
+        super(AnalysisConfig, self).__init__(
+            model_dir, model_filename=model_filename,
+            params_filename=params_filename, place=place)
+        self.ir_optim = ir_optim
+
+    def switch_ir_optim(self, flag=True):
+        self.ir_optim = flag
+        return self
+
+
+class AnalysisPredictor(Predictor):
+    """Predictor that runs offline graph rewrites on the loaded program
+    before serving (reference inference/api/analysis_predictor.cc runs
+    the ir fusion passes — fc_fuse, conv+bn, ... — before Prepare).
+    Here the rewrite set is the InferenceTranspiler's batch-norm
+    folding; elementwise/activation fusion is XLA's job at JIT time, so
+    those reference passes have no offline analog by design."""
+
+    def __init__(self, config, _clone_of=None):
+        super(AnalysisPredictor, self).__init__(config, _clone_of=_clone_of)
+        if _clone_of is None and getattr(config, 'ir_optim', True):
+            from .transpiler import InferenceTranspiler
+            InferenceTranspiler().transpile(
+                self._program, self._place, scope=self._scope)
+
+    def clone(self):
+        return AnalysisPredictor(self._config, _clone_of=self)
+
+
+def create_analysis_predictor(config):
+    if not isinstance(config, AnalysisConfig):
+        config = AnalysisConfig(
+            config.model_dir, model_filename=config.model_filename,
+            params_filename=config.params_filename, place=config.place)
+    return AnalysisPredictor(config)

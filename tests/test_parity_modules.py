@@ -222,3 +222,55 @@ def test_detection_map_metric():
     m.reset()
     with pytest.raises(ValueError):
         m.eval()
+
+
+def test_analysis_predictor_folds_bn(tmp_path):
+    from paddle_tpu.inference import (AnalysisConfig, AnalysisPredictor,
+                                      create_analysis_predictor)
+    prog, startup = Program(), Program()
+    with program_guard(prog, startup):
+        x = fluid.layers.data(name='x', shape=[3, 8, 8], dtype='float32')
+        c = fluid.layers.conv2d(x, num_filters=4, filter_size=3,
+                                padding=1, bias_attr=False)
+        bn = fluid.layers.batch_norm(c, is_test=True)
+        out = fluid.layers.reduce_sum(bn, dim=[1, 2, 3])
+    exe = fluid.Executor(fluid.CPUPlace())
+    model_dir = str(tmp_path / 'model')
+    xb = np.random.rand(2, 3, 8, 8).astype('float32')
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        want, = exe.run(prog, feed={'x': xb}, fetch_list=[out])
+        fluid.io.save_inference_model(model_dir, ['x'], [out], exe,
+                                      main_program=prog)
+
+    pred = create_analysis_predictor(
+        AnalysisConfig(model_dir, place=fluid.CPUPlace()))
+    # the loaded+optimized program must not contain batch_norm anymore
+    types = [op.type for op in pred._program.global_block().ops]
+    assert 'batch_norm' not in types
+    got = pred.run({'x': xb})[0]
+    np.testing.assert_allclose(got, np.asarray(want), rtol=2e-4,
+                               atol=2e-4)
+    # clone serves the same fused program from shared weights
+    got2 = pred.clone().run({'x': xb})[0]
+    np.testing.assert_allclose(got2, got, rtol=1e-6)
+
+
+def test_timeline_tool(tmp_path):
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        'timeline', os.path.join(os.path.dirname(__file__), '..',
+                                 'tools', 'timeline.py'))
+    timeline = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(timeline)
+    import json as _json
+    raw = [{'name': 'mul', 'pid': 0, 'tid': 0, 'ts': 10, 'dur': 5},
+           {'name': 'relu', 'pid': 0, 'tid': 0, 'ts': 16, 'dur': 2}]
+    p_in = str(tmp_path / 'prof.json')
+    p_out = str(tmp_path / 'tl.json')
+    with open(p_in, 'w') as f:
+        _json.dump(raw, f)
+    timeline.convert(p_in, p_out)
+    trace = _json.load(open(p_out))
+    names = [e.get('name') for e in trace['traceEvents']]
+    assert 'mul' in names and 'relu' in names

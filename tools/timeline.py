@@ -1,0 +1,85 @@
+"""Profile -> chrome://tracing converter (reference tools/timeline.py).
+
+This framework's profiler (paddle_tpu/profiler.py) already emits
+chrome-trace JSON natively; this tool keeps the reference's CLI
+contract for workflows that post-process saved profile files:
+
+    python tools/timeline.py --profile_path out.json --timeline_path tl.json
+
+It accepts either a file the profiler wrote (already chrome format —
+validated and passed through with sorted events) or a JSON list of
+{name, pid, tid, ts, dur} event dicts, which it wraps into the chrome
+trace envelope the way the reference's _ChromeTraceFormatter does.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+
+
+class _ChromeTraceFormatter(object):
+    """(reference tools/timeline.py:36) Build the chrome trace dict."""
+
+    def __init__(self):
+        self._events = []
+        self._metadata = []
+
+    def _create_event(self, ph, category, name, pid, tid, timestamp):
+        return {'ph': ph, 'cat': category, 'name': name, 'pid': pid,
+                'tid': tid, 'ts': timestamp}
+
+    def emit_pid(self, name, pid):
+        self._metadata.append({'name': 'process_name', 'ph': 'M',
+                               'pid': pid,
+                               'args': {'name': name}})
+
+    def emit_region(self, timestamp, duration, pid, tid, category, name,
+                    args):
+        event = self._create_event('X', category, name, pid, tid,
+                                   timestamp)
+        event['dur'] = duration
+        event['args'] = args
+        self._events.append(event)
+
+    def format_to_string(self, pretty=False):
+        trace = {'traceEvents': self._metadata + self._events}
+        if pretty:
+            return json.dumps(trace, indent=4, separators=(',', ': '))
+        return json.dumps(trace, separators=(',', ':'))
+
+
+def convert(profile_path, timeline_path, pretty=False):
+    with open(profile_path) as f:
+        data = json.load(f)
+    if isinstance(data, dict) and 'traceEvents' in data:
+        # already chrome format (profiler.py native output): normalize
+        data['traceEvents'].sort(key=lambda e: e.get('ts', 0))
+        out = json.dumps(data, indent=4 if pretty else None)
+    else:
+        fmt = _ChromeTraceFormatter()
+        pids = {}
+        for ev in data:
+            pid = ev.get('pid', 0)
+            if pid not in pids:
+                fmt.emit_pid(ev.get('process', 'process %d' % pid), pid)
+                pids[pid] = True
+            fmt.emit_region(ev['ts'], ev.get('dur', 0), pid,
+                            ev.get('tid', 0), ev.get('cat', 'Op'),
+                            ev['name'], ev.get('args', {}))
+        out = fmt.format_to_string(pretty)
+    with open(timeline_path, 'w') as f:
+        f.write(out)
+    return timeline_path
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--profile_path', required=True)
+    parser.add_argument('--timeline_path', required=True)
+    parser.add_argument('--pretty', action='store_true')
+    args = parser.parse_args()
+    print(convert(args.profile_path, args.timeline_path, args.pretty))
+
+
+if __name__ == '__main__':
+    main()
