@@ -10,15 +10,26 @@ from ..registry import register_op, op_emitter, register_vjp_grad, \
 
 @op_emitter('ring_attention')
 def _ring_attention_emit(ctx, op):
-    from ..parallel.ring_attention import ring_attention_global
+    from ..parallel.ring_attention import (ring_attention_global,
+                                           ring_flash_attention_global)
+    from ..flags import get_flag
     q = ctx.get(op.single_input('Q'))
     k = ctx.get(op.single_input('K'))
     v = ctx.get(op.single_input('V'))
     q, k, v = amp_cast(ctx, q, k, v)
     causal = op.attr('causal', True)
     sm_scale = op.attr('sm_scale', None)
-    out = ring_attention_global(q, k, v, getattr(ctx, 'mesh', None),
-                                causal=causal, sm_scale=sm_scale)
+    if get_flag('use_flash_attention'):
+        # ring x flash: per-block work through the Pallas kernel —
+        # the [Tl, Tl] score block never exists (parity-tested in
+        # tests/test_ring_flash.py; falls back per-block to XLA math
+        # for lane-unaligned shard shapes)
+        out = ring_flash_attention_global(
+            q, k, v, getattr(ctx, 'mesh', None), causal=causal,
+            sm_scale=sm_scale)
+    else:
+        out = ring_attention_global(q, k, v, getattr(ctx, 'mesh', None),
+                                    causal=causal, sm_scale=sm_scale)
     ctx.set(op.single_output('Out'), out)
 
 

@@ -38,7 +38,8 @@ except ImportError:                      # older jax
     _SHARD_MAP_KW = {'check_rep': False}
 from jax.sharding import PartitionSpec as P
 
-__all__ = ['ring_attention', 'ring_attention_global']
+__all__ = ['ring_attention', 'ring_attention_global',
+           'ring_flash_attention', 'ring_flash_attention_global']
 
 _NEG_INF = -1e30
 
@@ -153,3 +154,237 @@ def ring_attention_global(q, k, v, mesh, causal=True, sm_scale=None,
                            causal=causal, sm_scale=sm_scale)
     return shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
                      out_specs=spec, **_SHARD_MAP_KW)(q, k, v)
+
+
+# ---------------------------------------------------------------------------
+# ring x flash composition: the multi-chip long-context path.
+# ---------------------------------------------------------------------------
+
+def _kernel_enabled():
+    """Real kernel on TPU; interpreter mode only when the
+    pallas_interpret flag opts in (CPU tests) — same gate as the
+    single-chip flash_attention wrapper."""
+    from ..flags import get_flag
+    return jax.default_backend() == 'tpu' or bool(
+        get_flag('pallas_interpret'))
+
+
+def _flash_block(q, kb, vb, causal, sm_scale):
+    """Run the Pallas flash kernel over one KV block, returning the
+    attention PARTIAL (o, lse) for later merging. q/kb/vb: [B,H,Tl,dh]."""
+    from ..pallas.flash_attention import _fwd, _supported
+    B, H, Tl, dh = q.shape
+    qf = q.reshape(B * H, Tl, dh)
+    kf = kb.reshape(B * H, Tl, dh)
+    vf = vb.reshape(B * H, Tl, dh)
+    scale = sm_scale if sm_scale is not None else dh ** -0.5
+    if _supported(Tl, dh) and _kernel_enabled():
+        o, lse = _fwd(qf, kf, vf, causal, scale,
+                      jax.default_backend() != 'tpu')
+        lse = lse[..., 0]
+    else:
+        # small/unaligned blocks: same partial computed with XLA ops
+        s = jnp.einsum('btd,bsd->bts', qf * jnp.asarray(scale, qf.dtype),
+                       kf, preferred_element_type=jnp.float32)
+        if causal:
+            mask = jnp.tril(jnp.ones((Tl, Tl), bool))
+            s = jnp.where(mask[None], s, _NEG_INF)
+        m = jnp.max(s, axis=-1)
+        p = jnp.exp(s - m[..., None])
+        l = jnp.sum(p, axis=-1)
+        o = (jnp.einsum('bts,bsd->btd', p.astype(vf.dtype), vf,
+                        preferred_element_type=jnp.float32)
+             / jnp.maximum(l, 1e-30)[..., None]).astype(qf.dtype)
+        lse = jnp.where(m <= _NEG_INF / 2, _NEG_INF, m + jnp.log(
+            jnp.maximum(l, 1e-30)))
+    return (o.reshape(B, H, Tl, dh), lse.reshape(B, H, Tl))
+
+
+def _merge_partials(o1, lse1, o2, lse2):
+    """Combine two attention partials over disjoint key sets: the
+    standard log-sum-exp merge (o_i are softmax-normalized within their
+    own key set, lse_i the log partition)."""
+    m = jnp.maximum(lse1, lse2)
+    safe_m = jnp.where(m <= _NEG_INF / 2, 0.0, m)
+    w1 = jnp.exp(jnp.where(lse1 <= _NEG_INF / 2, _NEG_INF, lse1) - safe_m)
+    w2 = jnp.exp(jnp.where(lse2 <= _NEG_INF / 2, _NEG_INF, lse2) - safe_m)
+    denom = jnp.maximum(w1 + w2, 1e-30)
+    o = (o1.astype(jnp.float32) * w1[..., None] +
+         o2.astype(jnp.float32) * w2[..., None]) / denom[..., None]
+    lse = safe_m + jnp.log(denom)
+    lse = jnp.where((lse1 <= _NEG_INF / 2) & (lse2 <= _NEG_INF / 2),
+                    _NEG_INF, lse)
+    return o, lse                  # fp32: the ring carries fp32 until
+                                   # the final cast
+
+
+def ring_flash_attention(q, k, v, axis_name='sp', causal=True,
+                         sm_scale=None):
+    """Ring attention whose per-block work runs through the Pallas
+    flash kernel: K/V blocks rotate the 'sp' ring (ppermute) and each
+    arriving block is consumed as a flash partial (o, lse), merged with
+    the running partial by log-sum-exp. Per-device memory stays
+    O(Tl·dh) — the [Tl, Tl] score block of the plain ring fold never
+    exists either — and the MXU work inside each step is the tiled
+    flash kernel, so the composition scales T across chips (ring) and
+    within a chip (flash) at once.
+
+    Gradients: pallas kernels have no JVP rule, so the ring carries its
+    own custom_vjp — the backward re-runs the ring, feeding each block
+    through the flash dq/dkv kernels with the GLOBAL lse (the flash
+    backward identity P = exp(S − lse_global) makes per-block grads
+    additive), and each block's (dk, dv) travels the ring with it until
+    it arrives back home on the final rotation.
+
+    Exact: equals softmax(QKᵀ·scale [+causal])·V over the full ring
+    sequence (parity-tested against ring_attention/naive)."""
+    scale = sm_scale if sm_scale is not None else q.shape[-1] ** -0.5
+    o, _lse = _ring_flash(q, k, v, axis_name, causal, scale)
+    return o.astype(q.dtype)
+
+
+def _flash_bwd_block(q, kb, vb, o, lse, g, causal, scale, zero_block):
+    """Per-block flash backward with the global lse. zero_block: traced
+    bool — inflate lse so P=0 (future blocks under causal)."""
+    from ..pallas.flash_attention import _bwd, _supported
+    B, H, Tl, dh = q.shape
+    lse_eff = jnp.where(zero_block, 1e30, lse)
+
+    def flat(x):
+        return x.reshape(B * H, Tl, -1)
+    if _supported(Tl, dh) and _kernel_enabled():
+        dq, dk, dv = _bwd(flat(q), flat(kb), flat(vb), flat(o),
+                          lse_eff.reshape(B * H, Tl, 1), flat(g),
+                          causal, scale,
+                          jax.default_backend() != 'tpu')
+    else:
+        qf, kf, vf, of, gf = (flat(q), flat(kb), flat(vb), flat(o),
+                              flat(g))
+        s = jnp.einsum('btd,bsd->bts', qf * jnp.asarray(scale, qf.dtype),
+                       kf, preferred_element_type=jnp.float32)
+        if causal:
+            mask = jnp.tril(jnp.ones((Tl, Tl), bool))
+            s = jnp.where(mask[None], s, _NEG_INF)
+        p = jnp.exp(s - lse_eff.reshape(B * H, Tl, 1))
+        delta = jnp.sum(gf.astype(jnp.float32) * of.astype(jnp.float32),
+                        axis=-1, keepdims=True)
+        dp = jnp.einsum('btd,bsd->bts', gf, vf,
+                        preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        dq = jnp.einsum('bts,bsd->btd', ds.astype(kf.dtype), kf,
+                        preferred_element_type=jnp.float32) * scale
+        dk = jnp.einsum('bts,btd->bsd',
+                        ds.astype(qf.dtype),
+                        qf * jnp.asarray(scale, qf.dtype),
+                        preferred_element_type=jnp.float32)
+        dv = jnp.einsum('bts,btd->bsd', p.astype(gf.dtype), gf,
+                        preferred_element_type=jnp.float32)
+    shp = q.shape
+    return (dq.reshape(shp).astype(q.dtype),
+            dk.reshape(shp).astype(kb.dtype),
+            dv.reshape(shp).astype(vb.dtype))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _ring_flash(q, k, v, axis_name, causal, scale):
+    o, lse = _ring_flash_fwd_loop(q, k, v, axis_name, causal, scale)
+    return o, lse
+
+
+def _ring_flash_fwd_loop(q, k, v, axis_name, causal, scale):
+    n = jax.lax.psum(1, axis_name)
+    my = jax.lax.axis_index(axis_name)
+    o, lse = _flash_block(q, k, v, causal, scale)
+    o = o.astype(jnp.float32)      # fp32 merge carry (like the exact
+    perm = [(j, (j - 1) % n) for j in range(n)]   # ring's o/m/l)
+
+    def step(carry, i):
+        o, lse, kb, vb = carry
+        kb = jax.lax.ppermute(kb, axis_name, perm)
+        vb = jax.lax.ppermute(vb, axis_name, perm)
+        src = (my + i) % n
+        o_b, lse_b = _flash_block(q, kb, vb, False, scale)
+        if causal:
+            lse_b = jnp.where(src < my, lse_b, _NEG_INF)
+        o, lse = _merge_partials(o, lse, o_b, lse_b)
+        return (o, lse, kb, vb), None
+
+    (o, lse, _, _), _ = jax.lax.scan(step, (o, lse, k, v),
+                                     jnp.arange(1, n))
+    return o, lse
+
+
+def _ring_flash_vjp_fwd(q, k, v, axis_name, causal, scale):
+    o, lse = _ring_flash_fwd_loop(q, k, v, axis_name, causal, scale)
+    return (o, lse), (q, k, v, o, lse)
+
+
+def _ring_flash_vjp_bwd(axis_name, causal, scale, res, cots):
+    q, k, v, o, lse = res
+    g, _g_lse = cots       # lse is an internal byproduct; its cotangent
+    # is zero in any loss built from o (asserted by usage)
+    n = jax.lax.psum(1, axis_name)
+    my = jax.lax.axis_index(axis_name)
+    perm = [(j, (j - 1) % n) for j in range(n)]
+
+    dq, dkb, dvb = _flash_bwd_block(
+        q, k, v, o, lse, g, causal, scale,
+        zero_block=jnp.asarray(False))
+
+    def step(carry, i):
+        dq, kb, vb, dkb, dvb = carry
+        kb = jax.lax.ppermute(kb, axis_name, perm)
+        vb = jax.lax.ppermute(vb, axis_name, perm)
+        dkb = jax.lax.ppermute(dkb, axis_name, perm)
+        dvb = jax.lax.ppermute(dvb, axis_name, perm)
+        src = (my + i) % n
+        zero = jnp.asarray(causal) & (src >= my) if causal \
+            else jnp.asarray(False)
+        dq_b, dk_b, dv_b = _flash_bwd_block(
+            q, kb, vb, o, lse, g, False, scale, zero_block=zero)
+        return (dq + dq_b, kb, vb, dkb + dk_b, dvb + dv_b), None
+
+    (dq, _, _, dkb, dvb), _ = jax.lax.scan(
+        step, (dq, k, v, dkb, dvb), jnp.arange(1, n))
+    # one final rotation returns each block's accumulated grads home
+    dk = jax.lax.ppermute(dkb, axis_name, perm)
+    dv = jax.lax.ppermute(dvb, axis_name, perm)
+    return dq, dk, dv
+
+
+_ring_flash.defvjp(_ring_flash_vjp_fwd, _ring_flash_vjp_bwd)
+
+
+def ring_flash_attention_global(q, k, v, mesh, causal=True,
+                                sm_scale=None, seq_axis='sp',
+                                batch_axis='dp', head_axis='tp'):
+    """GSPMD-global entry for ring_flash_attention (mirrors
+    ring_attention_global's sharding contract and fallbacks)."""
+    def _divisible_axis(name, dim):
+        if name and mesh is not None and name in mesh.axis_names \
+                and mesh.shape[name] > 1 and dim % mesh.shape[name] == 0:
+            return name
+        return None
+
+    if mesh is None:
+        from ..pallas.flash_attention import flash_attention as _fa
+        return _fa(q, k, v, causal=causal, sm_scale=sm_scale)
+    if _divisible_axis(seq_axis, q.shape[2]) is None:
+        # mesh present but no usable sp axis: a bare pallas_call on
+        # GSPMD-sharded globals would all-gather (no partitioning rule
+        # for the custom call) — use the einsum fallback, which XLA
+        # partitions over dp/tp like any other op
+        return ring_attention_global(q, k, v, None, causal=causal,
+                                     sm_scale=sm_scale)
+    spec = P(_divisible_axis(batch_axis, q.shape[0]),
+             _divisible_axis(head_axis, q.shape[1]), seq_axis, None)
+    fn = functools.partial(ring_flash_attention, axis_name=seq_axis,
+                           causal=causal, sm_scale=sm_scale)
+    # pallas_call outputs carry no varying-mesh-axes annotation, which
+    # the new shard_map's check_vma rejects — disable the check (the
+    # per-device computation is manifestly per-shard)
+    kw = dict(_SHARD_MAP_KW)
+    if 'check_rep' not in kw:
+        kw['check_vma'] = False
+    return shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                     out_specs=spec, **kw)(q, k, v)
