@@ -18,6 +18,7 @@ __all__ = [
     'random_crop', 'mean_iou', 'crop', 'rank_loss', 'unstack',
     'bilinear_tensor_product', 'modified_huber_loss', 'l1_norm', 'sign',
     'fake_quantize', 'polygon_box_transform', 'flash_attention',
+    'auc',
 ]
 
 
@@ -472,3 +473,30 @@ def flash_attention(q, k, v, causal=True, sm_scale=None, name=None):
                      outputs={'Out': [out]},
                      attrs={'causal': causal, 'sm_scale': sm_scale})
     return out
+
+
+def auc(input, label, curve='ROC', num_thresholds=200, topk=1, name=None):
+    """Streaming AUC over threshold-bucketed confusion accumulators
+    (reference layers/metric_op.py auc -> auc_op): TP/FP/TN/FN live in
+    persistable state vars that accumulate across batches the way
+    batch_norm's running stats do."""
+    from ..initializer import Constant
+    helper = LayerHelper('auc', name=name)
+    states = {}
+    for stat in ('tp', 'fp', 'tn', 'fn'):
+        v = helper.create_global_variable(
+            name='%s.%s' % (helper.name, stat), shape=[num_thresholds],
+            dtype='float32', persistable=True)
+        helper.set_variable_initializer(v, Constant(0.0))
+        states[stat] = v
+    auc_out = helper.create_variable_for_type_inference('float32')
+    helper.append_op(
+        type='auc',
+        inputs={'Predict': [input], 'Label': [label],
+                'TP': [states['tp']], 'FP': [states['fp']],
+                'TN': [states['tn']], 'FN': [states['fn']]},
+        outputs={'AUC': [auc_out], 'TPOut': [states['tp']],
+                 'FPOut': [states['fp']], 'TNOut': [states['tn']],
+                 'FNOut': [states['fn']]},
+        attrs={'curve': curve, 'num_thresholds': num_thresholds})
+    return auc_out

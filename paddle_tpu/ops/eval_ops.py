@@ -135,3 +135,70 @@ def _chunk_eval_infer(op, block):
 
 register_op('chunk_eval', emit=_chunk_eval_emit,
             infer_shape=_chunk_eval_infer, host=True, no_grad=True)
+
+
+def _auc_emit(ctx, op):
+    """Streaming AUC (reference operators/auc_op.cc): threshold-bucketed
+    TP/FP/TN/FN accumulators (persistable state vars written back each
+    step, batch_norm-stats style) and the trapezoid-integrated curve.
+    Device op: one one-hot bucketing matmul per batch — but emitted as
+    numpy on the host when it appears in a host segment."""
+    import jax.numpy as jnp
+    probs = ctx.get(op.single_input('Predict'))    # [B, 2] or [B, 1]
+    labels = ctx.get(op.single_input('Label')).reshape(-1)
+    num_t = int(op.attr('num_thresholds', 200))
+    curve = op.attr('curve', 'ROC')
+    pos_prob = probs[:, -1] if probs.ndim == 2 else probs.reshape(-1)
+    pos = (labels > 0)
+    # bucket index of each sample's score: [0, num_t)
+    idx = jnp.clip((pos_prob * num_t).astype(jnp.int32), 0, num_t - 1)
+    onehot = (idx[:, None] ==
+              jnp.arange(num_t)[None, :]).astype(jnp.float32)
+    # cumulative from the top: samples with score >= threshold_i
+    pos_hist = jnp.sum(onehot * pos[:, None].astype(jnp.float32), axis=0)
+    neg_hist = jnp.sum(onehot * (~pos)[:, None].astype(jnp.float32),
+                       axis=0)
+    ge = jnp.cumsum(pos_hist[::-1])[::-1]     # TP at each threshold
+    ge_n = jnp.cumsum(neg_hist[::-1])[::-1]   # FP at each threshold
+    total_pos = jnp.sum(pos_hist)
+    total_neg = jnp.sum(neg_hist)
+    tp = ge + ctx.get(op.single_input('TP')).reshape(-1) \
+        if op.input('TP') else ge
+    fp = ge_n + ctx.get(op.single_input('FP')).reshape(-1) \
+        if op.input('FP') else ge_n
+    fn = (total_pos - ge) + ctx.get(op.single_input('FN')).reshape(-1) \
+        if op.input('FN') else (total_pos - ge)
+    tn = (total_neg - ge_n) + ctx.get(op.single_input('TN')).reshape(-1) \
+        if op.input('TN') else (total_neg - ge_n)
+    eps = 1e-6
+    if curve == 'PR':
+        precision = tp / jnp.maximum(tp + fp, eps)
+        recall = tp / jnp.maximum(tp + fn, eps)
+        x, y = recall, precision
+    else:
+        tpr = tp / jnp.maximum(tp + fn, eps)
+        fpr = fp / jnp.maximum(fp + tn, eps)
+        x, y = fpr, tpr
+    # thresholds ascend -> x descends; trapezoid over consecutive pairs
+    auc_val = jnp.sum((x[:-1] - x[1:]) * (y[:-1] + y[1:]) * 0.5)
+    ctx.set(op.single_output('AUC'),
+            auc_val.reshape((1,)).astype(jnp.float32))
+    for slot, val in (('TPOut', tp), ('FPOut', fp), ('TNOut', tn),
+                      ('FNOut', fn)):
+        if op.output(slot):
+            ctx.set(op.single_output(slot), val.astype(jnp.float32))
+
+
+def _auc_infer(op, block):
+    num_t = int(op.attr('num_thresholds', 200))
+    a = block.var_recursive(op.single_output('AUC'))
+    a.shape = (1,)
+    a.dtype = 'float32'
+    for slot in ('TPOut', 'FPOut', 'TNOut', 'FNOut'):
+        if op.output(slot):
+            v = block.var_recursive(op.single_output(slot))
+            v.shape = (num_t,)
+            v.dtype = 'float32'
+
+
+register_op('auc', emit=_auc_emit, infer_shape=_auc_infer, no_grad=True)

@@ -115,6 +115,22 @@ def ring_attention(q, k, v, axis_name='sp', causal=True, sm_scale=None):
     return out.astype(q.dtype)
 
 
+def _ring_spec(mesh, q, seq_axis, batch_axis, head_axis):
+    """PartitionSpec for the [B, H, T, dh] operands, mapping each mesh
+    axis only when it exists, is >1, and divides the dim (shard_map
+    hard-errors on non-divisible dims where GSPMD would pad). Returns
+    (spec, seq_ok)."""
+    def axis(name, dim):
+        if name and mesh is not None and name in mesh.axis_names \
+                and mesh.shape[name] > 1 and dim % mesh.shape[name] == 0:
+            return name
+        return None
+    seq_ok = axis(seq_axis, q.shape[2]) is not None
+    spec = P(axis(batch_axis, q.shape[0]), axis(head_axis, q.shape[1]),
+             seq_axis if seq_ok else None, None)
+    return spec, seq_ok
+
+
 def ring_attention_global(q, k, v, mesh, causal=True, sm_scale=None,
                           seq_axis='sp', batch_axis='dp',
                           head_axis='tp'):
@@ -124,17 +140,8 @@ def ring_attention_global(q, k, v, mesh, causal=True, sm_scale=None,
     mesh=None (no mesh in scope) lowers to plain fused attention; so do
     meshes whose sp size does not divide T (shard_map cannot pad the way
     GSPMD constraints can)."""
-    def _divisible_axis(name, dim):
-        # map a mesh axis into the shard_map spec only when it exists,
-        # is >1, and divides the dim — otherwise replicate that dim
-        # (GSPMD pads non-divisible dims; shard_map hard-errors)
-        if name and mesh is not None and name in mesh.axis_names \
-                and mesh.shape[name] > 1 and dim % mesh.shape[name] == 0:
-            return name
-        return None
-
-    if mesh is None or \
-            _divisible_axis(seq_axis, q.shape[2]) is None:
+    spec, seq_ok = _ring_spec(mesh, q, seq_axis, batch_axis, head_axis)
+    if mesh is None or not seq_ok:
         # no ring: plain attention, operand dtype preserved (bf16 under
         # AMP runs the MXU at full rate), fp32 accumulation
         scale = sm_scale if sm_scale is not None else q.shape[-1] ** -0.5
@@ -148,8 +155,6 @@ def ring_attention_global(q, k, v, mesh, causal=True, sm_scale=None,
         return jnp.einsum('bhqk,bhkd->bhqd', p.astype(v.dtype), v,
                           preferred_element_type=jnp.float32
                           ).astype(q.dtype)
-    spec = P(_divisible_axis(batch_axis, q.shape[0]),
-             _divisible_axis(head_axis, q.shape[1]), seq_axis, None)
     fn = functools.partial(ring_attention, axis_name=seq_axis,
                            causal=causal, sm_scale=sm_scale)
     return shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
@@ -243,12 +248,12 @@ def ring_flash_attention(q, k, v, axis_name='sp', causal=True,
     return o.astype(q.dtype)
 
 
-def _flash_bwd_block(q, kb, vb, o, lse, g, causal, scale, zero_block):
-    """Per-block flash backward with the global lse. zero_block: traced
-    bool — inflate lse so P=0 (future blocks under causal)."""
+def _flash_bwd_block(q, kb, vb, o, lse, g, causal, scale):
+    """Per-block flash backward with the global lse (fully-masked
+    future blocks are skipped by the caller's lax.cond)."""
     from ..pallas.flash_attention import _bwd, _supported
     B, H, Tl, dh = q.shape
-    lse_eff = jnp.where(zero_block, 1e30, lse)
+    lse_eff = lse
 
     def flat(x):
         return x.reshape(B * H, Tl, -1)
@@ -303,9 +308,20 @@ def _ring_flash_fwd_loop(q, k, v, axis_name, causal, scale):
         kb = jax.lax.ppermute(kb, axis_name, perm)
         vb = jax.lax.ppermute(vb, axis_name, perm)
         src = (my + i) % n
-        o_b, lse_b = _flash_block(q, kb, vb, False, scale)
+
+        def compute(kb, vb):
+            return _flash_block(q, kb, vb, False, scale)
+
+        def masked(kb, vb):
+            # future block under causal: contributes nothing — skip the
+            # kernel entirely (lse=-inf makes the merge a no-op)
+            return (jnp.zeros_like(q),
+                    jnp.full(q.shape[:3], _NEG_INF, jnp.float32))
+
         if causal:
-            lse_b = jnp.where(src < my, lse_b, _NEG_INF)
+            o_b, lse_b = jax.lax.cond(src < my, compute, masked, kb, vb)
+        else:
+            o_b, lse_b = compute(kb, vb)
         o, lse = _merge_partials(o, lse, o_b, lse_b)
         return (o, lse, kb, vb), None
 
@@ -327,9 +343,7 @@ def _ring_flash_vjp_bwd(axis_name, causal, scale, res, cots):
     my = jax.lax.axis_index(axis_name)
     perm = [(j, (j - 1) % n) for j in range(n)]
 
-    dq, dkb, dvb = _flash_bwd_block(
-        q, k, v, o, lse, g, causal, scale,
-        zero_block=jnp.asarray(False))
+    dq, dkb, dvb = _flash_bwd_block(q, k, v, o, lse, g, causal, scale)
 
     def step(carry, i):
         dq, kb, vb, dkb, dvb = carry
@@ -338,10 +352,21 @@ def _ring_flash_vjp_bwd(axis_name, causal, scale, res, cots):
         dkb = jax.lax.ppermute(dkb, axis_name, perm)
         dvb = jax.lax.ppermute(dvb, axis_name, perm)
         src = (my + i) % n
-        zero = jnp.asarray(causal) & (src >= my) if causal \
-            else jnp.asarray(False)
-        dq_b, dk_b, dv_b = _flash_bwd_block(
-            q, kb, vb, o, lse, g, False, scale, zero_block=zero)
+
+        def compute(kb, vb):
+            return _flash_bwd_block(q, kb, vb, o, lse, g, False, scale)
+
+        def masked(kb, vb):
+            # future block under causal: all three grads are exactly
+            # zero — skip both backward kernels
+            return (jnp.zeros_like(q), jnp.zeros_like(kb),
+                    jnp.zeros_like(vb))
+
+        if causal:
+            dq_b, dk_b, dv_b = jax.lax.cond(src < my, compute, masked,
+                                            kb, vb)
+        else:
+            dq_b, dk_b, dv_b = compute(kb, vb)
         return (dq + dq_b, kb, vb, dkb + dk_b, dvb + dv_b), None
 
     (dq, _, _, dkb, dvb), _ = jax.lax.scan(
@@ -360,24 +385,17 @@ def ring_flash_attention_global(q, k, v, mesh, causal=True,
                                 batch_axis='dp', head_axis='tp'):
     """GSPMD-global entry for ring_flash_attention (mirrors
     ring_attention_global's sharding contract and fallbacks)."""
-    def _divisible_axis(name, dim):
-        if name and mesh is not None and name in mesh.axis_names \
-                and mesh.shape[name] > 1 and dim % mesh.shape[name] == 0:
-            return name
-        return None
-
     if mesh is None:
         from ..pallas.flash_attention import flash_attention as _fa
         return _fa(q, k, v, causal=causal, sm_scale=sm_scale)
-    if _divisible_axis(seq_axis, q.shape[2]) is None:
+    spec, seq_ok = _ring_spec(mesh, q, seq_axis, batch_axis, head_axis)
+    if not seq_ok:
         # mesh present but no usable sp axis: a bare pallas_call on
         # GSPMD-sharded globals would all-gather (no partitioning rule
         # for the custom call) — use the einsum fallback, which XLA
         # partitions over dp/tp like any other op
         return ring_attention_global(q, k, v, None, causal=causal,
                                      sm_scale=sm_scale)
-    spec = P(_divisible_axis(batch_axis, q.shape[0]),
-             _divisible_axis(head_axis, q.shape[1]), seq_axis, None)
     fn = functools.partial(ring_flash_attention, axis_name=seq_axis,
                            causal=causal, sm_scale=sm_scale)
     # pallas_call outputs carry no varying-mesh-axes annotation, which

@@ -342,3 +342,39 @@ def test_fake_quantize_moving_scale_state():
             'fake_quantize_0.moving_scale')))
     # EMA from 0: s1 = 0.1*2 = 0.2; s2 = 0.9*0.2 + 0.1*2 = 0.38
     assert abs(s1 - 0.2) < 1e-5 and abs(s2 - 0.38) < 1e-5
+
+
+def test_auc_layer_accumulates():
+    prog, startup = Program(), Program()
+    with program_guard(prog, startup):
+        p = fluid.layers.data(name='p', shape=[2], dtype='float32')
+        y = fluid.layers.data(name='y', shape=[1], dtype='int64')
+        a = fluid.layers.auc(p, y, num_thresholds=200)
+    exe = fluid.Executor(fluid.CPUPlace())
+    rng = np.random.RandomState(0)
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        for _ in range(3):
+            pos = rng.uniform(0.8, 1.0, (32,))
+            neg = rng.uniform(0.0, 0.2, (32,))
+            sc = np.concatenate([pos, neg])
+            probs = np.stack([1 - sc, sc], 1).astype('float32')
+            labels = np.concatenate(
+                [np.ones(32), np.zeros(32)])[:, None].astype('int64')
+            v, = exe.run(prog, feed={'p': probs, 'y': labels},
+                         fetch_list=[a])
+        assert float(np.asarray(v)) > 0.99     # separable -> AUC ~ 1
+        # the confusion state persisted across the 3 batches
+        tp = np.asarray(fluid.fetch_var('auc_0.tp'))
+        assert tp.max() == 96                  # 3 batches x 32 positives
+
+
+def test_send_recv_layer_wrappers_build():
+    prog, startup = Program(), Program()
+    with program_guard(prog, startup):
+        x = fluid.layers.data(name='x', shape=[4], dtype='float32')
+        fluid.layers.Send('127.0.0.1:7164', [x])
+        fluid.layers.Recv('127.0.0.1:7164', [x])
+    types = [op.type for op in prog.global_block().ops]
+    assert types.count('send') == 1 and types.count('recv') == 1
+    assert 'send_barrier' in types and 'fetch_barrier' in types

@@ -26,26 +26,26 @@ def test_ring_flash_parity_kernel_blocks(causal):
     # Tl = 512/4 = 128: lane-aligned -> real flash kernel per block
     # (interpret mode on CPU via the pallas_interpret flag)
     fluid.set_flags({'pallas_interpret': True})
-    rng = np.random.RandomState(0)
-    B, H, T, d = 2, 2, 512, 128
-    mesh = _mesh_sp(4)
-    q = jnp.asarray(rng.randn(B, H, T, d).astype('float32') * 0.3)
-    k = jnp.asarray(rng.randn(B, H, T, d).astype('float32') * 0.3)
-    v = jnp.asarray(rng.randn(B, H, T, d).astype('float32'))
-    got = ring_flash_attention_global(q, k, v, mesh, causal=causal)
-    want = ring_attention_global(q, k, v, None, causal=causal)
-    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
-                               rtol=3e-2, atol=3e-2)
-
-    def loss_rf(q, k, v):
-        return jnp.sum(ring_flash_attention_global(
-            q, k, v, mesh, causal=causal).astype(jnp.float32) ** 2)
-
-    def loss_n(q, k, v):
-        return jnp.sum(ring_attention_global(
-            q, k, v, None, causal=causal).astype(jnp.float32) ** 2)
-
     try:
+        rng = np.random.RandomState(0)
+        B, H, T, d = 2, 2, 512, 128
+        mesh = _mesh_sp(4)
+        q = jnp.asarray(rng.randn(B, H, T, d).astype('float32') * 0.3)
+        k = jnp.asarray(rng.randn(B, H, T, d).astype('float32') * 0.3)
+        v = jnp.asarray(rng.randn(B, H, T, d).astype('float32'))
+        got = ring_flash_attention_global(q, k, v, mesh, causal=causal)
+        want = ring_attention_global(q, k, v, None, causal=causal)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=3e-2, atol=3e-2)
+
+        def loss_rf(q, k, v):
+            return jnp.sum(ring_flash_attention_global(
+                q, k, v, mesh, causal=causal).astype(jnp.float32) ** 2)
+
+        def loss_n(q, k, v):
+            return jnp.sum(ring_attention_global(
+                q, k, v, None, causal=causal).astype(jnp.float32) ** 2)
+
         gr = jax.grad(loss_rf, argnums=(0, 1, 2))(q, k, v)
         gn = jax.grad(loss_n, argnums=(0, 1, 2))(q, k, v)
         for name, a, b in zip('qkv', gr, gn):
