@@ -253,13 +253,12 @@ def _flash_bwd_block(q, kb, vb, o, lse, g, causal, scale):
     future blocks are skipped by the caller's lax.cond)."""
     from ..pallas.flash_attention import _bwd, _supported
     B, H, Tl, dh = q.shape
-    lse_eff = lse
 
     def flat(x):
         return x.reshape(B * H, Tl, -1)
     if _supported(Tl, dh) and _kernel_enabled():
         dq, dk, dv = _bwd(flat(q), flat(kb), flat(vb), flat(o),
-                          lse_eff.reshape(B * H, Tl, 1), flat(g),
+                          lse.reshape(B * H, Tl, 1), flat(g),
                           causal, scale,
                           jax.default_backend() != 'tpu')
     else:
@@ -270,7 +269,7 @@ def _flash_bwd_block(q, kb, vb, o, lse, g, causal, scale):
         if causal:
             mask = jnp.tril(jnp.ones((Tl, Tl), bool))
             s = jnp.where(mask[None], s, _NEG_INF)
-        p = jnp.exp(s - lse_eff.reshape(B * H, Tl, 1))
+        p = jnp.exp(s - lse.reshape(B * H, Tl, 1))
         delta = jnp.sum(gf.astype(jnp.float32) * of.astype(jnp.float32),
                         axis=-1, keepdims=True)
         dp = jnp.einsum('btd,bsd->bts', gf, vf,

@@ -11,6 +11,9 @@ family running under every execution engine:
           parallel   (ParallelExecutor over all visible devices)
           dist N     (N trainer processes, collective DP — subprocess
                       localhost, the test_dist_base.py pattern)
+          pserver    (N trainers + 2 parameter servers via the
+                      DistributeTranspiler — the reference harness's
+                      pserver update method)
 
 Usage:
   python tools/bench_suite.py                     # quick sweep, tiny shapes
@@ -163,6 +166,103 @@ def run_dist(model, n, steps, full):
     return row
 
 
+def run_pserver(model, n_trainers, steps, full):
+    """N trainers + 2 pservers via the DistributeTranspiler (the
+    reference fluid_benchmark.py's --update_method pserver)."""
+    import socket
+    socks = []
+    for _ in range(2):
+        so = socket.socket()
+        so.bind(('127.0.0.1', 0))
+        socks.append(so)
+    ports = [so.getsockname()[1] for so in socks]
+    for so in socks:        # hold all before freeing any: two bind(0)
+        so.close()          # calls can otherwise return the same port
+    eps = ','.join('127.0.0.1:%d' % p for p in ports)
+    procs = []
+
+    def spawn(role, extra):
+        env = dict(os.environ)
+        env.update({'BENCH_SUITE_PS_WORKER': '1',
+                    'BENCH_SUITE_MODEL': model,
+                    'BENCH_SUITE_STEPS': str(steps),
+                    'PS_ROLE': role, 'PS_ENDPOINTS': eps,
+                    'PS_TRAINERS': str(n_trainers)})
+        env.update(extra)
+        return subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__)], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+
+    for i in range(2):
+        procs.append(spawn('pserver', {'PS_PSERVER_ID': str(i)}))
+    time.sleep(1.0)
+    trainers = [spawn('trainer', {'PS_TRAINER_ID': str(i)})
+                for i in range(n_trainers)]
+    try:
+        outs = [p.communicate(timeout=600)[0] for p in trainers]
+        # diagnose trainer failures FIRST: a dead trainer never sends
+        # COMPLETE, so the pservers would hang forever
+        for p, out in zip(trainers, outs):
+            if p.returncode != 0:
+                raise RuntimeError('pserver-mode trainer failed:\n'
+                                   + out[-2000:])
+        for p in procs:
+            out, _ = p.communicate(timeout=60)
+            if p.returncode not in (0, None):
+                raise RuntimeError('pserver failed:\n' + out[-2000:])
+    finally:
+        for p in procs + trainers:
+            if p.poll() is None:
+                p.kill()
+    row = json.loads([ln for ln in outs[0].splitlines()
+                      if ln.startswith('{')][-1])
+    row['samples_per_sec'] = round(
+        row['samples_per_sec'] * n_trainers, 2)
+    row['mode'] = 'pserver%d' % n_trainers
+    return row
+
+
+def _pserver_worker():
+    import jax
+    jax.config.update('jax_platforms', 'cpu')
+    import paddle_tpu as fluid
+    model = os.environ['BENCH_SUITE_MODEL']
+    steps = int(os.environ['BENCH_SUITE_STEPS'])
+    role = os.environ['PS_ROLE']
+    eps = os.environ['PS_ENDPOINTS']
+    trainers = int(os.environ['PS_TRAINERS'])
+    trainer_id = int(os.environ.get('PS_TRAINER_ID', 0))
+    with fluid.program_guard(fluid.default_main_program(),
+                             fluid.default_startup_program()):
+        loss, feed_fn, bs = _build(model, False)
+        # pserver path: plain SGD (the transpiler moves optimize ops
+        # server-side)
+        fluid.optimizer.SGD(1e-3).minimize(loss)
+    t = fluid.DistributeTranspiler()
+    t.transpile(trainer_id, pservers=eps, trainers=trainers,
+                sync_mode=True)
+    exe = fluid.Executor(fluid.CPUPlace())
+    if role == 'pserver':
+        ep = eps.split(',')[int(os.environ['PS_PSERVER_ID'])]
+        main_prog, startup = t.get_pserver_programs(ep)
+        exe.run(startup)
+        exe.run(main_prog)
+        return
+    exe.run(t.get_trainer_startup_program())
+    prog = t.get_trainer_program()
+    rng = np.random.RandomState(trainer_id)
+    lv = exe.run(prog, feed=feed_fn(rng, bs), fetch_list=[loss])
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        lv = exe.run(prog, feed=feed_fn(rng, bs), fetch_list=[loss])
+    dt = time.perf_counter() - t0
+    print(json.dumps({'model': model,
+                      'samples_per_sec': round(bs * steps / dt, 2),
+                      'loss': round(float(np.asarray(lv[0]).mean()), 4)}),
+          flush=True)
+    exe.close()
+
+
 def _dist_worker():
     os.environ['XLA_FLAGS'] = (os.environ.get('XLA_FLAGS', '')
                                + ' --xla_force_host_platform_device_count=2')
@@ -201,13 +301,16 @@ MODELS = ['mnist', 'resnet', 'vgg', 'stacked_lstm', 'transformer']
 
 
 def main():
+    if os.environ.get('BENCH_SUITE_PS_WORKER'):
+        _pserver_worker()
+        return
     if os.environ.get('BENCH_SUITE_WORKER'):
         _dist_worker()
         return
     ap = argparse.ArgumentParser()
     ap.add_argument('--model', choices=MODELS + ['all'], default='all')
     ap.add_argument('--mode', choices=['local', 'parallel', 'dist',
-                                       'all'], default='all')
+                                       'pserver', 'all'], default='all')
     ap.add_argument('--dist-trainers', type=int, default=2)
     ap.add_argument('--steps', type=int, default=5)
     ap.add_argument('--full', action='store_true',
@@ -219,13 +322,16 @@ def main():
         import jax
         jax.config.update('jax_platforms', 'cpu')
     models = MODELS if args.model == 'all' else [args.model]
-    modes = (['local', 'parallel', 'dist'] if args.mode == 'all'
-             else [args.mode])
+    modes = (['local', 'parallel', 'dist', 'pserver']
+             if args.mode == 'all' else [args.mode])
     rows = []
     for model in models:
         for mode in modes:
             try:
-                if mode == 'dist':
+                if mode == 'pserver':
+                    row = run_pserver(model, args.dist_trainers,
+                                      args.steps, args.full)
+                elif mode == 'dist':
                     row = run_dist(model, args.dist_trainers, args.steps,
                                    args.full)
                 else:
