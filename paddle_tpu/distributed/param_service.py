@@ -36,12 +36,16 @@ __all__ = ['ParameterService']
 
 class ParameterService(object):
     def __init__(self, num_trainers, sync_mode, get_param, run_round,
-                 run_one_grad=None, prefetch=None):
+                 run_one_grad=None, prefetch=None, save_params=None):
         """get_param(name) -> value; run_round(merged: {grad: value});
-        run_one_grad(grad_name, value) for async; prefetch(table, ids)."""
+        run_one_grad(grad_name, value) for async; prefetch(table, ids);
+        save_params(dirname) checkpoints this server's shard (the
+        reference's RequestCheckpointHandler running the save block —
+        listen_and_serv_op.cc:251 checkpoint_point_block_id)."""
         self.num_trainers = num_trainers
         self.sync_mode = sync_mode
         self._get_param = get_param
+        self._save_params = save_params
         self._run_round = run_round
         self._run_one_grad = run_one_grad
         self._prefetch = prefetch
@@ -132,6 +136,14 @@ class ParameterService(object):
             if self.sync_mode:
                 self._wait_for_trainer_round_locked(tid)
             return self._prefetch(name, np.asarray(ids))
+
+    def on_checkpoint(self, dirname, tid):
+        if self._save_params is None:
+            raise RuntimeError('this pserver has no checkpoint support')
+        with self._lock:
+            if self.sync_mode:
+                self._wait_for_trainer_round_locked(tid)
+            self._save_params(dirname)
 
     def on_fetch_barrier(self, tid):
         pass    # round already closed by the sync wait in on_get_var

@@ -76,6 +76,11 @@ class PSClient(object):
     def fetch_barrier(self):
         self._call(wire.FETCH_BARRIER)
 
+    def checkpoint_notify(self, dirname):
+        """Ask the pserver to save its parameter shard (reference
+        checkpoint_notify_op.cc -> RequestCheckpointHandler)."""
+        self._call(wire.CHECKPOINT, {'dirname': dirname})
+
     def complete(self):
         self._call(wire.COMPLETE)
 
@@ -123,6 +128,7 @@ class PSServer(object):
       on_prefetch(name, trainer_id, ids) -> rows
       on_batch_barrier(trainer_id)
       on_fetch_barrier(trainer_id)
+      on_checkpoint(dirname, trainer_id)
       on_complete(trainer_id)  -> True when ALL trainers completed
     """
 
@@ -191,6 +197,9 @@ class PSServer(object):
                         wire.write_msg(conn, wire.REPLY_OK)
                     elif msg_type == wire.FETCH_BARRIER:
                         svc.on_fetch_barrier(tid)
+                        wire.write_msg(conn, wire.REPLY_OK)
+                    elif msg_type == wire.CHECKPOINT:
+                        svc.on_checkpoint(meta.get('dirname'), tid)
                         wire.write_msg(conn, wire.REPLY_OK)
                     elif msg_type == wire.COMPLETE:
                         all_done = svc.on_complete(tid)

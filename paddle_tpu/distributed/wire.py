@@ -28,6 +28,7 @@ PREFETCH = 3        # trainer -> pserver: distributed-lookup-table row fetch
 BATCH_BARRIER = 4   # trainer -> pserver: all grads for this step sent
 FETCH_BARRIER = 5   # trainer -> pserver: all params for this step fetched
 COMPLETE = 6        # trainer -> pserver: this trainer is done training
+CHECKPOINT = 10     # trainer -> pserver: save your param shard to dir
 REPLY_VAR = 7       # pserver -> trainer: a variable value
 REPLY_OK = 8        # pserver -> trainer: ack
 REPLY_ERR = 9       # pserver -> trainer: error (meta['error'])

@@ -56,6 +56,19 @@ def _recv_emit(ctx, op):
 register_op('recv', emit=_recv_emit, host=True, no_grad=True)
 
 
+def _checkpoint_notify_emit(ctx, op):
+    """Tell every pserver to checkpoint its shard (reference
+    checkpoint_notify_op.cc:28); each saves into dirname/<endpoint>."""
+    dirname = op.attr('dirname')
+    for ep in op.attr('endpoints'):
+        _client(op, ep).checkpoint_notify(
+            '%s/%s' % (dirname, ep.replace(':', '_')))
+
+
+register_op('checkpoint_notify', emit=_checkpoint_notify_emit, host=True,
+            no_grad=True)
+
+
 def _send_barrier_emit(ctx, op):
     for ep in op.attr('endpoints'):
         _client(op, ep).batch_barrier()
@@ -249,11 +262,28 @@ def _listen_and_serv_emit(ctx, op):
         shard = np.asarray(scope.find_var(op.attr('prefetch_table')))
         return shard[np.asarray(local_ids, dtype=np.int64)]
 
+    def save_params(dirname):
+        # checkpoint this shard: every persistable non-grad var in the
+        # pserver program (reference runs the kCheckpointBlockId save
+        # block; here the save set is derived from the program)
+        import os
+        from .io_ops import write_tensor
+        os.makedirs(dirname, exist_ok=True)
+        for name, var in program.global_block().vars.items():
+            if not var.persistable or name in grad_to_block:
+                continue
+            val = scope.find_var(name)
+            if val is None:
+                continue
+            with open(os.path.join(dirname, name), 'wb') as f:
+                write_tensor(f, np.asarray(val))
+
     service = ParameterService(
         num_trainers=num_trainers, sync_mode=sync_mode,
         get_param=get_param, run_round=run_round,
         run_one_grad=run_one_grad,
-        prefetch=prefetch if op.attr('prefetch_table', '') else None)
+        prefetch=prefetch if op.attr('prefetch_table', '') else None,
+        save_params=save_params)
     server = PSServer(op.attr('endpoint'), service)
     server.serve_forever()
 

@@ -37,7 +37,7 @@ def _free_ports(n):
 
 
 def _run_cluster(model, steps=4, optimizer='sgd', trainers=2, pservers=2,
-                 sync=True):
+                 sync=True, extra_env=None):
     eps = ','.join('127.0.0.1:%d' % p for p in _free_ports(pservers))
     base_env = dict(os.environ)
     base_env.pop('JAX_PLATFORMS', None)
@@ -46,6 +46,7 @@ def _run_cluster(model, steps=4, optimizer='sgd', trainers=2, pservers=2,
                      'PS_TRAINERS': str(trainers), 'PS_STEPS': str(steps),
                      'PS_SYNC': '1' if sync else '0',
                      'PS_OPTIMIZER': optimizer})
+    base_env.update(extra_env or {})
     procs = []
     for i in range(pservers):
         env = dict(base_env, PS_ROLE='pserver', PS_PSERVER_ID=str(i))
@@ -143,3 +144,37 @@ def test_async_mode_trains():
     results = _run_cluster('mlp', steps=8, sync=False)
     losses = results[0]['losses']
     assert losses[-1] < losses[0]
+
+
+def test_checkpoint_notify_saves_pserver_shards(tmp_path):
+    """checkpoint_notify (reference checkpoint_notify_op.cc): after a
+    few sync rounds, a trainer's notify makes each pserver write its
+    parameter shard, and the saved tensors equal the final trained
+    parameters the trainers pulled."""
+    import paddle_tpu.ops.io_ops as io_ops
+
+    ckpt = str(tmp_path / 'ps_ckpt')
+    results = _run_cluster('mlp', trainers=2, pservers=2, steps=3,
+                           sync=True, extra_env={'PS_CHECKPOINT': ckpt})
+    shard_dirs = sorted(os.listdir(ckpt))
+    assert len(shard_dirs) == 2
+    saved = {}
+    for d in shard_dirs:
+        for fn in os.listdir(os.path.join(ckpt, d)):
+            with open(os.path.join(ckpt, d, fn), 'rb') as f:
+                saved[fn] = io_ops.read_tensor(f)
+    # the split fc weight blocks and biases all appear across shards
+    assert any(n.startswith('w1') for n in saved)
+    assert any(n.startswith('b1') for n in saved)
+    # reassemble each split param (blocks named <p>.block<i>) and
+    # compare against the trainer's final pulled weights
+    final = {k: np.asarray(v) for k, v in results[0]['weights'].items()}
+    for pname, want in final.items():
+        blocks = sorted((n for n in saved if
+                         n == pname or n.startswith(pname + '.block')),
+                        key=lambda n: int(n.rsplit('block', 1)[-1])
+                        if 'block' in n else 0)
+        assert blocks, 'param %s missing from shards' % pname
+        got = np.concatenate([saved[b].reshape(-1) for b in blocks])
+        np.testing.assert_allclose(got, want.reshape(-1), rtol=1e-5,
+                                   atol=1e-6)
