@@ -68,11 +68,18 @@ class CheckpointConfig(object):
     checkpointing; step_interval counts steps within an epoch."""
 
     def __init__(self, checkpoint_dir=None, max_num_checkpoints=3,
-                 epoch_interval=1, step_interval=10):
+                 epoch_interval=1, step_interval=10,
+                 pserver_endpoints=None, trainer_id=0):
         self.checkpoint_dir = checkpoint_dir
         self.max_num_checkpoints = max(1, int(max_num_checkpoints))
         self.epoch_interval = max(1, int(epoch_interval))
         self.step_interval = max(1, int(step_interval))
+        # pserver mode: endpoints to checkpoint_notify at each save
+        # (reference trainer.py wires checkpoint_notify into the save
+        # flow; DistributeTranspiler.checkpoint_notify_program builds
+        # the same op for manual loops)
+        self.pserver_endpoints = list(pserver_endpoints or [])
+        self.trainer_id = int(trainer_id)
 
 
 def _checkpoint_ids(ckpt_dir):
@@ -152,6 +159,19 @@ class Trainer(object):
                 'rng_seed_used': getattr(active, '_seed_used', None)}
         with open(os.path.join(path, _METADATA_FILE), 'w') as f:
             json.dump(meta, f)
+        if cfg.pserver_endpoints and cfg.trainer_id == 0:
+            # pserver mode: have each parameter server save its shard
+            # (params + server-side optimizer state) under this
+            # checkpoint before the SUCCESS marker commits it
+            from .framework import Program
+            notify = Program()
+            notify.global_block().append_op(
+                type='checkpoint_notify', inputs={}, outputs={},
+                attrs={'dirname': os.path.join(path, 'pserver_shards'),
+                       'endpoints': list(cfg.pserver_endpoints),
+                       'trainer_id': cfg.trainer_id})
+            with scope_guard(self.scope):
+                self.exe.run(notify)
         # SUCCESS marker last: a partial checkpoint must never be resumed
         with open(os.path.join(path, _SUCCESS_FILE), 'w') as f:
             f.write('')

@@ -79,6 +79,21 @@ class DistributeTranspiler(object):
         self.config = config or DistributeTranspilerConfig()
 
     # ------------------------------------------------------------------
+    def checkpoint_notify_program(self, dirname):
+        """A one-op program asking every pserver of this transpile to
+        save its shard under dirname/<endpoint> (reference injects
+        checkpoint_notify into the trainer checkpoint flow;
+        Trainer/CheckpointConfig(pserver_endpoints=...) does the same
+        automatically)."""
+        from ..framework import Program
+        prog = Program()
+        prog.global_block().append_op(
+            type='checkpoint_notify', inputs={}, outputs={},
+            attrs={'dirname': dirname,
+                   'endpoints': list(self.pserver_endpoints),
+                   'trainer_id': self.trainer_id})
+        return prog
+
     def transpile(self, trainer_id, program=None, pservers='', trainers=1,
                   sync_mode=True, startup_program=None):
         self.origin_program = program or default_main_program()

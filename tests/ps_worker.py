@@ -190,14 +190,8 @@ def main():
         losses.append(float(l))
     ckpt = os.environ.get('PS_CHECKPOINT')
     if ckpt and trainer_id == 0:
-        # exercise checkpoint_notify: pservers save their shards
-        notify_prog = fluid.Program()
-        with fluid.program_guard(notify_prog):
-            notify_prog.global_block().append_op(
-                type='checkpoint_notify', inputs={}, outputs={},
-                attrs={'dirname': ckpt, 'endpoints': eps.split(','),
-                       'trainer_id': trainer_id})
-        exe.run(notify_prog)
+        # production path: the transpiler's checkpoint-notify program
+        exe.run(t.checkpoint_notify_program(ckpt))
     weights = {p: fluid.fetch_var(p).tolist() for p in params
                if fluid.global_scope().find_var(p) is not None}
     print('RESULT ' + json.dumps({'losses': losses, 'weights': weights}))

