@@ -33,10 +33,13 @@ from . import clip
 from .param_attr import ParamAttr, WeightNormParamAttr
 from . import executor
 from .executor import (Executor, Scope, global_scope, scope_guard,
-                       CPUPlace, TPUPlace, XLAPlace, CUDAPlace, fetch_var)
+                       CPUPlace, TPUPlace, XLAPlace, CUDAPlace,
+                       CUDAPinnedPlace, fetch_var)
 from . import lod_tensor
 from .lod_tensor import LoDTensor, create_lod_tensor, \
     create_random_int_lodtensor
+Tensor = LoDTensor      # reference alias: fluid.Tensor is LoDTensor
+                        # (pybind.cc binds Tensor as the LoD-less view)
 from . import parallel
 from . import reader
 from .batch import batch  # noqa: F401

@@ -113,6 +113,7 @@ class TPUPlace(Place):
 # reference-compatible aliases: scripts say fluid.CUDAPlace(0) / XLAPlace(0)
 XLAPlace = TPUPlace
 CUDAPlace = TPUPlace
+CUDAPinnedPlace = CPUPlace    # pinned host staging is PJRT's job here
 
 
 # ---------------------------------------------------------------------------

@@ -278,6 +278,16 @@ def _listen_and_serv_emit(ctx, op):
             with open(os.path.join(dirname, name), 'wb') as f:
                 write_tensor(f, np.asarray(val))
 
+    ckpt_dir = op.attr('checkpoint_dir', '')
+    if ckpt_dir:
+        # restore this shard from a checkpoint_notify save (the reload
+        # half of pserver checkpointing) before serving
+        import os
+        from .io_ops import read_tensor
+        for fn in sorted(os.listdir(ckpt_dir)):
+            with open(os.path.join(ckpt_dir, fn), 'rb') as f:
+                scope.set_var(fn, read_tensor(f))
+
     service = ParameterService(
         num_trainers=num_trainers, sync_mode=sync_mode,
         get_param=get_param, run_round=run_round,

@@ -162,14 +162,14 @@ class Trainer(object):
         if cfg.pserver_endpoints and cfg.trainer_id == 0:
             # pserver mode: have each parameter server save its shard
             # (params + server-side optimizer state) under this
-            # checkpoint before the SUCCESS marker commits it
-            from .framework import Program
-            notify = Program()
-            notify.global_block().append_op(
-                type='checkpoint_notify', inputs={}, outputs={},
-                attrs={'dirname': os.path.join(path, 'pserver_shards'),
-                       'endpoints': list(cfg.pserver_endpoints),
-                       'trainer_id': cfg.trainer_id})
+            # checkpoint before the SUCCESS marker commits it; restore
+            # happens pserver-side via
+            # get_pserver_programs(checkpoint_dir=...)
+            from .transpiler.distribute_transpiler import \
+                build_checkpoint_notify_program
+            notify = build_checkpoint_notify_program(
+                os.path.join(path, 'pserver_shards'),
+                cfg.pserver_endpoints, cfg.trainer_id)
             with scope_guard(self.scope):
                 self.exe.run(notify)
         # SUCCESS marker last: a partial checkpoint must never be resumed

@@ -85,14 +85,8 @@ class DistributeTranspiler(object):
         checkpoint_notify into the trainer checkpoint flow;
         Trainer/CheckpointConfig(pserver_endpoints=...) does the same
         automatically)."""
-        from ..framework import Program
-        prog = Program()
-        prog.global_block().append_op(
-            type='checkpoint_notify', inputs={}, outputs={},
-            attrs={'dirname': dirname,
-                   'endpoints': list(self.pserver_endpoints),
-                   'trainer_id': self.trainer_id})
-        return prog
+        return build_checkpoint_notify_program(
+            dirname, self.pserver_endpoints, self.trainer_id)
 
     def transpile(self, trainer_id, program=None, pservers='', trainers=1,
                   sync_mode=True, startup_program=None):
@@ -516,8 +510,33 @@ class DistributeTranspiler(object):
                    'op_role': 'rpc'})
         return prog
 
-    def get_pserver_programs(self, endpoint):
+    def get_pserver_programs(self, endpoint, checkpoint_dir=None):
+        """checkpoint_dir: a directory previously written by
+        checkpoint_notify (one shard subdir per pserver): the pserver
+        restores its shard from it before serving — the restore half of
+        pserver checkpointing (reference pservers reload via their
+        startup load block). Shards resolve by this endpoint's saved
+        subdir, falling back to POSITION (sorted subdir i for pserver
+        i) so a restarted cluster on fresh ports can still restore."""
         main = self.get_pserver_program(endpoint)
+        if checkpoint_dir:
+            import os
+            shard = os.path.join(checkpoint_dir,
+                                 endpoint.replace(':', '_'))
+            if not os.path.isdir(shard):
+                subdirs = sorted(
+                    d for d in os.listdir(checkpoint_dir)
+                    if os.path.isdir(os.path.join(checkpoint_dir, d)))
+                if len(subdirs) != len(self.pserver_endpoints):
+                    raise ValueError(
+                        'checkpoint %r holds %d shard dirs for %d '
+                        'pservers' % (checkpoint_dir, len(subdirs),
+                                      len(self.pserver_endpoints)))
+                idx = self.pserver_endpoints.index(endpoint)
+                shard = os.path.join(checkpoint_dir, subdirs[idx])
+            lsv = main.global_block().ops[-1]
+            assert lsv.type == 'listen_and_serv'
+            lsv.attrs['checkpoint_dir'] = shard
         return main, self.get_startup_program(endpoint, main)
 
     # ------------------------------------------------------------------
@@ -676,3 +695,15 @@ class _TableParamProxy(object):
     def __init__(self, shape):
         self.shape = tuple(shape)
         self.name = '__table__'
+
+
+def build_checkpoint_notify_program(dirname, endpoints, trainer_id=0):
+    """One-op program emitting checkpoint_notify to `endpoints` — shared
+    by DistributeTranspiler.checkpoint_notify_program and the Trainer
+    save flow."""
+    prog = Program()
+    prog.global_block().append_op(
+        type='checkpoint_notify', inputs={}, outputs={},
+        attrs={'dirname': dirname, 'endpoints': list(endpoints),
+               'trainer_id': int(trainer_id)})
+    return prog

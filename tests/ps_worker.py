@@ -173,7 +173,8 @@ def main():
 
     if role == 'pserver':
         ep = eps.split(',')[int(os.environ['PS_PSERVER_ID'])]
-        main_prog, startup = t.get_pserver_programs(ep)
+        main_prog, startup = t.get_pserver_programs(
+            ep, checkpoint_dir=os.environ.get('PS_RESTORE') or None)
         exe.run(startup)
         exe.run(main_prog)       # blocks until all trainers COMPLETE
         return

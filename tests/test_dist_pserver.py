@@ -178,3 +178,16 @@ def test_checkpoint_notify_saves_pserver_shards(tmp_path):
         got = np.concatenate([saved[b].reshape(-1) for b in blocks])
         np.testing.assert_allclose(got, want.reshape(-1), rtol=1e-5,
                                    atol=1e-6)
+
+    # ---- restore half: a FRESH cluster (new ports) restores the
+    # shards; trainers run 0 steps so the startup-pull exposes the
+    # served values exactly -------------------------------------------
+    results2 = _run_cluster('mlp', trainers=2, pservers=2, steps=0,
+                            sync=True,
+                            extra_env={'PS_RESTORE': ckpt})
+    final2 = {k: np.asarray(v)
+              for k, v in results2[0]['weights'].items()}
+    for pname, want in final.items():
+        np.testing.assert_allclose(
+            final2[pname].reshape(-1), want.reshape(-1), rtol=1e-5,
+            atol=1e-6, err_msg='restored param %s diverged' % pname)

@@ -1,7 +1,8 @@
 """Numeric-gradient checks (OpTest central differences) for round-3
 inventory ops whose first tests were forward-only: spp, pool3d,
 unpool, conv_shift, bilinear_interp, depthwise_conv2d_transpose,
-norm, flash_attention (vjp path), beam_gather."""
+flash_attention (vjp path), beam_gather. (norm's grad check lives in
+test_inventory_ops.TestL1NormAndNorm.)"""
 import numpy as np
 
 import paddle_tpu as fluid
