@@ -22,7 +22,7 @@ from .initializer import Constant
 from . import unique_name
 
 __all__ = ['Accuracy', 'ChunkEvaluator', 'EditDistance', 'DetectionMAP',
-           'Evaluator']
+           'PrecisionRecall', 'Evaluator']
 
 
 class Evaluator(object):
@@ -141,6 +141,48 @@ class ChunkEvaluator(Evaluator):
               if num_correct else 0.0)
         return (np.float32(precision), np.float32(recall),
                 np.float32(f1))
+
+
+class PrecisionRecall(Evaluator):
+    """Accumulated multi-class precision/recall/F1 through the
+    precision_recall op (reference operators/precision_recall_op.cc):
+    state = the [class_number, 4] TP/FP/TN/FN table, which the op reads
+    and rewrites in place each step."""
+
+    def __init__(self, input, label, class_number, weights=None,
+                 **kwargs):
+        super(PrecisionRecall, self).__init__('precision_recall',
+                                              **kwargs)
+        self.states_info = self._create_state(
+            'states_info', 'float32', (class_number, 4))
+        batch_metrics, accum_metrics, _ = layers.precision_recall(
+            input, label, class_number, weights=weights,
+            states_info=self.states_info)
+        self.accum_metrics = accum_metrics
+        self.metrics.extend((batch_metrics, accum_metrics))
+
+    def eval(self, executor, eval_program=None):
+        """(macro_p, macro_r, macro_f1, micro_p, micro_r, micro_f1)
+        from the accumulated states."""
+        states = self._read_state(self.states_info)
+        tp, fp, fn = states[:, 0], states[:, 1], states[:, 3]
+
+        def _p(t, f):
+            return float(t / (t + f)) if (t + f) > 0 else 1.0
+
+        prec = [_p(t, f) for t, f in zip(tp, fp)]
+        rec = [_p(t, f) for t, f in zip(tp, fn)]
+        macro_p = sum(prec) / len(prec)
+        macro_r = sum(rec) / len(rec)
+        micro_p = _p(tp.sum(), fp.sum())
+        micro_r = _p(tp.sum(), fn.sum())
+
+        def _f1(p, r):
+            return 2 * p * r / (p + r) if (p + r) > 0 else 0.0
+
+        return np.asarray([macro_p, macro_r, _f1(macro_p, macro_r),
+                           micro_p, micro_r, _f1(micro_p, micro_r)],
+                          np.float32)
 
 
 class EditDistance(Evaluator):

@@ -18,7 +18,7 @@ __all__ = [
     'random_crop', 'mean_iou', 'crop', 'rank_loss', 'unstack',
     'bilinear_tensor_product', 'modified_huber_loss', 'l1_norm', 'sign',
     'fake_quantize', 'polygon_box_transform', 'flash_attention',
-    'auc',
+    'auc', 'precision_recall', 'positive_negative_pair',
 ]
 
 
@@ -230,6 +230,61 @@ def chunk_eval(input, label, chunk_scheme, num_chunk_types,
                             'excluded_chunk_types':
                                 list(excluded_chunk_types or [])})
     return precision, recall, f1, num_infer, num_label, num_correct
+
+
+def precision_recall(input, label, class_number, weights=None,
+                     states_info=None):
+    """Multi-class streaming precision/recall (reference
+    operators/precision_recall_op.cc). `input` is the predicted class
+    index column [N, 1] int; pass `states_info` (a persistable
+    [class_number, 4] var) to accumulate across batches — the op
+    writes the new accumulated states to the same var. Returns
+    (batch_metrics[6], accum_metrics[6], accum_states)."""
+    helper = LayerHelper('precision_recall')
+    batch_metrics = helper.create_variable_for_type_inference('float32')
+    accum_metrics = helper.create_variable_for_type_inference('float32')
+    inputs = {'Indices': [input], 'Labels': [label]}
+    if weights is not None:
+        inputs['Weights'] = [weights]
+    if states_info is not None:
+        inputs['StatesInfo'] = [states_info]
+        accum_states = states_info
+    else:
+        accum_states = helper.create_variable_for_type_inference(
+            'float32')
+    helper.append_op(type='precision_recall', inputs=inputs,
+                     outputs={'BatchMetrics': [batch_metrics],
+                              'AccumMetrics': [accum_metrics],
+                              'AccumStatesInfo': [accum_states]},
+                     attrs={'class_number': int(class_number)})
+    return batch_metrics, accum_metrics, accum_states
+
+
+def positive_negative_pair(score, label, query_id, weight=None,
+                           accum=None, column=0):
+    """Ranking concordant/discordant pair counts (reference
+    operators/positive_negative_pair_op.cc). `accum`, if given, is a
+    (pos, neg, neu) tuple of persistable [1] vars that the op reads and
+    rewrites to stream across batches. Returns (pos, neg, neu)."""
+    helper = LayerHelper('positive_negative_pair')
+    inputs = {'Score': [score], 'Label': [label], 'QueryID': [query_id]}
+    if weight is not None:
+        inputs['Weight'] = [weight]
+    if accum is not None:
+        pos, neg, neu = accum
+        inputs['AccumulatePositivePair'] = [pos]
+        inputs['AccumulateNegativePair'] = [neg]
+        inputs['AccumulateNeutralPair'] = [neu]
+    else:
+        pos = helper.create_variable_for_type_inference('float32')
+        neg = helper.create_variable_for_type_inference('float32')
+        neu = helper.create_variable_for_type_inference('float32')
+    helper.append_op(type='positive_negative_pair', inputs=inputs,
+                     outputs={'PositivePair': [pos],
+                              'NegativePair': [neg],
+                              'NeutralPair': [neu]},
+                     attrs={'column': int(column)})
+    return pos, neg, neu
 
 
 def multiplex(inputs, index):

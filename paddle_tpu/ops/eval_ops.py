@@ -202,3 +202,179 @@ def _auc_infer(op, block):
 
 
 register_op('auc', emit=_auc_emit, infer_shape=_auc_infer, no_grad=True)
+
+
+def _precision_recall_emit(ctx, op):
+    """Multi-class streaming precision/recall (reference
+    operators/precision_recall_op.h:29-157): per-class TP/FP/TN/FN
+    accumulated across batches through the StatesInfo persistable var,
+    with macro + micro P/R/F1 over both the batch and the accumulated
+    states. Device op: the per-class counts are one-hot reductions."""
+    import jax.numpy as jnp
+    ids = ctx.get(op.single_input('Indices')).reshape(-1)
+    labels = ctx.get(op.single_input('Labels')).reshape(-1)
+    cls_num = int(op.attr('class_number'))
+    if op.input('Weights'):
+        w = ctx.get(op.single_input('Weights')).reshape(-1) \
+            .astype(jnp.float32)
+    else:
+        w = jnp.ones(ids.shape, jnp.float32)
+
+    # the reference PADDLE_ENFORCEs ids/labels in [0, cls_num)
+    # (precision_recall_op.h:60-64); a device op cannot raise on data,
+    # so out-of-range ids poison every metric with NaN instead of
+    # silently vanishing from the one-hot reductions
+    in_range = (jnp.all((ids >= 0) & (ids < cls_num)) &
+                jnp.all((labels >= 0) & (labels < cls_num)))
+    poison = jnp.where(in_range, 0.0, jnp.nan).astype(jnp.float32)
+
+    idx_oh = (ids[:, None] ==
+              jnp.arange(cls_num)[None, :]).astype(jnp.float32)
+    lab_oh = (labels[:, None] ==
+              jnp.arange(cls_num)[None, :]).astype(jnp.float32)
+    correct = (ids == labels).astype(jnp.float32)
+    wrong = 1.0 - correct
+    # reference accounting (precision_recall_op.h:57-83): TN goes to
+    # every class except the predicted one, and except the label when
+    # the prediction is wrong.
+    tp = jnp.sum((w * correct)[:, None] * idx_oh, axis=0)
+    fp = jnp.sum((w * wrong)[:, None] * idx_oh, axis=0)
+    fn = jnp.sum((w * wrong)[:, None] * lab_oh, axis=0)
+    tn = jnp.sum(w[:, None] * (1.0 - idx_oh - wrong[:, None] * lab_oh),
+                 axis=0)
+    batch_states = jnp.stack([tp, fp, tn, fn], axis=1)   # [cls, 4]
+
+    def metrics_of(states):
+        tp_, fp_, fn_ = states[:, 0], states[:, 1], states[:, 3]
+        # precision/recall default to 1.0 when the denominator is empty
+        # (CalcPrecision/CalcRecall, precision_recall_op.h:102-114)
+        prec = jnp.where(tp_ + fp_ > 0, tp_ / jnp.maximum(tp_ + fp_,
+                                                          1e-30), 1.0)
+        rec = jnp.where(tp_ + fn_ > 0, tp_ / jnp.maximum(tp_ + fn_,
+                                                         1e-30), 1.0)
+        macro_p, macro_r = jnp.mean(prec), jnp.mean(rec)
+        t_tp, t_fp, t_fn = jnp.sum(tp_), jnp.sum(fp_), jnp.sum(fn_)
+        micro_p = jnp.where(t_tp + t_fp > 0,
+                            t_tp / jnp.maximum(t_tp + t_fp, 1e-30), 1.0)
+        micro_r = jnp.where(t_tp + t_fn > 0,
+                            t_tp / jnp.maximum(t_tp + t_fn, 1e-30), 1.0)
+
+        def f1(p, r):
+            return jnp.where(p + r > 0,
+                             2 * p * r / jnp.maximum(p + r, 1e-30), 0.0)
+
+        return jnp.stack([macro_p, macro_r, f1(macro_p, macro_r),
+                          micro_p, micro_r, f1(micro_p, micro_r)])
+
+    ctx.set(op.single_output('BatchMetrics'),
+            metrics_of(batch_states).astype(jnp.float32) + poison)
+    accum = batch_states + poison
+    if op.input('StatesInfo'):
+        accum = accum + ctx.get(op.single_input('StatesInfo')) \
+            .astype(jnp.float32)
+    # poison the metric vector directly too: NaN states alone would
+    # vanish through the where(denom > 0, ..., 1.0) branches
+    ctx.set(op.single_output('AccumMetrics'),
+            metrics_of(accum).astype(jnp.float32) + poison)
+    ctx.set(op.single_output('AccumStatesInfo'), accum)
+
+
+def _precision_recall_infer(op, block):
+    cls_num = int(op.attr('class_number'))
+    for slot, shape in (('BatchMetrics', (6,)), ('AccumMetrics', (6,)),
+                        ('AccumStatesInfo', (cls_num, 4))):
+        v = block.var_recursive(op.single_output(slot))
+        v.shape = shape
+        v.dtype = 'float32'
+
+
+register_op('precision_recall', emit=_precision_recall_emit,
+            infer_shape=_precision_recall_infer, no_grad=True)
+
+
+def _positive_negative_pair_emit(ctx, op):
+    """Ranking pair statistics (reference
+    operators/positive_negative_pair_op.h:36-110): for every same-query
+    pair with different labels, count concordant (positive), discordant
+    (negative) and score-tied (neutral) pairs, weight = mean of the two
+    instance weights. Device redesign: the reference's per-query hash
+    map + nested loop becomes one [B, B] masked pairwise reduction —
+    O(B^2) elementwise on the VPU instead of host-sequential."""
+    import jax.numpy as jnp
+    score = ctx.get(op.single_input('Score'))
+    label = ctx.get(op.single_input('Label')).reshape(-1) \
+        .astype(jnp.float32)
+    query = ctx.get(op.single_input('QueryID')).reshape(-1)
+    column = int(op.attr('column', 0))
+    s = (score[:, column] if score.ndim == 2
+         else score.reshape(-1)).astype(jnp.float32)
+    B = s.shape[0]
+    if op.input('Weight'):
+        w = ctx.get(op.single_input('Weight')).reshape(-1) \
+            .astype(jnp.float32)
+    else:
+        w = jnp.ones((B,), jnp.float32)
+
+    # row-blocked pairwise sweep: [blk, B] masks per scan step instead
+    # of the full [B, B] — O(blk*B) memory for the O(B^2) pair count,
+    # so ranking-eval batches that OOM a dense formulation stream fine
+    from jax import lax
+    blk = min(B, 256)
+    pad = (-B) % blk
+    if pad:
+        s = jnp.pad(s, (0, pad))
+        label = jnp.pad(label, (0, pad))
+        w = jnp.pad(w, (0, pad))
+        # pad rows get a query id no real row carries, so they pair
+        # with nothing (query ids are non-negative int64 in practice)
+        query = jnp.pad(query, (0, pad), constant_values=-1)
+    total = B + pad
+    gidx = jnp.arange(total)
+
+    def block_counts(carry, start):
+        pos_c, neg_c, neu_c = carry
+        si = lax.dynamic_slice(s, (start,), (blk,))
+        li = lax.dynamic_slice(label, (start,), (blk,))
+        qi = lax.dynamic_slice(query, (start,), (blk,))
+        wi = lax.dynamic_slice(w, (start,), (blk,))
+        ii = start + jnp.arange(blk)
+        valid = ((qi[:, None] == query[None, :]) &
+                 (li[:, None] != label[None, :]) &
+                 (ii[:, None] < gidx[None, :]))
+        prod = (si[:, None] - s[None, :]) * (li[:, None] - label[None, :])
+        vw = 0.5 * (wi[:, None] + w[None, :]) * valid.astype(jnp.float32)
+        pos_c = pos_c + jnp.sum(vw * (prod > 0))
+        # score ties land in BOTH neutral and negative — the
+        # reference's ternary still runs after the tie branch
+        # (positive_negative_pair_op.h:95-100)
+        neg_c = neg_c + jnp.sum(vw * (prod <= 0))
+        neu_c = neu_c + jnp.sum(vw * (si[:, None] == s[None, :]))
+        return (pos_c, neg_c, neu_c), None
+
+    zero = jnp.float32(0)
+    (pos, neg, neu), _ = lax.scan(block_counts, (zero, zero, zero),
+                                  jnp.arange(0, total, blk))
+    if op.input('AccumulatePositivePair'):
+        pos = pos + ctx.get(
+            op.single_input('AccumulatePositivePair')).reshape(())
+        neg = neg + ctx.get(
+            op.single_input('AccumulateNegativePair')).reshape(())
+        neu = neu + ctx.get(
+            op.single_input('AccumulateNeutralPair')).reshape(())
+    ctx.set(op.single_output('PositivePair'),
+            pos.reshape((1,)).astype(jnp.float32))
+    ctx.set(op.single_output('NegativePair'),
+            neg.reshape((1,)).astype(jnp.float32))
+    ctx.set(op.single_output('NeutralPair'),
+            neu.reshape((1,)).astype(jnp.float32))
+
+
+def _positive_negative_pair_infer(op, block):
+    for slot in ('PositivePair', 'NegativePair', 'NeutralPair'):
+        v = block.var_recursive(op.single_output(slot))
+        v.shape = (1,)
+        v.dtype = 'float32'
+
+
+register_op('positive_negative_pair', emit=_positive_negative_pair_emit,
+            infer_shape=_positive_negative_pair_infer, no_grad=True)

@@ -405,3 +405,201 @@ def test_weight_norm_param_attr():
     assert float(np.asarray(l)) < 0.2 * l0
     assert not np.allclose(v1, v0)      # both halves trained
     assert not np.allclose(g1, g0)
+
+
+def _np_precision_recall_states(ids, labels, weights, cls_num):
+    """Independent oracle for the reference's per-class TP/FP/TN/FN
+    accounting (precision_recall_op.h:57-83)."""
+    states = np.zeros((cls_num, 4), np.float64)   # TP FP TN FN
+    for i in range(len(ids)):
+        idx, lab, w = int(ids[i]), int(labels[i]), float(weights[i])
+        if idx == lab:
+            states[idx, 0] += w
+            states[:, 2] += w
+            states[idx, 2] -= w
+        else:
+            states[lab, 3] += w
+            states[idx, 1] += w
+            states[:, 2] += w
+            states[idx, 2] -= w
+            states[lab, 2] -= w
+    return states
+
+
+def _np_metrics(states):
+    def p(t, f):
+        return t / (t + f) if (t + f) > 0 else 1.0
+
+    def f1(a, b):
+        return 2 * a * b / (a + b) if (a + b) > 0 else 0.0
+
+    prec = [p(s[0], s[1]) for s in states]
+    rec = [p(s[0], s[3]) for s in states]
+    mp, mr = np.mean(prec), np.mean(rec)
+    up = p(states[:, 0].sum(), states[:, 1].sum())
+    ur = p(states[:, 0].sum(), states[:, 3].sum())
+    return np.array([mp, mr, f1(mp, mr), up, ur, f1(up, ur)])
+
+
+def test_precision_recall_op():
+    rng = np.random.RandomState(0)
+    cls = 5
+    ids = rng.randint(0, cls, (16, 1)).astype('int64')
+    labels = rng.randint(0, cls, (16, 1)).astype('int64')
+    w = rng.rand(16, 1).astype('float32')
+    prev = rng.rand(cls, 4).astype('float32') * 3
+
+    def build():
+        i = fluid.layers.data(name='ids', shape=[1], dtype='int64')
+        l = fluid.layers.data(name='labels', shape=[1], dtype='int64')
+        wv = fluid.layers.data(name='w', shape=[1], dtype='float32')
+        sv = fluid.layers.data(name='states', shape=[cls, 4],
+                               dtype='float32', append_batch_size=False)
+        return fluid.layers.precision_recall(i, l, cls, weights=wv,
+                                             states_info=sv)
+    batch_m, accum_m, accum_s = _run(
+        build, {'ids': ids, 'labels': labels, 'w': w, 'states': prev})
+    ref_states = _np_precision_recall_states(ids.ravel(), labels.ravel(),
+                                             w.ravel(), cls)
+    np.testing.assert_allclose(batch_m, _np_metrics(ref_states),
+                               rtol=1e-5)
+    np.testing.assert_allclose(accum_s, ref_states + prev, rtol=1e-5)
+    np.testing.assert_allclose(
+        accum_m, _np_metrics(ref_states + prev.astype(np.float64)),
+        rtol=1e-5)
+
+
+def test_precision_recall_unweighted_defaults():
+    # empty-denominator classes must report precision/recall 1.0
+    ids = np.array([[0], [0], [1]], 'int64')
+    labels = np.array([[0], [1], [1]], 'int64')
+
+    def build():
+        i = fluid.layers.data(name='ids', shape=[1], dtype='int64')
+        l = fluid.layers.data(name='labels', shape=[1], dtype='int64')
+        return fluid.layers.precision_recall(i, l, 4)
+    batch_m, accum_m, _ = _run(build, {'ids': ids, 'labels': labels})
+    ref = _np_metrics(_np_precision_recall_states(
+        ids.ravel(), labels.ravel(), np.ones(3), 4))
+    np.testing.assert_allclose(batch_m, ref, rtol=1e-5)
+    np.testing.assert_allclose(accum_m, ref, rtol=1e-5)
+
+
+def _np_pnpair(score, label, query, weight, column=0):
+    pos = neg = neu = 0.0
+    B = len(label)
+    for i in range(B):
+        for j in range(i + 1, B):
+            if query[i] != query[j] or label[i] == label[j]:
+                continue
+            w = 0.5 * (weight[i] + weight[j])
+            si, sj = score[i, column], score[j, column]
+            if si == sj:
+                neu += w
+            if (si - sj) * (label[i] - label[j]) > 0:
+                pos += w
+            else:
+                neg += w
+    return pos, neg, neu
+
+
+def test_positive_negative_pair_op():
+    rng = np.random.RandomState(1)
+    B = 24
+    score = rng.rand(B, 3).astype('float32')
+    # force some exact score ties within a query
+    score[3, 1] = score[5, 1]
+    label = rng.randint(0, 3, (B, 1)).astype('float32')
+    query = rng.randint(0, 4, (B, 1)).astype('int64')
+    weight = rng.rand(B, 1).astype('float32')
+    acc = np.array([2.0, 3.0, 0.5], 'float32')
+
+    def build():
+        s = fluid.layers.data(name='s', shape=[3], dtype='float32')
+        l = fluid.layers.data(name='l', shape=[1], dtype='float32')
+        q = fluid.layers.data(name='q', shape=[1], dtype='int64')
+        w = fluid.layers.data(name='w', shape=[1], dtype='float32')
+        ap = fluid.layers.data(name='ap', shape=[1], dtype='float32',
+                               append_batch_size=False)
+        an = fluid.layers.data(name='an', shape=[1], dtype='float32',
+                               append_batch_size=False)
+        au = fluid.layers.data(name='au', shape=[1], dtype='float32',
+                               append_batch_size=False)
+        return fluid.layers.positive_negative_pair(
+            s, l, q, weight=w, accum=(ap, an, au), column=1)
+    pos, neg, neu = _run(build, {
+        's': score, 'l': label, 'q': query, 'w': weight,
+        'ap': acc[:1], 'an': acc[1:2], 'au': acc[2:]})
+    rp, rn, ru = _np_pnpair(score, label.ravel(), query.ravel(),
+                            weight.ravel(), column=1)
+    np.testing.assert_allclose(pos, rp + acc[0], rtol=1e-5)
+    np.testing.assert_allclose(neg, rn + acc[1], rtol=1e-5)
+    np.testing.assert_allclose(neu, ru + acc[2], rtol=1e-5)
+
+
+def test_precision_recall_evaluator_streams():
+    from paddle_tpu.evaluator import PrecisionRecall
+    rng = np.random.RandomState(2)
+    cls = 3
+    prog, startup = Program(), Program()
+    with program_guard(prog, startup):
+        ids = fluid.layers.data(name='ids', shape=[1], dtype='int64')
+        labels = fluid.layers.data(name='labels', shape=[1],
+                                   dtype='int64')
+        ev = PrecisionRecall(ids, labels, cls)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    all_ids, all_labels = [], []
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        ev.reset(exe)
+        for _ in range(3):
+            i = rng.randint(0, cls, (8, 1)).astype('int64')
+            l = rng.randint(0, cls, (8, 1)).astype('int64')
+            all_ids.append(i)
+            all_labels.append(l)
+            exe.run(prog, feed={'ids': i, 'labels': l},
+                    fetch_list=[m.name for m in ev.metrics])
+        got = ev.eval(exe)
+    ref = _np_metrics(_np_precision_recall_states(
+        np.concatenate(all_ids).ravel(),
+        np.concatenate(all_labels).ravel(),
+        np.ones(24), cls))
+    np.testing.assert_allclose(got, ref, rtol=1e-5)
+
+
+def test_precision_recall_out_of_range_poisons():
+    # reference PADDLE_ENFORCEs ids in [0, class_number)
+    # (precision_recall_op.h:60-64); the device op reports the
+    # violation as NaN metrics instead of silently dropping the sample
+    ids = np.array([[5], [0]], 'int64')      # 5 >= class_number=3
+    labels = np.array([[1], [0]], 'int64')
+
+    def build():
+        i = fluid.layers.data(name='ids', shape=[1], dtype='int64')
+        l = fluid.layers.data(name='labels', shape=[1], dtype='int64')
+        return fluid.layers.precision_recall(i, l, 3)[:2]
+    batch_m, accum_m = _run(build, {'ids': ids, 'labels': labels})
+    assert np.isnan(batch_m).all()
+    assert np.isnan(accum_m).all()
+
+
+def test_positive_negative_pair_blocked_rows():
+    # B larger than (and not a multiple of) the 256-row scan block
+    rng = np.random.RandomState(3)
+    B = 700
+    score = rng.rand(B, 1).astype('float32')
+    label = rng.randint(0, 3, (B, 1)).astype('float32')
+    query = rng.randint(0, 5, (B, 1)).astype('int64')
+
+    def build():
+        s = fluid.layers.data(name='s', shape=[1], dtype='float32')
+        l = fluid.layers.data(name='l', shape=[1], dtype='float32')
+        q = fluid.layers.data(name='q', shape=[1], dtype='int64')
+        return fluid.layers.positive_negative_pair(s, l, q)
+    pos, neg, neu = _run(build, {'s': score, 'l': label, 'q': query})
+    rp, rn, ru = _np_pnpair(score, label.ravel(), query.ravel(),
+                            np.ones(B))
+    np.testing.assert_allclose(pos, rp, rtol=1e-5)
+    np.testing.assert_allclose(neg, rn, rtol=1e-5)
+    np.testing.assert_allclose(neu, ru, rtol=1e-5)
