@@ -41,6 +41,13 @@ _DEFAULTS = {
     'use_pallas_fused_ops': False,
     'use_flash_attention': True,
     'pallas_interpret': False,
+    # under AMP, round fp32-parameter gradients to bf16 at the grad-op
+    # boundary: dW kernels write half the bytes and optimizer updates
+    # read half — master weights and optimizer state stay fp32, so the
+    # single rounding matches the standard bf16-grad training recipe
+    # (Megatron-style). Off by default: exact-fp32 grad parity tests
+    # rely on the precise path.
+    'amp_bf16_param_grads': False,
 }
 
 _FLAGS = dict(_DEFAULTS)

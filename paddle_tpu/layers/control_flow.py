@@ -21,7 +21,68 @@ __all__ = [
     'array_write', 'array_read', 'array_length', 'create_array',
     'less_than', 'less_equal', 'greater_than', 'greater_equal', 'equal',
     'not_equal', 'increment', 'is_empty', 'max_sequence_len', 'Print',
+    'recompute',
 ]
+
+
+_REMAT_TAG = [0]
+
+
+def recompute(build_fn, *inputs, **kwargs):
+    """Rematerialization scope: run `build_fn(*inputs)` inside a
+    sub-block lowered through jax.checkpoint — only the returned
+    variables are saved for backward; everything else inside the scope
+    is recomputed during the gradient pass. The TPU-native memory/FLOPs
+    trade (the reference's analog lever is memory_optimize's buffer
+    reuse; XLA owns buffers here, so remat is the knob that matters).
+
+        y = layers.recompute(lambda h: transformer_block(h), x)
+
+    policy='dots' additionally saves MXU (matmul) outputs
+    (jax.checkpoint_policies.checkpoint_dots) — less recompute, more
+    memory. Returns the rebuilt output Variable(s), usable after the
+    scope like any other var."""
+    policy = kwargs.pop('policy', 'nothing')
+    if kwargs:
+        raise TypeError('recompute: unknown kwargs %r' % list(kwargs))
+    program = default_main_program()
+    parent_block = program.current_block()
+    guard = BlockGuard(program)
+    with guard as sub_block:
+        outs = build_fn(*inputs)
+    single = not isinstance(outs, (list, tuple))
+    out_list = [outs] if single else list(outs)
+    x_names = _external_deps(sub_block)
+    out_names = [v.name for v in out_list]
+    # writes to OUTER vars (batch_norm running stats, accumulators…)
+    # must also leave the checkpointed fn, or they die in its local env
+    # and the scope flush never sees them (the _sub_block_io rule While
+    # uses; here they join the explicitly returned outputs)
+    for op in sub_block.ops:
+        for n in op.output_arg_names():
+            if n not in sub_block.vars and n not in out_names:
+                out_names.append(n)
+    # hoist output var descs into the parent block so later layers (and
+    # infer_shape walks) resolve them outside the scope
+    hoisted = []
+    for v in out_list:
+        if v.name in sub_block.vars:
+            pv = parent_block.create_var(name=v.name, shape=v.shape,
+                                         dtype=v.dtype)
+            if getattr(v, 'seq_lens', None) is not None:
+                pv.seq_lens = v.seq_lens
+                pv.lod_level = v.lod_level
+            hoisted.append(pv)
+        else:
+            hoisted.append(v)
+    _REMAT_TAG[0] += 1
+    parent_block.append_op(
+        type='remat_block',
+        inputs={'X': x_names},
+        outputs={'Out': out_names},
+        attrs={'sub_block': sub_block.idx, 'policy': policy,
+               'rng_tag': 7919 + _REMAT_TAG[0]})
+    return hoisted[0] if single else hoisted
 
 
 # ---------------------------------------------------------------------------

@@ -19,6 +19,7 @@ __all__ = [
     'bilinear_tensor_product', 'modified_huber_loss', 'l1_norm', 'sign',
     'fake_quantize', 'polygon_box_transform', 'flash_attention',
     'auc', 'precision_recall', 'positive_negative_pair',
+    'fused_softmax_cross_entropy',
 ]
 
 
@@ -230,6 +231,37 @@ def chunk_eval(input, label, chunk_scheme, num_chunk_types,
                             'excluded_chunk_types':
                                 list(excluded_chunk_types or [])})
     return precision, recall, f1, num_infer, num_label, num_correct
+
+
+def fused_softmax_cross_entropy(input, label, num_classes, chunk=1024,
+                                param_attr=None, bias_attr=None,
+                                ignore_index=-100, name=None):
+    """Classifier head + softmax cross-entropy as ONE op — the [N, V]
+    logits tensor is never materialized (token-chunked lax.scan with
+    per-chunk recompute in backward; ops/loss_ops.py). Use in place of
+    `fc(act=None)` + `softmax_with_cross_entropy` when num_classes is
+    large (LM heads). Owns the projection weight [D, num_classes]
+    (+ bias unless bias_attr=False). Returns Loss [..., 1] f32."""
+    helper = LayerHelper('fused_softmax_cross_entropy', input=input,
+                         param_attr=param_attr, bias_attr=bias_attr,
+                         name=name)
+    dtype = helper.input_dtype()
+    D = input.shape[-1]
+    w = helper.create_parameter(attr=helper.param_attr,
+                                shape=[int(D), int(num_classes)],
+                                dtype=dtype)
+    inputs = {'X': [input], 'W': [w], 'Label': [label]}
+    if bias_attr is not False:
+        b = helper.create_parameter(attr=helper.bias_attr,
+                                    shape=[int(num_classes)],
+                                    dtype=dtype, is_bias=True)
+        inputs['Bias'] = [b]
+    loss = helper.create_variable_for_type_inference('float32')
+    helper.append_op(type='fused_softmax_cross_entropy', inputs=inputs,
+                     outputs={'Loss': [loss]},
+                     attrs={'chunk': int(chunk),
+                            'ignore_index': int(ignore_index)})
+    return loss
 
 
 def precision_recall(input, label, class_number, weights=None,

@@ -27,7 +27,7 @@ class TransformerConfig(object):
     def __init__(self, vocab=1000, dim=64, heads=4, layers=2, ffn=128,
                  max_len=64, moe_experts=0, use_tp=True, use_sp=True,
                  pp_stages=0, ring_attention=False,
-                 flash_attention=False):
+                 flash_attention=False, remat=None):
         self.vocab, self.dim, self.heads = vocab, dim, heads
         self.layers, self.ffn, self.max_len = layers, ffn, max_len
         self.moe_experts = moe_experts
@@ -42,6 +42,11 @@ class TransformerConfig(object):
         # single-device long context: Pallas blockwise attention (no
         # [T, T] scores); composable alternative to the sp ring
         self.flash_attention = flash_attention
+        # rematerialization policy: None (save all activations),
+        # 'nothing' (save only each block's output — max memory saving),
+        # or 'dots' (also keep MXU outputs; less recompute). Applied
+        # per transformer block via layers.recompute.
+        self.remat = remat
 
 
 def _attention(x, cfg, prefix):
@@ -132,7 +137,12 @@ def _blocks(x, cfg):
                 x = _block(x, cfg, i)
         return x
     for i in range(cfg.layers):
-        x = _block(x, cfg, i)
+        if cfg.remat:
+            policy = 'dots' if cfg.remat == 'dots' else 'nothing'
+            x = L.recompute(lambda h, i=i: _block(h, cfg, i), x,
+                            policy=policy)
+        else:
+            x = _block(x, cfg, i)
     return x
 
 

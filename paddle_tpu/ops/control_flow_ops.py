@@ -41,8 +41,10 @@ def _run_sub_block(env, sub_block, rng_key, is_test, base_index,
         sub_ctx.amp = getattr(parent_ctx, 'amp', False)
         sub_ctx._fold_limits = dict(
             getattr(parent_ctx, '_fold_limits', {}))
-        sub_ctx._fold_limits[parent_ctx.block.idx] = \
-            getattr(parent_ctx, '_block_pos', len(parent_ctx.block.ops))
+        parent_block = getattr(parent_ctx, 'block', None)
+        if parent_block is not None:   # _SandboxCtx (vjp re-trace) has none
+            sub_ctx._fold_limits[parent_block.idx] = \
+                getattr(parent_ctx, '_block_pos', len(parent_block.ops))
     for i, sop in enumerate(sub_block.ops):
         sub_ctx._op_index = base_index * 1009 + i
         sub_ctx._block_pos = i
@@ -363,6 +365,53 @@ def _recurrent_grad_emit(ctx, op):
 register_op('recurrent', grad=_recurrent_grad_maker,
             infer_shape=_recurrent_infer)
 register_op('recurrent_grad')
+
+
+# ---------------------------------------------------------------------------
+# remat_block — rematerialization scope (TPU-native; no reference
+# analog: the reference trades memory for FLOPs with memory_optimize's
+# buffer reuse, while XLA owns buffers here, so the equivalent lever is
+# jax.checkpoint over a sub-block: activations inside the scope are
+# dropped after forward and recomputed during backward).
+#
+# inputs:  X = external vars the sub-block reads (activations + params)
+# outputs: Out = sub-block-built vars consumed after the scope (these
+#          are the ONLY tensors saved for backward)
+# attrs:   sub_block, rng_tag (stable int: the vjp grad re-traces this
+#          emitter under the GRAD op's index, so RNG must key off a
+#          build-time tag or dropout would draw a different mask in the
+#          backward recompute — the nce problem), policy
+#          ('nothing' = save only Out; 'dots' = also save MXU outputs,
+#          jax.checkpoint_policies.checkpoint_dots)
+# ---------------------------------------------------------------------------
+
+@op_emitter('remat_block')
+def _remat_block_emit(ctx, op):
+    sub_block = op.block.program.blocks[op.attr('sub_block')]
+    x_names = list(op.input('X'))
+    out_names = list(op.output('Out'))
+    tag = op.attr('rng_tag', 0)
+    policy_name = op.attr('policy', 'nothing')
+    policy = (jax.checkpoint_policies.checkpoint_dots
+              if policy_name == 'dots' else None)
+
+    def fn(*xs):
+        env = dict(zip(x_names, xs))
+        _run_sub_block(env, sub_block, ctx.rng_key, ctx.is_test,
+                       tag, parent_ctx=ctx)
+        return tuple(env[n] for n in out_names)
+
+    outs = jax.checkpoint(fn, policy=policy)(
+        *(ctx.get(n) for n in x_names))
+    for n, v in zip(out_names, outs):
+        ctx.set(n, v)
+
+
+register_op('remat_block', infer_shape=lambda op, block: None)
+
+from ..registry import register_vjp_grad  # noqa: E402
+
+register_vjp_grad('remat_block', in_slots=('X',), out_slots=('Out',))
 
 
 # ---------------------------------------------------------------------------

@@ -325,11 +325,11 @@ def _positive_negative_pair_emit(ctx, op):
         s = jnp.pad(s, (0, pad))
         label = jnp.pad(label, (0, pad))
         w = jnp.pad(w, (0, pad))
-        # pad rows get a query id no real row carries, so they pair
-        # with nothing (query ids are non-negative int64 in practice)
-        query = jnp.pad(query, (0, pad), constant_values=-1)
+        query = jnp.pad(query, (0, pad))
     total = B + pad
     gidx = jnp.arange(total)
+    # pad rows are excluded by INDEX (gidx < B), not by a query-id
+    # sentinel — sentinels can collide with real (e.g. negative) ids
 
     def block_counts(carry, start):
         pos_c, neg_c, neu_c = carry
@@ -340,7 +340,8 @@ def _positive_negative_pair_emit(ctx, op):
         ii = start + jnp.arange(blk)
         valid = ((qi[:, None] == query[None, :]) &
                  (li[:, None] != label[None, :]) &
-                 (ii[:, None] < gidx[None, :]))
+                 (ii[:, None] < gidx[None, :]) &
+                 (ii[:, None] < B) & (gidx[None, :] < B))
         prod = (si[:, None] - s[None, :]) * (li[:, None] - label[None, :])
         vw = 0.5 * (wi[:, None] + w[None, :]) * valid.astype(jnp.float32)
         pos_c = pos_c + jnp.sum(vw * (prod > 0))

@@ -24,7 +24,7 @@ import jax
 import jax.numpy as jnp
 
 from ..registry import (register_op, op_emitter, register_vjp_grad,
-                        same_shape_infer)
+                        same_shape_infer, amp_cast)
 
 
 # ---------------------------------------------------------------------------
@@ -105,6 +105,90 @@ def _sql2_infer(op, block):
 register_op('squared_l2_distance', infer_shape=_sql2_infer)
 register_vjp_grad('squared_l2_distance', in_slots=('X', 'Y'),
                   out_slots=('Out',))
+
+
+# ---------------------------------------------------------------------------
+# fused_softmax_cross_entropy — the LM-head loss without the logits
+# tensor (TPU redesign of the reference's fc + softmax_with_cross_entropy
+# pair, softmax_with_cross_entropy_op.cc). At vocab 32k+ the pair
+# materializes [B*T, V] fp32 logits in BOTH passes; here the head matmul
+# and the loss are one op, computed as a lax.scan over token chunks with
+# a jax.checkpoint'd body: each chunk's [chunk, V] logits live only in
+# VMEM-scale scratch, and the backward recomputes them per chunk (the
+# scan transpose accumulates dW across chunks).
+#
+# inputs:  X [B, T, D] (or [N, D]) features, W [D, V], optional Bias [V],
+#          Label [..., 1] int
+# outputs: Loss [..., 1] f32
+# attrs:   chunk (tokens per scan step, default 1024), ignore_index
+# ---------------------------------------------------------------------------
+
+@op_emitter('fused_softmax_cross_entropy')
+def _fused_swce_emit(ctx, op):
+    from jax import lax
+    x = ctx.get(op.single_input('X'))
+    w = ctx.get(op.single_input('W'))
+    bias = ctx.get(op.single_input('Bias')) if op.input('Bias') else None
+    label = ctx.get(op.single_input('Label'))
+    chunk = int(op.attr('chunk', 1024))
+    ignore = op.attr('ignore_index', -100)
+
+    lead_shape = x.shape[:-1]
+    D = x.shape[-1]
+    N = 1
+    for s in lead_shape:
+        N *= s
+    x2 = x.reshape(N, D)
+    lbl = label.reshape(N).astype(jnp.int32)
+
+    chunk = min(chunk, N)
+    pad = (-N) % chunk
+    if pad:
+        x2 = jnp.concatenate(
+            [x2, jnp.zeros((pad, D), x2.dtype)], axis=0)
+        # padded rows pick class 0 of a zero feature row — finite, and
+        # sliced off below
+        lbl = jnp.concatenate([lbl, jnp.zeros((pad,), lbl.dtype)])
+    n_chunks = (N + pad) // chunk
+
+    x2c, wc = amp_cast(ctx, x2, w)
+
+    def chunk_loss(x_c, l_c):
+        logits = lax.dot_general(
+            x_c, wc, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)        # [chunk, V] f32
+        if bias is not None:
+            logits = logits + bias.astype(jnp.float32)
+        lse = jax.scipy.special.logsumexp(logits, axis=-1)
+        picked = jnp.take_along_axis(
+            logits, l_c[:, None], axis=-1)[:, 0]
+        loss = lse - picked
+        return jnp.where(l_c == ignore, 0.0, loss)
+
+    body = jax.checkpoint(chunk_loss)
+
+    def scan_step(_, xs):
+        return None, body(*xs)
+
+    _, losses = lax.scan(
+        scan_step, None,
+        (x2c.reshape(n_chunks, chunk, D), lbl.reshape(n_chunks, chunk)))
+    loss_flat = losses.reshape(-1)[:N]
+    ctx.set(op.single_output('Loss'),
+            loss_flat.reshape(lead_shape + (1,)))
+
+
+def _fused_swce_infer(op, block):
+    x = block.var_recursive(op.single_input('X'))
+    loss = block.var_recursive(op.single_output('Loss'))
+    loss.shape = tuple(x.shape[:-1]) + (1,)
+    loss.dtype = 'float32'
+
+
+register_op('fused_softmax_cross_entropy', infer_shape=_fused_swce_infer)
+register_vjp_grad('fused_softmax_cross_entropy',
+                  in_slots=('X', 'W', 'Bias'), out_slots=('Loss',),
+                  nondiff_slots=('Label',))
 
 
 # ---------------------------------------------------------------------------

@@ -72,6 +72,27 @@ def _register_elementwise(name, fn):
                         SelectedRows(fn(x.values, y), x.rows, x.height))
                 return
             x = x.to_dense()
+        # AMP: a bf16 activation +/* an fp32 PARAM (bias add, LN-style
+        # scale) must not promote the stream back to fp32 — that leak
+        # turns every downstream activation AND its gradient fp32
+        # (measured: the whole transformer residual path reverted to
+        # fp32 through fc bias adds). Cast the param side down instead.
+        # Gated on persistable so an fp32-by-design tensor (a loss, a
+        # user accumulator) meeting a bf16 one keeps fp32 promotion.
+        if getattr(ctx, 'amp', False):
+            def _is_param(slot):
+                try:
+                    return bool(ctx.var(op.single_input(slot)).persistable)
+                except Exception:
+                    return False
+            xd = getattr(x, 'dtype', None)
+            yd = getattr(y, 'dtype', None)
+            if xd == jnp.bfloat16 and yd == jnp.float32 \
+                    and _is_param('Y'):
+                y = y.astype(jnp.bfloat16)
+            elif yd == jnp.bfloat16 and xd == jnp.float32 \
+                    and _is_param('X'):
+                x = x.astype(jnp.bfloat16)
         ctx.set(op.single_output('Out'),
                 fn(x, _broadcast_y(x, y, axis,
                                    _declared_rank(ctx, op, 'X'))))

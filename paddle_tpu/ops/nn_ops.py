@@ -442,8 +442,11 @@ register_vjp_grad('cross_entropy', in_slots=('X',), out_slots=('Y',),
 def _swce_emit(ctx, op):
     logits = ctx.get(op.single_input('Logits'))
     label = ctx.get(op.single_input('Label'))
-    log_sm = jax.nn.log_softmax(logits, axis=-1)
-    ctx.set(op.single_output('Softmax'), jnp.exp(log_sm))
+    # normalize in fp32 regardless of the (possibly bf16) stream dtype:
+    # a 32k-way logsumexp loses precision in bf16
+    log_sm = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    ctx.set(op.single_output('Softmax'),
+            jnp.exp(log_sm).astype(logits.dtype))
     if op.attr('soft_label', False):
         loss = -jnp.sum(label * log_sm, axis=-1, keepdims=True)
     else:
@@ -634,6 +637,11 @@ def _lookup_table_emit(ctx, op):
         out = jnp.where((flat == pad)[..., None], 0.0, out)
     if squeeze_last:
         out = out.reshape(ids.shape[:-1] + (w.shape[-1],))
+    # under AMP the embedding activation starts the bf16 stream: without
+    # this the residual path (and every activation GRADIENT flowing back
+    # through it) stays fp32 — measured 2x HBM traffic + mixed-dtype
+    # backward dots on the transformer bench
+    out = amp_cast(ctx, out)
     ctx.set(op.single_output('Out'), out)
 
 
@@ -810,8 +818,10 @@ def _position_embedding_emit(ctx, op):
     x = ctx.get(op.single_input('X'))          # [B, T, D]
     pos = ctx.get(op.single_input('Pos'))      # [max_len, D]
     T = x.shape[1]
+    # follow the (possibly bf16-under-AMP) activation stream dtype so
+    # the downstream residual add does not promote back to fp32
     ctx.set(op.single_output('Out'),
-            jnp.broadcast_to(pos[None, :T, :], x.shape))
+            jnp.broadcast_to(pos[None, :T, :], x.shape).astype(x.dtype))
 
 
 def _position_embedding_infer(op, block):

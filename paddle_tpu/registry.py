@@ -203,6 +203,7 @@ def register_vjp_grad(fwd_type, in_slots=('X',), out_slots=('Out',),
     nondiff_slots: input slots treated as constants (e.g. integer indices).
     """
     import jax
+    import jax.numpy as jnp
 
     grad_type = fwd_type + '_grad'
 
@@ -278,6 +279,22 @@ def register_vjp_grad(fwd_type, in_slots=('X',), out_slots=('Out',),
         _, vjp_fn = jax.vjp(f, *primals)
         cots = tuple(ctx.get(grad_var_name(n)) for n in out_names)
         grads = vjp_fn(cots)
+        # bf16 param grads (FLAGS_amp_bf16_param_grads): under AMP the
+        # only fp32 primals left are parameters (the activation stream
+        # is bf16), so rounding fp32-primal cotangents to bf16 here
+        # halves dW write + optimizer read traffic; XLA fuses the
+        # convert into the producing kernel.
+        bf16_param_grads = False
+        if getattr(ctx, 'amp', False):
+            from .flags import get_flag
+            bf16_param_grads = bool(get_flag('amp_bf16_param_grads'))
+
+        def _is_param(name):
+            try:
+                return bool(ctx.var(name).persistable)
+            except Exception:
+                return False
+
         grad_by_input = dict(zip(diff_names, grads))
         # write to the op's ACTUAL output names -- backward.py may have
         # renamed them (fan-out dedup) or blanked them (no_grad inputs)
@@ -285,8 +302,21 @@ def register_vjp_grad(fwd_type, in_slots=('X',), out_slots=('Out',),
             fwd_names = fwd_inputs.get(s, [])
             out_grad_names = op.output(s + '@GRAD')
             for fwd_n, out_n in zip(fwd_names, out_grad_names):
-                if out_n:
-                    ctx.set(out_n, grad_by_input[fwd_n])
+                if not out_n:
+                    continue
+                g = grad_by_input[fwd_n]
+                # bf16 param grads (FLAGS_amp_bf16_param_grads): round
+                # fp32 PARAM grads to bf16 — but only when this op is
+                # the grad's sole producer (out_n is the canonical
+                # @GRAD name). Fan-out contributions keep fp32 so the
+                # sum accumulates before the single rounding
+                # (Megatron-style bf16-grad recipe).
+                if (bf16_param_grads
+                        and getattr(g, 'dtype', None) == jnp.float32
+                        and out_n == grad_var_name(fwd_n)
+                        and _is_param(fwd_n)):
+                    g = g.astype(jnp.bfloat16)
+                ctx.set(out_n, g)
 
     register_op(fwd_type, grad=maker)
     register_op(grad_type, emit=emit)
