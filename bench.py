@@ -190,7 +190,7 @@ def _bench_lm(cfg, batch, warmup, iters, prefix, causal_flops,
             use_double_buffer=True)
         tokens, labels = fluid.layers.read_file(rdr)
         if fused_head:
-            trunk = tfm._trunk(tokens, cfg)
+            trunk = tfm.language_model_trunk(tokens, cfg)
             cost = fluid.layers.fused_softmax_cross_entropy(
                 trunk, labels, cfg.vocab, chunk=head_chunk,
                 name='lm_head')

@@ -48,6 +48,10 @@ _DEFAULTS = {
     # (Megatron-style). Off by default: exact-fp32 grad parity tests
     # rely on the precise path.
     'amp_bf16_param_grads': False,
+    # flash-attention kernel block overrides (0 = use the tuned table
+    # in pallas/flash_attention.py:_block_sizes)
+    'flash_block_q': 0,
+    'flash_block_k': 0,
 }
 
 _FLAGS = dict(_DEFAULTS)

@@ -25,9 +25,6 @@ __all__ = [
 ]
 
 
-_REMAT_TAG = [0]
-
-
 def recompute(build_fn, *inputs, **kwargs):
     """Rematerialization scope: run `build_fn(*inputs)` inside a
     sub-block lowered through jax.checkpoint — only the returned
@@ -75,13 +72,16 @@ def recompute(build_fn, *inputs, **kwargs):
             hoisted.append(pv)
         else:
             hoisted.append(v)
-    _REMAT_TAG[0] += 1
+    # rng_tag keys the sub-block RNG folding; the sub-block index is
+    # program-local and unique per scope, so a rebuilt program with the
+    # same seed reproduces the same dropout masks (a process-global
+    # counter would not)
     parent_block.append_op(
         type='remat_block',
         inputs={'X': x_names},
         outputs={'Out': out_names},
         attrs={'sub_block': sub_block.idx, 'policy': policy,
-               'rng_tag': 7919 + _REMAT_TAG[0]})
+               'rng_tag': 7919 + sub_block.idx})
     return hoisted[0] if single else hoisted
 
 

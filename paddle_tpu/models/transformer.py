@@ -158,6 +158,13 @@ def _trunk(tokens, cfg):
     return L.layer_norm(x, begin_norm_axis=2)
 
 
+def language_model_trunk(tokens, cfg):
+    """Public trunk (embed + position + blocks + final norm) WITHOUT a
+    head — pair with layers.fused_softmax_cross_entropy for the
+    logits-free LM loss (the bench path), or project manually."""
+    return _trunk(tokens, cfg)
+
+
 def language_model(tokens, cfg):
     """tokens: [B, T, 1] int64 ids (no lod: fixed T). Returns softmax
     probabilities [B, T, vocab]."""

@@ -41,6 +41,23 @@ __all__ = ['flash_attention']
 _NEG_INF = -1e30
 
 
+def _mask_if_straddling(s, qi, ki, block_q, block_k):
+    """Causal mask applied only when the (qi, ki) block straddles the
+    diagonal: a visited block with max k_pos <= min q_pos is fully
+    visible and skips the iota/compare/select VPU passes (the kernel's
+    dominant cost — PERF.md round-4 flash ladder)."""
+
+    def masked(s_):
+        q_pos = qi * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        k_pos = ki * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        return jnp.where(q_pos >= k_pos, s_, _NEG_INF)
+
+    return jax.lax.cond(ki * block_k + block_k - 1 > qi * block_q,
+                        masked, lambda s_: s_, s)
+
+
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
                 m_scr, l_scr, acc_scr, *, sm_scale, causal, block_q,
                 block_k, nk):
@@ -64,20 +81,20 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)       # [bq, bk]
         if causal:
-            q_pos = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            k_pos = ki * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+            # the kernel is VPU-bound (PERF.md round-4 flash ladder):
+            # only diagonal-straddling blocks pay for the iota mask —
+            # interior visited blocks are fully visible and skip the
+            # elementwise mask passes entirely
+            s = _mask_if_straddling(s, qi, ki, block_q, block_k)
         m_prev = m_scr[:]
         blk_max = jnp.max(s, axis=1)
         m_new = jnp.maximum(m_prev, blk_max)
         safe_m = jnp.where(m_new <= _NEG_INF / 2, 0.0, m_new)
         corr = jnp.exp(jnp.where(m_prev <= _NEG_INF / 2, safe_m, m_prev)
                        - safe_m)
+        # no second mask on p: masked s = -1e30, and exp(-1e30 - m)
+        # underflows to exactly 0 for any finite (or zeroed) safe_m
         p = jnp.exp(s - safe_m[:, None])
-        if causal:
-            p = jnp.where(q_pos >= k_pos, p, 0.0)
         l_new = l_scr[:] * corr + jnp.sum(p, axis=1)
         acc = acc_scr[:] * corr[:, None] + jax.lax.dot_general(
             p.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
@@ -116,11 +133,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
         if causal:
-            q_pos = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            k_pos = ki * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+            s = _mask_if_straddling(s, qi, ki, block_q, block_k)
         p = jnp.exp(s - lse_ref[0])                   # [bq, bk]
         dp = jax.lax.dot_general(
             do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
@@ -156,11 +169,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
         if causal:
-            q_pos = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            k_pos = ki * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+            s = _mask_if_straddling(s, qi, ki, block_q, block_k)
         p = jnp.exp(s - lse_ref[0])                   # [bq, bk]
         do = do_ref[0]
         dv_scr[:] += jax.lax.dot_general(
@@ -181,7 +190,30 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
 
 
+# (T, d) -> (block_q, block_k) overrides. Intentionally EMPTY: the
+# round-4 sweep on this chip found every config within the ±20%
+# measurement noise band (PERF.md "flash kernel autotune"), so the
+# 512x512 default stands; populate per-chip when a sweep resolves.
+_BLOCK_TABLE = {}
+
+
 def _block_sizes(T, d):
+    from ..flags import get_flag
+    fq = int(get_flag('flash_block_q', 0) or 0)
+    fk = int(get_flag('flash_block_k', 0) or 0)
+    if fq or fk:
+        # a half-set or non-dividing override silently benchmarking the
+        # default kernel is exactly the sweep corruption to avoid
+        if not (fq and fk):
+            raise ValueError('set BOTH FLAGS_flash_block_q and '
+                             'FLAGS_flash_block_k (got q=%d k=%d)'
+                             % (fq, fk))
+        if T % fq or T % fk:
+            raise ValueError('flash block override (%d, %d) does not '
+                             'divide T=%d' % (fq, fk, T))
+        return fq, fk
+    if (T, d) in _BLOCK_TABLE:
+        return _BLOCK_TABLE[(T, d)]
     bq = min(512, T)
     bk = min(512, T)
     while T % bq:
