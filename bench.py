@@ -101,17 +101,28 @@ def _transformer_train_flops_per_token(cfg, causal=False):
 
 
 def _run_steps(pe, fetch_name, warmup, iters):
-    """Timed async step loop; sync via host fetch only at the ends
-    (block_until_ready does not reliably block through remoted PJRT —
-    PERF.md measurement note)."""
+    """Timed async step loop, synced via host fetch (block_until_ready
+    does not reliably block through remoted PJRT — PERF.md note).
+
+    Differencing: the wall time of ANY fetch-terminated loop carries
+    one transport round-trip (~70-110 ms here) as an additive constant,
+    which at 20 iterations under-reports throughput by 3-5%. Timing
+    both an `iters` and a `2*iters` loop and differencing cancels every
+    per-sync constant exactly (PERF.md round-4 'measurement trap')."""
     for _ in range(warmup):
         wl = pe.run(fetch_list=[fetch_name], return_numpy=False)
     float(np.asarray(wl[0]))
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        loss = pe.run(fetch_list=[fetch_name], return_numpy=False)
-    float(np.asarray(loss[0]))
-    return time.perf_counter() - t0
+
+    def timed(n):
+        t0 = time.perf_counter()
+        for _ in range(n):
+            loss = pe.run(fetch_list=[fetch_name], return_numpy=False)
+        float(np.asarray(loss[0]))
+        return time.perf_counter() - t0
+
+    w1 = timed(iters)
+    w2 = timed(2 * iters)
+    return max(w2 - w1, 1e-9)
 
 
 def bench_resnet(on_tpu):

@@ -340,7 +340,7 @@ class HostContext(object):
 
 class _DeviceSegment(object):
     __slots__ = ('ops', 'op_offsets', 'in_names', 'out_names', 'jitted',
-                 'needs_rng')
+                 'needs_rng', '_arg_struct')
 
     def __init__(self, ops, op_offsets):
         self.ops = ops
@@ -349,6 +349,7 @@ class _DeviceSegment(object):
         self.out_names = []
         self.jitted = None
         self.needs_rng = False
+        self._arg_struct = None   # set on first run; see _run_prepared
 
 
 class _HostStep(object):
@@ -450,6 +451,23 @@ class PreparedProgram(object):
 # Executor
 # ---------------------------------------------------------------------------
 
+import weakref
+
+_LIVE_EXECUTORS = weakref.WeakSet()
+
+
+def all_compiled_hlo_texts():
+    """Compiled HLO of every device segment run so far by any live
+    Executor — the instruction→op_name metadata source the profiler
+    joins against xplane device events (profiler.py op attribution;
+    reference analog: device_tracer.cc correlating CUPTI records to op
+    annotations)."""
+    texts = []
+    for exe in list(_LIVE_EXECUTORS):
+        texts.extend(exe.compiled_hlo_texts())
+    return texts
+
+
 class Executor(object):
     def __init__(self, place=None):
         self.place = place if place is not None else TPUPlace()
@@ -460,6 +478,23 @@ class Executor(object):
         self._prepared_cache = {}
         self._step = 0
         self._base_key = None
+        _LIVE_EXECUTORS.add(self)
+
+    def compiled_hlo_texts(self):
+        """Optimized-HLO text of each compiled device segment (re-lowered
+        from the stashed abstract arg signature; hits the jit cache)."""
+        texts = []
+        for prepared in self._prepared_cache.values():
+            for step in prepared.steps:
+                if isinstance(step, _DeviceSegment) \
+                        and step.jitted is not None \
+                        and step._arg_struct is not None:
+                    try:
+                        texts.append(step.jitted.lower(*step._arg_struct)
+                                     .compile().as_text())
+                    except Exception:
+                        pass
+        return texts
 
     @property
     def device(self):
@@ -563,14 +598,17 @@ class Executor(object):
             return val
 
         from . import flags as flags_mod
+        from . import profiler as _prof
         check_nan_inf = flags_mod.get_flag('check_nan_inf')
 
-        for step in prepared.steps:
+        for step_idx, step in enumerate(prepared.steps):
             if isinstance(step, _HostStep):
                 # sync host-visible values then run on host
                 hctx = _RunHostContext(scope, local, block)
                 try:
-                    registry._REGISTRY[step.op.type].emit(hctx, step.op)
+                    with _prof.RecordEvent('host_op:%s' % step.op.type):
+                        registry._REGISTRY[step.op.type].emit(hctx,
+                                                              step.op)
                 except Exception as e:
                     if _passthrough_exception(e):
                         raise
@@ -604,7 +642,19 @@ class Executor(object):
                         step, block, program,
                         feed_names=tuple(feed_arrays.keys()),
                         donate=prepared.donate)
-                outs = step.jitted(donated, const, key_arg)
+                if getattr(step, '_arg_struct', None) is None:
+                    # abstract arg signature kept so the profiler can
+                    # re-lower this segment and read the compiled HLO
+                    # (instr -> op_name metadata join; profiler.py)
+                    step._arg_struct = jax.tree.map(
+                        lambda a: jax.ShapeDtypeStruct(
+                            np.shape(a), getattr(a, 'dtype', None)
+                            or np.asarray(a).dtype),
+                        (donated, const, key_arg))
+                with _prof.RecordEvent(
+                        'device_segment:%d(%d ops)'
+                        % (step_idx, len(step.ops))):
+                    outs = step.jitted(donated, const, key_arg)
             for name, val in zip(step.out_names, outs):
                 local[name] = val
                 var = block.vars.get(name)
@@ -722,7 +772,15 @@ class Executor(object):
                 ctx._op_index = off
                 ctx._block_pos = off
                 try:
-                    registry._REGISTRY[op.type].emit(ctx, op)
+                    # named_scope stamps the IR op identity into XLA
+                    # metadata, so xplane device events carry
+                    # "<type>.<index>/..." — the per-op device-time
+                    # attribution the reference gets from correlating
+                    # CUPTI records to op annotations
+                    # (platform/device_tracer.cc); consumed by
+                    # profiler.py + tools/timeline.py
+                    with jax.named_scope('%s.%d' % (op.type, off)):
+                        registry._REGISTRY[op.type].emit(ctx, op)
                 except Exception as e:
                     if _passthrough_exception(e):
                         raise

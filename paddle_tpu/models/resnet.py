@@ -64,10 +64,35 @@ _DEPTH_CFG = {
 }
 
 
-def resnet_imagenet(input, class_dim=1000, depth=50, is_test=False):
+def space_to_depth_stem(input, is_test=False):
+    """MLPerf-style space-to-depth stem: an EXACT retiling of the
+    7x7/stride-2 stem conv (VERDICT round-4 #1a). The input is repacked
+    [B, 3, H, W] -> [B, 12, H/2, W/2] (channel = (c, di, dj)) and the
+    stem becomes a 4x4/stride-1 conv with asymmetric pad (2, 1): every
+    output value equals the original conv's (weights related by
+    w'[o, c*4+di*2+dj, m, n] = w[o, c, 2m+di-1, 2n+dj-1], zero where
+    out of the 7x7 support — tests/test_resnet_s2d.py checks the
+    equivalence numerically). Why it is faster on the MXU: the original
+    stem has C_in=3 (3/128 lanes fed); the retiled conv has C_in=12 and
+    16 taps instead of 49."""
+    B_c, C, H, W = input.shape
+    x = layers.reshape(input, shape=[-1, C, H // 2, 2, W // 2, 2])
+    x = layers.transpose(x, perm=[0, 1, 3, 5, 2, 4])  # [B,C,di,dj,h,w]
+    x = layers.reshape(x, shape=[-1, C * 4, H // 2, W // 2])
+    # 4x4 kernel spans m-2 in [-2, 1]: pad (2, 1) per spatial dim
+    x = layers.pad(x, paddings=[0, 0, 0, 0, 2, 1, 2, 1])
+    return conv_bn_layer(x, ch_out=64, filter_size=4, stride=1,
+                         padding=0, is_test=is_test)
+
+
+def resnet_imagenet(input, class_dim=1000, depth=50, is_test=False,
+                    space_to_depth=False):
     block_func, stages = _DEPTH_CFG[depth]
-    conv = conv_bn_layer(input, ch_out=64, filter_size=7, stride=2,
-                         padding=3, is_test=is_test)
+    if space_to_depth:
+        conv = space_to_depth_stem(input, is_test=is_test)
+    else:
+        conv = conv_bn_layer(input, ch_out=64, filter_size=7, stride=2,
+                             padding=3, is_test=is_test)
     pool = layers.pool2d(input=conv, pool_type='max', pool_size=3,
                          pool_stride=2, pool_padding=1)
     res = pool
@@ -95,11 +120,12 @@ def resnet_cifar10(input, class_dim=10, depth=32, is_test=False):
 
 
 def train_network(image, label, class_dim=1000, depth=50, is_test=False,
-                  variant='imagenet'):
+                  variant='imagenet', space_to_depth=False):
     """Full training graph: predictions, mean cross-entropy loss, accuracy."""
     if variant == 'imagenet':
         predict = resnet_imagenet(image, class_dim=class_dim, depth=depth,
-                                  is_test=is_test)
+                                  is_test=is_test,
+                                  space_to_depth=space_to_depth)
     else:
         predict = resnet_cifar10(image, class_dim=class_dim, depth=depth,
                                  is_test=is_test)

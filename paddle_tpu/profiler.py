@@ -119,10 +119,95 @@ def _write_chrome_trace(path):
         json.dump({'traceEvents': agg_events}, f)
 
 
+_HLO_METADATA_RE = None
+
+
+def hlo_op_map(hlo_texts):
+    """instruction-name -> IR-op label, parsed from compiled-HLO
+    metadata. Emission wraps every op in jax.named_scope('<type>.<idx>')
+    (executor.py seg_fn), so each HLO instruction's op_name path carries
+    the IR op that produced it; fusions inherit their root's. This is
+    the correlation the reference builds between CUPTI kernel records
+    and platform::RecordEvent annotations (device_tracer.cc:81-99)."""
+    import re
+    global _HLO_METADATA_RE
+    if _HLO_METADATA_RE is None:
+        _HLO_METADATA_RE = re.compile(
+            r'%([\w.-]+) = .*metadata={[^}]*op_name="([^"]+)"')
+    scope_re = re.compile(r'([A-Za-z_][\w]*\.\d+)')
+    out = {}
+    ambiguous = set()
+    for text in hlo_texts:
+        for m in _HLO_METADATA_RE.finditer(text):
+            instr, path = m.group(1), m.group(2)
+            ops = scope_re.findall(path)
+            if not ops:
+                continue
+            # instruction names are unique only PER MODULE: when two
+            # segments disagree about an instr, drop it (mislabeling
+            # device events silently is worse than leaving the raw
+            # instruction name)
+            if instr in out and out[instr] != ops[-1]:
+                ambiguous.add(instr)
+            else:
+                out[instr] = ops[-1]
+    for instr in ambiguous:
+        out.pop(instr, None)
+    return out
+
+
+def device_op_events(xplane_dir, op_map=None):
+    """[(label, start_ns, dur_ns)] for every device-side XLA op event in
+    an xplane capture, labeled through op_map when the instruction's
+    metadata resolves to an IR op."""
+    import glob
+    from jax.profiler import ProfileData
+    files = sorted(glob.glob(
+        os.path.join(xplane_dir, '**', '*.xplane.pb'), recursive=True))
+    events = []
+    for fn in files:
+        p = ProfileData.from_file(fn)
+        for plane in p.planes:
+            if not plane.name.startswith('/device:'):
+                continue
+            for line in plane.lines:
+                if line.name != 'XLA Ops':
+                    continue
+                for e in line.events:
+                    instr = e.name.split(' = ')[0].lstrip('%')
+                    label = (op_map or {}).get(instr, instr)
+                    events.append((label, e.start_ns, e.duration_ns))
+    return events
+
+
+def _dump_segment_hlo(profile_path):
+    """Write each live executor's compiled segment HLO next to the
+    profile so tools/timeline.py can do the instr->op join offline."""
+    import glob
+    import shutil
+    from .executor import all_compiled_hlo_texts
+    hlo_dir = profile_path + '.hlo'
+    texts = all_compiled_hlo_texts()
+    if not texts:
+        return None
+    # clear stale segments: leftovers from a previous run at the same
+    # path would poison the instr->op join
+    if os.path.isdir(hlo_dir):
+        shutil.rmtree(hlo_dir)
+    os.makedirs(hlo_dir, exist_ok=True)
+    for i, t in enumerate(texts):
+        with open(os.path.join(hlo_dir, 'segment%03d.txt' % i), 'w') as f:
+            f.write(t)
+    return hlo_dir
+
+
 @contextlib.contextmanager
 def profiler(state='All', sorted_key=None, profile_path='/tmp/profile'):
-    """(reference python profiler.py:221) Optionally also captures an XLA
-    device trace to <profile_path>.xplane/ when state includes the device."""
+    """(reference python profiler.py:221) With a device state, also
+    captures an XLA trace to <profile_path>.xplane/ and dumps segment
+    HLO to <profile_path>.hlo/; tools/timeline.py --xplane_dir/--hlo_dir
+    merges both streams into one chrome trace with per-op device
+    slices."""
     start_profiler(state)
     jax_trace = None
     if state in ('GPU', 'All'):
@@ -140,6 +225,10 @@ def profiler(state='All', sorted_key=None, profile_path='/tmp/profile'):
             try:
                 import jax
                 jax.profiler.stop_trace()
+            except Exception:
+                pass
+            try:
+                _dump_segment_hlo(profile_path)
             except Exception:
                 pass
         stop_profiler(sorted_key, profile_path)

@@ -72,13 +72,72 @@ def convert(profile_path, timeline_path, pretty=False):
     return timeline_path
 
 
+def merge_device_stream(profile_path, timeline_path, xplane_dir,
+                        hlo_dir=None, pretty=False):
+    """Merge the host RecordEvent chrome trace with the xplane device
+    stream into ONE chrome trace, device slices renamed to the IR ops
+    that produced them via the compiled-HLO metadata join
+    (paddle_tpu.profiler.hlo_op_map — the reference's
+    device_tracer.cc/timeline.py two-stream output). Host events render
+    under pid 0, device ops under pid 1."""
+    import glob
+    import os
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), '..'))
+    from paddle_tpu import profiler as prof
+
+    with open(profile_path) as f:
+        data = json.load(f)
+    events = list(data.get('traceEvents', []))
+    events.append({'name': 'process_name', 'ph': 'M', 'pid': 0,
+                   'args': {'name': 'host (RecordEvent)'}})
+    events.append({'name': 'process_name', 'ph': 'M', 'pid': 1,
+                   'args': {'name': 'device (XLA ops)'}})
+
+    op_map = {}
+    if hlo_dir and os.path.isdir(hlo_dir):
+        texts = [open(fn).read()
+                 for fn in sorted(glob.glob(os.path.join(hlo_dir, '*.txt')))]
+        op_map = prof.hlo_op_map(texts)
+    dev_events = prof.device_op_events(xplane_dir, op_map)
+    # rebase both streams to their own start: host ts is
+    # perf_counter-epoch, device ts is unix-epoch — unaligned clocks
+    # would render the two pids an epoch apart in chrome://tracing
+    host_base = min((e['ts'] for e in events if 'ts' in e), default=0.0)
+    for e in events:
+        if 'ts' in e:
+            e['ts'] -= host_base
+    dev_base = min((s for _, s, _ in dev_events), default=0) / 1e3
+    for label, start_ns, dur_ns in dev_events:
+        events.append({'name': label, 'cat': 'device', 'ph': 'X',
+                       'ts': start_ns / 1e3 - dev_base,
+                       'dur': dur_ns / 1e3, 'pid': 1, 'tid': 0})
+    events.sort(key=lambda e: e.get('ts', 0))
+    with open(timeline_path, 'w') as f:
+        json.dump({'traceEvents': events}, f,
+                  indent=4 if pretty else None)
+    return timeline_path
+
+
 def main():
     parser = argparse.ArgumentParser()
     parser.add_argument('--profile_path', required=True)
     parser.add_argument('--timeline_path', required=True)
+    parser.add_argument('--xplane_dir', default=None,
+                        help='merge the device stream from this '
+                             'jax.profiler capture dir')
+    parser.add_argument('--hlo_dir', default=None,
+                        help='compiled-HLO dump dir (profiler writes '
+                             '<profile_path>.hlo) for instr->op naming')
     parser.add_argument('--pretty', action='store_true')
     args = parser.parse_args()
-    print(convert(args.profile_path, args.timeline_path, args.pretty))
+    if args.xplane_dir:
+        print(merge_device_stream(args.profile_path, args.timeline_path,
+                                  args.xplane_dir, args.hlo_dir,
+                                  args.pretty))
+    else:
+        print(convert(args.profile_path, args.timeline_path, args.pretty))
 
 
 if __name__ == '__main__':
