@@ -21,6 +21,17 @@ live set so stragglers don't deadlock (reference rpc_server.cc
 DecreaseClientNum), and the server shuts down once every trainer
 completed.
 
+Liveness (round-4): a trainer that dies WITHOUT sending COMPLETE used
+to stall every barrier forever. Every message now refreshes the
+trainer's last-seen time, and barrier evaluation retires any trainer
+silent for longer than `rpc_deadline` seconds (FLAGS_rpc_deadline —
+the reference's client-side deadline, operators/distributed/
+rpc_client.cc FLAGS_rpc_deadline, applied server-side where this
+design keeps the round state). Retired-dead trainers are recorded in
+`dead_tids`; the cluster finishes with the survivors instead of
+deadlocking, and the server can shut down once every trainer is
+accounted for (completed or dead).
+
 Sparse merge: SelectedRows from several trainers concatenate rows/values
 (duplicate rows are legal — optimizer scatter-adds merge them), then
 values scale by 1/num_trainers in sync mode.
@@ -36,12 +47,16 @@ __all__ = ['ParameterService']
 
 class ParameterService(object):
     def __init__(self, num_trainers, sync_mode, get_param, run_round,
-                 run_one_grad=None, prefetch=None, save_params=None):
+                 run_one_grad=None, prefetch=None, save_params=None,
+                 rpc_deadline=None):
         """get_param(name) -> value; run_round(merged: {grad: value});
         run_one_grad(grad_name, value) for async; prefetch(table, ids);
         save_params(dirname) checkpoints this server's shard (the
         reference's RequestCheckpointHandler running the save block —
-        listen_and_serv_op.cc:251 checkpoint_point_block_id)."""
+        listen_and_serv_op.cc:251 checkpoint_point_block_id).
+        rpc_deadline: seconds of silence after which a trainer is
+        declared dead and retired (None -> FLAGS_rpc_deadline)."""
+        import time
         self.num_trainers = num_trainers
         self.sync_mode = sync_mode
         self._get_param = get_param
@@ -49,6 +64,14 @@ class ParameterService(object):
         self._run_round = run_round
         self._run_one_grad = run_one_grad
         self._prefetch = prefetch
+        if rpc_deadline is None:
+            from ..flags import get_flag
+            rpc_deadline = float(get_flag('rpc_deadline', 180.0))
+        self.rpc_deadline = rpc_deadline
+        # a trainer that has NEVER connected gets the larger of the
+        # deadline and this grace: process spawn + jit compile of the
+        # first step must not count as "silent death"
+        self.first_contact_grace = max(rpc_deadline, 120.0)
 
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
@@ -57,11 +80,69 @@ class ParameterService(object):
         self._trainer_rounds = {}     # tid -> rounds contributed
         self._completed_rounds = 0
         self._done_tids = set()
+        self.dead_tids = set()        # retired by the liveness deadline
         self._error = None
+        # every expected trainer's clock starts now: one that NEVER
+        # connects must still be retireable
+        self._start = time.monotonic()
+        self._last_seen = {}          # tid -> monotonic last message
 
     # -- helpers -----------------------------------------------------------
     def _live_count(self):
         return self.num_trainers - len(self._done_tids)
+
+    def _touch(self, tid):
+        import time
+        with self._lock:
+            self._last_seen[tid] = time.monotonic()
+
+    def _retire_dead_locked(self):
+        """Retire every trainer silent past the deadline (the silent-
+        death path: no COMPLETE will ever come). Known tids use their
+        last message time; never-connected tids use service start."""
+        import time
+        now = time.monotonic()
+        changed = False
+        for tid in range(self.num_trainers):
+            if tid in self._done_tids:
+                continue
+            if tid in self._last_seen:
+                seen, limit = self._last_seen[tid], self.rpc_deadline
+            else:
+                seen, limit = self._start, self.first_contact_grace
+            if now - seen > limit:
+                self._done_tids.add(tid)
+                self.dead_tids.add(tid)
+                self._barrier_tids.discard(tid)
+                # a trainer that died MID-PUSH must not contribute its
+                # stale partial gradients to a round it never
+                # barriered into
+                for per_tid in self._pending.values():
+                    per_tid.pop(tid, None)
+                changed = True
+        if changed:
+            self._maybe_run_round_locked()
+            self._cond.notify_all()
+        return changed
+
+    def _check_not_dead(self, tid):
+        """Reject messages from a trainer already retired by the
+        deadline: a slow-but-alive 'zombie' must fail loudly (the
+        client surfaces the REPLY_ERR) instead of silently joining
+        rounds whose live set no longer counts it."""
+        if tid in self.dead_tids:
+            raise RuntimeError(
+                'trainer %d was retired by the liveness deadline '
+                '(%.0f s silent) and may not rejoin this sync session'
+                % (tid, self.rpc_deadline))
+
+    def check_liveness(self):
+        """Periodic liveness sweep (PSServer reaper thread). Returns
+        True when every trainer is accounted for (completed or dead) —
+        the server's shutdown condition."""
+        with self._lock:
+            self._retire_dead_locked()
+            return len(self._done_tids) >= self.num_trainers
 
     def _merge(self, values):
         """Merge one grad's per-trainer values: sum, then average over the
@@ -101,15 +182,27 @@ class ParameterService(object):
     def _wait_for_trainer_round_locked(self, tid):
         """Block until every round this trainer contributed to is applied
         (its own GET arrives, by per-connection ordering, after its
-        BATCH_BARRIER)."""
+        BATCH_BARRIER). Each wakeup sweeps for dead peers so a silently
+        dying trainer cannot stall the waiters forever."""
+        import time
         while self._completed_rounds < self._trainer_rounds.get(tid, 0):
             if self._error is not None:
                 raise RuntimeError('pserver optimize failed: %s'
                                    % self._error)
+            # the waiter itself is NOT silent — it has an in-flight
+            # request parked here; without this refresh a long round
+            # wait would get the live waiter retired as dead
+            self._last_seen[tid] = time.monotonic()
+            self._retire_dead_locked()
+            if self._completed_rounds >= self._trainer_rounds.get(tid, 0):
+                break
             self._cond.wait(timeout=1.0)
 
     # -- service interface (called from PSServer threads) ------------------
     def on_send_var(self, name, tid, value):
+        self._touch(tid)
+        with self._lock:
+            self._check_not_dead(tid)
         if not self.sync_mode and self._run_one_grad is not None:
             with self._lock:
                 self._run_one_grad(name, value)
@@ -118,18 +211,27 @@ class ParameterService(object):
             self._pending.setdefault(name, {})[tid] = value
 
     def on_batch_barrier(self, tid):
+        self._touch(tid)
+        with self._lock:
+            self._check_not_dead(tid)
         with self._lock:
             self._barrier_tids.add(tid)
             self._trainer_rounds[tid] = self._trainer_rounds.get(tid, 0) + 1
             self._maybe_run_round_locked()
 
     def on_get_var(self, name, tid):
+        self._touch(tid)
+        with self._lock:
+            self._check_not_dead(tid)
         with self._lock:
             if self.sync_mode:
                 self._wait_for_trainer_round_locked(tid)
             return self._get_param(name)
 
     def on_prefetch(self, name, tid, ids):
+        self._touch(tid)
+        with self._lock:
+            self._check_not_dead(tid)
         if self._prefetch is None:
             raise RuntimeError('this pserver hosts no lookup table')
         with self._lock:
@@ -138,6 +240,9 @@ class ParameterService(object):
             return self._prefetch(name, np.asarray(ids))
 
     def on_checkpoint(self, dirname, tid):
+        self._touch(tid)
+        with self._lock:
+            self._check_not_dead(tid)
         if self._save_params is None:
             raise RuntimeError('this pserver has no checkpoint support')
         with self._lock:
@@ -146,9 +251,10 @@ class ParameterService(object):
             self._save_params(dirname)
 
     def on_fetch_barrier(self, tid):
-        pass    # round already closed by the sync wait in on_get_var
+        self._touch(tid)  # round already closed by the on_get_var wait
 
     def on_complete(self, tid):
+        self._touch(tid)
         with self._lock:
             self._done_tids.add(tid)
             self._barrier_tids.discard(tid)

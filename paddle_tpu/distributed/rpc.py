@@ -146,9 +146,15 @@ class PSServer(object):
     def serve_forever(self):
         """Accept + dispatch until the service reports all trainers
         complete (the RunSyncLoop exit condition, listen_and_serv_op.cc:
-        exit_flag on COMPLETE messages)."""
+        exit_flag on COMPLETE messages). A reaper thread sweeps trainer
+        liveness (service.check_liveness) so a silently-dead trainer is
+        retired after its rpc_deadline and the server still exits —
+        the round-4 no-silent-deadlock guarantee."""
         accept_t = threading.Thread(target=self._accept_loop, daemon=True)
         accept_t.start()
+        if hasattr(self.service, 'check_liveness'):
+            reaper = threading.Thread(target=self._reap_loop, daemon=True)
+            reaper.start()
         self._done.wait()
         try:
             self._lsock.close()
@@ -156,6 +162,21 @@ class PSServer(object):
             pass
         for t in self._threads:
             t.join(timeout=5.0)
+
+    def _reap_loop(self):
+        warned = False
+        while not self._done.is_set():
+            try:
+                if self.service.check_liveness():
+                    self.shutdown()
+                    return
+            except Exception as e:
+                if not warned:   # a broken sweep must not fail silently
+                    import sys
+                    print('pserver liveness sweep failed: %r' % e,
+                          file=sys.stderr)
+                    warned = True
+            self._done.wait(timeout=1.0)
 
     def shutdown(self):
         self._done.set()

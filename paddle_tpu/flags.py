@@ -52,6 +52,11 @@ _DEFAULTS = {
     # in pallas/flash_attention.py:_block_sizes)
     'flash_block_q': 0,
     'flash_block_k': 0,
+    # seconds of trainer silence before a pserver declares it dead and
+    # retires it from sync rounds (reference FLAGS_rpc_deadline,
+    # operators/distributed/rpc_client.cc — applied server-side here
+    # where the round state lives)
+    'rpc_deadline': 180.0,
 }
 
 _FLAGS = dict(_DEFAULTS)

@@ -183,7 +183,14 @@ def main():
     prog = t.get_trainer_program()
     rng = np.random.RandomState(0)
     losses = []
-    for _ in range(steps):
+    # PS_DIE_AFTER=k: this trainer dies SILENTLY (no COMPLETE, no
+    # socket shutdown handshake) after k steps — the rpc_deadline
+    # fault-tolerance test's murder weapon
+    die_after = int(os.environ.get('PS_DIE_AFTER', 0))
+    for step in range(steps):
+        if die_after and trainer_id == int(
+                os.environ.get('PS_DIE_TID', 1)) and step == die_after:
+            os._exit(137)
         gbatch = make_batch(model, rng, BATCH_PER_TRAINER * trainers)
         lo = trainer_id * BATCH_PER_TRAINER
         batch = {k: v[lo:lo + BATCH_PER_TRAINER] for k, v in gbatch.items()}

@@ -191,3 +191,53 @@ def test_checkpoint_notify_saves_pserver_shards(tmp_path):
         np.testing.assert_allclose(
             final2[pname].reshape(-1), want.reshape(-1), rtol=1e-5,
             atol=1e-6, err_msg='restored param %s diverged' % pname)
+
+
+@pytest.mark.timeout(300)
+def test_sync_cluster_survives_silent_trainer_death():
+    """Round-4 liveness (reference FLAGS_rpc_deadline model,
+    operators/distributed/rpc_client.cc): trainer 1 dies silently
+    (no COMPLETE, os._exit) mid-round. The pserver must retire it at
+    the deadline, the surviving trainer must finish ALL its steps, and
+    every surviving process must exit cleanly — no silent deadlock."""
+    import time as _time
+    eps = ','.join('127.0.0.1:%d' % p for p in _free_ports(2))
+    base_env = dict(os.environ)
+    base_env.pop('JAX_PLATFORMS', None)
+    base_env.pop('XLA_FLAGS', None)
+    base_env.update({'PS_MODEL': 'mlp', 'PS_ENDPOINTS': eps,
+                     'PS_TRAINERS': '2', 'PS_STEPS': '6',
+                     'PS_SYNC': '1', 'PS_OPTIMIZER': 'sgd',
+                     'PS_DIE_AFTER': '2', 'PS_DIE_TID': '1',
+                     'FLAGS_rpc_deadline': '4'})
+    procs = []
+    for i in range(2):
+        env = dict(base_env, PS_ROLE='pserver', PS_PSERVER_ID=str(i))
+        procs.append(subprocess.Popen(
+            [sys.executable, _WORKER], env=env, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True))
+    tprocs = []
+    for i in range(2):
+        env = dict(base_env, PS_ROLE='trainer', PS_TRAINER_ID=str(i))
+        tprocs.append(subprocess.Popen(
+            [sys.executable, _WORKER], env=env, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True))
+
+    t0 = _time.monotonic()
+    out0, _ = tprocs[0].communicate(timeout=180)
+    out1, _ = tprocs[1].communicate(timeout=60)
+    survivor_wall = _time.monotonic() - t0
+    assert tprocs[1].returncode == 137, out1[-2000:]     # died as scripted
+    assert tprocs[0].returncode == 0, out0[-4000:]       # survivor finished
+    line = [ln for ln in out0.splitlines() if ln.startswith('RESULT ')]
+    assert line, out0[-4000:]
+    result = json.loads(line[-1][len('RESULT '):])
+    assert len(result['losses']) == 6                    # ALL steps ran
+    assert all(np.isfinite(result['losses']))
+    # pservers must exit (reaper accounts for the dead trainer)
+    for p in procs:
+        out, _ = p.communicate(timeout=60)
+        assert p.returncode == 0, out[-4000:]
+    # and the whole recovery happened on the deadline's timescale,
+    # not a 120 s socket timeout
+    assert survivor_wall < 60, survivor_wall

@@ -85,3 +85,26 @@ def test_pe_strategies_accepted():
     data = _data(3, 16)
     for xb, yb in data:
         pe.run(fetch_list=[loss.name], feed={'x': xb, 'y': yb})
+
+
+def test_scaling_harness_and_collective_audit():
+    """Round-4 scaling harness (tools/bench_suite.py run_scaling): the
+    weak-scaling points exist for 1..8 devices and the HLO collective
+    audit proves the per-gradient all-reduces coalesce into one tuple
+    collective (the whole-block-jit design's answer to the reference's
+    fused_all_reduce build strategy)."""
+    import sys
+    import os
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        'tools'))
+    import bench_suite
+
+    out = bench_suite.run_scaling('mnist', steps=1, full=False)
+    devs = [p['devices'] for p in out['points']]
+    assert devs == [1, 2, 4, 8]
+    assert all(p['step_ms'] > 0 for p in out['points'])
+    audit = out['collective_audit']
+    ar = audit.get('all-reduce')
+    assert ar and ar['count'] >= 1 and ar['total_mb'] > 0
+    assert audit['grad_allreduce_coalesced']   # 6 params, 1 collective
