@@ -86,6 +86,7 @@ class ParameterService(object):
         # connects must still be retireable
         self._start = time.monotonic()
         self._last_seen = {}          # tid -> monotonic last message
+        self._barrier_ever = set()    # tids past their FIRST barrier
 
     # -- helpers -----------------------------------------------------------
     def _live_count(self):
@@ -106,10 +107,13 @@ class ParameterService(object):
         for tid in range(self.num_trainers):
             if tid in self._done_tids:
                 continue
-            if tid in self._last_seen:
-                seen, limit = self._last_seen[tid], self.rpc_deadline
-            else:
-                seen, limit = self._start, self.first_contact_grace
+            # the tight deadline applies only once a trainer is in
+            # steady state (past its FIRST barrier): startup still
+            # includes client-side program compile AFTER the initial
+            # param pull, which must not count as silent death
+            seen = self._last_seen.get(tid, self._start)
+            limit = (self.rpc_deadline if tid in self._barrier_ever
+                     else self.first_contact_grace)
             if now - seen > limit:
                 self._done_tids.add(tid)
                 self.dead_tids.add(tid)
@@ -198,54 +202,53 @@ class ParameterService(object):
                 break
             self._cond.wait(timeout=1.0)
 
+    def _enter_locked(self, tid):
+        """Touch + liveness check under the CALLER's lock: check and
+        state mutation must be one atomic section, or a handler thread
+        descheduled between them can re-insert a retired trainer's
+        state after the reaper cleaned it."""
+        import time
+        self._last_seen[tid] = time.monotonic()
+        self._check_not_dead(tid)
+
     # -- service interface (called from PSServer threads) ------------------
     def on_send_var(self, name, tid, value):
-        self._touch(tid)
         with self._lock:
-            self._check_not_dead(tid)
-        if not self.sync_mode and self._run_one_grad is not None:
-            with self._lock:
+            self._enter_locked(tid)
+            if not self.sync_mode and self._run_one_grad is not None:
                 self._run_one_grad(name, value)
-            return
-        with self._lock:
+                return
             self._pending.setdefault(name, {})[tid] = value
 
     def on_batch_barrier(self, tid):
-        self._touch(tid)
         with self._lock:
-            self._check_not_dead(tid)
-        with self._lock:
+            self._enter_locked(tid)
+            self._barrier_ever.add(tid)
             self._barrier_tids.add(tid)
             self._trainer_rounds[tid] = self._trainer_rounds.get(tid, 0) + 1
             self._maybe_run_round_locked()
 
     def on_get_var(self, name, tid):
-        self._touch(tid)
         with self._lock:
-            self._check_not_dead(tid)
-        with self._lock:
+            self._enter_locked(tid)
             if self.sync_mode:
                 self._wait_for_trainer_round_locked(tid)
             return self._get_param(name)
 
     def on_prefetch(self, name, tid, ids):
-        self._touch(tid)
-        with self._lock:
-            self._check_not_dead(tid)
         if self._prefetch is None:
             raise RuntimeError('this pserver hosts no lookup table')
         with self._lock:
+            self._enter_locked(tid)
             if self.sync_mode:
                 self._wait_for_trainer_round_locked(tid)
             return self._prefetch(name, np.asarray(ids))
 
     def on_checkpoint(self, dirname, tid):
-        self._touch(tid)
-        with self._lock:
-            self._check_not_dead(tid)
         if self._save_params is None:
             raise RuntimeError('this pserver has no checkpoint support')
         with self._lock:
+            self._enter_locked(tid)
             if self.sync_mode:
                 self._wait_for_trainer_round_locked(tid)
             self._save_params(dirname)

@@ -1,0 +1,563 @@
+"""Registry-driven gradient sweep (VERDICT round-4 #6; reference
+posture: tests/unittests/op_test.py:392 check_grad as the default
+across ~200 op-test files).
+
+One parametrized test numeric-checks the registered gradient of every
+differentiable forward op in the registry against central finite
+differences, from a per-op example-config table. A completeness test
+walks the registry and fails if any differentiable op is neither in
+this table, nor grad-checked by another test file (auto-scanned), nor
+on the documented exception list.
+"""
+from __future__ import annotations
+
+import glob
+import os
+import re
+
+import numpy as np
+import pytest
+
+from op_test import OpTest
+from paddle_tpu import registry
+
+_R = np.random.RandomState
+
+
+def _pos(rng, *shape):
+    return (rng.rand(*shape) * 0.8 + 0.3).astype('float32')
+
+
+def _signed(rng, *shape):
+    """Values bounded away from 0 and kink points of common
+    activations (|x| in [0.2, 1.0])."""
+    s = rng.rand(*shape).astype('float32') * 0.8 + 0.2
+    return s * np.where(rng.rand(*shape) < 0.5, -1.0, 1.0).astype('f4')
+
+
+def _distinct(rng, *shape):
+    """All-distinct values (max/min-style kinks need a unique winner)."""
+    n = int(np.prod(shape))
+    vals = (np.arange(n, dtype='float32') / n + 0.05
+            + rng.rand(n).astype('f4') * 0.02 / n)
+    rng.shuffle(vals)
+    return vals.reshape(shape)
+
+
+# op -> config dict:
+#   inputs / attrs / outputs(optional slot->name list) /
+#   check (input slots to grad-check) / kwargs for check_grad
+def _configs():
+    rng = _R(7)
+    x34 = _signed(rng, 3, 4)
+    y34 = _signed(rng, 3, 4)
+    cfg = {}
+
+    # ---- unary elementwise (smooth, generic ranges) -------------------
+    unary_smooth = {
+        'sigmoid': {}, 'logsigmoid': {}, 'tanh': {}, 'softplus': {},
+        'softsign': {}, 'exp': {}, 'sin': {}, 'cos': {}, 'square': {},
+        'gelu': {}, 'stanh': {'scale_a': 0.67, 'scale_b': 1.7159},
+        'swish': {'beta': 1.0}, 'elu': {'alpha': 1.0},
+        'cumsum': {'axis': 1},
+    }
+    for op, attrs in unary_smooth.items():
+        cfg[op] = dict(inputs={'X': _signed(_R(hash(op) % 1000), 3, 4)},
+                       attrs=attrs, check=['X'])
+    # positive-domain unaries
+    for op, attrs in {'log': {}, 'sqrt': {}, 'rsqrt': {},
+                      'reciprocal': {},
+                      'pow': {'factor': 2.0}}.items():
+        cfg[op] = dict(inputs={'X': _pos(_R(hash(op) % 1000), 3, 4)},
+                       attrs=attrs, check=['X'])
+    # kinked unaries: inputs away from their kink points
+    cfg['abs'] = dict(inputs={'X': x34}, check=['X'])
+    cfg['relu'] = dict(inputs={'X': x34}, check=['X'])
+    cfg['leaky_relu'] = dict(inputs={'X': x34},
+                             attrs={'alpha': 0.1}, check=['X'])
+    cfg['relu6'] = dict(inputs={'X': x34}, check=['X'])
+    cfg['brelu'] = dict(inputs={'X': x34},
+                        attrs={'t_min': -5.0, 't_max': 5.0}, check=['X'])
+    cfg['hard_shrink'] = dict(inputs={'X': 3.0 * x34},
+                              attrs={'threshold': 0.5}, check=['X'])
+    cfg['softshrink'] = dict(inputs={'X': 3.0 * x34},
+                             attrs={'lambda': 0.5}, check=['X'])
+    cfg['tanh_shrink'] = dict(inputs={'X': x34}, check=['X'])
+    cfg['thresholded_relu'] = dict(inputs={'X': 3.0 * x34},
+                                   attrs={'threshold': 1.0}, check=['X'])
+    cfg['hard_sigmoid'] = dict(inputs={'X': 0.4 * x34},
+                               attrs={'slope': 0.2, 'offset': 0.5},
+                               check=['X'])
+    cfg['logit'] = dict(inputs={'X': np.clip(_pos(rng, 3, 4), 0.2, 0.8)},
+                        attrs={'eps': 1e-6}, check=['X'])
+    # piecewise-constant: analytic and numeric grads are both ~0 away
+    # from the jumps
+    cfg['ceil'] = dict(inputs={'X': x34 + 0.5}, check=['X'],
+                       kwargs={'numeric_delta': 1e-3})
+    cfg['floor'] = dict(inputs={'X': x34 + 0.5}, check=['X'],
+                        kwargs={'numeric_delta': 1e-3})
+    cfg['round'] = dict(inputs={'X': x34 + 0.2}, check=['X'],
+                        kwargs={'numeric_delta': 1e-3})
+    cfg['assign'] = dict(inputs={'X': x34}, check=['X'])
+    cfg['cast'] = dict(inputs={'X': x34},
+                       attrs={'out_dtype': 'float32'}, check=['X'])
+    cfg['clip'] = dict(inputs={'X': 3.0 * x34},
+                       attrs={'min': -1.2, 'max': 1.2}, check=['X'])
+    cfg['clip_by_norm'] = dict(inputs={'X': x34},
+                               attrs={'max_norm': 1.0}, check=['X'])
+    cfg['scale'] = dict(inputs={'X': x34},
+                        attrs={'scale': 2.5, 'bias': 0.5}, check=['X'])
+    cfg['label_smooth'] = dict(
+        inputs={'X': _pos(rng, 3, 4)},
+        attrs={'epsilon': 0.1}, check=['X'])
+
+    # ---- binary elementwise ------------------------------------------
+    # X and Y interleave on a fixed lattice: the min |X-Y| gap is
+    # 1/(2n), far above the finite-difference delta (no kink crossing)
+    lat = np.arange(12, dtype='float32') / 12
+    xmm = _R(1).permutation(lat).reshape(3, 4) + 0.05
+    ymm = _R(2).permutation(lat).reshape(3, 4) + 0.05 + 1.0 / 24
+    for op in ('elementwise_max', 'elementwise_min'):
+        cfg[op] = dict(inputs={'X': xmm, 'Y': ymm},
+                       attrs={'axis': -1}, check=['X', 'Y'])
+    cfg['elementwise_pow'] = dict(
+        inputs={'X': _pos(_R(3), 3, 4), 'Y': _pos(_R(4), 3, 4) + 1.0},
+        attrs={'axis': -1}, check=['X', 'Y'])
+    cfg['elementwise_mod'] = dict(
+        inputs={'X': _pos(_R(5), 3, 4) * 3, 'Y': _pos(_R(6), 3, 4) + 1},
+        attrs={'axis': -1}, check=['X'],
+        kwargs={'numeric_delta': 1e-3})
+    cfg['elementwise_floordiv'] = dict(
+        inputs={'X': _pos(_R(7), 3, 4) * 3 + 0.1,
+                'Y': np.full((3, 4), 0.7, 'f4')},
+        attrs={'axis': -1}, check=['X'],
+        kwargs={'numeric_delta': 1e-3})
+
+    # ---- shape/movement ----------------------------------------------
+    cfg['reshape'] = dict(inputs={'X': x34},
+                          attrs={'shape': [2, 6]}, check=['X'])
+    cfg['reshape2'] = dict(inputs={'X': x34},
+                           attrs={'shape': [4, 3]},
+                           outputs={'Out': ['r2_out'],
+                                    'XShape': ['r2_xs']},
+                           check=['X'],
+                           kwargs={'output_names': 'r2_out'})
+    cfg['squeeze'] = dict(inputs={'X': x34.reshape(3, 1, 4)},
+                          attrs={'axes': [1]}, check=['X'])
+    cfg['squeeze2'] = dict(inputs={'X': x34.reshape(3, 1, 4)},
+                           attrs={'axes': [1]},
+                           outputs={'Out': ['sq2_out'],
+                                    'XShape': ['sq2_xs']},
+                           check=['X'],
+                           kwargs={'output_names': 'sq2_out'})
+    cfg['unsqueeze'] = dict(inputs={'X': x34}, attrs={'axes': [1]},
+                            check=['X'])
+    cfg['unsqueeze2'] = dict(inputs={'X': x34}, attrs={'axes': [0]},
+                             outputs={'Out': ['us2_out'],
+                                      'XShape': ['us2_xs']},
+                             check=['X'],
+                             kwargs={'output_names': 'us2_out'})
+    cfg['transpose'] = dict(inputs={'X': x34},
+                            attrs={'axis': [1, 0]}, check=['X'])
+    cfg['transpose2'] = dict(inputs={'X': x34},
+                             attrs={'axis': [1, 0]},
+                             outputs={'Out': ['t2_out'],
+                                      'XShape': ['t2_xs']},
+                             check=['X'],
+                             kwargs={'output_names': 't2_out'})
+    cfg['reverse'] = dict(inputs={'X': x34}, attrs={'axis': [1]},
+                          check=['X'])
+    cfg['expand'] = dict(inputs={'X': x34.reshape(3, 4)},
+                         attrs={'expand_times': [2, 1]}, check=['X'])
+    cfg['stack'] = dict(
+        inputs={'X': [('st_a', x34), ('st_b', y34)]},
+        attrs={'axis': 0}, outputs={'Y': ['stack_y']},
+        check=['st_a', 'st_b'])
+    cfg['split'] = dict(
+        inputs={'X': x34},
+        attrs={'num': 2, 'axis': 1},
+        outputs={'Out': [('sp_a', x34[:, :2]), ('sp_b', x34[:, 2:])]},
+        check=['X'])
+    cfg['slice'] = dict(inputs={'Input': x34},
+                        attrs={'axes': [1], 'starts': [1], 'ends': [3]},
+                        check=['Input'])
+    cfg['pad'] = dict(inputs={'X': x34},
+                      attrs={'paddings': [1, 0, 0, 2],
+                             'pad_value': 0.0},
+                      check=['X'])
+    cfg['gather'] = dict(
+        inputs={'X': x34,
+                'Index': np.array([0, 2], 'int64')},
+        check=['X'])
+    cfg['scatter'] = dict(
+        inputs={'X': x34.copy(),
+                'Ids': np.array([0, 2], 'int64'),
+                'Updates': _signed(_R(8), 2, 4)},
+        check=['X', 'Updates'])
+    cfg['where'] = dict(
+        inputs={'Cond': (x34 > 0), 'X': x34, 'Y': y34},
+        check=['X', 'Y'])
+    cfg['concat'] = dict(
+        inputs={'X': [('cc_a', x34), ('cc_b', y34)]},
+        attrs={'axis': 1}, check=['cc_a', 'cc_b'])
+
+    # ---- reductions ---------------------------------------------------
+    cfg['reduce_max'] = dict(inputs={'X': _distinct(_R(9), 3, 4)},
+                             attrs={'dim': [1], 'keep_dim': False},
+                             check=['X'])
+    cfg['reduce_min'] = dict(inputs={'X': _distinct(_R(10), 3, 4)},
+                             attrs={'dim': [1], 'keep_dim': False},
+                             check=['X'])
+    cfg['reduce_prod'] = dict(inputs={'X': _pos(_R(11), 3, 3)},
+                              attrs={'dim': [1], 'keep_dim': False},
+                              check=['X'])
+
+    # ---- losses -------------------------------------------------------
+    cfg['log_loss'] = dict(
+        inputs={'Predicted': np.clip(_pos(rng, 4, 1), 0.2, 0.8),
+                'Labels': (rng.rand(4, 1) > 0.5).astype('f4')},
+        attrs={'epsilon': 1e-4},
+        outputs={'Loss': ['ll_loss']}, check=['Predicted'])
+    cfg['huber_loss'] = dict(
+        inputs={'X': _signed(_R(12), 4, 1), 'Y': _signed(_R(13), 4, 1)},
+        attrs={'delta': 2.0},
+        outputs={'Out': ['hub_out'], 'Residual': ['hub_res']},
+        check=['X'], kwargs={'output_names': 'hub_out'})
+    cfg['modified_huber_loss'] = dict(
+        inputs={'X': 0.3 * _signed(_R(14), 4, 1),
+                'Y': (rng.rand(4, 1) > 0.5).astype('f4')},
+        outputs={'Out': ['mh_out'],
+                 'IntermediateVal': ['mh_tmp']},
+        check=['X'], kwargs={'output_names': 'mh_out'})
+    cfg['smooth_l1_loss'] = dict(
+        inputs={'X': _signed(_R(15), 4, 3), 'Y': _signed(_R(16), 4, 3)},
+        attrs={'sigma': 1.0},
+        outputs={'Out': ['sml_out'], 'Diff': ['sml_diff']},
+        check=['X'], kwargs={'output_names': 'sml_out'})
+    cfg['square_error_cost'] = dict(
+        inputs={'X': x34, 'Y': y34}, check=['X', 'Y'])
+    cfg['squared_l2_distance'] = dict(
+        inputs={'X': x34, 'Y': y34},
+        outputs={'Out': ['sqd_out'], 'sub_result': ['sqd_sub']},
+        check=['X', 'Y'], kwargs={'output_names': 'sqd_out'})
+    cfg['squared_l2_norm'] = dict(inputs={'X': x34}, check=['X'])
+    cfg['rank_loss'] = dict(
+        inputs={'Label': (rng.rand(4, 1) > 0.5).astype('f4'),
+                'Left': _signed(_R(17), 4, 1),
+                'Right': _signed(_R(18), 4, 1)},
+        check=['Left', 'Right'])
+    cfg['hinge_loss'] = dict(
+        inputs={'Logits': 0.3 * _signed(_R(19), 4, 1),
+                'Labels': (rng.rand(4, 1) > 0.5).astype('f4')},
+        outputs={'Loss': ['hl_loss']}, check=['Logits'])
+
+    # ---- nn -----------------------------------------------------------
+    cfg['batch_norm'] = dict(
+        inputs={'X': _signed(_R(20), 2, 3, 2, 2),
+                'Scale': _pos(_R(21), 3), 'Bias': _signed(_R(22), 3),
+                'Mean': np.zeros(3, 'f4'),
+                'Variance': np.ones(3, 'f4')},
+        # inference path: in TRAIN mode both sum(Y) and sum(Y^2) are
+        # constants in X (normalization symmetry), so finite
+        # differences see only noise; the stats-dependent train-mode
+        # gradient is exercised by the convergence tests (LeNet/ResNet
+        # overfit to ~0 loss through dozens of BN layers)
+        attrs={'epsilon': 1e-5, 'is_test': True},
+        outputs={'Y': ['bn_y'], 'MeanOut': ['bn_m'],
+                 'VarianceOut': ['bn_v'], 'SavedMean': ['bn_sm'],
+                 'SavedVariance': ['bn_sv']},
+        check=['X', 'Scale', 'Bias'],
+        kwargs={'output_names': 'bn_y',
+                'max_relative_error': 0.02})
+    cfg['lrn'] = dict(
+        inputs={'X': _pos(_R(23), 2, 5, 3, 3)},
+        attrs={'n': 3, 'alpha': 1e-2, 'beta': 0.75, 'k': 1.0},
+        outputs={'Out': ['lrn_out'], 'MidOut': ['lrn_mid']},
+        check=['X'], kwargs={'output_names': 'lrn_out',
+                             'max_relative_error': 0.02})
+    cfg['prelu'] = dict(
+        inputs={'X': _signed(_R(24), 2, 3, 2, 2),
+                'Alpha': _pos(_R(25), 1)},
+        attrs={'mode': 'all'}, check=['X', 'Alpha'])
+    cfg['conv2d_transpose'] = dict(
+        inputs={'Input': _signed(_R(26), 1, 2, 3, 3),
+                'Filter': 0.5 * _signed(_R(27), 2, 2, 3, 3)},
+        attrs={'strides': [2, 2], 'paddings': [0, 0],
+               'dilations': [1, 1], 'groups': 1},
+        outputs={'Output': ['conv2d_transpose_out']},
+        check=['Input', 'Filter'],
+        kwargs={'output_names': 'conv2d_transpose_out',
+                'max_relative_error': 0.02})
+    cfg['conv3d_transpose'] = dict(
+        inputs={'Input': _signed(_R(28), 1, 2, 2, 2, 2),
+                'Filter': 0.5 * _signed(_R(29), 2, 1, 2, 2, 2)},
+        attrs={'strides': [1, 1, 1], 'paddings': [0, 0, 0],
+               'dilations': [1, 1, 1], 'groups': 1},
+        outputs={'Output': ['conv3d_transpose_out']},
+        check=['Input', 'Filter'],
+        kwargs={'output_names': 'conv3d_transpose_out',
+                'max_relative_error': 0.02})
+    cfg['depthwise_conv2d'] = dict(
+        inputs={'Input': _signed(_R(30), 1, 3, 4, 4),
+                'Filter': 0.5 * _signed(_R(31), 3, 1, 2, 2)},
+        attrs={'strides': [1, 1], 'paddings': [0, 0],
+               'dilations': [1, 1], 'groups': 3},
+        outputs={'Output': ['depthwise_conv2d_out']},
+        check=['Input', 'Filter'],
+        kwargs={'output_names': 'depthwise_conv2d_out',
+                'max_relative_error': 0.02})
+    cfg['max_pool2d_with_index'] = dict(
+        inputs={'X': _distinct(_R(32), 1, 2, 4, 4)},
+        attrs={'ksize': [2, 2], 'strides': [2, 2], 'paddings': [0, 0]},
+        outputs={'Out': ['mpi_out'], 'Mask': ['mpi_mask']},
+        check=['X'], kwargs={'output_names': 'mpi_out'})
+    cfg['max_pool3d_with_index'] = dict(
+        inputs={'X': _distinct(_R(33), 1, 1, 2, 4, 4)},
+        attrs={'ksize': [1, 2, 2], 'strides': [1, 2, 2],
+               'paddings': [0, 0, 0]},
+        outputs={'Out': ['mpi3_out'], 'Mask': ['mpi3_mask']},
+        check=['X'], kwargs={'output_names': 'mpi3_out'})
+    cfg['im2sequence'] = dict(
+        inputs={'X': _signed(_R(34), 1, 2, 4, 4)},
+        attrs={'kernels': [2, 2], 'strides': [2, 2],
+               'paddings': [0, 0, 0, 0]},
+        outputs={'Out': ['i2s_out'], 'OutLens': ['i2s_lens']},
+        check=['X'], kwargs={'output_names': 'i2s_out'})
+    cfg['dropout'] = dict(
+        inputs={'X': x34},
+        attrs={'dropout_prob': 0.0, 'is_test': False},
+        outputs={'Out': ['do_out'], 'Mask': ['do_mask']},
+        check=['X'], kwargs={'output_names': 'do_out'})
+    cfg['cos_sim'] = dict(
+        inputs={'X': _signed(_R(35), 3, 4), 'Y': _signed(_R(36), 3, 4)},
+        outputs={'Out': ['cs_out'], 'XNorm': ['cs_xn'],
+                 'YNorm': ['cs_yn']},
+        check=['X', 'Y'],
+        kwargs={'output_names': 'cs_out',
+                'max_relative_error': 0.02})
+    cfg['mean'] = dict(inputs={'X': x34}, check=['X'])
+    cfg['sum'] = dict(
+        inputs={'X': [('sum_a', x34), ('sum_b', y34)]},
+        check=['sum_a', 'sum_b'])
+    cfg['position_embedding'] = dict(
+        inputs={'X': _signed(_R(38), 2, 3, 4),
+                'Pos': _signed(_R(39), 5, 4)},
+        check=['Pos'])
+    cfg['lookup_table'] = dict(
+        inputs={'W': _signed(_R(40), 6, 3),
+                'Ids': np.array([[1], [4], [2]], 'int64')},
+        check=['W'])
+    cfg['cross_entropy'] = dict(
+        inputs={'X': np.clip(_pos(_R(41), 3, 4), 0.1, 0.9),
+                'Label': np.array([[0], [3], [1]], 'int64')},
+        outputs={'Y': ['ce_y']},
+        check=['X'], kwargs={'output_names': 'ce_y'})
+
+    return cfg
+
+
+CONFIGS = _configs()
+
+# grads exercised by dedicated tests that do NOT go through the OpTest
+# check_grad harness (custom-vjp parity or end-to-end training tests);
+# the completeness check accepts these with the named evidence
+COVERED_ELSEWHERE = {
+    'flash_attention': 'tests/test_flash_attention.py grad parity vs '
+                       'naive reference',
+    'causal_mask': 'test_causal_mask_grad_composed in this file '
+                   '(through softmax; -1e9 fill swamps a direct sum)',
+    'fused_softmax_cross_entropy': 'tests/test_fused_xent.py grad '
+                                   'parity vs unfused pair',
+    'remat_block': 'tests/test_recompute.py parity + dropout-mask '
+                   'consistency',
+    'recurrent': 'tests/test_control_flow.py StaticRNN/DynamicRNN '
+                 'training convergence',
+    'sharding_constraint': 'tests/test_parallel_axes.py (identity '
+                           'grad; needs a device mesh)',
+    'warpctc': 'tests/test_sequence_ops.py CTC loss parity + training',
+    'linear_chain_crf': 'tests/test_sequence_ops.py CRF parity tests',
+    'nce': 'tests/test_inventory_grads.py sampled-loss training test',
+    'gru': 'tests/test_sequence_ops.py dynamic_gru parity/training',
+    'lstm': 'tests/test_sequence_ops.py dynamic_lstm parity/training',
+    'lstmp': 'tests/test_layer_api_complete.py dynamic_lstmp runs; '
+             'grad via shared lstm vjp machinery',
+    'gru_unit': 'tests/test_layer_api_complete.py;'
+                ' composed of checked primitives',
+    'lstm_unit': 'tests/test_layer_api_complete.py;'
+                 ' composed of checked primitives',
+    'moe_aux_loss': 'tests/test_moe_dispatch.py aux-loss training',
+    'moe_ffn': 'tests/test_round3_op_grads.py + test_moe_dispatch.py',
+    'conv2d_bn': 'tests/test_pallas_fused.py fused conv+bn parity '
+                 '(incl. backward)',
+    'fake_quantize': 'tests/test_inventory_grads.py STE grad test',
+    'ring_attention': 'tests/test_ring_attention.py + '
+                      'test_round3_op_grads.py',
+    'beam_gather': 'tests/test_contrib_decoder.py beam decode tests',
+    'bilinear_interp': 'tests/test_inventory_ops.py resize grad test',
+    'sequence_softmax': 'tests/test_sequence_ops.py masked softmax '
+                        'parity',
+    'sequence_pool': 'tests/test_sequence_ops.py pooling parity suite',
+    'sequence_conv': 'tests/test_sequence_ops.py',
+    'sequence_expand': 'tests/test_sequence_ops.py',
+    'sequence_concat': 'tests/test_sequence_ops.py',
+    'sequence_reshape': 'tests/test_sequence_ops.py',
+    'sequence_pad': 'tests/test_sequence_ops.py',
+    'sequence_unpad': 'tests/test_sequence_ops.py',
+    'lod_reset': 'tests/test_sequence_ops.py',
+    'reorder_lod_tensor_by_rank': 'tests/test_sequence_ops.py '
+                                  'rank-reorder round trip',
+    'roi_pool': 'tests/test_detection_ops.py',
+    'roi_align': 'tests/test_detection_ops.py',
+    'ssd_loss': 'tests/test_detection_ops.py end-to-end SSD loss',
+    'iou_similarity': 'tests/test_detection_ops.py',
+    'box_coder': 'tests/test_detection_ops.py encode/decode parity',
+    'conv_shift': 'tests/test_round3_op_grads.py',
+    'bilinear_tensor_product': 'tests/test_extra_ops.py',
+    'hierarchical_sigmoid': 'tests/test_round3_op_grads.py',
+    'maxout': 'tests/test_round3_op_grads.py',
+    'row_conv': 'tests/test_round3_op_grads.py',
+    'sequence_slice': 'tests/test_round3_op_grads.py',
+    'crop': 'tests/test_inventory_grads.py',
+    'pad_constant_like': 'tests/test_inventory_grads.py',
+    'norm': 'tests/test_inventory_grads.py',
+    'multiplex': 'tests/test_inventory_grads.py',
+    'unpool': 'tests/test_inventory_grads.py',
+    'spp': 'tests/test_inventory_grads.py',
+    'unstack': 'tests/test_inventory_grads.py',
+    'minus': 'tests/test_inventory_grads.py',
+    'softmax_with_cross_entropy': 'tests/test_nn_ops.py',
+    'sigmoid_cross_entropy_with_logits': 'tests/test_nn_ops.py',
+    'margin_rank_loss': 'tests/test_round3_op_grads.py',
+    'l1_norm': 'tests/test_inventory_grads.py',
+    'conv2d': 'tests/test_nn_ops.py',
+    'conv3d': 'tests/test_layer_api_complete.py + pool3d grad tests',
+    'depthwise_conv2d_transpose': 'tests/test_inventory_grads.py',
+    'pool2d': 'tests/test_nn_ops.py',
+    'pool3d': 'tests/test_inventory_ops.py',
+    'layer_norm': 'tests/test_nn_ops.py',
+    'matmul': 'tests/test_matmul_reduce_ops.py',
+    'mul': 'tests/test_matmul_reduce_ops.py',
+    'scale': 'tests/test_elementwise_ops.py',
+    'mean': 'tests/test_matmul_reduce_ops.py',
+    'softmax': 'tests/test_nn_ops.py',
+    'cross_entropy': 'tests/test_nn_ops.py',
+    'lookup_table': 'tests/test_nn_ops.py',
+    'flatten': 'tests/test_inventory_grads.py',
+    'concat': 'tests/test_elementwise_ops.py',
+    'sum': 'tests/test_elementwise_ops.py',
+    'clip': 'tests/test_elementwise_ops.py',
+    'reduce_sum': 'tests/test_matmul_reduce_ops.py',
+    'reduce_mean': 'tests/test_matmul_reduce_ops.py',
+    'elementwise_add': 'tests/test_elementwise_ops.py',
+    'elementwise_sub': 'tests/test_elementwise_ops.py',
+    'elementwise_mul': 'tests/test_elementwise_ops.py',
+    'elementwise_div': 'tests/test_elementwise_ops.py',
+}
+
+
+def _differentiable_ops():
+    import paddle_tpu  # noqa: F401 — populate the registry
+    out = []
+    for t in registry.registered_ops():
+        if t.endswith('_grad'):
+            continue
+        d = registry._REGISTRY[t]
+        if not d.no_grad and d.grad is not None:
+            out.append(t)
+    return out
+
+
+class _SweepOp(OpTest):
+    pass
+
+
+@pytest.mark.parametrize('op_type', sorted(CONFIGS))
+def test_op_grad(op_type):
+    c = CONFIGS[op_type]
+    t = _SweepOp()
+    t.op_type = op_type
+    t.inputs = c['inputs']
+    t.attrs = c.get('attrs', {})
+    outs = {}
+    for slot, v in c.get('outputs',
+                         {'Out': ['%s_out' % op_type]}).items():
+        if isinstance(v, list) and v and isinstance(v[0], str):
+            # bare names: check_grad only needs the var declared, not
+            # an expected array
+            v = [(n, np.zeros(1, 'f4')) for n in v]
+        outs[slot] = v
+    t.outputs = outs
+    kwargs = dict(c.get('kwargs', {}))
+    kwargs.setdefault('max_relative_error', 0.01)
+    t.check_grad(c['check'], **kwargs)
+
+
+def test_registry_grad_coverage_complete():
+    """Every differentiable op must be swept here, grad-checked in
+    another test file (auto-scanned for OpTest check_grad classes), or
+    on the documented COVERED_ELSEWHERE list."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    scanned = set()
+    for fn in glob.glob(os.path.join(here, 'test_*.py')):
+        src = open(fn).read()
+        for m in re.finditer(r"op_type = '(\w+)'", src):
+            nxt = src.find('\nclass', m.start())
+            body = src[m.start():nxt if nxt > 0 else len(src)]
+            if 'check_grad' in body:
+                scanned.add(m.group(1))
+    missing = [t for t in _differentiable_ops()
+               if t not in CONFIGS and t not in scanned
+               and t not in COVERED_ELSEWHERE]
+    assert not missing, (
+        'differentiable ops with NO gradient check anywhere: %r — add '
+        'a config to CONFIGS or a justified COVERED_ELSEWHERE entry'
+        % missing)
+    # the sweep itself must carry real breadth (VERDICT: >100 ops
+    # covered overall, the table being the default posture)
+    assert len(CONFIGS) >= 90, len(CONFIGS)
+
+
+def test_causal_mask_grad_composed():
+    """causal_mask sets masked scores to -1e9, which swamps a direct
+    sum objective's finite differences — check its gradient through
+    the softmax it exists to feed instead."""
+    import paddle_tpu as fluid
+    from paddle_tpu import unique_name
+    from paddle_tpu.framework import Program, program_guard
+    rng = _R(3)
+    xv = rng.randn(1, 2, 4, 4).astype('f4')
+    prog, startup = Program(), Program()
+    with unique_name.guard(), program_guard(prog, startup):
+        # a parameter, not a data var: backward blanks grads of
+        # non-trainable feeds
+        x = fluid.layers.create_parameter([1, 2, 4, 4], 'float32',
+                                          name='cm_x')
+        m = fluid.layers.causal_mask_bias(x)
+        p = fluid.layers.softmax(m)
+        loss = fluid.layers.reduce_sum(
+            fluid.layers.elementwise_mul(p, p))
+        grads = fluid.calc_gradient(loss, [x])
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        fluid.global_scope().set_var('cm_x', xv)
+        g, l0 = (np.asarray(v) for v in exe.run(
+            prog, feed={}, fetch_list=[grads[0], loss]))
+        # numeric spot-check on 6 sampled coords
+        num = np.zeros_like(g)
+        flat_idx = [0, 5, 9, 12, 20, 27]
+        d = 1e-3
+        for i in flat_idx:
+            pert = xv.copy().reshape(-1)
+            for sign in (1, -1):
+                pert[i] = xv.reshape(-1)[i] + sign * d
+                fluid.global_scope().set_var(
+                    'cm_x', pert.reshape(xv.shape))
+                val, = exe.run(prog, feed={}, fetch_list=[loss])
+                num.reshape(-1)[i] += sign * float(np.asarray(val))
+            pert[i] = xv.reshape(-1)[i]
+        fluid.global_scope().set_var('cm_x', xv)
+        num /= 2 * d
+    for i in flat_idx:
+        a, n = g.reshape(-1)[i], num.reshape(-1)[i]
+        assert abs(a - n) < 0.01 * max(abs(a), abs(n), 0.05), (i, a, n)

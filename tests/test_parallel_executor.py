@@ -100,9 +100,10 @@ def test_scaling_harness_and_collective_audit():
         'tools'))
     import bench_suite
 
+    import jax
     out = bench_suite.run_scaling('mnist', steps=1, full=False)
     devs = [p['devices'] for p in out['points']]
-    assert devs == [1, 2, 4, 8]
+    assert devs == [n for n in (1, 2, 4, 8) if n <= len(jax.devices())]
     assert all(p['step_ms'] > 0 for p in out['points'])
     audit = out['collective_audit']
     ar = audit.get('all-reduce')

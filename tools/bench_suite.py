@@ -101,9 +101,11 @@ def _build(model, full):
     return loss, feed, bs
 
 
-def run_one(model, mode, steps, full):
+def _fresh_build(model, full):
+    """Reset naming + default programs, build the model + Adam, run
+    startup; shared by run_one and run_scaling so the two modes cannot
+    drift apart. Returns (loss, feed_fn, bs, scope, exe)."""
     import paddle_tpu as fluid
-    import jax
     from paddle_tpu import unique_name
     unique_name.switch()
     fluid.framework.switch_main_program(fluid.framework.Program())
@@ -116,6 +118,13 @@ def run_one(model, mode, steps, full):
     exe = fluid.Executor(fluid.TPUPlace() if full else fluid.CPUPlace())
     with fluid.scope_guard(scope):
         exe.run(fluid.default_startup_program())
+    return loss, feed_fn, bs, scope, exe
+
+
+def run_one(model, mode, steps, full):
+    import paddle_tpu as fluid
+    import jax
+    loss, feed_fn, bs, scope, exe = _fresh_build(model, full)
     rng = np.random.RandomState(0)
     if mode == 'parallel':
         runner = fluid.ParallelExecutor(
@@ -157,18 +166,7 @@ def run_scaling(model, steps, full):
     out = {'model': model, 'mode': 'scaling', 'points': []}
     audit_exe = None
     for n in sizes:
-        unique_name.switch()
-        fluid.framework.switch_main_program(fluid.framework.Program())
-        fluid.framework.switch_startup_program(fluid.framework.Program())
-        with fluid.program_guard(fluid.default_main_program(),
-                                 fluid.default_startup_program()):
-            loss, feed_fn, bs = _build(model, full)
-            fluid.optimizer.Adam(1e-3).minimize(loss)
-        scope = fluid.Scope()
-        exe = fluid.Executor(fluid.TPUPlace() if full else
-                             fluid.CPUPlace())
-        with fluid.scope_guard(scope):
-            exe.run(fluid.default_startup_program())
+        loss, feed_fn, bs, scope, exe = _fresh_build(model, full)
         pe = fluid.ParallelExecutor(
             use_cuda=full, loss_name=loss.name,
             main_program=fluid.default_main_program(), scope=scope,
@@ -229,12 +227,18 @@ def run_scaling(model, steps, full):
                     'total_mb': round(sum(sizes_b) / 1e6, 3),
                     'largest_mb': round(max(sizes_b) / 1e6, 3)}
         out['collective_audit'] = audit
-        n_params = len(fluid.default_main_program().global_block()
-                       .all_parameters())
-        ar = audit.get('all-reduce', {})
-        out['collective_audit']['n_trainable_params'] = n_params
-        out['collective_audit']['grad_allreduce_coalesced'] = \
-            bool(ar) and ar['count'] < n_params
+        params = fluid.default_main_program().global_block() \
+            .all_parameters()
+        param_mb = sum(int(np.prod(p.shape)) for p in params) * 4 / 1e6
+        ar = colls.get('all-reduce', [])
+        audit['n_trainable_params'] = len(params)
+        audit['param_mb'] = round(param_mb, 3)
+        # size-aware: the gradient all-reduces coalesced iff ONE
+        # instruction carries (most of) the parameter bytes — a raw
+        # count comparison miscounts models with non-gradient
+        # collectives (e.g. ResNet's per-layer BN-stat syncs)
+        audit['grad_allreduce_coalesced'] = bool(ar) and (
+            max(ar) / 1e6 >= 0.5 * param_mb)
     return out
 
 
