@@ -108,11 +108,14 @@ class ParameterService(object):
             if tid in self._done_tids:
                 continue
             # the tight deadline applies only once a trainer is in
-            # steady state (past its FIRST barrier): startup still
-            # includes client-side program compile AFTER the initial
-            # param pull, which must not count as silent death
+            # steady state: past its FIRST barrier in sync mode (the
+            # startup recv is followed by client-side program compile,
+            # which must not count as silent death), or simply once
+            # seen in async mode (which has no barriers at all)
             seen = self._last_seen.get(tid, self._start)
-            limit = (self.rpc_deadline if tid in self._barrier_ever
+            steady = (tid in self._barrier_ever if self.sync_mode
+                      else tid in self._last_seen)
+            limit = (self.rpc_deadline if steady
                      else self.first_contact_grace)
             if now - seen > limit:
                 self._done_tids.add(tid)

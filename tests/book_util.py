@@ -1,0 +1,20 @@
+"""Shared helper for the book chapters' training contract (reference
+tests/book/test_fit_a_line.py:40-55: train UNTIL the loss crosses the
+chapter threshold within bounded steps, never merely 'smaller than
+before')."""
+import numpy as np
+
+
+def train_until_threshold(exe, prog, feed, cost, threshold, max_steps,
+                          what='loss'):
+    """Run `prog` until fetch(cost) < threshold; assert it happened."""
+    last = None
+    for _ in range(max_steps):
+        l, = exe.run(prog, feed=feed, fetch_list=[cost])
+        last = float(np.asarray(l))
+        if last < threshold:
+            break
+    assert np.isfinite(last) and last < threshold, (
+        '%s %.3f never crossed the chapter threshold %.2f in %d steps'
+        % (what, last, threshold, max_steps))
+    return last

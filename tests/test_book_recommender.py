@@ -77,10 +77,6 @@ def test_recommender_trains():
 
     data = [bucket(s) for s in raw]
     feed = feeder.feed(data)
-    first = last = None
-    for _ in range(40):
-        l, = exe.run(prog, feed=feed, fetch_list=[avg_cost])
-        if first is None:
-            first = float(l)
-        last = float(l)
-    assert np.isfinite(last) and last < 0.7 * first, (first, last)
+    from book_util import train_until_threshold
+    train_until_threshold(exe, prog, feed, avg_cost, threshold=1.0,
+                          max_steps=200)

@@ -67,14 +67,11 @@ def _train(net_fn, steps=50, lr=0.005):
         ids[i, :len(seq), 0] = seq
         lens[i] = len(seq)
         labels[i] = lab
-    first = last = None
-    for _ in range(steps):
-        l, = exe.run(prog, feed={'words': (ids, lens), 'label': labels},
-                     fetch_list=[avg_cost])
-        if first is None:
-            first = float(l)
-        last = float(l)
-    assert np.isfinite(last) and last < 0.5 * first, (first, last)
+    from book_util import train_until_threshold
+    train_until_threshold(exe, prog,
+                          {'words': (ids, lens), 'label': labels},
+                          avg_cost, threshold=0.35,
+                          max_steps=max(steps, 120))
 
 
 def test_sentiment_conv():

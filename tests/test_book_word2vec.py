@@ -42,11 +42,21 @@ def test_word2vec_trains():
         place=fluid.CPUPlace(), program=prog)
     exe = fluid.Executor(fluid.CPUPlace())
     exe.run(startup)
+    # book contract (reference test_word2vec trains to a cost target):
+    # smoothed loss must cross the chapter threshold within the epoch
+    threshold, max_epochs = 4.0, 6
     losses = []
-    for i, data in enumerate(train_reader()):
-        l, = exe.run(prog, feed=feeder.feed(data), fetch_list=[avg_cost])
-        losses.append(float(l))
-        if i >= 120:
+    reached = False
+    for epoch in range(max_epochs):
+        for data in train_reader():
+            l, = exe.run(prog, feed=feeder.feed(data),
+                         fetch_list=[avg_cost])
+            losses.append(float(np.asarray(l)))
+            if len(losses) >= 5 and np.mean(losses[-5:]) < threshold:
+                reached = True
+                break
+        if reached:
             break
-    first, last = np.mean(losses[:5]), np.mean(losses[-5:])
-    assert np.isfinite(last) and last < first - 0.8, (first, last)
+    assert reached, (
+        'smoothed loss %.3f never crossed %.1f in %d batches'
+        % (np.mean(losses[-5:]), threshold, len(losses)))

@@ -1,0 +1,73 @@
+"""Concurrent inference serving: N threads run clone()d predictors
+simultaneously against shared weights and must agree with the serial
+results (reference multi-thread inference helper,
+paddle/fluid/inference/tests/test_helper.h TestMultiThreadInference /
+tests/book/ usage). clone() shares the weight Scope; programs and
+compile caches are per-clone, so concurrent run() must be safe."""
+import threading
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import unique_name
+from paddle_tpu.framework import Program, program_guard
+
+
+def _save_model(tmp_path):
+    prog, startup = Program(), Program()
+    prog.random_seed = startup.random_seed = 3
+    with unique_name.guard(), program_guard(prog, startup):
+        x = fluid.layers.data(name='x', shape=[8], dtype='float32')
+        h = fluid.layers.fc(input=x, size=16, act='relu')
+        out = fluid.layers.fc(input=h, size=4, act='softmax')
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        fluid.io.save_inference_model(str(tmp_path), ['x'], [out], exe,
+                                      main_program=prog)
+
+
+def test_concurrent_cloned_predictors_agree_with_serial(tmp_path):
+    _save_model(tmp_path)
+    from paddle_tpu.inference import Config, create_predictor
+    base = create_predictor(Config(str(tmp_path),
+                                   place=fluid.CPUPlace()))
+    rng = np.random.RandomState(0)
+    batches = [rng.rand(5, 8).astype('f4') for _ in range(8)]
+
+    # serial reference results from the base predictor
+    serial = [base.run([b])[0] for b in batches]
+
+    n_threads = 4
+    clones = [base.clone() for _ in range(n_threads)]
+    results = [[None] * len(batches) for _ in range(n_threads)]
+    errors = []
+    start = threading.Barrier(n_threads)
+
+    def worker(t):
+        try:
+            start.wait(timeout=30)
+            for rep in range(3):                 # sustained concurrency
+                for i, b in enumerate(batches):
+                    results[t][i] = clones[t].run([b])[0]
+        except Exception as e:                   # surface, don't hang
+            errors.append((t, repr(e)))
+
+    threads = [threading.Thread(target=worker, args=(t,))
+               for t in range(n_threads)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join(timeout=120)
+        assert not th.is_alive(), 'predictor thread hung (deadlock?)'
+    assert not errors, errors
+    for t in range(n_threads):
+        for i in range(len(batches)):
+            np.testing.assert_allclose(
+                results[t][i], serial[i], rtol=1e-5, atol=1e-6,
+                err_msg='thread %d batch %d diverged from serial'
+                        % (t, i))
+    # weights are genuinely shared, not copied: the clones' scope IS
+    # the base predictor's scope object
+    assert all(c._scope is base._scope for c in clones)
