@@ -281,9 +281,12 @@ def bench_long_context(on_tpu):
                                     ffn=128, max_len=64, use_tp=False,
                                     use_sp=False, flash_attention=False)
         batch, warmup, iters = 2, 1, 2
+    # head_chunk 8192: 2 scan chunks at N=16384 measured ~4% faster
+    # than 4 (in-process differencing A/B); a single 16384 chunk loses
+    # again (2 GB fp32 logits transient)
     return _bench_lm(cfg, batch, warmup, iters, 'longcontext',
                      causal_flops=True, reader_name='lc_reader',
-                     fused_head=on_tpu)
+                     fused_head=on_tpu, head_chunk=8192)
 
 
 def main():

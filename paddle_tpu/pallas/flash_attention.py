@@ -23,9 +23,14 @@ one q block live in VMEM scratch across the ki sweep; causal q-blocks
 stop their sweep at the diagonal (pl.when skips both compute and the
 write until the final valid ki).
 
-Backward: delta = rowsum(dO·O) in plain JAX, then two kernels —
-dq (grid bh, qi, ki) and dk/dv (grid bh, ki, qi) — each recomputing
-P = exp(S − LSE) for its block pair, the standard flash backward.
+Backward (round-4 HYBRID): delta = rowsum(dO·O) in plain JAX, then a
+size-based dispatch. Small grids (nk <= 2, e.g. T=512 default blocks)
+run ONE fused kernel that recomputes P = exp(S − LSE) once per block
+pair and emits dk/dv plus per-k-block dq partials (5 block-matmuls,
+one launch — measured 2.8x the split at T=512). Large grids keep the
+classic dq + dk/dv two-kernel split (7 block-matmuls) because the
+fused variant's per-block dq-partial HBM flush costs more than the
+recompute it saves at nk=16 (measured at T=8192; PERF.md round-4).
 """
 from __future__ import annotations
 
@@ -149,8 +154,20 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
 
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                dk_ref, dv_ref, dk_scr, dv_scr, *, sm_scale, causal,
-                block_q, block_k, nq):
+                dk_ref, dv_ref, *rest, sm_scale, causal, block_q,
+                block_k, nq, emit_dqp=False):
+    """dk/dv sweep (grid bh, ki, qi; VMEM-scratch accumulation over
+    qi). With emit_dqp=True this is the round-4 FUSED single-pass
+    backward: the same sweep also writes each block pair's dq
+    contribution ds·k as a per-k-block partial (dqp) that a plain XLA
+    reduction sums afterwards — cross-grid-dim accumulation being the
+    thing a Pallas output cannot do directly. One kernel body serves
+    both dispatch arms so the shared math cannot drift."""
+    if emit_dqp:
+        dqp_ref, dk_scr, dv_scr = rest
+    else:
+        dqp_ref = None
+        dk_scr, dv_scr = rest
     ki = pl.program_id(1)
     qi = pl.program_id(2)
     first_qi = 0
@@ -181,6 +198,20 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dk_scr[:] += jax.lax.dot_general(
             ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)       # [bk, d]
+        if emit_dqp:
+            # dq contribution of THIS k-block; the sm_scale mirrors
+            # the split dq kernel's finalize
+            dqp_ref[0] = (jax.lax.dot_general(
+                ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32) * sm_scale)
+
+    if emit_dqp:
+        @pl.when(qi < first_qi)
+        def _skipped():
+            # causal-skipped pairs still own a dqp block: zero it or
+            # the reduction reads uninitialized memory
+            dqp_ref[0] = jnp.zeros((block_q, q_ref.shape[-1]),
+                                   jnp.float32)
 
     @pl.when(qi == pl.num_programs(2) - 1)
     def _finalize():
@@ -274,6 +305,64 @@ def _bwd(q, k, v, o, lse, do, causal, sm_scale, interpret=False):
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
                     axis=-1, keepdims=True)            # [BH, T, 1]
 
+    if nk > 2:
+        return _bwd_split(q, k, v, do, lse, delta, causal, sm_scale,
+                          interpret, bq, bk, nq, nk)
+    dk, dv, dq_part = pl.pallas_call(
+        functools.partial(_dkv_kernel, sm_scale=sm_scale,
+                          causal=causal, block_q=bq, block_k=bk,
+                          nq=nq, emit_dqp=True),
+        grid=(BH, nk, nq),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bq, 1), lambda b, j, i: (b, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bq, 1), lambda b, j, i: (b, i, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0),
+                         memory_space=pltpu.VMEM),
+            # dq partials: one [bq, d] block per (ki, qi) pair, laid
+            # out [BH*nk, T, d] so each grid step owns one block
+            pl.BlockSpec((1, bq, d),
+                         lambda b, j, i, _nk=nk: (b * _nk + j, i, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, T, d), k.dtype),
+            jax.ShapeDtypeStruct((BH, T, d), v.dtype),
+            jax.ShapeDtypeStruct((BH * nk, T, d), jnp.float32),
+        ],
+        scratch_shapes=[pltpu.VMEM((bk, d), jnp.float32),
+                        pltpu.VMEM((bk, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=('parallel', 'parallel', 'arbitrary')),
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+    # cross-k-block dq accumulation as a plain XLA reduction (a Pallas
+    # output can only accumulate along its innermost grid dim)
+    dq = dq_part.reshape(BH, nk, T, d).sum(axis=1).astype(q.dtype)
+    return dq, dk, dv
+
+
+def _bwd_split(q, k, v, do, lse, delta, causal, sm_scale, interpret,
+               bq, bk, nq, nk):
+    """Two-kernel backward for LARGE grids: at nk > 2 the fused
+    kernel's per-(ki, qi) dq-partial flush to HBM costs more than the
+    S/dp recompute it saves (measured T=8192: split 16.7 ms vs fused
+    21.3 ms), while at nk <= 2 the fused path wins big (T=512: 1.0 vs
+    2.8 ms — one launch, no recompute). _bwd dispatches on nk."""
+    BH, T, d = q.shape
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, sm_scale=sm_scale, causal=causal,
                           block_q=bq, block_k=bk, nk=nk),

@@ -69,6 +69,7 @@ def test_resnet_trains_with_s2d_stem():
     rng = np.random.RandomState(1)
     from paddle_tpu.models import resnet
     prog, startup = Program(), Program()
+    prog.random_seed = startup.random_seed = 7   # unseeded init flaked
     with unique_name.guard(), program_guard(prog, startup):
         img = fluid.layers.data(name='img', shape=[3, 32, 32],
                                 dtype='float32')
@@ -84,10 +85,14 @@ def test_resnet_trains_with_s2d_stem():
         iv = rng.rand(4, 3, 32, 32).astype('f4')
         lv = rng.randint(0, 8, (4, 1)).astype('int64')
         l0 = None
-        for _ in range(5):
+        best = float('inf')
+        for _ in range(15):
             l, = exe.run(prog, feed={'img': iv, 'lbl': lv},
                          fetch_list=[cost])
             if l0 is None:
                 l0 = float(np.asarray(l))
+            best = min(best, float(np.asarray(l)))
+            if best < 0.8 * l0:
+                break
     assert np.isfinite(np.asarray(l)).all()
-    assert float(np.asarray(l)) < l0
+    assert best < 0.8 * l0, (l0, best)
