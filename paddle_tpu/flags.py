@@ -57,6 +57,10 @@ _DEFAULTS = {
     # operators/distributed/rpc_client.cc — applied server-side here
     # where the round state lives)
     'rpc_deadline': 180.0,
+    # store the Momentum velocity accumulator in bf16 (halves the
+    # optimizer's dominant HBM stream; one rounding per step; master
+    # params stay fp32). Off by default for exact-fp32 parity.
+    'bf16_momentum': False,
 }
 
 _FLAGS = dict(_DEFAULTS)
