@@ -71,20 +71,16 @@ def _momentum_emit(ctx, op):
     v = ctx.get(op.single_input('Velocity'))
     lr = ctx.get(op.single_input('LearningRate'))
     mu = op.attr('mu')
-    v_new = mu * v.astype(jnp.float32) + g
+    # math in the param dtype; the accumulator keeps ITS OWN dtype
+    # (fp32 normally; bf16 under FLAGS_bf16_momentum, which creates it
+    # bf16 at startup — optimizer.py Momentum._create_accumulators)
+    v_new = mu * v.astype(p.dtype) + g.astype(p.dtype)
     if op.attr('use_nesterov', False):
-        p_new = p - (g + mu * v_new) * lr
+        p_new = p - (g.astype(p.dtype) + mu * v_new) * lr
     else:
         p_new = p - lr * v_new
     ctx.set(op.single_output('ParamOut'), p_new.astype(p.dtype))
-    # FLAGS_bf16_momentum: store the velocity accumulator in bf16 —
-    # halves the optimizer's dominant HBM stream (read+write of v) at
-    # one rounding per step; master params stay fp32. Off by default
-    # (exact-fp32 parity tests).
-    from ..flags import get_flag
-    if get_flag('bf16_momentum') and p.dtype == jnp.float32:
-        v_new = v_new.astype(jnp.bfloat16)
-    ctx.set(op.single_output('VelocityOut'), v_new)
+    ctx.set(op.single_output('VelocityOut'), v_new.astype(v.dtype))
 
 
 register_op('momentum', emit=_momentum_emit, no_grad=True,

@@ -155,8 +155,19 @@ class Momentum(Optimizer):
         self._use_nesterov = use_nesterov
 
     def _create_accumulators(self, block, parameters):
+        from .flags import get_flag
+        # FLAGS_bf16_momentum: the accumulator is CREATED bf16 so its
+        # dtype is stable from step 1 (creating fp32 and downcasting at
+        # the first update would change the jitted step's input aval —
+        # a full recompile — and desync the var desc from the runtime
+        # array). The update math still runs in the param dtype
+        # (ops/optimizer_ops.py stores back in the accumulator dtype).
+        bf16 = get_flag('bf16_momentum')
         for p in parameters:
-            self._add_accumulator(self._velocity_acc_str, p)
+            self._add_accumulator(
+                self._velocity_acc_str, p,
+                dtype='bfloat16' if (bf16 and str(p.dtype) == 'float32')
+                else None)
 
     def _append_optimize_op(self, block, param_and_grad):
         velocity = self._get_accumulator(self._velocity_acc_str,

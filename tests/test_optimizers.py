@@ -166,3 +166,54 @@ def test_lr_scheduler_piecewise():
         lrs.append(float(np.asarray(lr_val).reshape(-1)[0]))
     # step counter is 1-based: steps 1..5 -> [1.0, 0.1, 0.1, 0.01, 0.01]
     np.testing.assert_allclose(lrs, [1.0, 0.1, 0.1, 0.01, 0.01], rtol=1e-5)
+
+
+def test_bf16_momentum_flag():
+    """FLAGS_bf16_momentum: the velocity accumulator is CREATED bf16
+    (stable dtype from step 1 — no step-2 retrace), the update math
+    runs in the param dtype, and training matches the fp32-velocity
+    path within bf16 tolerance."""
+    import paddle_tpu as fluid
+    from paddle_tpu import unique_name
+    from paddle_tpu.framework import Program, program_guard
+
+    def train(flag):
+        fluid.set_flags({'FLAGS_bf16_momentum': flag})
+        try:
+            prog, startup = Program(), Program()
+            prog.random_seed = startup.random_seed = 9
+            with unique_name.guard(), program_guard(prog, startup):
+                x = fluid.layers.data(name='x', shape=[6],
+                                      dtype='float32')
+                y = fluid.layers.data(name='y', shape=[1],
+                                      dtype='float32')
+                pred = fluid.layers.fc(input=x, size=1, name='m')
+                loss = fluid.layers.mean(
+                    fluid.layers.square_error_cost(pred, y))
+                fluid.optimizer.Momentum(0.05, 0.9).minimize(loss)
+            vel_vars = [v for v in prog.global_block().vars.values()
+                        if 'velocity' in v.name]
+            assert vel_vars
+            want = 'bfloat16' if flag else 'float32'
+            assert all(str(v.dtype) == want for v in vel_vars), (
+                [(v.name, v.dtype) for v in vel_vars])
+            scope = fluid.Scope()
+            exe = fluid.Executor(fluid.CPUPlace())
+            rng = np.random.RandomState(0)
+            w = rng.randn(6, 1).astype('f4')
+            with fluid.scope_guard(scope):
+                exe.run(startup)
+                for _ in range(120):
+                    xb = rng.randn(16, 6).astype('f4')
+                    l, = exe.run(prog, feed={'x': xb, 'y': xb @ w},
+                                 fetch_list=[loss])
+                vel = np.asarray(scope.find_var(vel_vars[0].name))
+            assert str(vel.dtype) == want
+            return float(np.asarray(l))
+        finally:
+            fluid.set_flags({'FLAGS_bf16_momentum': False})
+
+    l_fp32 = train(False)
+    l_bf16 = train(True)
+    assert l_fp32 < 0.05
+    assert l_bf16 < 0.08                    # converges despite rounding
