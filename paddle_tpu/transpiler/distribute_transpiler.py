@@ -516,8 +516,10 @@ class DistributeTranspiler(object):
         restores its shard from it before serving — the restore half of
         pserver checkpointing (reference pservers reload via their
         startup load block). Shards resolve by this endpoint's saved
-        subdir, falling back to POSITION (sorted subdir i for pserver
-        i) so a restarted cluster on fresh ports can still restore."""
+        subdir, falling back to CONTENT matching (the subdir holding
+        this pserver's own uniquely-named param blocks) so a restarted
+        cluster on fresh ports still restores the right shards;
+        ambiguous matches raise instead of guessing."""
         main = self.get_pserver_program(endpoint)
         if checkpoint_dir:
             import os
@@ -532,8 +534,39 @@ class DistributeTranspiler(object):
                         'checkpoint %r holds %d shard dirs for %d '
                         'pservers' % (checkpoint_dir, len(subdirs),
                                       len(self.pserver_endpoints)))
-                idx = self.pserver_endpoints.index(endpoint)
-                shard = os.path.join(checkpoint_dir, subdirs[idx])
+                # match the shard by CONTENT: each shard holds this
+                # pserver's uniquely-named param blocks (w1.block0 …).
+                # A positional fallback (sorted subdir i for pserver i)
+                # was WRONG: subdirs sort lexicographically by the OLD
+                # endpoint strings, which orders by port STRING — when
+                # the old ports' string order differed from their
+                # position order, a restarted cluster silently loaded
+                # SWAPPED shards (the restore-half flake this replaces).
+                my_vars = set(main.global_block().vars)
+                scores = []
+                for d in subdirs:
+                    files = set(os.listdir(
+                        os.path.join(checkpoint_dir, d)))
+                    scores.append((len(files & my_vars), d))
+                scores.sort(reverse=True)
+                best = scores[0]
+                if best[0] == 0:
+                    raise ValueError(
+                        'no shard dir under %r contains vars of pserver '
+                        '%s (vars: %r)' % (checkpoint_dir, endpoint,
+                                           sorted(my_vars)[:8]))
+                if len(scores) > 1 and scores[1][0] == best[0]:
+                    # shared-name files (learning_rate_0 …) appear in
+                    # every shard; a TIE means this pserver has no
+                    # distinguishing vars and guessing would silently
+                    # restore another pserver's (or a duplicate) shard
+                    raise ValueError(
+                        'ambiguous checkpoint restore: shard dirs %r '
+                        'match pserver %s equally (%d vars) — restore '
+                        'with the original endpoints instead'
+                        % ([d for sc, d in scores if sc == best[0]],
+                           endpoint, best[0]))
+                shard = os.path.join(checkpoint_dir, best[1])
             lsv = main.global_block().ops[-1]
             assert lsv.type == 'listen_and_serv'
             lsv.attrs['checkpoint_dir'] = shard

@@ -167,3 +167,61 @@ def test_sparse_grad_clipped_still_split_sparse():
         # the clipped grad carries a temp name — match the recorded one
         srcs = [op for op in block.ops if op.type == 'split_selected_rows']
         assert any(op.input('X') == [emb_blocks[0].grad] for op in srcs)
+
+
+def test_restore_shard_fallback_matches_by_content(tmp_path):
+    """Restore onto FRESH ports must pick each pserver's own shard by
+    CONTENT (its uniquely-named param blocks), not by sorted-subdir
+    position: old endpoint strings sort by port STRING, so positional
+    matching silently loaded SWAPPED shards whenever the old ports'
+    lexicographic order differed from their position order (e.g. old
+    ports 9531, 12345)."""
+    import os
+    import paddle_tpu as fluid
+    from paddle_tpu import unique_name
+    from paddle_tpu.framework import Program, program_guard
+
+    # adversarial OLD ports: position order (9531, 12345) but string
+    # order ('12345' < '9531') — the old bug's trigger
+    old_eps = ['127.0.0.1:9531', '127.0.0.1:12345']
+    new_eps = ['127.0.0.1:7001', '127.0.0.1:7002']
+
+    def transpile(eps):
+        prog, startup = Program(), Program()
+        with unique_name.guard(), program_guard(prog, startup):
+            x = fluid.layers.data(name='x', shape=[16], dtype='float32')
+            y = fluid.layers.data(name='y', shape=[1], dtype='float32')
+            p = fluid.layers.fc(input=x, size=1, name='w1')
+            loss = fluid.layers.mean(
+                fluid.layers.square_error_cost(p, y))
+            fluid.optimizer.SGD(0.1).minimize(loss)
+        t = fluid.DistributeTranspiler()
+        t.transpile(0, program=prog, pservers=','.join(eps), trainers=1,
+                    startup_program=startup)
+        return t
+
+    # fake checkpoint written by the OLD cluster: shard dirs named by
+    # old endpoints, each containing that POSITION's param blocks
+    t_old = transpile(old_eps)
+    ckpt = tmp_path / 'ck'
+    for i, ep in enumerate(old_eps):
+        prog_i, _ = t_old.get_pserver_programs(ep)
+        d = ckpt / ep.replace(':', '_')
+        d.mkdir(parents=True)
+        for name, var in prog_i.global_block().vars.items():
+            if var.persistable and '@' not in name:
+                (d / name).write_bytes(b'x')
+
+    t_new = transpile(new_eps)
+    for i, ep in enumerate(new_eps):
+        main, _ = t_new.get_pserver_programs(ep, checkpoint_dir=str(ckpt))
+        lsv = main.global_block().ops[-1]
+        shard = lsv.attrs['checkpoint_dir']
+        # position i's new pserver owns the same vars position i's old
+        # pserver saved, so content-matching must select the OLD
+        # position-i dir — which string-sorting put at the WRONG index
+        assert shard.endswith(old_eps[i].replace(':', '_')), (ep, shard)
+        my_persistable = {n for n, v in main.global_block().vars.items()
+                          if v.persistable and '@' not in n}
+        files = set(os.listdir(shard))
+        assert my_persistable & files, (ep, shard, sorted(files))
