@@ -18,7 +18,8 @@ import tempfile
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
 
-_EXTRA_LIBS = {'recordio': ['-lz']}
+_EXTRA_LIBS = {'recordio': ['-lz'],
+               'prefetcher': ['-lz', '-pthread']}
 
 _loaded = {}
 

@@ -26,7 +26,8 @@ import numpy as np
 
 from .native import load_library
 
-__all__ = ['Compressor', 'RecordIOWriter', 'RecordIOScanner', 'reader',
+__all__ = ['Compressor', 'RecordIOWriter', 'RecordIOScanner',
+           'ParallelRecordIOScanner', 'parallel_reader', 'reader',
            'convert_reader_to_recordio_file',
            'convert_reader_to_recordio_files']
 
@@ -185,6 +186,143 @@ def reader(pattern):
                 for rec in sc:
                     yield tuple(_decode_sample(rec))
     return _read
+
+
+
+
+_U32 = struct.Struct('<I')
+
+_pf_lib = None
+
+
+def _prefetch_lib():
+    global _pf_lib
+    if _pf_lib is None:
+        lib = load_library('prefetcher')
+        lib.rupt_prefetcher_open.restype = ctypes.c_void_p
+        lib.rupt_prefetcher_open.argtypes = [
+            ctypes.POINTER(ctypes.c_char_p), ctypes.c_uint32,
+            ctypes.c_uint32, ctypes.c_uint32, ctypes.c_int]
+        lib.rupt_prefetcher_next_chunk.restype = ctypes.c_int
+        lib.rupt_prefetcher_next_chunk.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
+            ctypes.POINTER(ctypes.c_uint32),
+            ctypes.POINTER(ctypes.c_uint32)]
+        lib.rupt_prefetcher_close.argtypes = [ctypes.c_void_p]
+        lib.rupt_pf_last_error.restype = ctypes.c_char_p
+        _pf_lib = lib
+    return _pf_lib
+
+
+class ParallelRecordIOScanner(object):
+    """GIL-free multi-threaded record iterator over MANY recordio files
+    (native/prefetcher.cc — the C++ data-loader analog of the
+    reference's reader-op stack: a background blocking queue like
+    create_double_buffer_reader_op.cc fed by open_files-style
+    work-stealing workers). IO, CRC32 and inflate run on C++ threads;
+    Python drains whole decompressed chunks from one bounded queue
+    (per-record FFI crossings measured slower — PERF.md). Records keep
+    file order WITHIN a file; global order is nondeterministic
+    (parallel). Single-consumer: drive from one thread.
+
+    Honest measurement (PERF.md round 4): on this image's CPU the
+    Python-side drain (chunk copy + record slicing) is the bound at
+    ~400-500 MB/s, so thread count does not change end-to-end record
+    throughput — the serial Scanner is at parity because python zlib
+    already releases the GIL. The native path is the structural home
+    for heavier codecs/decode stages; today its value is keeping
+    worker decode off the trainer thread."""
+
+    def __init__(self, filenames, n_threads=4, capacity=64,
+                 loop=False):
+        if isinstance(filenames, str):
+            filenames = [filenames]
+        self._libref = _prefetch_lib()
+        arr = (ctypes.c_char_p * len(filenames))(
+            *[f.encode() for f in filenames])
+        self._pending = []
+        self._h = self._libref.rupt_prefetcher_open(
+            arr, len(filenames), n_threads, capacity, 1 if loop else 0)
+        if not self._h:
+            raise IOError(
+                self._libref.rupt_pf_last_error().decode(
+                    'utf-8', 'replace'))
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        # hand-off is per CHUNK (one FFI+lock crossing per hundreds of
+        # records); records of the current chunk drain from a local list
+        if self._pending:
+            return self._pending.pop()
+        if self._h is None:
+            raise StopIteration
+        out = ctypes.POINTER(ctypes.c_uint8)()
+        ln = ctypes.c_uint32()
+        nrec = ctypes.c_uint32()
+        rc = self._libref.rupt_prefetcher_next_chunk(
+            self._h, ctypes.byref(out), ctypes.byref(ln),
+            ctypes.byref(nrec))
+        if rc == 1:
+            self.close()
+            raise StopIteration
+        if rc != 0:
+            msg = self._libref.rupt_pf_last_error().decode(
+                'utf-8', 'replace')
+            self.close()
+            raise IOError(msg)
+        payload = ctypes.string_at(out, ln.value)
+        recs = []
+        off = 0
+        for _ in range(nrec.value):
+            (rlen,) = _U32.unpack_from(payload, off)
+            off += 4
+            recs.append(payload[off:off + rlen])
+            off += rlen
+        recs.reverse()                  # pop() yields in file order
+        self._pending = recs
+        if not recs:
+            return self.__next__()
+        return self._pending.pop()
+
+    def close(self):
+        if self._h is not None:
+            self._libref.rupt_prefetcher_close(self._h)
+            self._h = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+def parallel_reader(filenames, n_threads=4, capacity=64):
+    """Reader creator: decoded samples from many recordio files (or
+    glob patterns) via the native prefetcher — drop-in for `reader`
+    (same tuple samples, same glob support). capacity counts CHUNKS in
+    flight, matching the C ABI (a records-sized number here would
+    buffer GBs of decompressed chunks)."""
+    if isinstance(filenames, str):
+        filenames = [filenames]
+    paths = []
+    for pat in filenames:
+        hits = sorted(_glob.glob(pat))
+        paths.extend(hits if hits else [pat])
+
+    def impl():
+        with ParallelRecordIOScanner(paths, n_threads, capacity) as sc:
+            for rec in sc:
+                yield tuple(_decode_sample(rec))
+    return impl
+
 
 
 def convert_reader_to_recordio_file(filename, reader_creator,
