@@ -155,11 +155,29 @@ def open_recordio_file(filename, shapes, dtypes, lod_levels=None,
 
 def open_files(filenames, shapes, dtypes, lod_levels=None, pass_num=1,
                thread_num=1, buffer_size=None, for_parallel=None):
-    """Reader over many RecordIO files (reference layers/io.py:724 —
-    multithreaded there; file-sequential here, the async device staging
-    happens in the PyReader queue threads)."""
+    """Reader over many RecordIO files (reference layers/io.py:724,
+    multithreaded there too). thread_num > 1 routes through the native
+    C++ prefetcher (native/prefetcher.cc: work-stealing file workers,
+    GIL-free chunk decode, one bounded queue) — the reference's
+    multi-threaded multi-file reader as a native component; with
+    thread_num == 1 files scan sequentially. Either way the async
+    device staging happens in the PyReader queue threads."""
     from .. import recordio as _recordio
-    return _file_reader(_recordio.reader(list(filenames)), shapes, dtypes,
+    if thread_num and thread_num > 1:
+        # buffer_size keeps the reference's SAMPLE units; the native
+        # queue counts CHUNKS (~1000 records each with the writer
+        # default), so convert — passing samples straight through
+        # would buffer a thousand times the intended memory
+        if buffer_size:
+            capacity = max(2, min(256, -(-int(buffer_size) // 1000)))
+        else:
+            capacity = 64
+        sample_gen = _recordio.parallel_reader(
+            list(filenames), n_threads=int(thread_num),
+            capacity=capacity)
+    else:
+        sample_gen = _recordio.reader(list(filenames))
+    return _file_reader(sample_gen, shapes, dtypes,
                         lod_levels, 'multi_file_reader', pass_num)
 
 

@@ -70,6 +70,10 @@ std::string scan_file(
     if (n == 0) break;                       // clean EOF
     if (n != sizeof(h)) { err = "truncated header in " + path; break; }
     if (h.magic != kMagic) { err = "bad magic in " + path; break; }
+    if (h.version != 1) {
+      err = "unsupported recordio version in " + path;
+      break;
+    }
     if (h.raw_len > kMaxChunkLen || h.stored_len > kMaxChunkLen) {
       err = "oversized chunk in " + path;
       break;

@@ -254,36 +254,33 @@ class ParallelRecordIOScanner(object):
     def __next__(self):
         # hand-off is per CHUNK (one FFI+lock crossing per hundreds of
         # records); records of the current chunk drain from a local list
-        if self._pending:
-            return self._pending.pop()
-        if self._h is None:
-            raise StopIteration
-        out = ctypes.POINTER(ctypes.c_uint8)()
-        ln = ctypes.c_uint32()
-        nrec = ctypes.c_uint32()
-        rc = self._libref.rupt_prefetcher_next_chunk(
-            self._h, ctypes.byref(out), ctypes.byref(ln),
-            ctypes.byref(nrec))
-        if rc == 1:
-            self.close()
-            raise StopIteration
-        if rc != 0:
-            msg = self._libref.rupt_pf_last_error().decode(
-                'utf-8', 'replace')
-            self.close()
-            raise IOError(msg)
-        payload = ctypes.string_at(out, ln.value)
-        recs = []
-        off = 0
-        for _ in range(nrec.value):
-            (rlen,) = _U32.unpack_from(payload, off)
-            off += 4
-            recs.append(payload[off:off + rlen])
-            off += rlen
-        recs.reverse()                  # pop() yields in file order
-        self._pending = recs
-        if not recs:
-            return self.__next__()
+        while not self._pending:        # loop: empty chunks are legal
+            if self._h is None:
+                raise StopIteration
+            out = ctypes.POINTER(ctypes.c_uint8)()
+            ln = ctypes.c_uint32()
+            nrec = ctypes.c_uint32()
+            rc = self._libref.rupt_prefetcher_next_chunk(
+                self._h, ctypes.byref(out), ctypes.byref(ln),
+                ctypes.byref(nrec))
+            if rc == 1:
+                self.close()
+                raise StopIteration
+            if rc != 0:
+                msg = self._libref.rupt_pf_last_error().decode(
+                    'utf-8', 'replace')
+                self.close()
+                raise IOError(msg)
+            payload = ctypes.string_at(out, ln.value)
+            recs = []
+            off = 0
+            for _ in range(nrec.value):
+                (rlen,) = _U32.unpack_from(payload, off)
+                off += 4
+                recs.append(payload[off:off + rlen])
+                off += rlen
+            recs.reverse()              # pop() yields in file order
+            self._pending = recs
         return self._pending.pop()
 
     def close(self):
@@ -310,12 +307,11 @@ def parallel_reader(filenames, n_threads=4, capacity=64):
     (same tuple samples, same glob support). capacity counts CHUNKS in
     flight, matching the C ABI (a records-sized number here would
     buffer GBs of decompressed chunks)."""
-    if isinstance(filenames, str):
-        filenames = [filenames]
-    paths = []
-    for pat in filenames:
-        hits = sorted(_glob.glob(pat))
-        paths.extend(hits if hits else [pat])
+    # EXACTLY reader()'s path contract: a string is a glob pattern,
+    # a list is literal paths (diverging by thread count would make
+    # the same open_files call read different file sets)
+    paths = filenames if isinstance(filenames, (list, tuple)) \
+        else sorted(_glob.glob(filenames)) or [filenames]
 
     def impl():
         with ParallelRecordIOScanner(paths, n_threads, capacity) as sc:

@@ -94,14 +94,19 @@ class TestModifiedHuberLoss(OpTest):
 class TestL1NormAndNorm(OpTest):
     def test_l1(self):
         self.op_type = 'l1_norm'
-        x = np.random.uniform(-1, 1, (4, 6)).astype('float32')
+        # seeded: values near 0 put the |x| kink inside the numeric
+        # delta and flake the grad comparison
+        rng = np.random.RandomState(11)
+        x = rng.uniform(-1, 1, (4, 6)).astype('float32')
+        x = np.where(np.abs(x) < 0.05, 0.1, x).astype('float32')
         self.inputs = {'X': x}
         self.outputs = {'Out': np.array([np.abs(x).sum()], 'float32')}
         self.check_output()
 
     def test_l2_normalize(self):
         self.op_type = 'norm'
-        x = np.random.rand(3, 5).astype('float32') + 0.1
+        rng = np.random.RandomState(12)   # unseeded draw flaked 1/500
+        x = rng.rand(3, 5).astype('float32') + 0.1
         norm = np.sqrt((x ** 2).sum(axis=1, keepdims=True) + 1e-10)
         self.inputs = {'X': x}
         self.outputs = {'Out': x / norm, 'Norm': norm}

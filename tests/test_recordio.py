@@ -193,14 +193,12 @@ def test_parallel_scanner_error_paths(tmp_path):
                 pass
 
 
-def test_parallel_scanner_loop_mode_continues_past_one_epoch():
+def test_parallel_scanner_loop_mode_continues_past_one_epoch(tmp_path):
     """loop=True must keep producing across epoch boundaries (the
     reset-the-cursor CAS design deadlocked after exactly one epoch —
     modulo indexing now wraps the atomic cursor)."""
-    import tempfile
     from paddle_tpu import recordio
-    d = tempfile.mkdtemp()
-    p = os.path.join(d, 'loop-shard')
+    p = str(tmp_path / 'loop-shard')
     with recordio.RecordIOWriter(p, max_num_records=4) as w:
         for r in range(10):
             w.append_record(b'rec-%03d' % r)
