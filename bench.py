@@ -142,8 +142,10 @@ def bench_resnet(on_tpu):
             dtypes=['float32', 'int64'], name='resnet_reader',
             use_double_buffer=True)
         image, label = fluid.layers.read_file(rdr)
+        # NHWC on TPU: channels-last is the lane-native layout (one tiny
+        # stem transpose; numerics identical — layout parity test)
         _, avg_cost, _ = resnet.train_network(
-            image, label, class_dim=class_dim, depth=depth)
+            image, label, class_dim=class_dim, depth=depth, nhwc=on_tpu)
         opt = fluid.optimizer.Momentum(learning_rate=0.01, momentum=0.9)
         opt = fluid.contrib.mixed_precision.decorate(opt)
         opt.minimize(avg_cost)

@@ -95,13 +95,16 @@ lookup_table = embedding
 
 def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
            groups=None, param_attr=None, bias_attr=None, use_cudnn=True,
-           act=None, name=None):
-    """2-D convolution, NCHW (reference layers/nn.py:1369). use_cudnn is
-    accepted for API parity and ignored -- XLA picks the conv algorithm."""
+           act=None, name=None, data_format='NCHW'):
+    """2-D convolution (reference layers/nn.py:1369). use_cudnn is
+    accepted for API parity and ignored -- XLA picks the conv algorithm.
+    data_format='NHWC' runs channels-last, the TPU-native layout (channels
+    on the lane dimension); filters stay OIHW in the IR/checkpoint."""
     helper = LayerHelper('conv2d', param_attr=param_attr,
                          bias_attr=bias_attr, act=act, name=name)
     dtype = input.dtype
-    num_channels = input.shape[1]
+    num_channels = input.shape[1] if data_format == 'NCHW' \
+        else input.shape[-1]
     groups = groups or 1
     if num_channels % groups != 0:
         raise ValueError('num_channels must be divisible by groups')
@@ -123,22 +126,23 @@ def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
         inputs={'Input': [input], 'Filter': [w]},
         outputs={'Output': [pre_bias]},
         attrs={'strides': stride, 'paddings': padding, 'dilations': dilation,
-               'groups': groups})
-    pre_act = _append_channel_bias(helper, pre_bias)
+               'groups': groups, 'data_format': data_format})
+    pre_act = _append_channel_bias(helper, pre_bias, data_format)
     return helper.append_activation(pre_act)
 
 
-def _append_channel_bias(helper, pre_bias):
+def _append_channel_bias(helper, pre_bias, data_format='NCHW'):
     bias_attr = helper.bias_attr
     if not bias_attr:
         return pre_bias
-    num_channels = pre_bias.shape[1]
+    ch_axis = 1 if data_format == 'NCHW' else len(pre_bias.shape) - 1
+    num_channels = pre_bias.shape[ch_axis]
     b = helper.create_parameter(attr=bias_attr, shape=[num_channels],
                                 dtype=pre_bias.dtype, is_bias=True)
     tmp = helper.create_variable_for_type_inference(dtype=pre_bias.dtype)
     helper.append_op(type='elementwise_add',
                      inputs={'X': [pre_bias], 'Y': [b]},
-                     outputs={'Out': [tmp]}, attrs={'axis': 1})
+                     outputs={'Out': [tmp]}, attrs={'axis': ch_axis})
     return tmp
 
 
@@ -184,7 +188,7 @@ def conv2d_transpose(input, num_filters, output_size=None, filter_size=None,
 
 def pool2d(input, pool_size=-1, pool_type='max', pool_stride=1,
            pool_padding=0, global_pooling=False, use_cudnn=True,
-           ceil_mode=False, exclusive=True, name=None):
+           ceil_mode=False, exclusive=True, name=None, data_format='NCHW'):
     """2-D pooling (reference layers/nn.py pool2d)."""
     if pool_type not in ('max', 'avg'):
         raise ValueError("pool_type must be 'max' or 'avg'")
@@ -198,7 +202,7 @@ def pool2d(input, pool_size=-1, pool_type='max', pool_stride=1,
         attrs={'pooling_type': pool_type, 'ksize': _pair(pool_size),
                'global_pooling': global_pooling, 'strides': _pair(pool_stride),
                'paddings': _pair(pool_padding), 'ceil_mode': ceil_mode,
-               'exclusive': exclusive})
+               'exclusive': exclusive, 'data_format': data_format})
     return out
 
 

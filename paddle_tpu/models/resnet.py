@@ -3,16 +3,19 @@
 
 Structural parity with reference benchmark/fluid/models/resnet.py (bottleneck
 blocks, conv→bn→relu stem, stage widths 64/128/256/512) but written directly
-against paddle_tpu.layers. NCHW layout; XLA lays out for the MXU."""
+against paddle_tpu.layers. Layout is selectable: NCHW (the reference's
+contract) or NHWC (channels-last — the TPU-native layout, putting C on the
+lane dimension so conv/BN fusions and Pallas kernels stream at full lane
+width; the feed stays NCHW and is transposed once at the stem)."""
 from __future__ import annotations
 
 from .. import layers
 
 
 def conv_bn_layer(input, ch_out, filter_size, stride, padding, act='relu',
-                  is_test=False):
+                  is_test=False, fmt='NCHW'):
     from ..flags import get_flag
-    if get_flag('use_pallas_fused_ops'):
+    if get_flag('use_pallas_fused_ops') and fmt == 'NCHW':
         # single fused op: 1x1 convs lower through the Pallas
         # matmul+BN-stats kernel (ops/fused_ops.py)
         return layers.conv_bn(input, num_filters=ch_out,
@@ -20,38 +23,44 @@ def conv_bn_layer(input, ch_out, filter_size, stride, padding, act='relu',
                               padding=padding, act=act, is_test=is_test)
     conv = layers.conv2d(input=input, num_filters=ch_out,
                          filter_size=filter_size, stride=stride,
-                         padding=padding, act=None, bias_attr=False)
-    return layers.batch_norm(input=conv, act=act, is_test=is_test)
+                         padding=padding, act=None, bias_attr=False,
+                         data_format=fmt)
+    return layers.batch_norm(input=conv, act=act, is_test=is_test,
+                             data_layout=fmt)
 
 
-def shortcut(input, ch_out, stride, is_test=False):
-    ch_in = input.shape[1]
+def shortcut(input, ch_out, stride, is_test=False, fmt='NCHW'):
+    ch_in = input.shape[1 if fmt == 'NCHW' else -1]
     if ch_in != ch_out or stride != 1:
         return conv_bn_layer(input, ch_out, 1, stride, 0, act=None,
-                             is_test=is_test)
+                             is_test=is_test, fmt=fmt)
     return input
 
 
-def basicblock(input, ch_out, stride, is_test=False):
-    short = shortcut(input, ch_out, stride, is_test=is_test)
-    conv1 = conv_bn_layer(input, ch_out, 3, stride, 1, is_test=is_test)
-    conv2 = conv_bn_layer(conv1, ch_out, 3, 1, 1, act=None, is_test=is_test)
+def basicblock(input, ch_out, stride, is_test=False, fmt='NCHW'):
+    short = shortcut(input, ch_out, stride, is_test=is_test, fmt=fmt)
+    conv1 = conv_bn_layer(input, ch_out, 3, stride, 1, is_test=is_test,
+                          fmt=fmt)
+    conv2 = conv_bn_layer(conv1, ch_out, 3, 1, 1, act=None, is_test=is_test,
+                          fmt=fmt)
     return layers.elementwise_add(x=short, y=conv2, act='relu')
 
 
-def bottleneck(input, ch_out, stride, is_test=False):
-    short = shortcut(input, ch_out * 4, stride, is_test=is_test)
-    conv1 = conv_bn_layer(input, ch_out, 1, stride, 0, is_test=is_test)
-    conv2 = conv_bn_layer(conv1, ch_out, 3, 1, 1, is_test=is_test)
+def bottleneck(input, ch_out, stride, is_test=False, fmt='NCHW'):
+    short = shortcut(input, ch_out * 4, stride, is_test=is_test, fmt=fmt)
+    conv1 = conv_bn_layer(input, ch_out, 1, stride, 0, is_test=is_test,
+                          fmt=fmt)
+    conv2 = conv_bn_layer(conv1, ch_out, 3, 1, 1, is_test=is_test, fmt=fmt)
     conv3 = conv_bn_layer(conv2, ch_out * 4, 1, 1, 0, act=None,
-                          is_test=is_test)
+                          is_test=is_test, fmt=fmt)
     return layers.elementwise_add(x=short, y=conv3, act='relu')
 
 
-def layer_warp(block_func, input, ch_out, count, stride, is_test=False):
-    res_out = block_func(input, ch_out, stride, is_test=is_test)
+def layer_warp(block_func, input, ch_out, count, stride, is_test=False,
+               fmt='NCHW'):
+    res_out = block_func(input, ch_out, stride, is_test=is_test, fmt=fmt)
     for _ in range(1, count):
-        res_out = block_func(res_out, ch_out, 1, is_test=is_test)
+        res_out = block_func(res_out, ch_out, 1, is_test=is_test, fmt=fmt)
     return res_out
 
 
@@ -86,21 +95,31 @@ def space_to_depth_stem(input, is_test=False):
 
 
 def resnet_imagenet(input, class_dim=1000, depth=50, is_test=False,
-                    space_to_depth=False):
+                    space_to_depth=False, nhwc=False):
     block_func, stages = _DEPTH_CFG[depth]
+    fmt = 'NHWC' if nhwc else 'NCHW'
     if space_to_depth:
+        if nhwc:
+            raise ValueError('space_to_depth stem is NCHW-only; it cannot '
+                             'be combined with nhwc=True')
         conv = space_to_depth_stem(input, is_test=is_test)
     else:
+        if nhwc:
+            # one tiny [N,3,H,W] -> [N,H,W,3] transpose at the stem; every
+            # activation after this point is channels-last
+            input = layers.transpose(input, perm=[0, 2, 3, 1])
         conv = conv_bn_layer(input, ch_out=64, filter_size=7, stride=2,
-                             padding=3, is_test=is_test)
+                             padding=3, is_test=is_test, fmt=fmt)
     pool = layers.pool2d(input=conv, pool_type='max', pool_size=3,
-                         pool_stride=2, pool_padding=1)
+                         pool_stride=2, pool_padding=1, data_format=fmt)
     res = pool
     for i, count in enumerate(stages):
         res = layer_warp(block_func, res, 64 * (2 ** i), count,
-                         1 if i == 0 else 2, is_test=is_test)
+                         1 if i == 0 else 2, is_test=is_test, fmt=fmt)
     pool = layers.pool2d(input=res, pool_size=7, pool_type='avg',
-                         global_pooling=True)
+                         global_pooling=True, data_format=fmt)
+    # global-pooled [N,1,1,C] (NHWC) flattens to the same [N,C] the NCHW
+    # [N,C,1,1] does, so the fc head is layout-invariant
     out = layers.fc(input=pool, size=class_dim, act='softmax')
     return out
 
@@ -120,15 +139,15 @@ def resnet_cifar10(input, class_dim=10, depth=32, is_test=False):
 
 
 def train_network(image, label, class_dim=1000, depth=50, is_test=False,
-                  variant='imagenet', space_to_depth=False):
+                  variant='imagenet', space_to_depth=False, nhwc=False):
     """Full training graph: predictions, mean cross-entropy loss, accuracy."""
     if variant == 'imagenet':
         predict = resnet_imagenet(image, class_dim=class_dim, depth=depth,
                                   is_test=is_test,
-                                  space_to_depth=space_to_depth)
+                                  space_to_depth=space_to_depth, nhwc=nhwc)
     else:
-        predict = resnet_cifar10(image, class_dim=class_dim, depth=depth,
-                                 is_test=is_test)
+        predict = resnet_cifar10(input=image, class_dim=class_dim,
+                                 depth=depth, is_test=is_test)
     cost = layers.cross_entropy(input=predict, label=label)
     avg_cost = layers.mean(x=cost)
     acc = layers.accuracy(input=predict, label=label)

@@ -6,9 +6,10 @@ cross_entropy_op.cc, softmax_with_cross_entropy_op.cc, dropout_op.cc,
 lookup_table_op.cc, accuracy_op.cc, sigmoid_cross_entropy_with_logits_op.cc}.
 
 All convs/matmuls carry `preferred_element_type` so the MXU accumulates in
-fp32 even when activations are bf16. Layouts stay NCHW at the API surface
-(Paddle's contract); XLA's layout assignment re-tiles for the MXU internally,
-so there is no NHWC conversion pass like the reference's cuDNN path needs.
+fp32 even when activations are bf16. Layout is per-op: NCHW (Paddle's
+default contract) or data_format='NHWC' (channels-last, the TPU lane-native
+layout) on conv2d/pool2d and data_layout on batch_norm; filters stay OIHW
+in the IR/checkpoint contract in both modes.
 """
 from __future__ import annotations
 
@@ -32,8 +33,13 @@ def _conv2d_common_emit(ctx, op):
     paddings = op.attr('paddings', [0, 0])
     dilations = op.attr('dilations', [1, 1])
     groups = op.attr('groups', 1) or 1
+    # data_format NHWC puts channels on the TPU lane dimension end to end
+    # (the layout XLA's own assignment picks physically); filters stay
+    # OIHW in the IR/checkpoint contract and are relaid here
+    nhwc = op.attr('data_format', 'NCHW') == 'NHWC'
+    ch_axis = 3 if nhwc else 1
     if op.type == 'depthwise_conv2d':
-        groups = x.shape[1]
+        groups = x.shape[ch_axis]
     # bf16 operands on TPU: no explicit accumulator upcast -- the MXU
     # accumulates bf16 convs in fp32 internally, and JAX's conv transpose
     # rule rejects mixed-dtype operands that preferred_element_type would
@@ -48,7 +54,8 @@ def _conv2d_common_emit(ctx, op):
         window_strides=tuple(strides),
         padding=[(paddings[0], paddings[0]), (paddings[1], paddings[1])],
         rhs_dilation=tuple(dilations),
-        dimension_numbers=('NCHW', 'OIHW', 'NCHW'),
+        dimension_numbers=(('NHWC', 'OIHW', 'NHWC') if nhwc
+                           else ('NCHW', 'OIHW', 'NCHW')),
         feature_group_count=groups)
     ctx.set(op.single_output('Output'), out.astype(out_dtype))
 
@@ -66,12 +73,16 @@ def _conv2d_infer(op, block):
     strides = op.attr('strides', [1, 1])
     paddings = op.attr('paddings', [0, 0])
     dilations = op.attr('dilations', [1, 1])
-    n, _, h, wd = x.shape
+    nhwc = op.attr('data_format', 'NCHW') == 'NHWC'
+    if nhwc:
+        n, h, wd, _ = x.shape
+    else:
+        n, _, h, wd = x.shape
     oc, _, kh, kw = w.shape
+    oh = _conv_out_size(h, kh, paddings[0], strides[0], dilations[0])
+    ow = _conv_out_size(wd, kw, paddings[1], strides[1], dilations[1])
     out = block.var_recursive(op.single_output('Output'))
-    out.shape = (n, oc,
-                 _conv_out_size(h, kh, paddings[0], strides[0], dilations[0]),
-                 _conv_out_size(wd, kw, paddings[1], strides[1], dilations[1]))
+    out.shape = (n, oh, ow, oc) if nhwc else (n, oc, oh, ow)
     out.dtype = x.dtype
 
 
@@ -169,15 +180,22 @@ def _pool2d_emit(ctx, op):
     ksize = list(op.attr('ksize'))
     strides = list(op.attr('strides', [1, 1]))
     paddings = list(op.attr('paddings', [0, 0]))
+    nhwc = op.attr('data_format', 'NCHW') == 'NHWC'
+    hw = (1, 2) if nhwc else (2, 3)
     if op.attr('global_pooling', False):
-        ksize = [x.shape[2], x.shape[3]]
+        ksize = [x.shape[hw[0]], x.shape[hw[1]]]
         strides = [1, 1]
         paddings = [0, 0]
-    window = (1, 1, ksize[0], ksize[1])
-    strides4 = (1, 1, strides[0], strides[1])
-    sp = _pool_spatial_pads([x.shape[2], x.shape[3]], ksize, strides,
+    if nhwc:
+        window = (1, ksize[0], ksize[1], 1)
+        strides4 = (1, strides[0], strides[1], 1)
+    else:
+        window = (1, 1, ksize[0], ksize[1])
+        strides4 = (1, 1, strides[0], strides[1])
+    sp = _pool_spatial_pads([x.shape[hw[0]], x.shape[hw[1]]], ksize, strides,
                             paddings, op.attr('ceil_mode', False))
-    pads = ((0, 0), (0, 0)) + tuple(sp)
+    pads = (((0, 0),) + tuple(sp) + ((0, 0),)) if nhwc \
+        else ((0, 0), (0, 0)) + tuple(sp)
     padded = any(lo or hi for lo, hi in sp)
     if ptype == 'max':
         init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
@@ -196,10 +214,14 @@ def _pool2d_emit(ctx, op):
 
 def _pool2d_infer(op, block):
     x = block.var_recursive(op.single_input('X'))
-    n, c, h, w = x.shape
+    nhwc = op.attr('data_format', 'NCHW') == 'NHWC'
+    if nhwc:
+        n, h, w, c = x.shape
+    else:
+        n, c, h, w = x.shape
     out = block.var_recursive(op.single_output('Out'))
     if op.attr('global_pooling', False):
-        out.shape = (n, c, 1, 1)
+        out.shape = (n, 1, 1, c) if nhwc else (n, c, 1, 1)
     else:
         ksize = op.attr('ksize')
         strides = op.attr('strides', [1, 1])
@@ -211,8 +233,9 @@ def _pool2d_infer(op, block):
             if op.attr('ceil_mode', False):
                 return (i - k + 2 * p + s - 1) // s + 1
             return (i - k + 2 * p) // s + 1
-        out.shape = (n, c, osz(h, ksize[0], paddings[0], strides[0]),
-                     osz(w, ksize[1], paddings[1], strides[1]))
+        oh = osz(h, ksize[0], paddings[0], strides[0])
+        ow = osz(w, ksize[1], paddings[1], strides[1])
+        out.shape = (n, oh, ow, c) if nhwc else (n, c, oh, ow)
     out.dtype = x.dtype
 
 

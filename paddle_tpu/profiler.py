@@ -214,6 +214,14 @@ def profiler(state='All', sorted_key=None, profile_path='/tmp/profile'):
         try:
             import jax
             trace_dir = profile_path + '.xplane'
+            # clear stale captures: start_trace APPENDS a new dated run
+            # under <dir>/plugins/profile/, and device_op_events globs
+            # every *.xplane.pb recursively — a leftover run from an
+            # earlier session would silently double-count device time
+            # and poison the instr->op join with foreign module names
+            if os.path.isdir(trace_dir):
+                import shutil
+                shutil.rmtree(trace_dir)
             jax.profiler.start_trace(trace_dir)
             jax_trace = trace_dir
         except Exception:

@@ -1,0 +1,121 @@
+"""NHWC (channels-last) layout parity: the TPU-native layout must be
+numerically identical to the reference NCHW contract.
+
+Reference analog: conv_op.cc / batch_norm_op.cc accept a data_format /
+data_layout attribute (cuDNN path uses it for tensor descriptors); here
+NHWC additionally puts channels on the TPU lane dimension end to end.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import unique_name
+from paddle_tpu.models import resnet
+
+
+def _build(nhwc):
+    main, startup = fluid.Program(), fluid.Program()
+    with unique_name.guard():
+        with fluid.program_guard(main, startup):
+            img = fluid.layers.data(name='image', shape=[3, 32, 32],
+                                    dtype='float32')
+            lbl = fluid.layers.data(name='label', shape=[1], dtype='int64')
+            _, cost, _ = resnet.train_network(img, lbl, class_dim=10,
+                                              depth=18, nhwc=nhwc)
+            fluid.optimizer.Momentum(0.001, 0.9).minimize(cost)
+    return main, startup, cost
+
+
+class TestNHWCParity:
+    def test_resnet18_training_parity(self):
+        """Same weights -> identical losses across 4 training steps in
+        either layout (fwd, backward, and optimizer all agree)."""
+        rng = np.random.RandomState(0)
+        xb = rng.rand(8, 3, 32, 32).astype('f4')
+        yb = rng.randint(0, 10, (8, 1)).astype('int64')
+        snap = {}
+
+        def run(nhwc, seed_params):
+            main, startup, cost = _build(nhwc)
+            scope = fluid.Scope()
+            with fluid.scope_guard(scope):
+                exe = fluid.Executor(fluid.CPUPlace())
+                exe.run(startup)
+                names = [v.name for v in main.list_vars() if v.persistable]
+                for n in names:
+                    if seed_params:
+                        snap[n] = np.array(np.asarray(scope.find_var(n)))
+                    elif n in snap:
+                        scope.set_var(n, snap[n])
+                out = []
+                for _ in range(4):
+                    l, = exe.run(main, feed={'image': xb, 'label': yb},
+                                 fetch_list=[cost])
+                    out.append(float(l))
+            return out
+
+        a = run(False, True)
+        b = run(True, False)
+        np.testing.assert_allclose(a, b, rtol=3e-3, atol=1e-3)
+
+    @pytest.mark.parametrize('case', ['conv', 'conv_bias', 'pool_max',
+                                      'pool_avg_global', 'depthwise'])
+    def test_op_level_parity(self, case):
+        rng = np.random.RandomState(1)
+        x_np = rng.rand(2, 6, 9, 9).astype('f4')
+
+        def net(fmt):
+            main, startup = fluid.Program(), fluid.Program()
+            with unique_name.guard():
+                with fluid.program_guard(main, startup):
+                    x = fluid.layers.data(name='x', shape=[6, 9, 9],
+                                          dtype='float32')
+                    x.stop_gradient = False
+                    if fmt == 'NHWC':
+                        x = fluid.layers.transpose(x, perm=[0, 2, 3, 1])
+                    if case == 'conv':
+                        y = fluid.layers.conv2d(
+                            x, 8, 3, padding=1, stride=2, bias_attr=False,
+                            data_format=fmt)
+                    elif case == 'conv_bias':
+                        y = fluid.layers.conv2d(
+                            x, 8, 3, padding=1, data_format=fmt)
+                    elif case == 'depthwise':
+                        y = fluid.layers.conv2d(
+                            x, 6, 3, padding=1, groups=6, bias_attr=False,
+                            data_format=fmt)
+                    elif case == 'pool_max':
+                        y = fluid.layers.pool2d(
+                            x, pool_size=3, pool_type='max', pool_stride=2,
+                            pool_padding=1, data_format=fmt)
+                    else:
+                        y = fluid.layers.pool2d(
+                            x, pool_type='avg', global_pooling=True,
+                            data_format=fmt)
+                    if fmt == 'NHWC':
+                        y = fluid.layers.transpose(y, perm=[0, 3, 1, 2])
+                    loss = fluid.layers.reduce_mean(y)
+                    fluid.backward.append_backward(loss)
+                    g = fluid.framework.grad_var_name('x')
+            scope = fluid.Scope()
+            with fluid.scope_guard(scope):
+                exe = fluid.Executor(fluid.CPUPlace())
+                exe.run(startup)
+                names = [v.name for v in main.list_vars() if v.persistable]
+                for n in names:
+                    arr = np.array(np.asarray(scope.find_var(n)))
+                    seeded = getattr(net, '_snap', {})
+                    if n in seeded:
+                        scope.set_var(n, seeded[n])
+                    else:
+                        seeded[n] = arr
+                        net._snap = seeded
+                y_v, g_v = exe.run(main, feed={'x': x_np},
+                                   fetch_list=[y, g])
+            return np.asarray(y_v), np.asarray(g_v)
+
+        net._snap = {}
+        y_a, g_a = net('NCHW')
+        y_b, g_b = net('NHWC')
+        np.testing.assert_allclose(y_a, y_b, rtol=1e-5, atol=1e-5)
+        np.testing.assert_allclose(g_a, g_b, rtol=1e-5, atol=1e-5)
