@@ -1,0 +1,254 @@
+"""Memory-wall evidence for the ResNet bench step (VERDICT round-5 #1).
+
+For every top device instruction of the REAL bench training step, computes
+the bytes it moves (operand + output shapes from the compiled HLO) and the
+FLOPs it performs (for conv-rooted fusions, from the IR conv descriptor),
+then reports achieved GB/s and the attainment against the per-instruction
+roofline  max(bytes / HBM_BW, flops / MXU_PEAK).
+
+This is the proof obligation from the round-4 verdict: if the dominant
+fused regions stream at >=80% of the measured HBM bandwidth, the remaining
+gap to the coarse "activation-sweep" floor is irreducible traffic
+(statistics re-reads, masks, junction sums), not fusion quality.
+
+    python tools/resnet_wall.py [--batch 256] [--top 25]
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import re
+import sys
+import time
+from collections import defaultdict
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(
+    os.path.abspath(__file__)), '..'))
+
+MXU_PEAK = 155e12          # measured chained-matmul ceiling (PERF.md)
+HBM_BW_SPEC = 819e9        # v5e spec
+HBM_BW_MEAS = 639e9        # measured elementwise stream rate (PERF.md r4)
+
+_DTYPE_BYTES = {'f32': 4, 'bf16': 2, 'f16': 2, 's32': 4, 'u32': 4,
+                'pred': 1, 's8': 1, 'u8': 1, 's64': 8, 'u64': 8, 'f64': 8,
+                's16': 2, 'u16': 2}
+
+_SHAPE_RE = re.compile(r'(\w+)\[([\d,]*)\]')
+
+
+def shape_bytes(type_str):
+    """Total bytes of an HLO type string (handles tuples)."""
+    total = 0
+    for dt, dims in _SHAPE_RE.findall(type_str):
+        if dt not in _DTYPE_BYTES:
+            continue
+        n = 1
+        for d in dims.split(','):
+            if d:
+                n *= int(d)
+        total += n * _DTYPE_BYTES[dt]
+    return total
+
+
+def parse_hlo(text):
+    """name -> (output_type_str, [operand names])."""
+    defs = {}
+    for line in text.split('\n'):
+        m = re.match(r'\s*(?:ROOT )?%([\w.-]+) = (.*)', line)
+        if not m:
+            continue
+        name, rest = m.group(1), m.group(2)
+        # "TYPE opcode(args), attrs..." — TYPE may itself contain parens
+        # (tuple types, layout tiles like T(8,128)), so locate the opcode
+        # as the first bare lowercase word directly followed by '('
+        mo = re.search(r'(?:^|\s)([a-z][a-z0-9-]*)\(', rest)
+        if not mo:
+            defs[name] = (rest, [])
+            continue
+        out_type = rest[:mo.start(1)]
+        args = []
+        depth_ = 0
+        for i in range(mo.end(1), len(rest)):
+            if rest[i] == '(':
+                depth_ += 1
+            elif rest[i] == ')':
+                depth_ -= 1
+                if depth_ == 0:
+                    args = re.findall(r'%([\w.-]+)',
+                                      rest[mo.end(1):i + 1])
+                    break
+        defs[name] = (out_type, args)
+    return defs
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument('--batch', type=int, default=256)
+    ap.add_argument('--top', type=int, default=25)
+    ap.add_argument('--nchw', action='store_true')
+    ap.add_argument('--reuse', action='store_true',
+                    help='re-analyze the last capture without re-running')
+    args = ap.parse_args()
+
+    import jax
+    import paddle_tpu as fluid
+    from paddle_tpu import profiler
+    from paddle_tpu.models import resnet
+
+    fluid.flags.set_flags({'FLAGS_amp_bf16_param_grads': True})
+    batch, hw, class_dim = args.batch, 224, 1000
+    main_prog, startup_prog = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main_prog, startup_prog):
+        image = fluid.layers.data(name='image', shape=[3, hw, hw],
+                                  dtype='float32')
+        label = fluid.layers.data(name='label', shape=[1], dtype='int64')
+        _, avg_cost, _ = resnet.train_network(
+            image, label, class_dim=class_dim, depth=50,
+            nhwc=not args.nchw)
+        opt = fluid.optimizer.Momentum(learning_rate=0.01, momentum=0.9)
+        opt = fluid.contrib.mixed_precision.decorate(opt)
+        opt.minimize(avg_cost)
+
+    nsteps = 3
+    if not args.reuse:
+        exe = fluid.Executor(fluid.TPUPlace())
+        exe.run(startup_prog)
+        pe = fluid.ParallelExecutor(use_cuda=True, loss_name=avg_cost.name,
+                                    main_program=main_prog)
+        rng = np.random.RandomState(0)
+        feed = {'image': jax.device_put(rng.rand(batch, 3, hw, hw)
+                                        .astype('float32')),
+                'label': jax.device_put(rng.randint(0, class_dim,
+                                                    (batch, 1))
+                                        .astype('int64'))}
+        for _ in range(3):
+            wl = pe.run(fetch_list=[avg_cost.name], feed=feed,
+                        return_numpy=False)
+        float(np.asarray(wl[0]))
+
+        def timed(n):
+            t0 = time.perf_counter()
+            for _ in range(n):
+                l = pe.run(fetch_list=[avg_cost.name], feed=feed,
+                           return_numpy=False)
+            float(np.asarray(l[0]))
+            return time.perf_counter() - t0
+
+        w1 = timed(10)
+        w2 = timed(20)
+        step_ms = max(w2 - w1, 1e-9) / 10 * 1e3
+        print('step: %.1f ms (%.0f img/s)'
+              % (step_ms, batch / step_ms * 1e3))
+
+        with profiler.profiler('All', None, '/tmp/rn_wall'):
+            for _ in range(nsteps):
+                l = pe.run(fetch_list=[avg_cost.name], feed=feed,
+                           return_numpy=False)
+            float(np.asarray(l[0]))
+
+    import glob
+    texts = [open(f).read() for f in sorted(glob.glob('/tmp/rn_wall.hlo/*.txt'))]
+    # the main segment is the biggest text (startup has no convs)
+    main_text = max(texts, key=lambda t: t.count('convolution'))
+    defs = parse_hlo(main_text)
+    op_map = profiler.hlo_op_map([main_text])
+
+    # conv flops per IR op index (for conv-rooted fusions)
+    block = main_prog.global_block()
+    nhwc = not args.nchw
+    conv_flops = {}
+    for idx, op in enumerate(block.ops):
+        if op.type in ('conv2d', 'conv2d_grad'):
+            x = block.var_recursive(op.single_input('Input'))
+            w = block.var_recursive(op.single_input('Filter'))
+            oc, ic, kh, kw = w.shape
+            if nhwc:
+                n, h, wd, _ = x.shape
+            else:
+                n, _, h, wd = x.shape
+            s = op.attr('strides', [1, 1])[0]
+            mult = 1 if op.type == 'conv2d' else 2
+            conv_flops[idx] = mult * 2 * batch * (h // s) * (wd // s) \
+                * oc * ic * kh * kw
+
+    durs = defaultdict(float)
+    from jax.profiler import ProfileData
+    for fn in sorted(glob.glob('/tmp/rn_wall.xplane/**/*.xplane.pb',
+                               recursive=True)):
+        p = ProfileData.from_file(fn)
+        for plane in p.planes:
+            if not plane.name.startswith('/device:'):
+                continue
+            for line in plane.lines:
+                if line.name != 'XLA Ops':
+                    continue
+                for e in line.events:
+                    durs[e.name.split(' = ')[0].lstrip('%')] += e.duration_ns
+
+    total_ms = sum(durs.values()) / nsteps / 1e6
+    rows = []
+    for instr, ns in durs.items():
+        ms = ns / nsteps / 1e6
+        d = defs.get(instr)
+        if d is None:
+            rows.append((ms, instr, '?', None, None))
+            continue
+        out_type, operands = d
+        byts = shape_bytes(out_type)
+        for o in operands:
+            od = defs.get(o)
+            if od:
+                byts += shape_bytes(od[0])
+        label = op_map.get(instr, '')
+        fl = 0
+        m = re.match(r'conv2d(_grad)?\.(\d+)', label)
+        if m:
+            fl = conv_flops.get(int(m.group(2)), 0)
+        rows.append((ms, instr, label or instr, byts, fl))
+
+    rows.sort(reverse=True)
+    print('device total: %.1f ms/step' % total_ms)
+    print('| instr | IR op | ms | GB | GB/s | TF/s | roof ms | attain |')
+    print('|---|---|---|---|---|---|---|---|')
+    covered = 0.0
+    attained_w = 0.0
+    for ms, instr, label, byts, fl in rows[:args.top]:
+        if byts is None:
+            print('| %s | %s | %.2f | ? | ? | ? | ? | ? |' % (instr, label, ms))
+            continue
+        gb = byts / 1e9
+        gbs = byts / (ms / 1e3) / 1e9 if ms else 0
+        tfs = (fl or 0) / (ms / 1e3) / 1e12 if ms else 0
+        roof_ms = max(byts / HBM_BW_SPEC, (fl or 0) / MXU_PEAK) * 1e3
+        att = roof_ms / ms if ms else 0
+        covered += ms
+        attained_w += att * ms
+        print('| %s | %s | %5.2f | %5.2f | %5.0f | %5.1f | %5.2f | %4.0f%% |'
+              % (instr, label, ms, gb, gbs, tfs, roof_ms, att * 100))
+    print('top-%d cover %.1f/%.1f ms/step (%.0f%%); '
+          'time-weighted roofline attainment %.0f%%'
+          % (args.top, covered, total_ms, 100 * covered / total_ms,
+             100 * attained_w / max(covered, 1e-9)))
+    # full-coverage aggregate (all attributable instructions)
+    all_cov = all_att = below = 0.0
+    for ms, instr, label, byts, fl in rows:
+        if byts is None or ms <= 0:
+            continue
+        roof_ms = max(byts / HBM_BW_SPEC, (fl or 0) / MXU_PEAK) * 1e3
+        att = min(roof_ms / ms, 1.5)
+        all_cov += ms
+        all_att += att * ms
+        if att < 0.8:
+            below += ms
+    print('ALL %d instrs: %.1f ms attributed, attainment %.0f%%, '
+          'time below 80%% roofline: %.1f ms'
+          % (len(rows), all_cov, 100 * all_att / max(all_cov, 1e-9), below))
+    print('(attainment = max(bytes/%d GB/s, flops/%d TF/s) over measured '
+          'time; >=80%% means the region is at the memory wall)'
+          % (HBM_BW_SPEC / 1e9, MXU_PEAK / 1e12))
+
+
+if __name__ == '__main__':
+    main()
