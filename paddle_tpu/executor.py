@@ -256,7 +256,8 @@ class EmitContext(object):
     for IR-level constant folding, e.g. tensor-array indices)."""
 
     __slots__ = ('env', 'block', 'rng_key', 'is_test', '_op_index',
-                 '_block_pos', '_fold_limits', 'mesh', 'amp')
+                 '_block_pos', '_fold_limits', 'mesh', 'amp',
+                 'bn_local_stats')
 
     def __init__(self, env, block, rng_key, is_test, amp=False):
         self.env = env
@@ -274,6 +275,9 @@ class EmitContext(object):
         # device mesh for sharding_constraint emitters; None on a plain
         # single-device Executor (ParallelExecutor sets its Mesh)
         self.mesh = None
+        # per-executor BuildStrategy.bn_local_stats override (None =
+        # follow the global flag); see ops/nn_ops.py _bn_local_mode
+        self.bn_local_stats = None
 
     def get(self, name):
         try:
@@ -690,6 +694,7 @@ class Executor(object):
         ctx = EmitContext(env, block, rng_key, program._is_test,
                           amp=getattr(program, '_use_bf16', False))
         ctx.mesh = self._emit_mesh()
+        ctx.bn_local_stats = getattr(self, '_bn_local_stats', None)
         for op, off in zip(segment.ops, segment.op_offsets):
             ctx._op_index = off
             ctx._block_pos = off
@@ -768,6 +773,7 @@ class Executor(object):
             env.update(donated)
             ctx = EmitContext(env, block, rng_key, is_test, amp=amp)
             ctx.mesh = self._emit_mesh()
+            ctx.bn_local_stats = getattr(self, '_bn_local_stats', None)
             for op, off in zip(ops, offsets):
                 ctx._op_index = off
                 ctx._block_pos = off

@@ -61,6 +61,18 @@ _DEFAULTS = {
     # optimizer's dominant HBM stream; one rounding per step; master
     # params stay fp32). Off by default for exact-fp32 parity.
     'bf16_momentum': False,
+    # batch_norm under data parallelism: compute statistics per device
+    # (the reference's semantics — multi_devices_graph_pass.cc replicates
+    # batch_norm per device, so stats are local and un-synced) instead of
+    # the default cross-replica SyncBN that GSPMD derives from reducing
+    # over the sharded batch. Local mode removes every per-step BN-stat
+    # all-reduce from the compiled HLO (116 latency-bound collectives in
+    # the n=8 ResNet-50 step); scale/bias grads are psum'd so they join
+    # the one coalesced gradient all-reduce. Running means/variances
+    # update from LOCAL stats and therefore diverge per device exactly as
+    # the reference's per-device copies do (the addressable shard-0 copy
+    # wins at save/fetch time). See COVERAGE.md "divergences".
+    'bn_local_stats': False,
 }
 
 _FLAGS = dict(_DEFAULTS)

@@ -264,6 +264,42 @@ def _bn_batch_stats(x, axes):
     return mean, var
 
 
+def _bn_local_mode(ctx, op):
+    """True when this batch_norm should use per-device local statistics
+    (reference multi_devices_graph_pass.cc semantics: batch_norm is
+    replicated per device, stats never cross devices). Requires a mesh
+    with a 'dp' axis; training mode only. Per-executor BuildStrategy
+    override (ctx.bn_local_stats) wins over the global flag."""
+    from ..flags import get_flag
+    local = getattr(ctx, 'bn_local_stats', None)
+    if local is None:
+        local = get_flag('bn_local_stats')
+    return bool(local) and ctx.mesh is not None \
+        and 'dp' in ctx.mesh.axis_names
+
+
+def _bn_shard_map(ctx, fn, n_big, n_small, out_specs):
+    """shard_map wrapper for the local-stats paths: the first n_big args
+    are batch-dim-sharded activations, the rest are replicated channel
+    vectors. check_rep=False because per-device statistics outputs are
+    deliberately divergent across devices (reference per-device BN
+    state)."""
+    import inspect
+    from jax.sharding import PartitionSpec as P
+    try:
+        from jax import shard_map
+    except ImportError:                       # older jax
+        from jax.experimental.shard_map import shard_map
+    # the replication-check kwarg was renamed check_rep -> check_vma;
+    # probe the signature rather than the import path
+    sig = inspect.signature(shard_map).parameters
+    kw = ({'check_vma': False} if 'check_vma' in sig
+          else {'check_rep': False})
+    in_specs = tuple([P('dp')] * n_big + [P()] * n_small)
+    return shard_map(fn, mesh=ctx.mesh, in_specs=in_specs,
+                     out_specs=out_specs, **kw)
+
+
 @op_emitter('batch_norm')
 def _batch_norm_emit(ctx, op):
     x = ctx.get(op.single_input('X'))
@@ -280,25 +316,46 @@ def _batch_norm_emit(ctx, op):
     ch_shape = [1] * x.ndim
     ch_shape[1 if layout == 'NCHW' else -1] = -1
 
-    if is_test:
-        use_mean, use_var = mean, var
-        saved_mean = mean
-        saved_var = var
-        mean_out, var_out = mean, var
-    else:
-        use_mean, use_var = _bn_batch_stats(x, axes)
-        saved_mean = use_mean
-        saved_var = use_var
-        mean_out = mean * momentum + use_mean * (1 - momentum)
-        var_out = var * momentum + use_var * (1 - momentum)
+    def _affine(x_, mean_, var_, scale_, bias_):
+        # Fold (mean, inv_std, scale, bias) into one per-channel (a, b) so
+        # the normalize pass is a single fused multiply-add over the
+        # bf16 stream.
+        inv_std = jax.lax.rsqrt(var_.astype(jnp.float32) + eps)
+        a = scale_.astype(jnp.float32) * inv_std
+        b = bias_.astype(jnp.float32) - mean_.astype(jnp.float32) * a
+        y_ = x_.astype(jnp.float32) * a.reshape(ch_shape) + b.reshape(ch_shape)
+        return y_.astype(x_.dtype)
 
-    # Fold (mean, inv_std, scale, bias) into one per-channel (a, b) so the
-    # normalize pass is a single fused multiply-add over the bf16 stream.
-    inv_std = jax.lax.rsqrt(use_var.astype(jnp.float32) + eps)
-    a = scale.astype(jnp.float32) * inv_std
-    b = bias.astype(jnp.float32) - use_mean.astype(jnp.float32) * a
-    y = x.astype(jnp.float32) * a.reshape(ch_shape) + b.reshape(ch_shape)
-    ctx.set(op.single_output('Y'), y.astype(x.dtype))
+    if not is_test and _bn_local_mode(ctx, op):
+        # per-device statistics (reference replicated-batch_norm
+        # semantics): zero collectives; running stats diverge per device
+        from jax.sharding import PartitionSpec as P
+
+        def fwd(x_s, scale_s, bias_s, mean_s, var_s):
+            lm, lv = _bn_batch_stats(x_s, axes)
+            y_s = _affine(x_s, lm, lv, scale_s, bias_s)
+            mo = mean_s * momentum + lm * (1 - momentum)
+            vo = var_s * momentum + lv * (1 - momentum)
+            return y_s, mo, vo, lm, lv
+
+        y, mean_out, var_out, saved_mean, saved_var = _bn_shard_map(
+            ctx, fwd, 1, 4, (P('dp'), P(), P(), P(), P()))(
+                x, scale, bias, mean, var)
+        ctx.set(op.single_output('Y'), y)
+    else:
+        if is_test:
+            use_mean, use_var = mean, var
+            saved_mean = mean
+            saved_var = var
+            mean_out, var_out = mean, var
+        else:
+            use_mean, use_var = _bn_batch_stats(x, axes)
+            saved_mean = use_mean
+            saved_var = use_var
+            mean_out = mean * momentum + use_mean * (1 - momentum)
+            var_out = var * momentum + use_var * (1 - momentum)
+        ctx.set(op.single_output('Y'),
+                _affine(x, use_mean, use_var, scale, bias))
     if op.output('MeanOut'):
         ctx.set(op.single_output('MeanOut'), mean_out)
     if op.output('VarianceOut'):
@@ -379,6 +436,40 @@ def _batch_norm_grad_emit(ctx, op):
     m = 1
     for i in axes:
         m *= x.shape[i]
+
+    if not is_test and _bn_local_mode(ctx, op):
+        # per-device backward: local statistics recomputed per shard
+        # (deterministic, identical to the forward's local stats); dx is
+        # fully local; scale/bias grads are psum'd so GSPMD's collective
+        # combiner folds them into the ONE coalesced gradient all-reduce
+        from jax.sharding import PartitionSpec as P
+
+        def bwd(x_s, gy_s, scale_s):
+            m_l = 1
+            for i in axes:
+                m_l *= x_s.shape[i]
+            lm, lv = _bn_batch_stats(x_s, axes)
+            inv_std = jax.lax.rsqrt(lv + eps)
+            xf_s = x_s.astype(jnp.float32)
+            gyf_s = gy_s.astype(jnp.float32)
+            xhat = (xf_s - lm.reshape(ch_shape)) * inv_std.reshape(ch_shape)
+            sum_dy = jnp.sum(gyf_s, axis=axes)
+            sum_dy_xhat = jnp.sum(gyf_s * xhat, axis=axes)
+            coef = (scale_s.astype(jnp.float32) * inv_std) / m_l
+            gx_s = (coef.reshape(ch_shape)
+                    * (m_l * gyf_s - sum_dy.reshape(ch_shape)
+                       - xhat * sum_dy_xhat.reshape(ch_shape)))
+            gs = jax.lax.psum(sum_dy_xhat, 'dp')
+            gb = jax.lax.psum(sum_dy, 'dp')
+            return gx_s.astype(x_s.dtype), gs, gb
+
+        gx, gscale, gbias = _bn_shard_map(
+            ctx, bwd, 2, 1, (P('dp'), P(), P()))(x, gy, scale)
+        bias = ctx.get(fwd_inputs['Bias'][0])
+        ctx.set(op.single_output('X@GRAD'), gx)
+        ctx.set(op.single_output('Scale@GRAD'), gscale.astype(scale.dtype))
+        ctx.set(op.single_output('Bias@GRAD'), gbias.astype(bias.dtype))
+        return
 
     xf = x.astype(jnp.float32)
     gyf = gy.astype(jnp.float32)

@@ -71,6 +71,13 @@ class BuildStrategy(object):
             BuildStrategy.GradientScaleStrategy.CoeffNumDevice
         self.debug_graphviz_path = ''
         self.enable_data_balance = False
+        # per-device batch_norm statistics under data parallelism — the
+        # reference's semantics (multi_devices_graph_pass.cc replicates
+        # batch_norm per device). Default False = SyncBN (GSPMD reduces
+        # stats over the sharded batch: numerically stronger, but one
+        # latency-bound all-reduce per BN per direction per step).
+        # Maps onto FLAGS_bn_local_stats at construction.
+        self.bn_local_stats = False
 
 
 class ParallelExecutor(Executor):
@@ -96,6 +103,12 @@ class ParallelExecutor(Executor):
         self._loss_name = loss_name
         self._exec_strategy = exec_strategy or ExecutionStrategy()
         self._build_strategy = build_strategy or BuildStrategy()
+        # per-executor BN-stats override: True forces local stats for THIS
+        # executor's programs only; False (default) inherits the global
+        # FLAGS_bn_local_stats — no process-global state is mutated
+        self._bn_local_stats = (
+            True if getattr(self._build_strategy, 'bn_local_stats', False)
+            else None)
         self._num_trainers = num_trainers
         self._trainer_id = trainer_id
         self._scope = scope or global_scope()

@@ -145,7 +145,7 @@ def run_one(model, mode, steps, full):
             'loss': round(float(np.asarray(lv[0]).mean()), 4)}
 
 
-def run_scaling(model, steps, full):
+def run_scaling(model, steps, full, bn_local_stats=False):
     """Weak-scaling + collective audit (VERDICT round-4 #4; the
     BASELINE 'ParallelExecutor scaling eff' metric's measurement path;
     reference analog: benchmark/fluid/fluid_benchmark.py:198
@@ -164,85 +164,92 @@ def run_scaling(model, steps, full):
     devices = jax.devices()
     sizes = [n for n in (1, 2, 4, 8) if n <= len(devices)]
     out = {'model': model, 'mode': 'scaling', 'points': []}
-    audit_exe = None
-    for n in sizes:
-        loss, feed_fn, bs, scope, exe = _fresh_build(model, full)
-        pe = fluid.ParallelExecutor(
-            use_cuda=full, loss_name=loss.name,
-            main_program=fluid.default_main_program(), scope=scope,
-            devices=devices[:n])
-        rng = np.random.RandomState(0)
-        global_bs = bs * sizes[-1]        # SAME global batch at every n
-        f = feed_fn(rng, global_bs)
-        pe.run(fetch_list=[loss.name], feed=f)     # compile
-        t0 = time.perf_counter()
-        for _ in range(steps):
-            lv = pe.run(fetch_list=[loss.name], feed=f)
-        dt = (time.perf_counter() - t0) / steps
-        out['points'].append({'devices': n, 'step_ms': round(dt * 1e3, 2)})
-        if n == sizes[-1]:
-            audit_exe = pe
-    base = out['points'][0]['step_ms']
-    for p in out['points']:
-        p['efficiency_vs_1dev'] = round(base / p['step_ms'], 3)
+    prior_bn_local = fluid.flags.get_flag('bn_local_stats')
+    if bn_local_stats:
+        out['bn_local_stats'] = True
+        fluid.flags.set_flags({'FLAGS_bn_local_stats': True})
+    try:
+        audit_exe = None
+        for n in sizes:
+            loss, feed_fn, bs, scope, exe = _fresh_build(model, full)
+            pe = fluid.ParallelExecutor(
+                use_cuda=full, loss_name=loss.name,
+                main_program=fluid.default_main_program(), scope=scope,
+                devices=devices[:n])
+            rng = np.random.RandomState(0)
+            global_bs = bs * sizes[-1]        # SAME global batch at every n
+            f = feed_fn(rng, global_bs)
+            pe.run(fetch_list=[loss.name], feed=f)     # compile
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                lv = pe.run(fetch_list=[loss.name], feed=f)
+            dt = (time.perf_counter() - t0) / steps
+            out['points'].append({'devices': n, 'step_ms': round(dt * 1e3, 2)})
+            if n == sizes[-1]:
+                audit_exe = pe
+        base = out['points'][0]['step_ms']
+        for p in out['points']:
+            p['efficiency_vs_1dev'] = round(base / p['step_ms'], 3)
 
-    # ---- collective audit on the widest mesh ----
-    if audit_exe is not None:
-        kinds = ('all-reduce', 'all-gather', 'reduce-scatter',
-                 'collective-permute', 'all-to-all')
-        colls = {k: [] for k in kinds}
-        dt_bytes = {'f32': 4, 'bf16': 2, 's32': 4, 'f16': 2, 'u32': 4,
-                    'pred': 1, 's64': 8, 'f64': 8}
-        # 'all-reduce(' after the type part, incl. the async '-start'
-        # form real-TPU XLA emits ('-done' excluded: same collective)
-        kind_re = re.compile(
-            r'[)\]}] (all-reduce|all-gather|reduce-scatter|'
-            r'collective-permute|all-to-all)(?:-start)?\(')
-        for text in audit_exe.compiled_hlo_texts():
-            for line in text.splitlines():
-                if ' = ' not in line:
-                    continue
-                _, rhs = line.split(' = ', 1)
-                m = kind_re.search(rhs)
-                if m is None:
-                    continue
-                kind = m.group(1)
-                # shapes live between '=' and the op name; tuples of
-                # per-grad tensors in ONE instruction = coalesced
-                nbytes = 0
-                for shp in re.finditer(r'([a-z]+\d*)\[([\d,]*)\]',
-                                       rhs[:m.start() + 1]):
-                    dims = [int(d) for d in shp.group(2).split(',')
-                            if d]
-                    sz = 1
-                    for d in dims:
-                        sz *= d
-                    nbytes += sz * dt_bytes.get(shp.group(1), 4)
-                colls[kind].append(nbytes)
-        audit = {}
-        for kind, sizes_b in colls.items():
-            if sizes_b:
-                audit[kind] = {
-                    'count': len(sizes_b),
-                    'total_mb': round(sum(sizes_b) / 1e6, 3),
-                    'largest_mb': round(max(sizes_b) / 1e6, 3)}
-        out['collective_audit'] = audit
-        params = fluid.default_main_program().global_block() \
-            .all_parameters()
-        param_mb = sum(int(np.prod(p.shape)) for p in params) * 4 / 1e6
-        ar = colls.get('all-reduce', [])
-        audit['n_trainable_params'] = len(params)
-        audit['param_mb'] = round(param_mb, 3)
-        # size-aware coalescing check: count only GRADIENT-SCALE
-        # all-reduces (>=1% of param bytes — filters BN-stat syncs),
-        # then require few instructions carrying most of the bytes.
-        # A max-only test would call a model with one dominant param
-        # (a vocab embedding) coalesced even when every grad has its
-        # own all-reduce.
-        big = [b for b in ar if b >= 0.01 * param_mb * 1e6]
-        audit['grad_allreduce_coalesced'] = bool(big) and (
-            len(big) <= max(1, len(params) // 8)
-            and sum(big) / 1e6 >= 0.5 * param_mb)
+        # ---- collective audit on the widest mesh ----
+        if audit_exe is not None:
+            kinds = ('all-reduce', 'all-gather', 'reduce-scatter',
+                     'collective-permute', 'all-to-all')
+            colls = {k: [] for k in kinds}
+            dt_bytes = {'f32': 4, 'bf16': 2, 's32': 4, 'f16': 2, 'u32': 4,
+                        'pred': 1, 's64': 8, 'f64': 8}
+            # 'all-reduce(' after the type part, incl. the async '-start'
+            # form real-TPU XLA emits ('-done' excluded: same collective)
+            kind_re = re.compile(
+                r'[)\]}] (all-reduce|all-gather|reduce-scatter|'
+                r'collective-permute|all-to-all)(?:-start)?\(')
+            for text in audit_exe.compiled_hlo_texts():
+                for line in text.splitlines():
+                    if ' = ' not in line:
+                        continue
+                    _, rhs = line.split(' = ', 1)
+                    m = kind_re.search(rhs)
+                    if m is None:
+                        continue
+                    kind = m.group(1)
+                    # shapes live between '=' and the op name; tuples of
+                    # per-grad tensors in ONE instruction = coalesced
+                    nbytes = 0
+                    for shp in re.finditer(r'([a-z]+\d*)\[([\d,]*)\]',
+                                           rhs[:m.start() + 1]):
+                        dims = [int(d) for d in shp.group(2).split(',')
+                                if d]
+                        sz = 1
+                        for d in dims:
+                            sz *= d
+                        nbytes += sz * dt_bytes.get(shp.group(1), 4)
+                    colls[kind].append(nbytes)
+            audit = {}
+            for kind, sizes_b in colls.items():
+                if sizes_b:
+                    audit[kind] = {
+                        'count': len(sizes_b),
+                        'total_mb': round(sum(sizes_b) / 1e6, 3),
+                        'largest_mb': round(max(sizes_b) / 1e6, 3)}
+            out['collective_audit'] = audit
+            params = fluid.default_main_program().global_block() \
+                .all_parameters()
+            param_mb = sum(int(np.prod(p.shape)) for p in params) * 4 / 1e6
+            ar = colls.get('all-reduce', [])
+            audit['n_trainable_params'] = len(params)
+            audit['param_mb'] = round(param_mb, 3)
+            # size-aware coalescing check: count only GRADIENT-SCALE
+            # all-reduces (>=1% of param bytes — filters BN-stat syncs),
+            # then require few instructions carrying most of the bytes.
+            # A max-only test would call a model with one dominant param
+            # (a vocab embedding) coalesced even when every grad has its
+            # own all-reduce.
+            big = [b for b in ar if b >= 0.01 * param_mb * 1e6]
+            audit['grad_allreduce_coalesced'] = bool(big) and (
+                len(big) <= max(1, len(params) // 8)
+                and sum(big) / 1e6 >= 0.5 * param_mb)
+    finally:
+        fluid.flags.set_flags({'FLAGS_bn_local_stats': prior_bn_local})
     return out
 
 
@@ -426,6 +433,9 @@ def main():
     ap.add_argument('--steps', type=int, default=5)
     ap.add_argument('--full', action='store_true',
                     help='benchmark shapes (needs a real accelerator)')
+    ap.add_argument('--bn-local-stats', action='store_true',
+                    help='scaling mode: per-device BN statistics '
+                         '(FLAGS_bn_local_stats — reference semantics)')
     args = ap.parse_args()
     if not args.full:
         os.environ.setdefault(
@@ -440,7 +450,8 @@ def main():
         for mode in modes:
             try:
                 if mode == 'scaling':
-                    row = run_scaling(model, args.steps, args.full)
+                    row = run_scaling(model, args.steps, args.full,
+                                      bn_local_stats=args.bn_local_stats)
                 elif mode == 'pserver':
                     row = run_pserver(model, args.dist_trainers,
                                       args.steps, args.full)
