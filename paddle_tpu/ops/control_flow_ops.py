@@ -39,6 +39,8 @@ def _run_sub_block(env, sub_block, rng_key, is_test, base_index,
     if parent_ctx is not None:
         sub_ctx.mesh = getattr(parent_ctx, 'mesh', None)
         sub_ctx.amp = getattr(parent_ctx, 'amp', False)
+        sub_ctx.bn_local_stats = getattr(parent_ctx, 'bn_local_stats',
+                                         None)
         sub_ctx._fold_limits = dict(
             getattr(parent_ctx, '_fold_limits', {}))
         parent_block = getattr(parent_ctx, 'block', None)

@@ -146,6 +146,7 @@ def build_pp_segment_fn(pe, segment, block, program):
 
     strategy = pe._strategy
     mesh = pe.mesh
+    bn_local = getattr(pe, '_bn_local_stats', None)
     n_micro = max(int(strategy.micro_batches or 0), strategy.pp)
     loss_name = pe._loss_name
     if not loss_name:
@@ -199,6 +200,7 @@ def build_pp_segment_fn(pe, segment, block, program):
             env2.update(pvals)
             ctx = EmitContext(env2, block, rng_key, is_test, amp=amp)
             ctx.mesh = mesh
+            ctx.bn_local_stats = bn_local
             emit_ops(ctx, pre)
 
             def stage_fn(plist, x):
@@ -206,6 +208,7 @@ def build_pp_segment_fn(pe, segment, block, program):
                 e3[region_in] = x
                 sctx = EmitContext(e3, block, rng_key, is_test, amp=amp)
                 sctx.mesh = mesh
+                sctx.bn_local_stats = bn_local
                 emit_ops(sctx, stage0_ops)
                 return e3[infos[0]['x_out']]
 
@@ -232,6 +235,7 @@ def build_pp_segment_fn(pe, segment, block, program):
             env[grad_of[p]] = g
         ctx = EmitContext(env, block, rng_key, is_test, amp=amp)
         ctx.mesh = mesh
+        ctx.bn_local_stats = bn_local
         emit_ops(ctx, opt)
         return tuple(env[n] for n in out_names)
 

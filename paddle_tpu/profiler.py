@@ -253,3 +253,40 @@ def cuda_profiler(output_file, output_mode=None, config=None):
         yield
     finally:
         jax.profiler.stop_trace()
+
+
+def collective_audit(hlo_texts):
+    """kind -> [payload bytes] for every collective instruction in the
+    given compiled-HLO texts. The ONE audit implementation shared by
+    tools/bench_suite.py (scaling-mode collective audit) and the
+    BN-local-stats tests, so both count the same spellings: the plain
+    and async '-start' forms ('-done' excluded — same collective), with
+    tuple outputs (coalesced per-grad all-reduces) counted as one
+    instruction whose bytes sum over the tuple."""
+    import re
+    kinds = ('all-reduce', 'all-gather', 'reduce-scatter',
+             'collective-permute', 'all-to-all')
+    dt_bytes = {'f32': 4, 'bf16': 2, 's32': 4, 'f16': 2, 'u32': 4,
+                'pred': 1, 's64': 8, 'f64': 8}
+    kind_re = re.compile(
+        r'[)\]}] (all-reduce|all-gather|reduce-scatter|'
+        r'collective-permute|all-to-all)(?:-start)?\(')
+    colls = {k: [] for k in kinds}
+    for text in hlo_texts:
+        for line in text.splitlines():
+            if ' = ' not in line:
+                continue
+            _, rhs = line.split(' = ', 1)
+            m = kind_re.search(rhs)
+            if m is None:
+                continue
+            nbytes = 0
+            for shp in re.finditer(r'([a-z]+\d*)\[([\d,]*)\]',
+                                   rhs[:m.start() + 1]):
+                dims = [int(d) for d in shp.group(2).split(',') if d]
+                sz = 1
+                for d in dims:
+                    sz *= d
+                nbytes += sz * dt_bytes.get(shp.group(1), 4)
+            colls[m.group(1)].append(nbytes)
+    return {k: v for k, v in colls.items() if v}

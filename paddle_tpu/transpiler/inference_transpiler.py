@@ -61,7 +61,10 @@ class InferenceTranspiler(object):
                       .astype(w.dtype))
         bias = (beta - mean * inv_std).astype(w.dtype)
 
-        # new channel-bias var + elementwise_add replacing the BN op
+        # new channel-bias var + elementwise_add replacing the BN op;
+        # the broadcast axis follows the conv's layout (channels-last
+        # puts C on the trailing axis)
+        nhwc = conv_op.attr('data_format', 'NCHW') == 'NHWC'
         bias_name = w_name + '.bn_fold_bias'
         bv = block.create_parameter(
             name=bias_name, shape=list(bias.shape), dtype=str(bias.dtype))
@@ -69,11 +72,13 @@ class InferenceTranspiler(object):
         scope.set_var(bias_name, bias)
         bn_out = bn_op.single_output('Y')
         conv_out = conv_op.single_output('Output')
+        x_rank = len(block.var_recursive(conv_out).shape)
         bn_idx = conv_idx + 1
         block.remove_op(bn_idx)
         block._insert_op(bn_idx, type='elementwise_add',
                          inputs={'X': [conv_out], 'Y': [bias_name]},
-                         outputs={'Out': [bn_out]}, attrs={'axis': 1})
+                         outputs={'Out': [bn_out]},
+                         attrs={'axis': x_rank - 1 if nhwc else 1})
 
     @staticmethod
     def _param(scope, name):

@@ -8,16 +8,15 @@ reduces over the sharded batch — numerically stronger); FLAGS_bn_local_stats
 or BuildStrategy.bn_local_stats selects the reference behavior, removing
 every per-step BN-stat all-reduce from the compiled HLO.
 """
-import re
-
 import numpy as np
 
 import paddle_tpu as fluid
 from paddle_tpu import unique_name
+from paddle_tpu.profiler import collective_audit
 
-_KIND_RE = re.compile(
-    r'[)\]}] (all-reduce|all-gather|reduce-scatter|'
-    r'collective-permute|all-to-all)(?:-start)?\(')
+
+def _n_collectives(hlo_texts):
+    return sum(len(v) for v in collective_audit(hlo_texts).values())
 
 
 def _build(nhwc=False, seed=7):
@@ -63,8 +62,8 @@ def _train(local, n_devices=None, steps=5, nhwc=False, audit=False):
             losses = [float(pe.run(fetch_list=[loss.name],
                                    feed={'x': xb, 'y': yb})[0])
                       for _ in range(steps)]
-            n_coll = sum(len(_KIND_RE.findall(t))
-                         for t in pe.compiled_hlo_texts()) if audit else None
+            n_coll = _n_collectives(
+                pe.compiled_hlo_texts()) if audit else None
         return losses, n_coll
     finally:
         fluid.flags.set_flags({'FLAGS_bn_local_stats': False})
@@ -129,8 +128,7 @@ def test_build_strategy_knob():
                                         main_program=prog, scope=scope,
                                         build_strategy=build_strategy)
             pe.run(fetch_list=[loss.name], feed=feed)
-            return sum(len(_KIND_RE.findall(t))
-                       for t in pe.compiled_hlo_texts())
+            return _n_collectives(pe.compiled_hlo_texts())
 
     assert audit(bs) == 1                      # local for THIS executor
     assert not fluid.flags.get_flag('bn_local_stats')   # no global leak

@@ -193,44 +193,14 @@ def run_scaling(model, steps, full, bn_local_stats=False):
 
         # ---- collective audit on the widest mesh ----
         if audit_exe is not None:
-            kinds = ('all-reduce', 'all-gather', 'reduce-scatter',
-                     'collective-permute', 'all-to-all')
-            colls = {k: [] for k in kinds}
-            dt_bytes = {'f32': 4, 'bf16': 2, 's32': 4, 'f16': 2, 'u32': 4,
-                        'pred': 1, 's64': 8, 'f64': 8}
-            # 'all-reduce(' after the type part, incl. the async '-start'
-            # form real-TPU XLA emits ('-done' excluded: same collective)
-            kind_re = re.compile(
-                r'[)\]}] (all-reduce|all-gather|reduce-scatter|'
-                r'collective-permute|all-to-all)(?:-start)?\(')
-            for text in audit_exe.compiled_hlo_texts():
-                for line in text.splitlines():
-                    if ' = ' not in line:
-                        continue
-                    _, rhs = line.split(' = ', 1)
-                    m = kind_re.search(rhs)
-                    if m is None:
-                        continue
-                    kind = m.group(1)
-                    # shapes live between '=' and the op name; tuples of
-                    # per-grad tensors in ONE instruction = coalesced
-                    nbytes = 0
-                    for shp in re.finditer(r'([a-z]+\d*)\[([\d,]*)\]',
-                                           rhs[:m.start() + 1]):
-                        dims = [int(d) for d in shp.group(2).split(',')
-                                if d]
-                        sz = 1
-                        for d in dims:
-                            sz *= d
-                        nbytes += sz * dt_bytes.get(shp.group(1), 4)
-                    colls[kind].append(nbytes)
+            from paddle_tpu.profiler import collective_audit
+            colls = collective_audit(audit_exe.compiled_hlo_texts())
             audit = {}
             for kind, sizes_b in colls.items():
-                if sizes_b:
-                    audit[kind] = {
-                        'count': len(sizes_b),
-                        'total_mb': round(sum(sizes_b) / 1e6, 3),
-                        'largest_mb': round(max(sizes_b) / 1e6, 3)}
+                audit[kind] = {
+                    'count': len(sizes_b),
+                    'total_mb': round(sum(sizes_b) / 1e6, 3),
+                    'largest_mb': round(max(sizes_b) / 1e6, 3)}
             out['collective_audit'] = audit
             params = fluid.default_main_program().global_block() \
                 .all_parameters()
