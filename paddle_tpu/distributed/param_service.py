@@ -260,8 +260,11 @@ class ParameterService(object):
         self._touch(tid)  # round already closed by the on_get_var wait
 
     def on_complete(self, tid):
-        self._touch(tid)
         with self._lock:
+            # same zombie rejection as every other handler: a
+            # deadline-retired trainer's COMPLETE must fail loudly, not
+            # silently shrink the expected-completions set
+            self._enter_locked(tid)
             self._done_tids.add(tid)
             self._barrier_tids.discard(tid)
             # a straggler-free round may now be unblocked

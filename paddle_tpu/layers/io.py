@@ -165,9 +165,12 @@ def open_files(filenames, shapes, dtypes, lod_levels=None, pass_num=1,
     from .. import recordio as _recordio
     if thread_num and thread_num > 1:
         # buffer_size keeps the reference's SAMPLE units; the native
-        # queue counts CHUNKS (~1000 records each with the writer
-        # default), so convert — passing samples straight through
-        # would buffer a thousand times the intended memory
+        # queue counts CHUNKS, so convert assuming the WRITER DEFAULT of
+        # ~1000 records/chunk (recordio_writer.py max_num_records) —
+        # files written with a different chunk size will buffer
+        # proportionally more/fewer samples than requested. Passing
+        # samples straight through would buffer a thousand times the
+        # intended memory.
         if buffer_size:
             capacity = max(2, min(256, -(-int(buffer_size) // 1000)))
         else:

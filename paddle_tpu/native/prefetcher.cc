@@ -189,7 +189,10 @@ void* rupt_prefetcher_open(const char** paths, uint32_t n_paths,
   p->capacity = capacity ? capacity : 64;
   p->loop = loop != 0;
   if (n_threads == 0) n_threads = 4;
-  if (n_threads > n_paths && !p->loop) n_threads = n_paths;
+  // clamp in loop mode too: with more workers than files the cursor's
+  // modulo wrap would hand the SAME file to two workers concurrently,
+  // duplicating in-flight records within an epoch
+  if (n_threads > n_paths) n_threads = n_paths;
   p->live_workers = n_threads;
   for (uint32_t t = 0; t < n_threads; ++t)
     p->workers.emplace_back([p] { p->worker(); });
@@ -204,11 +207,16 @@ int rupt_prefetcher_next_chunk(void* handle, const uint8_t** out,
     return !p->queue.empty() || p->live_workers.load() == 0 ||
            p->stopping;
   });
-  if (!p->error.empty()) {
-    g_pf_error = p->error;
-    return -1;
+  // Drain chunks already decoded from healthy files before surfacing a
+  // failed file's error: successfully-read records must not be lost to
+  // an unrelated file's IOError. The error fires once the queue empties.
+  if (p->queue.empty()) {
+    if (!p->error.empty()) {
+      g_pf_error = p->error;
+      return -1;
+    }
+    return 1;                                // all files drained
   }
-  if (p->queue.empty()) return 1;            // all files drained
   p->current = std::move(p->queue.front().first);
   *nrec = p->queue.front().second;
   p->queue.pop_front();
