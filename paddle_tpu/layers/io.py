@@ -123,6 +123,16 @@ def _file_reader(sample_gen_creator, shapes, dtypes, lod_levels, name_hint,
             for s in sample_gen_creator():
                 yield s
     r._sample_gen = multi_pass
+    # chunk-level fast path (native decode readers): batches assemble by
+    # array slicing instead of per-sample stacking — see
+    # _set_batched_source
+    chunk_gen = getattr(sample_gen_creator, '_chunk_gen', None)
+    if chunk_gen is not None:
+        def multi_pass_chunks():
+            for _ in range(pass_num) if pass_num > 0 else iter(int, 1):
+                for c in chunk_gen():
+                    yield c
+        r._chunk_gen = multi_pass_chunks
     # default: batch of 1 until layers.batch() re-decorates
     _set_batched_source(r, 1)
     return r
@@ -132,6 +142,37 @@ def _set_batched_source(reader, batch_size, drop_last=True):
     from ..reader.pipeline import stack_samples
     reader._batch_size = batch_size
     reader._drop_last = drop_last
+    chunk_gen = getattr(reader, '_chunk_gen', None)
+
+    if chunk_gen is not None and batch_size > 1:
+        # chunk-level batching: the native decode stage already hands
+        # whole (images, labels) arrays per chunk, so batches are array
+        # SLICES (views when a chunk covers the batch) instead of 256
+        # per-sample np.stack copies — at bs256x224² the per-sample
+        # stack alone costs ~the model step (reference analog: the
+        # double-buffer reader feeds whole LoDTensor batches,
+        # create_double_buffer_reader_op.cc)
+        import numpy as np
+
+        def source():
+            rem = None
+            for slots in chunk_gen():
+                slots = list(slots)
+                if rem is not None:
+                    slots = [np.concatenate([r, c])
+                             for r, c in zip(rem, slots)]
+                    rem = None
+                n = slots[0].shape[0]
+                off = 0
+                while n - off >= batch_size:
+                    yield [c[off:off + batch_size] for c in slots]
+                    off += batch_size
+                if off < n:
+                    rem = [c[off:] for c in slots]
+            if rem is not None and not drop_last:
+                yield rem
+        reader._source = source
+        return
 
     def source():
         buf = []
@@ -154,15 +195,41 @@ def open_recordio_file(filename, shapes, dtypes, lod_levels=None,
 
 
 def open_files(filenames, shapes, dtypes, lod_levels=None, pass_num=1,
-               thread_num=1, buffer_size=None, for_parallel=None):
+               thread_num=1, buffer_size=None, for_parallel=None,
+               image_norm=None):
     """Reader over many RecordIO files (reference layers/io.py:724,
     multithreaded there too). thread_num > 1 routes through the native
     C++ prefetcher (native/prefetcher.cc: work-stealing file workers,
     GIL-free chunk decode, one bounded queue) — the reference's
     multi-threaded multi-file reader as a native component; with
     thread_num == 1 files scan sequentially. Either way the async
-    device staging happens in the PyReader queue threads."""
+    device staging happens in the PyReader queue threads.
+
+    image_norm (with thread_num > 1): dict(mean=[...], std=[...]) for
+    shards whose records are (uint8 CHW image, int64 label) .npy pairs —
+    the NATIVE decode stage normalizes to float32 on the C++ workers
+    (the reference's decoder-thread work, reader/decorator.py
+    xmap_readers / the double-buffer reader's decode, moved native).
+    shapes[0] must be the image shape [-1, C, H, W]."""
     from .. import recordio as _recordio
+    if image_norm is not None and thread_num and thread_num > 1:
+        img_shape = tuple(int(d) for d in shapes[0][-3:])
+        # buffer_size keeps the reference's SAMPLE units here too (the
+        # same ~1000 records/chunk writer-default assumption as the
+        # branch below); decoded f32 chunks are big, so the chunk cap
+        # is lower (16 ~= 2.5 GB of 224² float batches in flight)
+        if buffer_size:
+            capacity = max(2, min(16, -(-int(buffer_size) // 1000)))
+        else:
+            capacity = 8
+        sample_gen = _recordio.parallel_image_reader(
+            list(filenames), img_shape,
+            mean=image_norm.get('mean'), std=image_norm.get('std'),
+            n_threads=int(thread_num), capacity=capacity,
+            loop=pass_num <= 0)
+        return _file_reader(sample_gen, shapes, dtypes,
+                            lod_levels, 'multi_file_reader',
+                            1 if pass_num <= 0 else pass_num)
     if thread_num and thread_num > 1:
         # buffer_size keeps the reference's SAMPLE units; the native
         # queue counts CHUNKS, so convert assuming the WRITER DEFAULT of

@@ -111,10 +111,88 @@ std::string scan_file(
   return err;
 }
 
+// ---- native decode stage (round-5 VERDICT #4) -----------------------
+// Record layout for decode_mode 1: two concatenated .npy blobs — a
+// uint8 CHW image of img_elems elements and one int64 label (the
+// repo's _encode_sample format with a u8 image slot). Workers
+// normalize to float32 ((x/255 - mean[c]) * inv_std[c]) while the
+// chunk is hot in cache — the per-record augmentation/normalization
+// work the reference runs in its decoder threads
+// (operators/reader/..., reader/decorator.py xmap_readers) — and emit
+// a chunk of [n*img_elems f32 images][n int64 labels].
+
+// minimal .npy v1 framing: returns the payload offset or 0 on error
+static size_t npy_data_offset(const uint8_t* p, size_t len) {
+  if (len < 10 || std::memcmp(p, "\x93NUMPY", 6) != 0) return 0;
+  uint16_t hlen;
+  std::memcpy(&hlen, p + 8, 2);
+  size_t off = 10 + (size_t)hlen;
+  return off <= len ? off : 0;
+}
+
+struct DecodeSpec {
+  bool enabled = false;
+  uint32_t channels = 0, hw = 0;          // img_elems = channels * hw
+  std::vector<float> mean, inv_std;
+};
+
+static std::string decode_chunk(const DecodeSpec& d, const std::string& in,
+                                uint32_t nrec, std::string* out) {
+  const size_t img_elems = (size_t)d.channels * d.hw;
+  // labels block starts 8-byte aligned (odd nrec*img_elems would
+  // otherwise make the int64 pointer misaligned — UB, and an unaligned
+  // numpy view on the Python side)
+  const size_t label_off = ((nrec * img_elems * 4) + 7) & ~size_t(7);
+  out->resize(label_off + nrec * 8);
+  float* imgs = (float*)out->data();
+  int64_t* labels = (int64_t*)(out->data() + label_off);
+  const uint8_t* p = (const uint8_t*)in.data();
+  size_t off = 0, len = in.size();
+  for (uint32_t r = 0; r < nrec; ++r) {
+    if (off + 4 > len) return "truncated record length";
+    uint32_t rlen;
+    std::memcpy(&rlen, p + off, 4);
+    off += 4;
+    if (off + rlen > len) return "truncated record";
+    const uint8_t* rec = p + off;
+    // record = u32 nslots, then per slot u32 len + .npy blob
+    // (recordio.py _encode_sample)
+    if (rlen < 12) return "record too short";
+    uint32_t nslots, len1, len2;
+    std::memcpy(&nslots, rec, 4);
+    if (nslots != 2) return "image record needs exactly 2 slots";
+    std::memcpy(&len1, rec + 4, 4);
+    if (8 + (size_t)len1 + 4 > rlen) return "bad image slot length";
+    const uint8_t* blob1 = rec + 8;
+    std::memcpy(&len2, rec + 8 + len1, 4);
+    if (12 + (size_t)len1 + len2 > rlen) return "bad label slot length";
+    const uint8_t* blob2 = rec + 12 + len1;
+    size_t h1 = npy_data_offset(blob1, len1);
+    // exact-size check doubles as the dtype contract: a float32 image
+    // slot is 4x bigger and must error, not be read as u8 garbage
+    if (!h1 || h1 + img_elems != len1) return "bad image npy framing";
+    const uint8_t* px = blob1 + h1;
+    float* dst = imgs + (size_t)r * img_elems;
+    for (uint32_t c = 0; c < d.channels; ++c) {
+      const float m = d.mean[c], is = d.inv_std[c];
+      const uint8_t* src = px + (size_t)c * d.hw;
+      float* dc = dst + (size_t)c * d.hw;
+      for (uint32_t i = 0; i < d.hw; ++i)
+        dc[i] = ((float)src[i] * (1.0f / 255.0f) - m) * is;
+    }
+    size_t h2 = npy_data_offset(blob2, len2);
+    if (!h2 || h2 + 8 > len2) return "bad label npy framing";
+    std::memcpy(&labels[r], blob2 + h2, 8);
+    off += rlen;
+  }
+  return "";
+}
+
 struct Prefetcher {
   std::vector<std::string> paths;
   uint32_t capacity;
   bool loop;
+  DecodeSpec decode;
 
   std::mutex mu;
   std::condition_variable not_full, not_empty;
@@ -140,7 +218,15 @@ struct Prefetcher {
         if (raw >= paths.size()) break;
         i = raw;
       }
-      auto sink = [this](std::string&& payload, uint32_t nrec) {
+      std::string decode_err;
+      auto sink = [this, &decode_err](std::string&& payload,
+                                      uint32_t nrec) {
+        if (decode.enabled) {
+          std::string out;
+          decode_err = decode_chunk(decode, payload, nrec, &out);
+          if (!decode_err.empty()) return false;
+          payload = std::move(out);
+        }
         std::unique_lock<std::mutex> lk(mu);
         not_full.wait(lk, [this] {
           return stopping || queue.size() < capacity;
@@ -151,6 +237,10 @@ struct Prefetcher {
         return true;
       };
       std::string err = scan_file(paths[i], sink);
+      // a decode failure stops the sink with scan_file reporting clean
+      // consumer-stop; surface the real cause
+      if (err.empty() && !decode_err.empty())
+        err = decode_err + " in " + paths[i];
       if (!err.empty()) {
         std::unique_lock<std::mutex> lk(mu);
         if (error.empty()) error = err;
@@ -177,9 +267,9 @@ extern "C" {
 
 const char* rupt_pf_last_error() { return g_pf_error.c_str(); }
 
-void* rupt_prefetcher_open(const char** paths, uint32_t n_paths,
-                           uint32_t n_threads, uint32_t capacity,
-                           int loop) {
+static void* open_common(const char** paths, uint32_t n_paths,
+                         uint32_t n_threads, uint32_t capacity,
+                         int loop, DecodeSpec decode) {
   if (n_paths == 0) {
     g_pf_error = "no input files";
     return nullptr;
@@ -188,6 +278,7 @@ void* rupt_prefetcher_open(const char** paths, uint32_t n_paths,
   for (uint32_t i = 0; i < n_paths; ++i) p->paths.emplace_back(paths[i]);
   p->capacity = capacity ? capacity : 64;
   p->loop = loop != 0;
+  p->decode = std::move(decode);
   if (n_threads == 0) n_threads = 4;
   // clamp in loop mode too: with more workers than files the cursor's
   // modulo wrap would hand the SAME file to two workers concurrently,
@@ -197,6 +288,34 @@ void* rupt_prefetcher_open(const char** paths, uint32_t n_paths,
   for (uint32_t t = 0; t < n_threads; ++t)
     p->workers.emplace_back([p] { p->worker(); });
   return p;
+}
+
+void* rupt_prefetcher_open(const char** paths, uint32_t n_paths,
+                           uint32_t n_threads, uint32_t capacity,
+                           int loop) {
+  return open_common(paths, n_paths, n_threads, capacity, loop,
+                     DecodeSpec{});
+}
+
+// Image-decode variant: workers additionally parse each record's two
+// .npy slots (u8 CHW image of channels*hw elements + one int64 label)
+// and emit normalized float32 chunks ([n*channels*hw f32][n i64]).
+void* rupt_prefetcher_open_image(const char** paths, uint32_t n_paths,
+                                 uint32_t n_threads, uint32_t capacity,
+                                 int loop, uint32_t channels,
+                                 uint32_t hw, const float* mean,
+                                 const float* std_dev) {
+  DecodeSpec d;
+  d.enabled = true;
+  d.channels = channels;
+  d.hw = hw;
+  for (uint32_t c = 0; c < channels; ++c) {
+    d.mean.push_back(mean ? mean[c] : 0.0f);
+    float s = std_dev ? std_dev[c] : 1.0f;
+    d.inv_std.push_back(s != 0.0f ? 1.0f / s : 1.0f);
+  }
+  return open_common(paths, n_paths, n_threads, capacity, loop,
+                     std::move(d));
 }
 
 int rupt_prefetcher_next_chunk(void* handle, const uint8_t** out,

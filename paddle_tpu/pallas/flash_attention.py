@@ -23,14 +23,17 @@ one q block live in VMEM scratch across the ki sweep; causal q-blocks
 stop their sweep at the diagonal (pl.when skips both compute and the
 write until the final valid ki).
 
-Backward (round-4 HYBRID): delta = rowsum(dO·O) in plain JAX, then a
-size-based dispatch. Small grids (nk <= 2, e.g. T=512 default blocks)
-run ONE fused kernel that recomputes P = exp(S − LSE) once per block
-pair and emits dk/dv plus per-k-block dq partials (5 block-matmuls,
-one launch — measured 2.8x the split at T=512). Large grids keep the
-classic dq + dk/dv two-kernel split (7 block-matmuls) because the
-fused variant's per-block dq-partial HBM flush costs more than the
-recompute it saves at nk=16 (measured at T=8192; PERF.md round-4).
+Backward (round-5): delta = rowsum(dO·O) in plain JAX, then ONE
+single-pass kernel for every T whose dk/dv accumulators fit VMEM
+(T·d ≤ 4M elements ≈ T=32k at d=128): grid (bh, qi, ki) with BOTH
+inner dims sequential; per pair it computes S, P, dP, dS exactly once
+and performs the 5 block-matmuls the math needs (S, dP, dq+=dS·k,
+dv[ki]+=Pᵀ·dO, dk[ki]+=dSᵀ·q). dq accumulates in per-qi scratch; dk/dv
+accumulate across the ENTIRE (qi, ki) sweep in (nk, bk, d) fp32 VMEM
+scratch and are written once at the final grid step — no per-pair
+partials flush (the round-4 fused arm's 10.7 GB dq-partial HBM
+round-trip at nk=16) and no recompute (the round-4 split arm's 7
+block-matmuls). Giant T falls back to the classic two-kernel split.
 """
 from __future__ import annotations
 
@@ -44,6 +47,10 @@ from jax.experimental.pallas import tpu as pltpu
 __all__ = ['flash_attention']
 
 _NEG_INF = -1e30
+
+# test hook: force the two-kernel split backward (the giant-T fallback
+# arm) at sizes where the single-pass kernel would normally dispatch
+_FORCE_SPLIT = False
 
 
 def _mask_if_straddling(s, qi, ki, block_q, block_k):
@@ -154,20 +161,10 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
 
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                dk_ref, dv_ref, *rest, sm_scale, causal, block_q,
-                block_k, nq, emit_dqp=False):
+                dk_ref, dv_ref, dk_scr, dv_scr, *, sm_scale, causal,
+                block_q, block_k, nq):
     """dk/dv sweep (grid bh, ki, qi; VMEM-scratch accumulation over
-    qi). With emit_dqp=True this is the round-4 FUSED single-pass
-    backward: the same sweep also writes each block pair's dq
-    contribution ds·k as a per-k-block partial (dqp) that a plain XLA
-    reduction sums afterwards — cross-grid-dim accumulation being the
-    thing a Pallas output cannot do directly. One kernel body serves
-    both dispatch arms so the shared math cannot drift."""
-    if emit_dqp:
-        dqp_ref, dk_scr, dv_scr = rest
-    else:
-        dqp_ref = None
-        dk_scr, dv_scr = rest
+    qi) — the large-T fallback arm of the split backward."""
     ki = pl.program_id(1)
     qi = pl.program_id(2)
     first_qi = 0
@@ -198,20 +195,6 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dk_scr[:] += jax.lax.dot_general(
             ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)       # [bk, d]
-        if emit_dqp:
-            # dq contribution of THIS k-block; the sm_scale mirrors
-            # the split dq kernel's finalize
-            dqp_ref[0] = (jax.lax.dot_general(
-                ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32) * sm_scale)
-
-    if emit_dqp:
-        @pl.when(qi < first_qi)
-        def _skipped():
-            # causal-skipped pairs still own a dqp block: zero it or
-            # the reduction reads uninitialized memory
-            dqp_ref[0] = jnp.zeros((block_q, q_ref.shape[-1]),
-                                   jnp.float32)
 
     @pl.when(qi == pl.num_programs(2) - 1)
     def _finalize():
@@ -219,6 +202,69 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         # already-scaled q, which carries the factor
         dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
         dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _bwd_onepass_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                        dq_ref, dk_ref, dv_ref, dq_scr, dk_scr, dv_scr,
+                        *, sm_scale, causal, block_q, block_k, nq, nk):
+    """Round-5 single-pass backward: grid (bh, qi, ki), BOTH inner dims
+    sequential. Each visited pair computes S, P, dP, dS once and does
+    exactly the 5 block-matmuls the gradients need. dq accumulates in a
+    per-qi scratch (reset at ki==0, flushed at the diagonal/last ki);
+    dk/dv accumulate in full-sequence (nk, bk, d) fp32 scratch across
+    the WHOLE sweep — VMEM-resident because T·d elements is ≤ 4M for
+    every supported long-context shape — and are written to HBM once at
+    the final grid step (their output blocks span the whole sequence,
+    index-mapped constant, so Pallas keeps one buffer live)."""
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    last_ki = nk - 1
+    if causal:
+        last_ki = ((qi + 1) * block_q - 1) // block_k
+
+    @pl.when((qi == 0) & (ki == 0))
+    def _init_kv():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    @pl.when(ki == 0)
+    def _init_q():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    @pl.when(ki <= last_ki)
+    def _step():
+        q = q_ref[0] * sm_scale
+        k = k_ref[0]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal:
+            s = _mask_if_straddling(s, qi, ki, block_q, block_k)
+        p = jnp.exp(s - lse_ref[0])                   # [bq, bk]
+        do = do_ref[0]
+        dp = jax.lax.dot_general(do, v_ref[0], (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_ref[0])                  # [bq, bk]
+        dq_scr[:] += jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dv_scr[ki] += jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)       # [bk, d]
+        dk_scr[ki] += jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)       # [bk, d]
+
+    @pl.when(ki == last_ki)
+    def _fin_q():
+        dq_ref[0] = (dq_scr[:] * sm_scale).astype(dq_ref.dtype)
+
+    @pl.when((qi == nq - 1) & (ki == nk - 1))
+    def _fin_kv():
+        # q carried sm_scale into dk's accumulation already
+        dk_ref[0] = dk_scr[:].reshape(dk_ref.shape[1:]) \
+            .astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[:].reshape(dv_ref.shape[1:]) \
+            .astype(dv_ref.dtype)
 
 
 # (T, d) -> (block_q, block_k) overrides. Intentionally EMPTY: the
@@ -305,53 +351,57 @@ def _bwd(q, k, v, o, lse, do, causal, sm_scale, interpret=False):
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
                     axis=-1, keepdims=True)            # [BH, T, 1]
 
-    if nk > 2:
+    # The dk/dv full-sequence fp32 accumulators AND their VMEM-resident
+    # output buffers must fit ~16 MB/core VMEM alongside the working
+    # blocks: budget 12 MB for scratch+outputs (q/k/v/do/dq blocks and
+    # double buffering take the rest). T=8k at d=128 lands exactly at
+    # the budget (8 MB scratch + 4 MB bf16 outputs); bigger T splits.
+    # _FORCE_SPLIT keeps the fallback arm test-reachable at small T.
+    kv_bytes = 2 * T * d * (4 + k.dtype.itemsize)
+    if kv_bytes > 12 * 1024 * 1024 or _FORCE_SPLIT:
         return _bwd_split(q, k, v, do, lse, delta, causal, sm_scale,
                           interpret, bq, bk, nq, nk)
-    dk, dv, dq_part = pl.pallas_call(
-        functools.partial(_dkv_kernel, sm_scale=sm_scale,
+    dq, dk, dv = pl.pallas_call(
+        functools.partial(_bwd_onepass_kernel, sm_scale=sm_scale,
                           causal=causal, block_q=bq, block_k=bk,
-                          nq=nq, emit_dqp=True),
-        grid=(BH, nk, nq),
+                          nq=nq, nk=nk),
+        grid=(BH, nq, nk),
         in_specs=[
-            pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, 0),
+            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0),
+            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0),
+            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, 0),
+            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, bq, 1), lambda b, j, i: (b, i, 0),
+            pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, i, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, bq, 1), lambda b, j, i: (b, i, 0),
+            pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, i, 0),
                          memory_space=pltpu.VMEM),
         ],
         out_specs=[
-            pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0),
+            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0),
+            # dk/dv blocks span the whole sequence, index-mapped
+            # constant: one live buffer, flushed once at the end
+            pl.BlockSpec((1, T, d), lambda b, i, j: (b, 0, 0),
                          memory_space=pltpu.VMEM),
-            # dq partials: one [bq, d] block per (ki, qi) pair, laid
-            # out [BH*nk, T, d] so each grid step owns one block
-            pl.BlockSpec((1, bq, d),
-                         lambda b, j, i, _nk=nk: (b * _nk + j, i, 0),
+            pl.BlockSpec((1, T, d), lambda b, i, j: (b, 0, 0),
                          memory_space=pltpu.VMEM),
         ],
         out_shape=[
+            jax.ShapeDtypeStruct((BH, T, d), q.dtype),
             jax.ShapeDtypeStruct((BH, T, d), k.dtype),
             jax.ShapeDtypeStruct((BH, T, d), v.dtype),
-            jax.ShapeDtypeStruct((BH * nk, T, d), jnp.float32),
         ],
-        scratch_shapes=[pltpu.VMEM((bk, d), jnp.float32),
-                        pltpu.VMEM((bk, d), jnp.float32)],
+        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32),
+                        pltpu.VMEM((nk, bk, d), jnp.float32),
+                        pltpu.VMEM((nk, bk, d), jnp.float32)],
         compiler_params=pltpu.CompilerParams(
-            dimension_semantics=('parallel', 'parallel', 'arbitrary')),
+            dimension_semantics=('parallel', 'arbitrary', 'arbitrary')),
         interpret=interpret,
     )(q, k, v, do, lse, delta)
-    # cross-k-block dq accumulation as a plain XLA reduction (a Pallas
-    # output can only accumulate along its innermost grid dim)
-    dq = dq_part.reshape(BH, nk, T, d).sum(axis=1).astype(q.dtype)
     return dq, dk, dv
 
 

@@ -251,30 +251,37 @@ class ParallelRecordIOScanner(object):
     def __iter__(self):
         return self
 
+    def _fetch_chunk(self):
+        """One (payload bytes, n_records) pair from the native queue.
+        Raises StopIteration at end-of-data and IOError on a native
+        error — the ONE lifecycle/error-translation implementation both
+        scanner classes share."""
+        if self._h is None:
+            raise StopIteration
+        out = ctypes.POINTER(ctypes.c_uint8)()
+        ln = ctypes.c_uint32()
+        nrec = ctypes.c_uint32()
+        rc = self._libref.rupt_prefetcher_next_chunk(
+            self._h, ctypes.byref(out), ctypes.byref(ln),
+            ctypes.byref(nrec))
+        if rc == 1:
+            self.close()
+            raise StopIteration
+        if rc != 0:
+            msg = self._libref.rupt_pf_last_error().decode(
+                'utf-8', 'replace')
+            self.close()
+            raise IOError(msg)
+        return ctypes.string_at(out, ln.value), nrec.value
+
     def __next__(self):
         # hand-off is per CHUNK (one FFI+lock crossing per hundreds of
         # records); records of the current chunk drain from a local list
         while not self._pending:        # loop: empty chunks are legal
-            if self._h is None:
-                raise StopIteration
-            out = ctypes.POINTER(ctypes.c_uint8)()
-            ln = ctypes.c_uint32()
-            nrec = ctypes.c_uint32()
-            rc = self._libref.rupt_prefetcher_next_chunk(
-                self._h, ctypes.byref(out), ctypes.byref(ln),
-                ctypes.byref(nrec))
-            if rc == 1:
-                self.close()
-                raise StopIteration
-            if rc != 0:
-                msg = self._libref.rupt_pf_last_error().decode(
-                    'utf-8', 'replace')
-                self.close()
-                raise IOError(msg)
-            payload = ctypes.string_at(out, ln.value)
+            payload, n = self._fetch_chunk()
             recs = []
             off = 0
-            for _ in range(nrec.value):
+            for _ in range(n):
                 (rlen,) = _U32.unpack_from(payload, off)
                 off += 4
                 recs.append(payload[off:off + rlen])
@@ -358,3 +365,95 @@ def convert_reader_to_recordio_files(filename, batch_per_file,
         if w is not None:
             w.close()
     return counts
+
+
+class ParallelImageScanner(ParallelRecordIOScanner):
+    """Chunk iterator with the NATIVE DECODE stage (round-5 VERDICT #4):
+    C++ workers parse each record's (u8 CHW image, int64 label) .npy
+    slots and normalize to float32 ((x/255 - mean[c]) / std[c]) while
+    the chunk is cache-hot — the per-record decode/augmentation work the
+    reference runs in its reader threads (xmap_readers, the double-
+    buffer reader's decoder) moved off the trainer process's GIL.
+    Yields (images f32 [n, C, H, W], labels i64 [n]) per chunk; the
+    arrays are COPIES (safe to hold across next()). Shares the parent's
+    handle lifecycle + error translation (_fetch_chunk/close); only the
+    open call and the per-chunk decode differ."""
+
+    def __init__(self, filenames, image_shape, mean=None, std=None,
+                 n_threads=4, capacity=16, loop=False):
+        if isinstance(filenames, str):
+            filenames = [filenames]
+        c, h, w = (int(d) for d in image_shape)
+        self._shape = (c, h, w)
+        mean = np.asarray([0.0] * c if mean is None else mean,
+                          dtype='float32')
+        std = np.asarray([1.0] * c if std is None else std,
+                         dtype='float32')
+        if mean.shape != (c,) or std.shape != (c,):
+            raise ValueError(
+                'image_norm mean/std must have one value per channel '
+                '(%d); got mean%s std%s' % (c, mean.shape, std.shape))
+        self._libref = _prefetch_lib()
+        lib = self._libref
+        if not hasattr(lib, '_image_open_wired'):
+            lib.rupt_prefetcher_open_image.restype = ctypes.c_void_p
+            lib.rupt_prefetcher_open_image.argtypes = [
+                ctypes.POINTER(ctypes.c_char_p), ctypes.c_uint32,
+                ctypes.c_uint32, ctypes.c_uint32, ctypes.c_int,
+                ctypes.c_uint32, ctypes.c_uint32,
+                ctypes.POINTER(ctypes.c_float),
+                ctypes.POINTER(ctypes.c_float)]
+            lib._image_open_wired = True
+        arr = (ctypes.c_char_p * len(filenames))(
+            *[f.encode() for f in filenames])
+        self._pending = []
+        self._h = lib.rupt_prefetcher_open_image(
+            arr, len(filenames), n_threads, capacity,
+            1 if loop else 0, c, h * w,
+            mean.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            std.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+        if not self._h:
+            raise IOError(lib.rupt_pf_last_error().decode(
+                'utf-8', 'replace'))
+
+    def __next__(self):
+        buf, n = self._fetch_chunk()
+        c, h, w = self._shape
+        elems = c * h * w
+        imgs = np.frombuffer(buf, dtype='float32',
+                             count=n * elems).reshape(n, c, h, w)
+        # labels block starts 8-byte aligned (native layout contract)
+        label_off = (n * elems * 4 + 7) & ~7
+        labels = np.frombuffer(buf, dtype='int64', count=n,
+                               offset=label_off)
+        return imgs, labels
+
+
+def parallel_image_reader(filenames, image_shape, mean=None, std=None,
+                          n_threads=4, capacity=16, loop=False):
+    """Sample-reader creator over natively-decoded image shards:
+    yields (image f32 [C,H,W], label int64) — composes with
+    paddle.batch / py_reader like any reader creator."""
+    paths = filenames if isinstance(filenames, (list, tuple)) \
+        else sorted(_glob.glob(filenames)) or [filenames]
+
+    def _read():
+        with ParallelImageScanner(list(paths), image_shape, mean=mean,
+                                  std=std, n_threads=n_threads,
+                                  capacity=capacity, loop=loop) as sc:
+            for imgs, labels in sc:
+                for i in range(imgs.shape[0]):
+                    yield imgs[i], labels[i:i + 1]
+
+    def _read_chunks():
+        """Chunk-level arrays for the batching fast path
+        (layers/io.py _set_batched_source): one (images [n,C,H,W],
+        labels [n,1]) pair per chunk, no per-record slicing."""
+        with ParallelImageScanner(list(paths), image_shape, mean=mean,
+                                  std=std, n_threads=n_threads,
+                                  capacity=capacity, loop=loop) as sc:
+            for imgs, labels in sc:
+                yield imgs, labels.reshape(-1, 1)
+
+    _read._chunk_gen = _read_chunks
+    return _read
