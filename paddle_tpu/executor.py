@@ -599,6 +599,23 @@ class Executor(object):
                 raise RuntimeError(
                     'var %r used before initialization -- did you run the '
                     'startup program?' % name)
+            # Pin host-resident persistables to the device ONCE: values
+            # written by host ops (load_inference_model's load ops, set
+            # vars) arrive as numpy; without this, every run() of a
+            # program that only READS them (inference!) re-uploads all
+            # parameters through the transport — measured 5 s/call for
+            # ResNet-50 and minutes for a 740M-param LM over the
+            # remoted link (reference analog: parameters live on-device
+            # in the Scope, framework/tensor.h holder semantics).
+            # (64-bit dtypes excluded: with x64 off, device_put would
+            # narrow them and the narrowed array would leak back into
+            # host-side save paths)
+            if isinstance(val, np.ndarray) and \
+                    val.dtype not in (np.int64, np.uint64, np.float64):
+                var = block.vars.get(name)
+                if var is not None and var.persistable:
+                    val = jax.device_put(val, self.device)
+                    scope.set_var(name, val)
             return val
 
         from . import flags as flags_mod

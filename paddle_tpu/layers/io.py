@@ -212,6 +212,11 @@ def open_files(filenames, shapes, dtypes, lod_levels=None, pass_num=1,
     xmap_readers / the double-buffer reader's decode, moved native).
     shapes[0] must be the image shape [-1, C, H, W]."""
     from .. import recordio as _recordio
+    if image_norm is not None and not (thread_num and thread_num > 1):
+        raise ValueError(
+            'image_norm requires thread_num > 1 (the native decode '
+            'stage); with thread_num=1 the u8 records would silently '
+            'pass through unnormalized')
     if image_norm is not None and thread_num and thread_num > 1:
         img_shape = tuple(int(d) for d in shapes[0][-3:])
         # buffer_size keeps the reference's SAMPLE units here too (the
@@ -284,6 +289,10 @@ def shuffle(reader, buffer_size):
         for b in buf:
             yield b
     reader._sample_gen = gen
+    # the chunk-level fast path serves FILE-ORDER batches straight from
+    # chunk arrays; a shuffled reader must drop it or shuffle() would be
+    # a silent no-op
+    reader._chunk_gen = None
     # re-derive the batched source, preserving any earlier batch() setting
     _set_batched_source(reader, getattr(reader, '_batch_size', 1),
                         getattr(reader, '_drop_last', True))
