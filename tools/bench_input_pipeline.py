@@ -157,6 +157,21 @@ def main():
     out['shard_mb'] = round(sum(os.path.getsize(p) for p in paths)
                             / 1e6, 1)
 
+    # ---- host memory-bandwidth probe ---------------------------------
+    # Context for the scaling numbers: decode moves ~1 MB of memory
+    # traffic per 224² sample (inflate read+write, normalize read+write,
+    # queue hand-off); if one copy stream saturates the host, worker
+    # threads CANNOT scale a memory-bound decode no matter the design.
+    probe_src = np.random.randint(0, 255, 64 << 20, dtype=np.uint8)
+    probe_dst = np.empty_like(probe_src)
+    t0 = time.perf_counter()
+    reps = 0
+    while time.perf_counter() - t0 < 2.0:
+        np.copyto(probe_dst, probe_src)
+        reps += 1
+    out['host_memcpy_gbps'] = round(
+        reps * 64 / 1024 / (time.perf_counter() - t0), 2)
+
     # ---- native decode thread scaling (standalone) -------------------
     for nt in (1, 2, args.threads):
         rate = decode_throughput(paths, shape, nt,

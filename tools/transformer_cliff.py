@@ -1,0 +1,137 @@
+"""Transformer bs8-vs-bs16 cliff + optimizer-tail attribution
+(round-5 VERDICT #5).
+
+Profiles the REAL bench transformer step at two batch sizes with the
+exact-join xplane machinery (profiler.hlo_op_map + device_op_events)
+and prints a per-HLO-class device-time comparison, normalized per
+SAMPLE so batch-independent work (optimizer updates) shows up as a
+flat cost and batch-scaling work as constant-per-sample. The round-4
+breakdown showed every class ~2x slower at bs16 including
+batch-independent momentum updates; this tool reproduces that with the
+clean capture (round-5 profiler fix) to pin WHERE the cliff lives.
+
+    python tools/transformer_cliff.py [--bs 8 16]
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+from collections import defaultdict
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def profile_step(batch, nsteps=3):
+    import jax
+    import paddle_tpu as fluid
+    from paddle_tpu import profiler, unique_name
+    from paddle_tpu.models import transformer as tfm
+
+    fluid.flags.set_flags({'FLAGS_amp_bf16_param_grads': True})
+    cfg = tfm.TransformerConfig(vocab=32768, dim=2048, heads=16,
+                                layers=12, ffn=8192, max_len=512,
+                                use_tp=False, use_sp=False,
+                                flash_attention=True)
+    with unique_name.guard():
+        main_prog, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main_prog, startup):
+            tokens = fluid.layers.data(name='tokens',
+                                       shape=[cfg.max_len, 1],
+                                       dtype='int64')
+            labels = fluid.layers.data(name='labels',
+                                       shape=[cfg.max_len, 1],
+                                       dtype='int64')
+            trunk = tfm.language_model_trunk(tokens, cfg)
+            cost = fluid.layers.fused_softmax_cross_entropy(
+                trunk, labels, cfg.vocab, chunk=8192, name='lm_head')
+            avg_cost = fluid.layers.mean(cost)
+            opt = fluid.optimizer.Momentum(learning_rate=0.001,
+                                           momentum=0.9)
+            opt = fluid.contrib.mixed_precision.decorate(opt)
+            opt.minimize(avg_cost)
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.TPUPlace())
+        exe.run(startup)
+        pe = fluid.ParallelExecutor(use_cuda=True,
+                                    loss_name=avg_cost.name,
+                                    main_program=main_prog, scope=scope)
+        rng = np.random.RandomState(0)
+        toks = jax.device_put(rng.randint(
+            0, cfg.vocab, (batch, cfg.max_len, 1)).astype('int64'))
+        feed = {'tokens': toks,
+                'labels': jax.device_put(np.roll(np.asarray(toks), -1,
+                                                 axis=1))}
+        for _ in range(3):
+            wl = pe.run(fetch_list=[avg_cost.name], feed=feed,
+                        return_numpy=False)
+        float(np.asarray(wl[0]))
+
+        def timed(n):
+            t0 = time.perf_counter()
+            for _ in range(n):
+                l = pe.run(fetch_list=[avg_cost.name], feed=feed,
+                           return_numpy=False)
+            float(np.asarray(l[0]))
+            return time.perf_counter() - t0
+
+        w1, w2 = timed(8), timed(16)
+        step_ms = max(w2 - w1, 1e-9) / 8 * 1e3
+
+        path = '/tmp/tf_cliff_bs%d' % batch
+        with profiler.profiler('All', None, path):
+            for _ in range(nsteps):
+                l = pe.run(fetch_list=[avg_cost.name], feed=feed,
+                           return_numpy=False)
+            float(np.asarray(l[0]))
+
+    import glob
+    texts = [open(f).read()
+             for f in sorted(glob.glob(path + '.hlo/*.txt'))]
+    main_text = max(texts, key=len)
+    op_map = profiler.hlo_op_map([main_text])
+    events = profiler.device_op_events(path + '.xplane', op_map)
+    classes = defaultdict(float)
+    for label, _s, dur in events:
+        cls = label.split('.')[0]
+        classes[cls] += dur / nsteps / 1e6
+    return step_ms, classes
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument('--bs', type=int, nargs='+', default=[8, 16])
+    args = ap.parse_args()
+    results = {}
+    for bs in args.bs:
+        step_ms, classes = profile_step(bs)
+        results[bs] = (step_ms, classes)
+        print('bs%d: %.1f ms/step (%.0f tok/s)'
+              % (bs, step_ms, bs * 512 / step_ms * 1e3))
+    b0, b1 = args.bs[0], args.bs[-1]
+    s0, c0 = results[b0]
+    s1, c1 = results[b1]
+    keys = sorted(set(c0) | set(c1),
+                  key=lambda k: -(c0.get(k, 0) + c1.get(k, 0)))
+    print('| class | bs%d ms | bs%d ms | ratio | per-sample ratio |'
+          % (b0, b1))
+    print('|---|---|---|---|---|')
+    for k in keys[:16]:
+        a, b = c0.get(k, 0.0), c1.get(k, 0.0)
+        if a + b < 0.5:
+            continue
+        ratio = b / a if a else float('inf')
+        print('| %s | %6.2f | %6.2f | %5.2f | %5.2f |'
+              % (k, a, b, ratio, ratio * b0 / b1))
+    print('device totals: bs%d %.1f ms, bs%d %.1f ms; '
+          'wall %.1f / %.1f ms'
+          % (b0, sum(c0.values()), b1, sum(c1.values()), s0, s1))
+
+
+if __name__ == '__main__':
+    main()
