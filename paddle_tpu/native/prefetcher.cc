@@ -36,6 +36,7 @@
 #include <cstdio>
 #include <cstring>
 #include <deque>
+#include <memory>
 #include <functional>
 #include <mutex>
 #include <string>
@@ -206,6 +207,30 @@ struct Prefetcher {
   std::vector<std::thread> workers;
   std::string current;                       // last record handed out
 
+  // Blocking dequeue shared by both hand-off ABIs: waits for data,
+  // drains already-decoded chunks BEFORE surfacing a failed file's
+  // error (successfully-read records must not be lost to an unrelated
+  // file's IOError), returns 0 with the popped chunk, 1 at clean end,
+  // -1 with the error surfaced.
+  int pop_chunk(std::string* payload, uint32_t* nrec) {
+    std::unique_lock<std::mutex> lk(mu);
+    not_empty.wait(lk, [this] {
+      return !queue.empty() || live_workers.load() == 0 || stopping;
+    });
+    if (queue.empty()) {
+      if (!error.empty()) {
+        g_pf_error = error;
+        return -1;
+      }
+      return 1;
+    }
+    *payload = std::move(queue.front().first);
+    *nrec = queue.front().second;
+    queue.pop_front();
+    not_full.notify_one();
+    return 0;
+  }
+
   void worker() {
     for (;;) {
       size_t raw = next_file.fetch_add(1);
@@ -332,25 +357,8 @@ void* rupt_prefetcher_open_image(const char** paths, uint32_t n_paths,
 int rupt_prefetcher_next_chunk(void* handle, const uint8_t** out,
                                uint32_t* len, uint32_t* nrec) {
   auto* p = (Prefetcher*)handle;
-  std::unique_lock<std::mutex> lk(p->mu);
-  p->not_empty.wait(lk, [p] {
-    return !p->queue.empty() || p->live_workers.load() == 0 ||
-           p->stopping;
-  });
-  // Drain chunks already decoded from healthy files before surfacing a
-  // failed file's error: successfully-read records must not be lost to
-  // an unrelated file's IOError. The error fires once the queue empties.
-  if (p->queue.empty()) {
-    if (!p->error.empty()) {
-      g_pf_error = p->error;
-      return -1;
-    }
-    return 1;                                // all files drained
-  }
-  p->current = std::move(p->queue.front().first);
-  *nrec = p->queue.front().second;
-  p->queue.pop_front();
-  p->not_full.notify_one();
+  int rc = p->pop_chunk(&p->current, nrec);
+  if (rc != 0) return rc;
   *out = (const uint8_t*)p->current.data();
   *len = (uint32_t)p->current.size();
   return 0;
@@ -364,25 +372,12 @@ int rupt_prefetcher_take_chunk(void* handle, const uint8_t** out,
                                void** free_handle, uint32_t* len,
                                uint32_t* nrec) {
   auto* p = (Prefetcher*)handle;
-  std::unique_lock<std::mutex> lk(p->mu);
-  p->not_empty.wait(lk, [p] {
-    return !p->queue.empty() || p->live_workers.load() == 0 ||
-           p->stopping;
-  });
-  if (p->queue.empty()) {
-    if (!p->error.empty()) {
-      g_pf_error = p->error;
-      return -1;
-    }
-    return 1;
-  }
-  auto* s = new std::string(std::move(p->queue.front().first));
-  *nrec = p->queue.front().second;
-  p->queue.pop_front();
-  p->not_full.notify_one();
+  auto s = std::make_unique<std::string>();
+  int rc = p->pop_chunk(s.get(), nrec);
+  if (rc != 0) return rc;
   *out = (const uint8_t*)s->data();
   *len = (uint32_t)s->size();
-  *free_handle = s;
+  *free_handle = s.release();
   return 0;
 }
 

@@ -258,19 +258,10 @@ class ParallelRecordIOScanner(object):
     def __iter__(self):
         return self
 
-    def _fetch_chunk(self):
-        """One (payload bytes, n_records) pair from the native queue.
-        Raises StopIteration at end-of-data and IOError on a native
-        error — the ONE lifecycle/error-translation implementation both
-        scanner classes share."""
-        if self._h is None:
-            raise StopIteration
-        out = ctypes.POINTER(ctypes.c_uint8)()
-        ln = ctypes.c_uint32()
-        nrec = ctypes.c_uint32()
-        rc = self._libref.rupt_prefetcher_next_chunk(
-            self._h, ctypes.byref(out), ctypes.byref(ln),
-            ctypes.byref(nrec))
+    def _translate_rc(self, rc):
+        """Shared end-of-data / native-error translation for the two
+        fetch flavors: rc 1 -> StopIteration, rc<0 -> IOError, both
+        closing the handle."""
         if rc == 1:
             self.close()
             raise StopIteration
@@ -279,6 +270,20 @@ class ParallelRecordIOScanner(object):
                 'utf-8', 'replace')
             self.close()
             raise IOError(msg)
+
+    def _fetch_chunk(self):
+        """One (payload bytes, n_records) pair from the native queue.
+        Raises StopIteration at end-of-data and IOError on a native
+        error."""
+        if self._h is None:
+            raise StopIteration
+        out = ctypes.POINTER(ctypes.c_uint8)()
+        ln = ctypes.c_uint32()
+        nrec = ctypes.c_uint32()
+        rc = self._libref.rupt_prefetcher_next_chunk(
+            self._h, ctypes.byref(out), ctypes.byref(ln),
+            ctypes.byref(nrec))
+        self._translate_rc(rc)
         return ctypes.string_at(out, ln.value), nrec.value
 
     class _ChunkOwner(object):
@@ -307,14 +312,7 @@ class ParallelRecordIOScanner(object):
         rc = self._libref.rupt_prefetcher_take_chunk(
             self._h, ctypes.byref(out), ctypes.byref(fh),
             ctypes.byref(ln), ctypes.byref(nrec))
-        if rc == 1:
-            self.close()
-            raise StopIteration
-        if rc != 0:
-            msg = self._libref.rupt_pf_last_error().decode(
-                'utf-8', 'replace')
-            self.close()
-            raise IOError(msg)
+        self._translate_rc(rc)
         cbuf = (ctypes.c_uint8 * ln.value).from_address(
             ctypes.cast(out, ctypes.c_void_p).value or 0)
         # the ctypes array becomes the numpy base; pinning the owner on

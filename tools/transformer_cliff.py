@@ -27,8 +27,13 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 
 
 def profile_step(batch, nsteps=3):
+    import gc
     import jax
     import paddle_tpu as fluid
+    # drop the previous run's executors: _dump_segment_hlo dumps every
+    # LIVE executor's segments, and a surviving bs8 module in the bs16
+    # capture dir would poison module selection below
+    gc.collect()
     from paddle_tpu import profiler, unique_name
     from paddle_tpu.models import transformer as tfm
 
@@ -93,6 +98,11 @@ def profile_step(batch, nsteps=3):
     import glob
     texts = [open(f).read()
              for f in sorted(glob.glob(path + '.hlo/*.txt'))]
+    if not texts:
+        raise RuntimeError(
+            'no HLO segments dumped under %s.hlo — the device trace '
+            'capture failed (profiler.profiler swallows start_trace '
+            'errors); cannot attribute' % path)
     main_text = max(texts, key=len)
     op_map = profiler.hlo_op_map([main_text])
     events = profiler.device_op_events(path + '.xplane', op_map)
