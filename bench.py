@@ -439,8 +439,9 @@ def _peak_hbm_gb(on_tpu, program=None, batch=1):
             return round(int(stats['peak_bytes_in_use']) / 2 ** 30, 2)
         est = 0
         if program is not None:
-            est = memory.estimate_program_memory(
-                program, batch_size=batch)['total']
+            est = memory.estimate_peak_memory(
+                program, batch_size=batch,
+                amp_bf16=getattr(program, '_use_bf16', False))
         live = memory.scope_footprint()
         return round(max(est, live) / 2 ** 30, 2)
     except Exception:

@@ -119,3 +119,49 @@ def estimate_program_memory(program, batch_size=1):
                 acts += b * (batch_size if has_batch else 1)
     return {'params': params, 'activations': acts,
             'total': params + acts}
+
+
+def estimate_peak_memory(program, batch_size=1, amp_bf16=False):
+    """Liveness-aware peak-HBM estimate for one run of `program`:
+    persistable parameters + the MAXIMUM over program points of the
+    live activation set (ControlFlowGraph dataflow — the same analysis
+    the memory-optimize transpiler runs; amp_bf16 halves float32
+    activation bytes — the AMP emitters' bf16 stream). A much tighter
+    bound than
+    estimate_program_memory's sum-of-all-activations: forward
+    activations count only while a later (backward) op still reads
+    them. Still an upper bound — XLA's buffer reuse within a fusion and
+    rematerialization only improve on it. Returns bytes."""
+    from .transpiler.memory_optimization_transpiler import \
+        ControlFlowGraph
+    params = 0
+    seen = set()
+    for block in program.blocks:
+        for var in block.vars.values():
+            if var.name in seen:
+                continue
+            seen.add(var.name)
+            if getattr(var, 'persistable', False):
+                params += _var_bytes(var)
+
+    def var_cost(block, name):
+        var = block.vars.get(name)
+        if var is None or getattr(var, 'persistable', False):
+            return 0
+        b = _var_bytes(var)
+        # under AMP the ACTIVATION stream moves as bf16 even though the
+        # IR declares float32 (emitters cast at the boundary)
+        if amp_bf16 and str(var.dtype) == 'float32':
+            b //= 2
+        has_batch = var.shape and int(var.shape[0]) in (-1, 0)
+        return b * (batch_size if has_batch else 1)
+
+    peak = 0
+    for block in program.blocks:
+        cfg = ControlFlowGraph(block)
+        live_out = cfg._dataflow_analyze()
+        for i in range(len(block.ops)):
+            live = live_out[i] | cfg.uses[i] | cfg.defs[i]
+            total = sum(var_cost(block, n) for n in live)
+            peak = max(peak, total)
+    return params + peak
