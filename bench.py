@@ -426,9 +426,10 @@ def _peak_hbm_gb(on_tpu, program=None, batch=1):
     """HBM footprint for the BENCH artifact, in GiB. Prefers the PJRT
     allocator's cumulative peak; the remoted axon backend exposes NO
     allocator stats (memory_stats() is None), so the fallback is the
-    analytic per-program estimate (params + batch-scaled activation
-    upper bound, memory.estimate_program_memory) combined with the
-    live framework-tracked device footprint — an upper bound on the
+    analytic per-program estimate (params + liveness-peak batch-scaled
+    activations, memory.estimate_peak_memory — AMP-aware, sub-blocks
+    stacked on the parent live set) combined with the live
+    framework-tracked device footprint — an upper bound on the
     series' requirement, labeled via bench's hbm_source field."""
     if not on_tpu:
         return None

@@ -17,7 +17,8 @@ from __future__ import annotations
 import numpy as np
 
 __all__ = ['memory_stats', 'memory_allocated', 'max_memory_allocated',
-           'memory_limit', 'scope_footprint', 'estimate_program_memory']
+           'memory_limit', 'scope_footprint', 'estimate_program_memory',
+           'estimate_peak_memory']
 
 _DTYPE_BYTES = {
     'float64': 8, 'int64': 8, 'uint64': 8,
@@ -92,6 +93,21 @@ def _var_bytes(var):
     return n * _DTYPE_BYTES.get(str(var.dtype), 4)
 
 
+def _params_bytes(program):
+    """Persistable-parameter footprint, deduped by name across blocks
+    (shared by both analytic estimators)."""
+    params = 0
+    seen = set()
+    for block in program.blocks:
+        for var in block.vars.values():
+            if var.name in seen:
+                continue
+            seen.add(var.name)
+            if getattr(var, 'persistable', False):
+                params += _var_bytes(var)
+    return params
+
+
 def estimate_program_memory(program, batch_size=1):
     """Analytic HBM estimate for one run of `program`: persistable
     parameters + peak of the non-persistable activations under XLA's
@@ -102,7 +118,7 @@ def estimate_program_memory(program, batch_size=1):
     The TPU-native replacement for the reference's memory-optimize
     transpiler planning questions ('will this fit?'), answerable before
     paying a compile."""
-    params = 0
+    params = _params_bytes(program)
     acts = 0
     seen = set()
     for block in program.blocks:
@@ -110,13 +126,11 @@ def estimate_program_memory(program, batch_size=1):
             if var.name in seen:
                 continue
             seen.add(var.name)
-            b = _var_bytes(var)
             if getattr(var, 'persistable', False):
-                params += b
-            else:
-                # non-persistables scale with the fed batch
-                has_batch = var.shape and int(var.shape[0]) in (-1, 0)
-                acts += b * (batch_size if has_batch else 1)
+                continue
+            # non-persistables scale with the fed batch
+            has_batch = var.shape and int(var.shape[0]) in (-1, 0)
+            acts += _var_bytes(var) * (batch_size if has_batch else 1)
     return {'params': params, 'activations': acts,
             'total': params + acts}
 
@@ -130,38 +144,48 @@ def estimate_peak_memory(program, batch_size=1, amp_bf16=False):
     bound than
     estimate_program_memory's sum-of-all-activations: forward
     activations count only while a later (backward) op still reads
-    them. Still an upper bound — XLA's buffer reuse within a fusion and
+    them. Control-flow sub-blocks run while their parent op's live set
+    is held, so a sub-block op's cost is its block's own peak ON TOP of
+    the parent live set (vars resolve up the parent chain). Still an
+    upper bound — XLA's buffer reuse within a fusion and
     rematerialization only improve on it. Returns bytes."""
     from .transpiler.memory_optimization_transpiler import \
         ControlFlowGraph
-    params = 0
-    seen = set()
-    for block in program.blocks:
-        for var in block.vars.values():
-            if var.name in seen:
-                continue
-            seen.add(var.name)
-            if getattr(var, 'persistable', False):
-                params += _var_bytes(var)
+    params = _params_bytes(program)
 
-    def var_cost(block, name):
-        var = block.vars.get(name)
+    def var_cost(block, name, outer_priced):
+        # local-first resolution; a parent-chain var already priced in
+        # the enclosing live set costs 0 here (no double count)
+        if name not in block.vars and name in outer_priced:
+            return 0
+        var, b = None, block
+        while b is not None:
+            if name in b.vars:
+                var = b.vars[name]
+                break
+            b = b.parent_block
         if var is None or getattr(var, 'persistable', False):
             return 0
-        b = _var_bytes(var)
+        nbytes = _var_bytes(var)
         # under AMP the ACTIVATION stream moves as bf16 even though the
         # IR declares float32 (emitters cast at the boundary)
         if amp_bf16 and str(var.dtype) == 'float32':
-            b //= 2
+            nbytes //= 2
         has_batch = var.shape and int(var.shape[0]) in (-1, 0)
-        return b * (batch_size if has_batch else 1)
+        return nbytes * (batch_size if has_batch else 1)
 
-    peak = 0
-    for block in program.blocks:
+    def block_peak(block, outer_priced=frozenset()):
         cfg = ControlFlowGraph(block)
-        live_out = cfg._dataflow_analyze()
-        for i in range(len(block.ops)):
+        live_out = cfg.liveness()
+        peak = 0
+        for i, op in enumerate(block.ops):
             live = live_out[i] | cfg.uses[i] | cfg.defs[i]
-            total = sum(var_cost(block, n) for n in live)
+            total = sum(var_cost(block, n, outer_priced) for n in live)
+            sub_idx = op.attr('sub_block')
+            if sub_idx is not None:
+                total += block_peak(program.blocks[sub_idx],
+                                    outer_priced | live)
             peak = max(peak, total)
-    return params + peak
+        return peak
+
+    return params + block_peak(program.blocks[0])

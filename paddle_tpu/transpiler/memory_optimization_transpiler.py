@@ -38,6 +38,12 @@ class ControlFlowGraph(object):
             self.uses.append({n for ns in op.inputs.values() for n in ns})
             self.defs.append({n for ns in op.outputs.values() for n in ns})
 
+    def liveness(self):
+        """Public accessor for the per-op live-out sets (the backward
+        dataflow fixpoint). memory.estimate_peak_memory consumes this;
+        keep it stable across internal refactors."""
+        return self._dataflow_analyze()
+
     def _dataflow_analyze(self):
         n = len(self.block.ops)
         live_out = [set() for _ in range(n)]

@@ -95,6 +95,41 @@ def test_memory_stats_and_estimate():
     assert est['total'] == est['params'] + est['activations']
 
 
+def test_estimate_peak_memory_stacks_sub_blocks():
+    """A While body's live set must be priced ON TOP of the parent live
+    set (the sub-block runs while the parent op holds its operands),
+    and sub-block references to parent-block vars must resolve up the
+    parent chain instead of costing 0."""
+    prog, startup = Program(), Program()
+    with program_guard(prog, startup):
+        x = fluid.layers.data(name='x', shape=[256], dtype='float32')
+        big = fluid.layers.fc(input=x, size=1024, bias_attr=False)
+        i = fluid.layers.fill_constant(shape=[1], dtype='int64', value=0)
+        n = fluid.layers.fill_constant(shape=[1], dtype='int64', value=2)
+        cond = fluid.layers.less_than(x=i, y=n)
+        w = fluid.layers.While(cond=cond)
+        with w.block():
+            # reads the PARENT-block var `big`: cost must resolve via
+            # the parent chain (non-zero), stacked on the parent live
+            # set that holds `big` across the while op
+            inner = fluid.layers.elementwise_add(big, big)
+            fluid.layers.increment(x=i, in_place=True)
+            fluid.layers.less_than(x=i, y=n, cond=cond)
+        out = fluid.layers.elementwise_add(big, big)
+        fluid.layers.mean(out)
+    peak = fluid.memory.estimate_peak_memory(prog, batch_size=4)
+    # `big` (parent, live across the while) + `inner` (sub-block) must
+    # BOTH be in the peak: 2 batch-scaled [4, 1024] fp32 tensors plus
+    # params; max-over-blocks or 0-cost parent refs would be below it
+    big_bytes = 4 * 1024 * 4
+    params = 256 * 1024 * 4
+    assert peak >= params + 2 * big_bytes
+    # amp halves fp32 activation pricing but never params
+    peak_amp = fluid.memory.estimate_peak_memory(prog, batch_size=4,
+                                                 amp_bf16=True)
+    assert params < peak_amp < peak
+
+
 def test_scope_footprint_counts_persistables():
     prog, startup = Program(), Program()
     with program_guard(prog, startup):

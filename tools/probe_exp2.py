@@ -32,7 +32,10 @@ def _kernel(x_ref, o_ref, *, reps, fn):
     o_ref[...] = x
 
 
-def _run(fn, reps, blocks=64, bq=512, bk=512, iters=20):
+BLOCKS, BQ, BK, ITERS = 64, 512, 512, 20
+
+
+def _run(fn, reps, blocks=BLOCKS, bq=BQ, bk=BK, iters=ITERS):
     x = jnp.asarray(
         np.random.RandomState(0).rand(blocks, bq, bk).astype('f4'))
     call = pl.pallas_call(
@@ -65,8 +68,8 @@ def main():
     for name, fn in [('exp', jnp.exp), ('exp2', jnp.exp2)]:
         t1 = _run(fn, reps=4)
         t2 = _run(fn, reps=8)
-        per_rep = (t2 - t1) / 4  # 20 iters x 64 blocks x 4 extra reps
-        elems = 20 * 64 * 512 * 512
+        per_rep = (t2 - t1) / 4  # 4 extra reps between the two runs
+        elems = ITERS * BLOCKS * BQ * BK
         print('%s: 4rep %.4fs  8rep %.4fs  -> %.3f ns/elem  %.1f Gexp/s'
               % (name, t1, t2, per_rep / elems * 1e9,
                  elems / per_rep / 1e9))
