@@ -153,10 +153,16 @@ def estimate_peak_memory(program, batch_size=1, amp_bf16=False):
         ControlFlowGraph
     params = _params_bytes(program)
 
-    def var_cost(block, name, outer_priced):
-        # local-first resolution; a parent-chain var already priced in
-        # the enclosing live set costs 0 here (no double count)
-        if name not in block.vars and name in outer_priced:
+    def var_cost(block, name, outer_priced, hoisted):
+        # no double count against the enclosing live set: a name that
+        # resolves up the parent chain is the same buffer, and so is a
+        # sub-block-local var the control-flow op HOISTS into the
+        # parent under the same name (layers.recompute outputs — one
+        # buffer in two var tables). A local var that merely shadows an
+        # outer name (user-chosen names bypass unique_name) is a
+        # distinct buffer and still priced.
+        if name in outer_priced and (name not in block.vars
+                                     or name in hoisted):
             return 0
         var, b = None, block
         while b is not None:
@@ -174,18 +180,37 @@ def estimate_peak_memory(program, batch_size=1, amp_bf16=False):
         has_batch = var.shape and int(var.shape[0]) in (-1, 0)
         return nbytes * (batch_size if has_batch else 1)
 
-    def block_peak(block, outer_priced=frozenset()):
+    visited = set()
+
+    def block_peak(block, outer_priced=frozenset(),
+                   hoisted=frozenset()):
+        visited.add(block.idx)
         cfg = ControlFlowGraph(block)
         live_out = cfg.liveness()
         peak = 0
         for i, op in enumerate(block.ops):
             live = live_out[i] | cfg.uses[i] | cfg.defs[i]
-            total = sum(var_cost(block, n, outer_priced) for n in live)
+            total = sum(var_cost(block, n, outer_priced, hoisted)
+                        for n in live)
             sub_idx = op.attr('sub_block')
             if sub_idx is not None:
-                total += block_peak(program.blocks[sub_idx],
-                                    outer_priced | live)
+                # only the DIRECT parent op's outputs hoist into its
+                # own sub-block; deeper levels are covered by the
+                # parent-chain-resolution clause (accumulating would
+                # zero-price a deeper local var shadowing an ancestor's
+                # hoisted name)
+                total += block_peak(
+                    program.blocks[sub_idx], outer_priced | live,
+                    set(op.output_arg_names()))
             peak = max(peak, total)
         return peak
 
-    return params + block_peak(program.blocks[0])
+    peak = block_peak(program.blocks[0])
+    # blocks referenced OUTSIDE the sub_block attr chain (pserver
+    # programs wire optimize/LR blocks via lr_block_id /
+    # grad_to_block_id string attrs) still run; keep the upper-bound
+    # contract by folding their standalone peaks in
+    for block in program.blocks:
+        if block.idx not in visited:
+            peak = max(peak, block_peak(block))
+    return params + peak

@@ -130,6 +130,25 @@ def test_estimate_peak_memory_stacks_sub_blocks():
     assert params < peak_amp < peak
 
 
+def test_estimate_peak_memory_recompute_no_double_count():
+    """layers.recompute hoists its output into the parent block under
+    the SAME name (one buffer in two var tables); the estimator must
+    price it once."""
+    prog, startup = Program(), Program()
+    with program_guard(prog, startup):
+        x = fluid.layers.data(name='x', shape=[256], dtype='float32')
+        y = fluid.layers.recompute(
+            lambda h: fluid.layers.fc(input=h, size=1024,
+                                      bias_attr=False), x)
+        fluid.layers.mean(y)
+    peak = fluid.memory.estimate_peak_memory(prog, batch_size=4)
+    params = 256 * 1024 * 4
+    y_bytes = 4 * 1024 * 4
+    x_bytes = 4 * 256 * 4
+    # one y + one x (+ tiny mean scalar), never two y's
+    assert peak <= params + y_bytes + x_bytes + 64
+
+
 def test_scope_footprint_counts_persistables():
     prog, startup = Program(), Program()
     with program_guard(prog, startup):

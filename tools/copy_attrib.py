@@ -1,0 +1,76 @@
+"""Per-INSTRUCTION attribution of the transformer bench's copy class.
+
+tools/transformer_cliff.py showed ~5% of bs8 device time in
+copy/bitcast relayouts (PERF.md round-5 cliff section) but only at
+class granularity. This tool profiles the same bench program (reusing
+profile_step's capture machinery) and prints every copy-family event
+with its duration, HLO result shape (parsed from the dumped
+main-segment HLO), and the IR op the metadata join resolves it to — so
+the question "are these the attention-layout transposes or something
+else?" gets an evidence-grade answer.
+
+Classification runs on RAW HLO instruction names (a copy whose
+metadata maps it to an IR label like `mul.247` is still a copy); the
+IR op is looked up separately for the report column.
+
+    python tools/copy_attrib.py [--bs 8] [--top 25]
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import re
+import sys
+from collections import defaultdict
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+_COPY_CLASSES = ('copy', 'bitcast', 'transpose', 'copy-done',
+                 'copy-start')
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument('--bs', type=int, default=8)
+    ap.add_argument('--top', type=int, default=25)
+    ap.add_argument('--nsteps', type=int, default=3)
+    args = ap.parse_args()
+
+    from transformer_cliff import profile_step  # reuse the bench build
+    from resnet_wall import parse_hlo  # tuple-type-safe HLO parsing
+
+    step_ms, _classes, ex = profile_step(args.bs, nsteps=args.nsteps)
+
+    # instr name -> result type string (handles tuple-typed results
+    # like copy-start's (bf16[...], bf16[...], u32[]))
+    shape_of = {name: out_type.strip()
+                for name, (out_type, _args)
+                in parse_hlo(ex['main_text']).items()}
+
+    per_instr = defaultdict(float)
+    for instr, _s, dur in ex['raw_events']:
+        per_instr[instr] += dur / ex['nsteps'] / 1e6
+
+    rows = []
+    for name, ms in per_instr.items():
+        cls = name.split('.')[0]
+        if cls not in _COPY_CLASSES:
+            continue
+        rows.append((ms, name, shape_of.get(name, '?'),
+                     ex['op_map'].get(name, '-')))
+    rows.sort(reverse=True)
+    total = sum(r[0] for r in rows)
+    print('bs%d step %.1f ms; copy-family device %.2f ms/step '
+          '(%d instrs)' % (args.bs, step_ms, total, len(rows)))
+    print('| ms | instr | shape | ir op |')
+    print('|---|---|---|---|')
+    for ms, name, shape, ir in rows[:args.top]:
+        # drop the tiling annotation, keep the minor-to-major order
+        shape = re.sub(r':[^}]*}', '}', shape)
+        print('| %.3f | %s | %s | %s |' % (ms, name, shape, ir))
+
+
+if __name__ == '__main__':
+    main()

@@ -96,6 +96,7 @@ def profile_step(batch, nsteps=3):
             float(np.asarray(l[0]))
 
     import glob
+    import re
     texts = [open(f).read()
              for f in sorted(glob.glob(path + '.hlo/*.txt'))]
     if not texts:
@@ -103,14 +104,32 @@ def profile_step(batch, nsteps=3):
             'no HLO segments dumped under %s.hlo — the device trace '
             'capture failed (profiler.profiler swallows start_trace '
             'errors); cannot attribute' % path)
-    main_text = max(texts, key=len)
+    # RAW instruction-name events (no op_map): the class table counts
+    # HLO opcodes (stable across join quality), and per-instruction
+    # consumers (tools/copy_attrib.py) must see the opcode even for
+    # instructions whose metadata maps to an IR label
+    raw_events = profiler.device_op_events(path + '.xplane')
+    # the TRAIN segment is the one that defines the captured events'
+    # instructions — NOT the largest dump (the startup/init segment's
+    # text outweighs the step segment at this model size)
+    event_names = {instr for instr, _s, _d in raw_events}
+    def_re = re.compile(r'^\s*(?:ROOT )?%?([\w.-]+)\s*=', re.M)
+    overlaps = [len(event_names & set(def_re.findall(t)))
+                for t in texts]
+    if not raw_events or max(overlaps) == 0:
+        raise RuntimeError(
+            'device capture empty or no dumped HLO segment defines '
+            'any captured event instruction (start_trace failure / '
+            'stale dump dir) — refusing to report a silently-wrong '
+            'attribution')
+    main_text = texts[overlaps.index(max(overlaps))]
     op_map = profiler.hlo_op_map([main_text])
-    events = profiler.device_op_events(path + '.xplane', op_map)
     classes = defaultdict(float)
-    for label, _s, dur in events:
-        cls = label.split('.')[0]
-        classes[cls] += dur / nsteps / 1e6
-    return step_ms, classes
+    for instr, _s, dur in raw_events:
+        classes[instr.split('.')[0]] += dur / nsteps / 1e6
+    extras = {'raw_events': raw_events, 'op_map': op_map,
+              'main_text': main_text, 'nsteps': nsteps}
+    return step_ms, classes, extras
 
 
 def main():
@@ -119,7 +138,7 @@ def main():
     args = ap.parse_args()
     results = {}
     for bs in args.bs:
-        step_ms, classes = profile_step(bs)
+        step_ms, classes, _ = profile_step(bs)
         results[bs] = (step_ms, classes)
         print('bs%d: %.1f ms/step (%.0f tok/s)'
               % (bs, step_ms, bs * 512 / step_ms * 1e3))
