@@ -48,6 +48,13 @@ _DEFAULTS = {
     # (Megatron-style). Off by default: exact-fp32 grad parity tests
     # rely on the precise path.
     'amp_bf16_param_grads': False,
+    # mul (FC matmul) with one contracted dim on a batched input:
+    # contract via 3D dot_general on the ORIGINAL shape instead of
+    # flattening to 2D first, so the vjp-derived dW is a batch-dims
+    # contraction over the un-flattened activation (measured faster on
+    # the bench transformer; tools/probe_dw_layout.py + PERF.md
+    # round-5 A/B). Off = the reshape-to-2D formulation.
+    'mul_dotgen': True,
     # flash-attention kernel block overrides (0 = use the tuned table
     # in pallas/flash_attention.py:_block_sizes)
     'flash_block_q': 0,

@@ -146,13 +146,28 @@ def _mul_emit(ctx, op):
             'mul: cannot align x shape %s (declared rank %s, '
             'x_num_col_dims %d) with contraction size %d'
             % (x.shape, declared, xnc, k))
+    from ..flags import get_flag
+    out_shape = x.shape[:x.ndim - nd] + y.shape[ync:]
+    if nd == 1 and x.ndim > 2 and get_flag('mul_dotgen'):
+        # single contracted dim on a batched x: contract directly with
+        # dot_general instead of flattening to 2D. Same forward HLO
+        # after XLA's reshape folding, but the vjp-derived dW becomes a
+        # batch-dims contraction over the ORIGINAL shape rather than
+        # d/d(reshape) — giving layout assignment the un-flattened view
+        # of the activation (tools/probe_dw_layout.py).
+        xq, y2 = amp_cast(ctx, x, y2)
+        out = jax.lax.dot_general(
+            xq, y2, (((xq.ndim - 1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32
+            if xq.dtype == jnp.bfloat16 else xq.dtype).astype(xq.dtype)
+        ctx.set(op.single_output('Out'), out.reshape(out_shape))
+        return
     x2 = x.reshape(-1, int(np.prod(x.shape[x.ndim - nd:])))
     x2, y2 = amp_cast(ctx, x2, y2)
     out2 = jnp.matmul(
         x2, y2,
         preferred_element_type=jnp.float32
         if x2.dtype == jnp.bfloat16 else x2.dtype).astype(x2.dtype)
-    out_shape = x.shape[:x.ndim - nd] + y.shape[ync:]
     ctx.set(op.single_output('Out'), out2.reshape(out_shape))
 
 

@@ -122,8 +122,13 @@ def profile_step(batch, nsteps=3):
             'any captured event instruction (start_trace failure / '
             'stale dump dir) — refusing to report a silently-wrong '
             'attribution')
+    # main_text (shape parsing) = best event overlap; the op MAP joins
+    # across ALL dumps — hlo_op_map drops names two modules disagree
+    # on, so a cross-module collision yields no entry rather than a
+    # wrong one, and events from secondary compiled executables still
+    # resolve
     main_text = texts[overlaps.index(max(overlaps))]
-    op_map = profiler.hlo_op_map([main_text])
+    op_map = profiler.hlo_op_map(texts)
     classes = defaultdict(float)
     for instr, _s, dur in raw_events:
         classes[instr.split('.')[0]] += dur / nsteps / 1e6
