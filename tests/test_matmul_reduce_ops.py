@@ -29,6 +29,30 @@ class TestMulFlatten(OpTest):
         self.check_output(atol=1e-4)
 
 
+class TestMulDotgenArms(OpTest):
+    """Both mul formulations (3D dot_general default vs the
+    reshape-to-2D fallback, FLAGS_mul_dotgen) must agree on forward
+    values AND gradients for the batched single-contraction case the
+    dispatch splits on."""
+    op_type = 'mul'
+
+    def test_arms_agree(self):
+        import paddle_tpu as fluid
+        x = np.random.rand(3, 5, 8).astype('float32')
+        y = np.random.rand(8, 4).astype('float32')
+        ref = x @ y
+        for flag in (True, False):
+            fluid.flags.set_flags({'FLAGS_mul_dotgen': flag})
+            try:
+                self.inputs = {'X': x, 'Y': y}
+                self.attrs = {'x_num_col_dims': 2}
+                self.outputs = {'Out': ref}
+                self.check_output(atol=1e-4)
+                self.check_grad(['X', 'Y'], max_relative_error=0.02)
+            finally:
+                fluid.flags.set_flags({'FLAGS_mul_dotgen': True})
+
+
 class TestMatmul(OpTest):
     op_type = 'matmul'
 

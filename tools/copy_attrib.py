@@ -36,12 +36,15 @@ def main():
     ap.add_argument('--bs', type=int, default=8)
     ap.add_argument('--top', type=int, default=25)
     ap.add_argument('--nsteps', type=int, default=3)
+    ap.add_argument('--config', default='transformer',
+                    choices=['transformer', 'longcontext'])
     args = ap.parse_args()
 
     from transformer_cliff import profile_step  # reuse the bench build
     from resnet_wall import parse_hlo  # tuple-type-safe HLO parsing
 
-    step_ms, _classes, ex = profile_step(args.bs, nsteps=args.nsteps)
+    step_ms, _classes, ex = profile_step(args.bs, nsteps=args.nsteps,
+                                     config=args.config)
 
     # instr name -> result type string (handles tuple-typed results
     # like copy-start's (bf16[...], bf16[...], u32[]))

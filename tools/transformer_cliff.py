@@ -26,7 +26,9 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
 
-def profile_step(batch, nsteps=3):
+def profile_step(batch, nsteps=3, config='transformer'):
+    """config: 'transformer' (bench T=512 flagship) or 'longcontext'
+    (the bench T=8192 series)."""
     import gc
     import jax
     import paddle_tpu as fluid
@@ -38,10 +40,16 @@ def profile_step(batch, nsteps=3):
     from paddle_tpu.models import transformer as tfm
 
     fluid.flags.set_flags({'FLAGS_amp_bf16_param_grads': True})
-    cfg = tfm.TransformerConfig(vocab=32768, dim=2048, heads=16,
-                                layers=12, ffn=8192, max_len=512,
-                                use_tp=False, use_sp=False,
-                                flash_attention=True)
+    if config == 'longcontext':
+        cfg = tfm.TransformerConfig(vocab=32768, dim=1024, heads=8,
+                                    layers=4, ffn=4096, max_len=8192,
+                                    use_tp=False, use_sp=False,
+                                    flash_attention=True)
+    else:
+        cfg = tfm.TransformerConfig(vocab=32768, dim=2048, heads=16,
+                                    layers=12, ffn=8192, max_len=512,
+                                    use_tp=False, use_sp=False,
+                                    flash_attention=True)
     with unique_name.guard():
         main_prog, startup = fluid.Program(), fluid.Program()
         with fluid.program_guard(main_prog, startup):
@@ -88,7 +96,7 @@ def profile_step(batch, nsteps=3):
         w1, w2 = timed(8), timed(16)
         step_ms = max(w2 - w1, 1e-9) / 8 * 1e3
 
-        path = '/tmp/tf_cliff_bs%d' % batch
+        path = '/tmp/tf_cliff_%s_bs%d' % (config, batch)
         with profiler.profiler('All', None, path):
             for _ in range(nsteps):
                 l = pe.run(fetch_list=[avg_cost.name], feed=feed,
@@ -133,20 +141,24 @@ def profile_step(batch, nsteps=3):
     for instr, _s, dur in raw_events:
         classes[instr.split('.')[0]] += dur / nsteps / 1e6
     extras = {'raw_events': raw_events, 'op_map': op_map,
-              'main_text': main_text, 'nsteps': nsteps}
+              'main_text': main_text, 'nsteps': nsteps,
+              'tokens_per_sample': cfg.max_len}
     return step_ms, classes, extras
 
 
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument('--bs', type=int, nargs='+', default=[8, 16])
+    ap.add_argument('--config', default='transformer',
+                    choices=['transformer', 'longcontext'])
     args = ap.parse_args()
     results = {}
     for bs in args.bs:
-        step_ms, classes, _ = profile_step(bs)
+        step_ms, classes, ex = profile_step(bs, config=args.config)
         results[bs] = (step_ms, classes)
         print('bs%d: %.1f ms/step (%.0f tok/s)'
-              % (bs, step_ms, bs * 512 / step_ms * 1e3))
+              % (bs, step_ms,
+                 bs * ex['tokens_per_sample'] / step_ms * 1e3))
     b0, b1 = args.bs[0], args.bs[-1]
     s0, c0 = results[b0]
     s1, c1 = results[b1]
