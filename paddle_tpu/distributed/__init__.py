@@ -17,6 +17,7 @@ win that motivates the parameter-server design for CTR models.
 """
 from .rpc import PSClient, PSServer, get_client, close_all_clients
 from .param_service import ParameterService
+from .env import ClusterEnv, cluster_from_env
 
 __all__ = ['PSClient', 'PSServer', 'ParameterService', 'get_client',
-           'close_all_clients']
+           'close_all_clients', 'ClusterEnv', 'cluster_from_env']

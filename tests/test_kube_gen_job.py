@@ -1,0 +1,105 @@
+"""tools/kube_gen_job.py + distributed.cluster_from_env — the k8s spec
+generator and the env contract its pods boot through (reference analog:
+benchmark/fluid/kube_gen_job.py + trainer.py env bootstrap)."""
+import os
+import subprocess
+import sys
+
+import yaml
+
+TOOL = os.path.join(os.path.dirname(__file__), '..', 'tools',
+                    'kube_gen_job.py')
+
+
+def _gen(*argv):
+    out = subprocess.run([sys.executable, TOOL] + list(argv),
+                         capture_output=True, text=True, check=True)
+    return list(yaml.safe_load_all(out.stdout))
+
+
+def _envmap(workload):
+    cont = workload['spec']['template']['spec']['containers'][0]
+    return {e['name']: e.get('value') for e in cont['env']}
+
+
+def test_tpu_mode_wires_distributed_env():
+    docs = _gen('--mode', 'tpu', '--hosts', '4', '--jobname', 'j1',
+                '--tpu-topology', '4x4')
+    svc, job = docs
+    assert svc['kind'] == 'Service'
+    assert svc['spec']['clusterIP'] in (None, 'None')
+    assert job['spec']['completions'] == 4
+    assert job['spec']['completionMode'] == 'Indexed'
+    env = _envmap(job)
+    assert env['PADDLE_TRAINERS_NUM'] == '4'
+    eps = env['PADDLE_TRAINER_ENDPOINTS'].split(',')
+    assert len(eps) == 4 and eps[0].startswith('j1-0.j1:')
+    # the id env comes from the completion-index annotation
+    assert 'PADDLE_TRAINER_ID' in env
+    pod = job['spec']['template']['spec']
+    assert pod['nodeSelector']['cloud.google.com/gke-tpu-topology'] \
+        == '4x4'
+    cont = pod['containers'][0]
+    assert cont['resources']['limits']['google.com/tpu'] == '4'
+
+
+def test_pserver_mode_statefulset_plus_trainer_job():
+    docs = _gen('--mode', 'pserver', '--pservers', '3',
+                '--trainers', '5', '--jobname', 'ps')
+    assert len(docs) == 3
+    _svc, pservers, trainers = docs
+    # pservers are long-lived: StatefulSet (stable DNS, restarts),
+    # NOT a Job that can never complete
+    assert pservers['kind'] == 'StatefulSet'
+    assert pservers['spec']['replicas'] == 3
+    assert pservers['spec']['template']['spec']['restartPolicy'] \
+        == 'Always'
+    # ordinal exported under the shared contract name by the wrapper
+    cmd = pservers['spec']['template']['spec']['containers'][0][
+        'command'][-1]
+    assert 'PADDLE_TRAINER_ID="${HOSTNAME##*-}"' in cmd
+    assert trainers['kind'] == 'Job'
+    assert trainers['spec']['completions'] == 5
+    ps_env, tr_env = _envmap(pservers), _envmap(trainers)
+    assert ps_env['TRAINING_ROLE'] == 'PSERVER'
+    assert tr_env['TRAINING_ROLE'] == 'TRAINER'
+    assert ps_env['PADDLE_PSERVER_ENDPOINTS'] == \
+        tr_env['PADDLE_PSERVER_ENDPOINTS']
+    assert len(ps_env['PADDLE_PSERVER_ENDPOINTS'].split(',')) == 3
+    # trainers ALSO get their own roster (init_parallel_env contract)
+    assert len(tr_env['PADDLE_TRAINER_ENDPOINTS'].split(',')) == 5
+
+
+def test_local_mode_single_pod_no_tpu_by_default():
+    docs = _gen('--mode', 'local')
+    _svc, job = docs
+    assert job['spec']['completions'] == 1
+    pod = job['spec']['template']['spec']
+    assert 'nodeSelector' not in pod
+    assert 'google.com/tpu' not in \
+        pod['containers'][0]['resources']['limits']
+
+
+def test_cluster_from_env_parses_generated_contract():
+    from paddle_tpu.distributed import cluster_from_env
+    docs = _gen('--mode', 'pserver', '--pservers', '2',
+                '--trainers', '3', '--jobname', 'c')
+    tr_env = _envmap(docs[2])
+    env = dict(tr_env, PADDLE_TRAINER_ID='1')
+    c = cluster_from_env(env)
+    assert c.role == 'TRAINER' and c.trainer_id == 1
+    assert c.num_trainers == 3
+    assert len(c.pserver_endpoints) == 2
+    assert c.pserver_csv == tr_env['PADDLE_PSERVER_ENDPOINTS']
+    assert c.current_endpoint == c.trainer_endpoints[1]
+    ps = cluster_from_env(dict(_envmap(docs[1]),
+                               PADDLE_TRAINER_ID='0'))
+    assert ps.role == 'PSERVER'
+    assert ps.current_endpoint == ps.pserver_endpoints[0]
+
+
+def test_cluster_from_env_local_default():
+    from paddle_tpu.distributed import cluster_from_env
+    c = cluster_from_env({})
+    assert c.role == 'TRAINER' and c.num_trainers == 1
+    assert c.trainer_id == 0 and c.pserver_endpoints == []

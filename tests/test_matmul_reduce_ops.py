@@ -41,16 +41,17 @@ class TestMulDotgenArms(OpTest):
         x = np.random.rand(3, 5, 8).astype('float32')
         y = np.random.rand(8, 4).astype('float32')
         ref = x @ y
-        for flag in (True, False):
-            fluid.flags.set_flags({'FLAGS_mul_dotgen': flag})
-            try:
+        saved = fluid.flags.get_flag('mul_dotgen')
+        try:
+            for flag in (True, False):
+                fluid.flags.set_flags({'FLAGS_mul_dotgen': flag})
                 self.inputs = {'X': x, 'Y': y}
                 self.attrs = {'x_num_col_dims': 2}
                 self.outputs = {'Out': ref}
                 self.check_output(atol=1e-4)
                 self.check_grad(['X', 'Y'], max_relative_error=0.02)
-            finally:
-                fluid.flags.set_flags({'FLAGS_mul_dotgen': True})
+        finally:
+            fluid.flags.set_flags({'FLAGS_mul_dotgen': saved})
 
 
 class TestMatmul(OpTest):

@@ -1,0 +1,208 @@
+"""Kubernetes job-spec generator for distributed paddle_tpu training.
+
+Capability analog of the reference's benchmark job generator
+(`benchmark/fluid/kube_gen_job.py`), redesigned for TPU pods instead
+of GPU/RDMA boxes:
+
+- **tpu** mode (the nccl2-mode analog): ONE indexed Job whose pods are
+  the jax.distributed processes of a multi-host TPU slice. Pod i gets
+  `PADDLE_TRAINER_ID` from the Job completion index and the full
+  `PADDLE_TRAINER_ENDPOINTS` roster via a headless Service — the env
+  contract `paddle_tpu.parallel.init_parallel_env()` reads
+  (parallel/distributed.py): endpoint 0 is the coordination-service
+  address, collectives ride ICI inside the slice and DCN across hosts.
+  TPU resources/topology go through the standard GKE node selectors.
+- **pserver** mode: parameter servers are a **StatefulSet** (long-lived
+  services need stable DNS + restart-on-eviction; a Job would never
+  complete and one eviction would kill the run) plus an indexed trainer
+  Job. Both sides get `PADDLE_PSERVER_ENDPOINTS` / `TRAINING_ROLE` /
+  trainer roster, the contract `paddle_tpu.distributed.
+  cluster_from_env()` parses (pserver ordinal = StatefulSet hostname
+  suffix, exported as PADDLE_TRAINER_ID by the entry wrapper).
+- **local** mode: a single-pod Job (smoke/dev; requests no TPU unless
+  --chips-per-host is given explicitly).
+
+Prints multi-document YAML to stdout (or --out FILE). No cluster is
+touched — this generates specs, like the reference tool.
+
+    python tools/kube_gen_job.py --mode tpu --hosts 4 \
+        --tpu-type tpu-v5-lite-podslice --tpu-topology 4x4 \
+        --entry "python train.py" --image my/image:tag
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+
+import yaml
+
+# StatefulSet pods have no completion-index annotation; the ordinal is
+# the hostname suffix. The wrapper exports it under the same variable
+# the indexed-Job pods get, so entry scripts read ONE contract.
+_ORDINAL_WRAP = 'export PADDLE_TRAINER_ID="${HOSTNAME##*-}"; '
+
+
+def _env(**kv):
+    return [{'name': k, 'value': str(v)} for k, v in kv.items()]
+
+
+def _endpoints(name, n, port, subdomain):
+    return ','.join('%s-%d.%s:%d' % (name, i, subdomain, port)
+                    for i in range(n))
+
+
+def _pod(args, envs, role, tpu=False, indexed=True):
+    env = list(envs)
+    entry = args.entry
+    if indexed:
+        env.append(
+            {'name': 'PADDLE_TRAINER_ID', 'valueFrom': {'fieldRef': {
+                'fieldPath': "metadata.annotations["
+                             "'batch.kubernetes.io/job-completion-index']"
+            }}})
+    else:
+        entry = _ORDINAL_WRAP + entry
+    container = {
+        'name': role,
+        'image': args.image,
+        'command': ['sh', '-c', entry],
+        'env': env,
+        'resources': {'requests': {'cpu': str(args.cpu),
+                                   'memory': '%dGi' % args.memory},
+                      'limits': {}},
+        'ports': [{'containerPort': args.port}],
+    }
+    spec = {'containers': [container],
+            'restartPolicy': 'Never' if indexed else 'Always',
+            'subdomain': args.jobname}
+    if tpu:
+        container['resources']['limits']['google.com/tpu'] = \
+            str(args.chips_per_host)
+        container['resources']['requests']['google.com/tpu'] = \
+            str(args.chips_per_host)
+        spec['nodeSelector'] = {
+            'cloud.google.com/gke-tpu-accelerator': args.tpu_type,
+            'cloud.google.com/gke-tpu-topology': args.tpu_topology,
+        }
+    return spec
+
+
+def _indexed_job(args, name, count, envs, tpu=False):
+    return {
+        'apiVersion': 'batch/v1',
+        'kind': 'Job',
+        'metadata': {'name': name},
+        'spec': {
+            'completions': count,
+            'parallelism': count,
+            'completionMode': 'Indexed',
+            'backoffLimit': 0,
+            'template': {
+                'metadata': {'labels': {'app': args.jobname}},
+                'spec': _pod(args, envs, name, tpu=tpu),
+            },
+        },
+    }
+
+
+def _stateful_set(args, name, count, envs):
+    pod = _pod(args, envs, name, indexed=False)
+    return {
+        'apiVersion': 'apps/v1',
+        'kind': 'StatefulSet',
+        'metadata': {'name': name},
+        'spec': {
+            'serviceName': args.jobname,
+            'replicas': count,
+            'selector': {'matchLabels': {'app': args.jobname,
+                                         'role': name}},
+            'template': {
+                'metadata': {'labels': {'app': args.jobname,
+                                        'role': name}},
+                'spec': pod,
+            },
+        },
+    }
+
+
+def _headless_service(args):
+    return {
+        'apiVersion': 'v1',
+        'kind': 'Service',
+        'metadata': {'name': args.jobname},
+        'spec': {'clusterIP': 'None',
+                 'selector': {'app': args.jobname},
+                 'ports': [{'port': args.port}]},
+    }
+
+
+def gen(args):
+    docs = [_headless_service(args)]
+    if args.mode == 'tpu':
+        eps = _endpoints(args.jobname, args.hosts, args.port,
+                         args.jobname)
+        envs = _env(PADDLE_TRAINERS_NUM=args.hosts,
+                    PADDLE_TRAINER_ENDPOINTS=eps,
+                    TRAINING_ROLE='TRAINER')
+        docs.append(_indexed_job(args, args.jobname, args.hosts, envs,
+                                 tpu=True))
+    elif args.mode == 'pserver':
+        ps_name = args.jobname + '-pserver'
+        tr_name = args.jobname + '-trainer'
+        ps_eps = _endpoints(ps_name, args.pservers, args.port,
+                            args.jobname)
+        tr_eps = _endpoints(tr_name, args.trainers, args.port,
+                            args.jobname)
+        common = dict(PADDLE_PSERVER_ENDPOINTS=ps_eps,
+                      PADDLE_TRAINER_ENDPOINTS=tr_eps,
+                      PADDLE_TRAINERS_NUM=args.trainers)
+        docs.append(_stateful_set(
+            args, ps_name, args.pservers,
+            _env(TRAINING_ROLE='PSERVER', **common)))
+        docs.append(_indexed_job(
+            args, tr_name, args.trainers,
+            _env(TRAINING_ROLE='TRAINER', **common),
+            tpu=args.chips_per_host > 0))
+    else:  # local
+        envs = _env(PADDLE_TRAINERS_NUM=1, TRAINING_ROLE='TRAINER')
+        docs.append(_indexed_job(args, args.jobname, 1, envs,
+                                 tpu=args.chips_per_host > 0))
+    return docs
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description='Generate k8s job specs for distributed '
+                    'paddle_tpu training.')
+    ap.add_argument('--jobname', default='paddletpu')
+    ap.add_argument('--mode', choices=['tpu', 'pserver', 'local'],
+                    default='tpu')
+    ap.add_argument('--hosts', type=int, default=2,
+                    help='tpu mode: number of slice hosts '
+                         '(jax.distributed processes)')
+    ap.add_argument('--pservers', type=int, default=2)
+    ap.add_argument('--trainers', type=int, default=2)
+    ap.add_argument('--tpu-type', default='tpu-v5-lite-podslice')
+    ap.add_argument('--tpu-topology', default='2x4')
+    ap.add_argument('--chips-per-host', type=int, default=None,
+                    help='default: 4 for tpu/pserver trainers, 0 '
+                         '(no TPU request) for local mode')
+    ap.add_argument('--cpu', type=int, default=8)
+    ap.add_argument('--memory', type=int, default=32, help='GiB')
+    ap.add_argument('--port', type=int, default=7164)
+    ap.add_argument('--image', default='paddle-tpu:latest')
+    ap.add_argument('--entry', default='python train.py')
+    ap.add_argument('--out', default=None)
+    args = ap.parse_args(argv)
+    if args.chips_per_host is None:
+        args.chips_per_host = 0 if args.mode == 'local' else 4
+    text = yaml.safe_dump_all(gen(args), sort_keys=False)
+    if args.out:
+        with open(args.out, 'w') as f:
+            f.write(text)
+    else:
+        sys.stdout.write(text)
+
+
+if __name__ == '__main__':
+    main()

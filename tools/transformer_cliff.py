@@ -40,16 +40,16 @@ def profile_step(batch, nsteps=3, config='transformer'):
     from paddle_tpu.models import transformer as tfm
 
     fluid.flags.set_flags({'FLAGS_amp_bf16_param_grads': True})
-    if config == 'longcontext':
-        cfg = tfm.TransformerConfig(vocab=32768, dim=1024, heads=8,
-                                    layers=4, ffn=4096, max_len=8192,
-                                    use_tp=False, use_sp=False,
-                                    flash_attention=True)
-    else:
-        cfg = tfm.TransformerConfig(vocab=32768, dim=2048, heads=16,
-                                    layers=12, ffn=8192, max_len=512,
-                                    use_tp=False, use_sp=False,
-                                    flash_attention=True)
+    shapes = {'transformer': dict(dim=2048, heads=16, layers=12,
+                                  ffn=8192, max_len=512),
+              'longcontext': dict(dim=1024, heads=8, layers=4,
+                                  ffn=4096, max_len=8192)}
+    if config not in shapes:
+        raise ValueError('unknown config %r (have %s)'
+                         % (config, sorted(shapes)))
+    cfg = tfm.TransformerConfig(vocab=32768, use_tp=False,
+                                use_sp=False, flash_attention=True,
+                                **shapes[config])
     with unique_name.guard():
         main_prog, startup = fluid.Program(), fluid.Program()
         with fluid.program_guard(main_prog, startup):
