@@ -1,0 +1,51 @@
+"""Top-N device instructions for a bench config, with shapes + IR join
+— the all-classes sibling of tools/copy_attrib.py (same capture reuse).
+
+    python tools/top_instrs.py [--config longcontext] [--bs 2] [--top 30]
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from collections import defaultdict
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument('--bs', type=int, default=2)
+    ap.add_argument('--top', type=int, default=30)
+    ap.add_argument('--nsteps', type=int, default=3)
+    ap.add_argument('--config', default='longcontext')
+    args = ap.parse_args()
+
+    from transformer_cliff import profile_step
+    from resnet_wall import parse_hlo
+
+    step_ms, _classes, ex = profile_step(args.bs, nsteps=args.nsteps,
+                                         config=args.config)
+    shape_of = {name: out_type.strip()
+                for name, (out_type, _args)
+                in parse_hlo(ex['main_text']).items()}
+    per_instr = defaultdict(float)
+    for instr, _s, dur in ex['raw_events']:
+        per_instr[instr] += dur / ex['nsteps'] / 1e6
+    rows = sorted(((ms, n) for n, ms in per_instr.items()),
+                  reverse=True)
+    total = sum(ms for ms, _ in rows)
+    print('%s bs%d: step %.1f ms, %d instrs, %.1f ms attributed'
+          % (args.config, args.bs, step_ms, len(rows), total))
+    print('| ms | instr | shape | ir op |')
+    print('|---|---|---|---|')
+    for ms, name in rows[:args.top]:
+        print('| %.3f | %s | %.60s | %s |'
+              % (ms, name, shape_of.get(name, '?'),
+                 ex['op_map'].get(name, '-')))
+
+
+if __name__ == '__main__':
+    main()
