@@ -288,11 +288,16 @@ def _bwd_onepass_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             .astype(dv_ref.dtype)
 
 
-# (T, d) -> (block_q, block_k) overrides. Intentionally EMPTY: the
-# round-4 sweep on this chip found every config within the ±20%
-# measurement noise band (PERF.md "flash kernel autotune"), so the
-# 512x512 default stands; populate per-chip when a sweep resolves.
-_BLOCK_TABLE = {}
+# (T, d) -> (block_q, block_k) overrides. The round-4 one-process-per-
+# config sweep could not resolve differences inside the chip's noise
+# band (honest null, PERF.md round-4); the round-5 INTERLEAVED
+# in-process sweep (tools/flash_autotune.py) did: bk=1024 wins at
+# every bq in every round at T=8192 (median 11.7 vs 20.6 ms for
+# 512x512), and the full long-context bench confirms +8-10% MFU
+# across 3 interleaved rounds (PERF.md round-5 autotune section).
+_BLOCK_TABLE = {
+    (8192, 128): (512, 1024),
+}
 
 
 def _block_sizes(T, d):

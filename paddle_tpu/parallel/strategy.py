@@ -15,14 +15,21 @@ class DistributedStrategy(object):
     sharded_optimizer: ZeRO-1-style optimizer-state sharding over dp
         (the reference BuildStrategy.kReduce analog; consumed by
         ParallelExecutor._bcast_params)
+    sharded_params: ZeRO-3-style PARAMETER sharding over dp on top of
+        the optimizer-state sharding (implies sharded_optimizer).
+        Beyond-reference: per-device parameter memory drops ~dp-fold;
+        GSPMD inserts the gather-on-use / reduce-scatter collectives.
+        Parameters whose no dim divides dp stay replicated.
     micro_batches: pipeline microbatch count, consumed by the pp engine
         (parallel/pipeline.py pipeline_apply's n_micro)
     """
 
     def __init__(self, dp=1, tp=1, sp=1, pp=1, ep=1,
-                 sharded_optimizer=False, micro_batches=1):
+                 sharded_optimizer=False, sharded_params=False,
+                 micro_batches=1):
         self.dp, self.tp, self.sp, self.pp, self.ep = dp, tp, sp, pp, ep
-        self.sharded_optimizer = sharded_optimizer
+        self.sharded_optimizer = sharded_optimizer or sharded_params
+        self.sharded_params = sharded_params
         self.micro_batches = micro_batches
 
     def mesh_config(self, devices=None):

@@ -245,6 +245,16 @@ class ParallelExecutor(Executor):
              and self._strategy.sharded_optimizer)
             or self._build_strategy.reduce_strategy ==
             BuildStrategy.ReduceStrategy.Reduce)
+        zero3 = self._dp_size > 1 and self._strategy is not None and \
+            getattr(self._strategy, 'sharded_params', False)
+
+        def _first_divisible_dim_sharding(shape):
+            for axis, dim in enumerate(shape or ()):
+                if dim and dim > 0 and dim % self._dp_size == 0:
+                    spec = [None] * len(shape)
+                    spec[axis] = 'dp'
+                    return NamedSharding(self.mesh, P(*spec))
+            return None
         block = self._main_program.global_block()
         for name, var in block.vars.items():
             if not var.persistable:
@@ -254,17 +264,30 @@ class ParallelExecutor(Executor):
                 continue
             sharding = self._var_sharding(name)
             if sharding is None and zero1 and \
-                    not isinstance(var, Parameter) and \
-                    var.shape and len(var.shape) >= 1 and \
-                    var.shape[0] and var.shape[0] > 0 and \
-                    var.shape[0] % self._dp_size == 0:
+                    not isinstance(var, Parameter) and var.shape:
                 # ZeRO-1-style: optimizer accumulators (persistable
                 # non-Parameter state) sharded over dp -- the reference
                 # BuildStrategy.kReduce analog (multi_devices_graph_pass
                 # :413-422). Elementwise optimizer math partitions exactly;
-                # GSPMD reshards grads into the shards.
-                sharding = NamedSharding(
-                    self.mesh, P('dp', *([None] * (len(var.shape) - 1))))
+                # GSPMD reshards grads into the shards. Plain ZeRO-1
+                # keeps the dim-0-only rule (r2 semantics); under
+                # ZeRO-3 the accumulators follow the same first-
+                # divisible-dim rule as their parameters, so an
+                # axis-1-sharded weight gets axis-1-sharded moments.
+                if zero3:
+                    sharding = _first_divisible_dim_sharding(var.shape)
+                elif var.shape[0] and var.shape[0] > 0 and \
+                        var.shape[0] % self._dp_size == 0:
+                    sharding = NamedSharding(
+                        self.mesh,
+                        P('dp', *([None] * (len(var.shape) - 1))))
+            if sharding is None and zero3 and isinstance(var, Parameter):
+                # ZeRO-3-style (beyond-reference): the PARAMETERS
+                # themselves shard over dp on the first dp-divisible
+                # dim; GSPMD gathers on use and reduce-scatters the
+                # grads into the shard. Per-device parameter + grad
+                # memory drops ~dp-fold.
+                sharding = _first_divisible_dim_sharding(var.shape)
             target = sharding or self._replicated
             if jax.process_count() > 1:
                 from .parallel import distributed as dist

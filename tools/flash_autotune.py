@@ -1,0 +1,116 @@
+"""Interleaved in-process flash-attention block autotune.
+
+The round-4 sweep ran one process per config and the ±10-20% chip/
+transport noise swallowed every difference (PERF.md round-4 autotune
+— honest null). Round-5's mul A/B showed the fix: keep EVERY arm in
+ONE process, alternate arms across rounds, and difference in-jit N/2N
+loops. This tool re-runs the (block_q, block_k) sweep that way.
+
+    python tools/flash_autotune.py [--T 8192] [--bh 16] [--rounds 3]
+
+Prints per-config fwd+bwd ms (median over rounds) so a real >5%
+winner, if one exists, survives the noise floor. Populate
+pallas/flash_attention._BLOCK_TABLE with any config that wins
+consistently.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import statistics
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+
+def timed_step(flash, q, k, v, iters):
+    def step(q, k, v):
+        def loss(q, k, v):
+            return flash._flash(q, k, v, True, 0.0884, False) \
+                .astype(jnp.float32).sum()
+        # grads wrt ALL inputs: argnums=0 alone would let XLA DCE the
+        # dk/dv kernel out of the loop and the sweep would rank
+        # configs on fwd+dq cost only
+        gq, gk, gv = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+        eps = jnp.bfloat16(1e-12)
+        return (q + gq.astype(q.dtype) * eps,
+                k + gk.astype(k.dtype) * eps,
+                v + gv.astype(v.dtype) * eps)
+
+    @jax.jit
+    def loop(q, k, v):
+        def body(c, _):
+            return step(*c), None
+        (q, k, v), _ = jax.lax.scan(body, (q, k, v), None,
+                                    length=iters)
+        return q[0, 0, 0] + k[0, 0, 0] + v[0, 0, 0]
+    return loop
+
+
+def measure(flash, q, k, v, iters=6):
+    l1 = timed_step(flash, q, k, v, iters)
+    l2 = timed_step(flash, q, k, v, 2 * iters)
+    np.asarray(l1(q, k, v)); np.asarray(l2(q, k, v))   # compile both
+    t0 = time.perf_counter(); np.asarray(l1(q, k, v))
+    t1 = time.perf_counter(); np.asarray(l2(q, k, v))
+    t2 = time.perf_counter()
+    return ((t2 - t1) - (t1 - t0)) / iters * 1e3  # ms per fwd+bwd
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument('--T', type=int, default=8192)
+    ap.add_argument('--d', type=int, default=128)
+    ap.add_argument('--bh', type=int, default=16)
+    ap.add_argument('--rounds', type=int, default=3)
+    ap.add_argument('--blocks', type=int, nargs='+',
+                    default=[256, 512, 1024])
+    args = ap.parse_args()
+
+    import paddle_tpu as fluid
+    from paddle_tpu.pallas import flash_attention as flash
+
+    rng = np.random.RandomState(0)
+    q = jnp.asarray(rng.randn(args.bh, args.T, args.d), jnp.bfloat16)
+    k = jnp.asarray(rng.randn(args.bh, args.T, args.d), jnp.bfloat16)
+    v = jnp.asarray(rng.randn(args.bh, args.T, args.d), jnp.bfloat16)
+
+    configs = [(bq, bk) for bq in args.blocks for bk in args.blocks
+               if args.T % bq == 0 and args.T % bk == 0]
+    results = {c: [] for c in configs}
+    for rnd in range(args.rounds):
+        for cfg in configs:
+            fluid.flags.set_flags({'FLAGS_flash_block_q': cfg[0],
+                                   'FLAGS_flash_block_k': cfg[1]})
+            # block sizes bind at TRACE time via the flag — stale
+            # traces must go
+            flash._fwd.clear_cache()
+            flash._bwd.clear_cache()
+            ms = measure(flash, q, k, v)
+            results[cfg].append(ms)
+            print('round %d  bq=%-5d bk=%-5d  %.2f ms'
+                  % (rnd, cfg[0], cfg[1], ms), flush=True)
+    fluid.flags.set_flags({'FLAGS_flash_block_q': 0,
+                           'FLAGS_flash_block_k': 0})
+    ranked = sorted(configs, key=lambda c: statistics.median(results[c]))
+    base_cfg = (512, 512) if (512, 512) in results else ranked[0]
+    base = statistics.median(results[base_cfg])
+    print('\n| bq | bk | median ms | spread | vs %dx%d |'
+          % base_cfg)
+    print('|---|---|---|---|---|')
+    for cfg in ranked:
+        ms = results[cfg]
+        print('| %d | %d | %.2f | %.2f-%.2f | %+.1f%% |'
+              % (cfg[0], cfg[1], statistics.median(ms), min(ms),
+                 max(ms), (statistics.median(ms) / base - 1) * 100))
+
+
+if __name__ == '__main__':
+    main()
