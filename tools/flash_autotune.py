@@ -85,22 +85,35 @@ def main():
     configs = [(bq, bk) for bq in args.blocks for bk in args.blocks
                if args.T % bq == 0 and args.T % bk == 0]
     results = {c: [] for c in configs}
+    failed = set()
     for rnd in range(args.rounds):
         for cfg in configs:
+            if cfg in failed:   # deterministic failures (VMEM OOM):
+                continue        # don't re-pay compile every round
             fluid.flags.set_flags({'FLAGS_flash_block_q': cfg[0],
                                    'FLAGS_flash_block_k': cfg[1]})
             # block sizes bind at TRACE time via the flag — stale
             # traces must go
             flash._fwd.clear_cache()
             flash._bwd.clear_cache()
-            ms = measure(flash, q, k, v)
+            try:
+                ms = measure(flash, q, k, v)
+            except Exception as e:   # noqa: BLE001 — e.g. VMEM OOM
+                failed.add(cfg)
+                print('round %d  bq=%-5d bk=%-5d  FAILED (%.80s)'
+                      % (rnd, cfg[0], cfg[1], str(e)), flush=True)
+                continue
             results[cfg].append(ms)
             print('round %d  bq=%-5d bk=%-5d  %.2f ms'
                   % (rnd, cfg[0], cfg[1], ms), flush=True)
     fluid.flags.set_flags({'FLAGS_flash_block_q': 0,
                            'FLAGS_flash_block_k': 0})
+    configs = [c for c in configs if results[c]]   # drop all-failed
+    if not configs:
+        print('\nevery config failed — nothing to rank')
+        return
     ranked = sorted(configs, key=lambda c: statistics.median(results[c]))
-    base_cfg = (512, 512) if (512, 512) in results else ranked[0]
+    base_cfg = (512, 512) if results.get((512, 512)) else ranked[0]
     base = statistics.median(results[base_cfg])
     print('\n| bq | bk | median ms | spread | vs %dx%d |'
           % base_cfg)
