@@ -21,7 +21,8 @@ from . import ops            # registers all operators (import side effect)
 from . import framework
 from .framework import (Program, Block, Operator, Variable, Parameter,
                         default_main_program, default_startup_program,
-                        program_guard, name_scope, grad_var_name)
+                        program_guard, name_scope, grad_var_name,
+                        get_var)
 from . import layers
 from . import initializer
 from . import unique_name
@@ -33,8 +34,8 @@ from . import clip
 from .param_attr import ParamAttr, WeightNormParamAttr
 from . import executor
 from .executor import (Executor, Scope, global_scope, scope_guard,
-                       CPUPlace, TPUPlace, XLAPlace, CUDAPlace,
-                       CUDAPinnedPlace, fetch_var)
+                       _switch_scope, CPUPlace, TPUPlace, XLAPlace,
+                       CUDAPlace, CUDAPinnedPlace, fetch_var)
 from . import lod_tensor
 from .lod_tensor import LoDTensor, create_lod_tensor, \
     create_random_int_lodtensor
@@ -88,10 +89,12 @@ __version__ = '0.1.0'
 __all__ = [
     'Program', 'Block', 'Operator', 'Variable', 'Parameter',
     'default_main_program', 'default_startup_program', 'program_guard',
-    'name_scope', 'grad_var_name', 'layers', 'initializer', 'unique_name',
+    'name_scope', 'grad_var_name', 'get_var', 'layers', 'initializer',
+    'unique_name',
     'backward', 'append_backward', 'optimizer', 'regularizer', 'clip',
     'ParamAttr', 'WeightNormParamAttr', 'Executor', 'Scope', 'global_scope',
-    'scope_guard', 'CPUPlace', 'TPUPlace', 'XLAPlace', 'CUDAPlace',
+    'scope_guard', '_switch_scope', 'CPUPlace', 'TPUPlace', 'XLAPlace',
+    'CUDAPlace',
     'fetch_var', 'LoDTensor', 'create_lod_tensor',
     'create_random_int_lodtensor', 'io', 'nets', 'metrics', 'profiler',
     'DataFeeder', 'ParallelExecutor', 'ExecutionStrategy', 'BuildStrategy',

@@ -29,8 +29,8 @@ from . import registry
 from .framework import default_main_program, Program, Variable
 
 __all__ = ['Executor', 'Scope', 'global_scope', 'scope_guard',
-           'CPUPlace', 'TPUPlace', 'XLAPlace', 'CUDAPlace', 'fetch_var',
-           'OpExecutionError']
+           '_switch_scope', 'CPUPlace', 'TPUPlace', 'XLAPlace',
+           'CUDAPlace', 'fetch_var', 'OpExecutionError']
 
 
 class OpExecutionError(RuntimeError):
@@ -174,14 +174,22 @@ def global_scope():
     return _global_scope
 
 
-@contextlib.contextmanager
-def scope_guard(scope):
+def _switch_scope(scope):
+    """Swap the global scope, returning the previous one (reference
+    executor.py:39 — scripts use it for manual scope juggling where
+    scope_guard's context shape does not fit)."""
     global _global_scope
     prev, _global_scope = _global_scope, scope
+    return prev
+
+
+@contextlib.contextmanager
+def scope_guard(scope):
+    prev = _switch_scope(scope)
     try:
         yield
     finally:
-        _global_scope = prev
+        _switch_scope(prev)
 
 
 def fetch_var(name, scope=None, return_numpy=True):

@@ -24,7 +24,7 @@ __all__ = [
     'Program', 'Block', 'Operator', 'Variable', 'Parameter',
     'default_main_program', 'default_startup_program', 'program_guard',
     'switch_main_program', 'switch_startup_program', 'name_scope',
-    'grad_var_name', 'GRAD_VAR_SUFFIX', 'convert_np_dtype',
+    'grad_var_name', 'GRAD_VAR_SUFFIX', 'convert_np_dtype', 'get_var',
 ]
 
 GRAD_VAR_SUFFIX = '@GRAD'
@@ -615,3 +615,11 @@ def name_scope(prefix=None):
         yield
     finally:
         _name_scope_stack.pop()
+
+
+def get_var(name, program=None):
+    """Variable lookup in a program's global block (reference
+    framework.py:2070)."""
+    if program is None:
+        program = default_main_program()
+    return program.global_block().var(name)

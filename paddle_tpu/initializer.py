@@ -12,7 +12,10 @@ import numpy as np
 
 __all__ = ['Constant', 'Uniform', 'Normal', 'TruncatedNormal', 'Xavier',
            'MSRA', 'Bilinear', 'NumpyArrayInitializer', 'Initializer',
-           'force_init_on_cpu', 'init_on_cpu']
+           'force_init_on_cpu', 'init_on_cpu',
+           'ConstantInitializer', 'UniformInitializer',
+           'NormalInitializer', 'XavierInitializer',
+           'BilinearInitializer', 'MSRAInitializer']
 
 
 import contextlib
@@ -165,3 +168,13 @@ class NumpyArrayInitializer(Initializer):
             type='assign_value', outputs={'Out': var},
             attrs={'shape': list(self.value.shape), 'dtype': var.dtype,
                    'values': self.value.tolist()})
+
+
+# long-form aliases the reference exports beside the short names
+# (reference initializer.py __all__)
+ConstantInitializer = Constant
+UniformInitializer = Uniform
+NormalInitializer = Normal
+XavierInitializer = Xavier
+BilinearInitializer = Bilinear
+MSRAInitializer = MSRA

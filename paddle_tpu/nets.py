@@ -6,7 +6,7 @@ from __future__ import annotations
 from . import layers
 
 __all__ = ['simple_img_conv_pool', 'img_conv_group', 'glu',
-           'scaled_dot_product_attention']
+           'scaled_dot_product_attention', 'sequence_conv_pool']
 
 
 def simple_img_conv_pool(input, num_filters, filter_size, pool_size,

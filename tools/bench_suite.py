@@ -165,15 +165,12 @@ def run_scaling(model, steps, full, bn_local_stats=False,
     devices = jax.devices()
     sizes = [n for n in (1, 2, 4, 8) if n <= len(devices)]
     out = {'model': model, 'mode': 'scaling', 'points': []}
-    prior_bn_local = fluid.flags.get_flag('bn_local_stats')
-    if bn_local_stats:
-        out['bn_local_stats'] = True
-        fluid.flags.set_flags({'FLAGS_bn_local_stats': True})
     strategy_for = (lambda n: None)
     if zero3:
         # ZeRO-3 sharded params (parallel/strategy.py sharded_params):
         # the audit shows the gather-on-use / reduce-scatter pattern
-        # and the per-device parameter shards
+        # and the per-device parameter shards. Validate BEFORE any
+        # global flag mutation so an error leaks no state.
         from paddle_tpu.parallel import DistributedStrategy
         if len(devices) < 2:
             raise RuntimeError('--zero3 needs a multi-device mesh '
@@ -183,6 +180,10 @@ def run_scaling(model, steps, full, bn_local_stats=False,
         out['zero3_sharded_params'] = True
         strategy_for = (lambda n: DistributedStrategy(
             dp=n, sharded_params=True) if n > 1 else None)
+    prior_bn_local = fluid.flags.get_flag('bn_local_stats')
+    if bn_local_stats:
+        out['bn_local_stats'] = True
+        fluid.flags.set_flags({'FLAGS_bn_local_stats': True})
     try:
         audit_exe = None
         for n in sizes:
