@@ -6,7 +6,7 @@ import numpy as np
 
 from . import common
 
-__all__ = ['train10', 'test10', 'train100', 'test100']
+__all__ = ['train10', 'test10', 'train100', 'test100', 'convert']
 
 _N_TRAIN, _N_TEST = 4096, 512
 
@@ -38,3 +38,12 @@ def train100():
 
 def test100():
     return _creator(100, 'test', _N_TEST)
+
+
+def convert(path):
+    """Write the four CIFAR series to RecordIO shards under `path`
+    (reference cifar.py:149)."""
+    common.convert(path, train100(), 1000, 'cifar_train100')
+    common.convert(path, test100(), 1000, 'cifar_test100')
+    common.convert(path, train10(), 1000, 'cifar_train10')
+    common.convert(path, test10(), 1000, 'cifar_test10')

@@ -7,7 +7,8 @@ import os
 
 import numpy as np
 
-__all__ = ['DATA_HOME', 'md5file', 'synthetic_rng']
+__all__ = ['DATA_HOME', 'md5file', 'synthetic_rng',
+           'split', 'cluster_files_reader', 'convert']
 
 DATA_HOME = os.path.expanduser('~/.cache/paddle_tpu/dataset')
 
@@ -43,3 +44,87 @@ def synthetic_rng(module_name, split):
     seed = int(hashlib.md5(
         ('%s/%s' % (module_name, split)).encode()).hexdigest()[:8], 16)
     return np.random.RandomState(seed)
+
+
+def split(reader, line_count, suffix='%05d.pickle', dumper=None):
+    """Chunk a reader's samples into pickled files of `line_count`
+    samples each (reference dataset/common.py:135 — modernized to
+    binary mode; the reference's text-mode pickle was a py2 relic).
+    `suffix` must contain a %d-style slot for the chunk index."""
+    import pickle
+    dumper = dumper if dumper is not None else pickle.dump
+    if not callable(dumper):
+        raise TypeError('dumper should be callable.')
+    lines = []
+    indx_f = 0
+
+    def flush():
+        nonlocal lines, indx_f
+        with open(suffix % indx_f, 'wb') as f:
+            dumper(lines, f)
+        lines = []
+        indx_f += 1
+
+    for d in reader():
+        lines.append(d)
+        if len(lines) >= line_count:
+            flush()
+    if lines:
+        flush()
+
+
+def cluster_files_reader(files_pattern, trainer_count, trainer_id,
+                         loader=None):
+    """Reader over the chunk files written by split(), taking every
+    trainer_count-th file starting at trainer_id (reference
+    dataset/common.py:173 — the file-level sharding contract the
+    cluster launcher relies on)."""
+    import glob
+    import pickle
+    loader = loader if loader is not None else pickle.load
+
+    def reader():
+        if not callable(loader):
+            raise TypeError('loader should be callable.')
+        file_list = sorted(glob.glob(files_pattern))
+        for idx, fn in enumerate(file_list):
+            if idx % trainer_count == trainer_id:
+                with open(fn, 'rb') as f:
+                    for line in loader(f):
+                        yield line
+
+    return reader
+
+
+def convert(output_path, reader, line_count, name_prefix):
+    """Write a reader's samples to RecordIO shard files of
+    `line_count` pickled records each: `<output_path>/<prefix>-00000`…
+    (reference dataset/common.py:214; every dataset module's
+    convert(path) delegates here). Readable back with
+    reader.creator.recordio(paths)."""
+    import pickle
+    from ..recordio import RecordIOWriter
+    assert line_count >= 1
+    must_mkdirs(output_path)
+    indx_f = 0
+    lines = []
+
+    def write_chunk():
+        nonlocal lines, indx_f
+        filename = '%s/%s-%05d' % (output_path, name_prefix, indx_f)
+        w = RecordIOWriter(filename)
+        try:
+            for l in lines:
+                w.append_record(pickle.dumps(
+                    l, protocol=pickle.HIGHEST_PROTOCOL))
+        finally:
+            w.close()
+        lines = []
+        indx_f += 1
+
+    for d in reader():
+        lines.append(d)
+        if len(lines) >= line_count:
+            write_chunk()
+    if lines:
+        write_chunk()

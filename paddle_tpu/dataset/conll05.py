@@ -6,7 +6,7 @@ from __future__ import annotations
 
 from . import common
 
-__all__ = ['get_dict', 'get_embedding', 'test']
+__all__ = ['get_dict', 'get_embedding', 'test', 'convert']
 
 _WORD_VOCAB, _VERB_VOCAB = 7477, 3162
 _N_LABELS = 59          # reference label dict size (BIO over 29 roles + O)
@@ -43,3 +43,8 @@ def test():
                    ctx[2].tolist(), ctx[3].tolist(), ctx[4].tolist(),
                    verbs.tolist(), mark.tolist(), labels.tolist())
     return reader
+
+
+def convert(path):
+    """Write the test split to RecordIO shards under `path`."""
+    common.convert(path, test(), 1000, 'conl105_test')

@@ -8,7 +8,7 @@ import numpy as np
 
 from . import common
 
-__all__ = ['train', 'test', 'word_dict']
+__all__ = ['train', 'test', 'word_dict', 'build_dict', 'convert']
 
 _VOCAB = 5147          # smallish; reference's is ~5147 after cutoff
 _N_TRAIN, _N_TEST = 2048, 512
@@ -43,3 +43,18 @@ def train(word_idx=None):
 
 def test(word_idx=None):
     return _creator('test', _N_TEST)
+
+
+def build_dict(pattern=None, cutoff=0):
+    """Vocabulary builder (reference imdb.py:58 walks the review tar
+    with a frequency cutoff). The synthetic corpus has a fixed
+    catalog, so every (pattern, cutoff) returns the same dict —
+    documented divergence, same contract shape."""
+    return word_dict()
+
+
+def convert(path):
+    """Write train/test to RecordIO shards under `path`."""
+    w = word_dict()
+    common.convert(path, train(w), 1000, 'imdb_train')
+    common.convert(path, test(w), 1000, 'imdb_test')

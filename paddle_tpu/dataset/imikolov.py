@@ -7,7 +7,7 @@ import numpy as np
 
 from . import common
 
-__all__ = ['build_dict', 'train', 'test', 'N']
+__all__ = ['build_dict', 'train', 'test', 'N', 'convert']
 
 N = 5
 _VOCAB = 2074          # reference dict ~2074 after min_word_freq cutoff
@@ -48,3 +48,11 @@ def train(word_idx, n=N):
 
 def test(word_idx, n=N):
     return _creator('test', _N_TEST, word_idx, n)
+
+
+def convert(path):
+    """Write train/test (default dict) to RecordIO shards under `path`
+    (reference imikolov.py:151)."""
+    word_idx = build_dict()
+    common.convert(path, train(word_idx), 1000, 'imikolov_train')
+    common.convert(path, test(word_idx), 1000, 'imikolov_test')

@@ -7,7 +7,7 @@ import numpy as np
 
 from . import common
 
-__all__ = ['train', 'test']
+__all__ = ['train', 'test', 'convert']
 
 _N_TRAIN, _N_TEST = 8192, 1024
 
@@ -36,3 +36,10 @@ def train():
 
 def test():
     return reader_creator('test', _N_TEST)
+
+
+def convert(path):
+    """Write train/test to RecordIO shards under `path` (reference
+    mnist.py:133)."""
+    common.convert(path, train(), 1000, 'minist_train')
+    common.convert(path, test(), 1000, 'minist_test')

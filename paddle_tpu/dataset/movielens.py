@@ -7,7 +7,7 @@ from __future__ import annotations
 from . import common
 
 __all__ = ['train', 'test', 'max_user_id', 'max_movie_id', 'max_job_id',
-           'age_table', 'movie_categories', 'get_movie_title_dict']
+           'age_table', 'movie_categories', 'get_movie_title_dict', 'user_info', 'movie_info', 'convert']
 
 _MAX_USER, _MAX_MOVIE, _MAX_JOB = 6040, 3952, 20
 _N_CATEGORIES, _TITLE_VOCAB = 18, 1512
@@ -64,3 +64,81 @@ def train():
 
 def test():
     return _creator('test', _N_TEST)
+
+
+class MovieInfo(object):
+    """Movie catalog entry (reference movielens.py:36)."""
+
+    def __init__(self, index, categories, title):
+        self.index = int(index)
+        self.categories = categories
+        self.title = title
+
+    def value(self):
+        cat_ids = movie_categories()
+        title_ids = get_movie_title_dict()
+        return [self.index,
+                [cat_ids[c] for c in self.categories],
+                [title_ids[w] for w in self.title.split()]]
+
+    def __repr__(self):
+        return '<MovieInfo id(%d), title(%s), categories(%s)>' % (
+            self.index, self.title, self.categories)
+
+
+class UserInfo(object):
+    """User catalog entry (reference movielens.py:66)."""
+
+    def __init__(self, index, gender, age, job_id):
+        self.index = int(index)
+        self.is_male = gender == 'M'
+        self.age = age_table.index(int(age))
+        self.job_id = int(job_id)
+
+    def value(self):
+        return [self.index, 0 if self.is_male else 1, self.age,
+                self.job_id]
+
+    def __repr__(self):
+        return '<UserInfo id(%d), gender(%s), age(%d), job(%d)>' % (
+            self.index, 'M' if self.is_male else 'F',
+            age_table[self.age], self.job_id)
+
+
+def movie_info():
+    """id -> MovieInfo for the synthetic catalog (reference
+    movielens.py:241). Deterministic across calls. Divergence from the
+    reference: samples draw their category/title features from the
+    per-split streams, not from this catalog, so joining samples to
+    the catalog by movie_id gives independent features."""
+    rng = common.synthetic_rng('movielens', 'catalog')
+    cats = sorted(movie_categories())
+    out = {}
+    for mid in range(1, _MAX_MOVIE + 1):
+        n_cat = int(rng.randint(1, 4))
+        cat = [cats[int(c)]
+               for c in rng.randint(0, _N_CATEGORIES, n_cat)]
+        n_title = int(rng.randint(1, 6))
+        title = ' '.join('t%04d' % t
+                         for t in rng.randint(0, _TITLE_VOCAB, n_title))
+        out[mid] = MovieInfo(mid, cat, title)
+    return out
+
+
+def user_info():
+    """id -> UserInfo for the synthetic catalog (reference
+    movielens.py:233)."""
+    rng = common.synthetic_rng('movielens', 'users')
+    out = {}
+    for uid in range(1, _MAX_USER + 1):
+        gender = 'M' if int(rng.randint(0, 2)) else 'F'
+        age = age_table[int(rng.randint(0, len(age_table)))]
+        job = int(rng.randint(0, _MAX_JOB + 1))
+        out[uid] = UserInfo(uid, gender, age, job)
+    return out
+
+
+def convert(path):
+    """Write train/test to RecordIO shards under `path`."""
+    common.convert(path, train(), 1000, 'movielens_train')
+    common.convert(path, test(), 1000, 'movielens_test')

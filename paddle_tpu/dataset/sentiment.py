@@ -4,7 +4,9 @@ from __future__ import annotations
 
 from . import imdb
 
-__all__ = ['get_word_dict', 'train', 'test']
+from . import common
+
+__all__ = ['get_word_dict', 'train', 'test', 'convert']
 
 
 def get_word_dict():
@@ -17,3 +19,9 @@ def train():
 
 def test():
     return imdb.test()
+
+
+def convert(path):
+    """Write train/test to RecordIO shards under `path`."""
+    common.convert(path, train(), 1000, 'sentiment_train')
+    common.convert(path, test(), 1000, 'sentiment_test')

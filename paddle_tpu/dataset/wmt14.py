@@ -6,7 +6,7 @@ from __future__ import annotations
 
 from . import common
 
-__all__ = ['train', 'test', 'N']
+__all__ = ['train', 'test', 'N', 'get_dict', 'convert']
 
 N = 30000               # reference dict size per side
 
@@ -32,3 +32,22 @@ def train(dict_size):
 
 def test(dict_size):
     return _creator('test', 256, dict_size)
+
+
+def get_dict(dict_size, reverse=True):
+    """(src_dict, trg_dict) id maps (reference wmt14.py:155; reverse
+    gives id->word, matching the reference default)."""
+    src = {('s%05d' % i): i for i in range(dict_size)}
+    trg = {('t%05d' % i): i for i in range(dict_size)}
+    if reverse:
+        src = {v: k for k, v in src.items()}
+        trg = {v: k for k, v in trg.items()}
+    return src, trg
+
+
+def convert(path):
+    """Write train/test (dict_size 30000 — the reference tar's size)
+    to RecordIO shards under `path`."""
+    dict_size = 30000
+    common.convert(path, train(dict_size), 1000, 'wmt14_train')
+    common.convert(path, test(dict_size), 1000, 'wmt14_test')

@@ -4,7 +4,7 @@ from __future__ import annotations
 
 from . import common
 
-__all__ = ['train', 'test', 'get_dict']
+__all__ = ['train', 'test', 'get_dict', 'validation', 'fetch', 'convert']
 
 
 def get_dict(lang, dict_size, reverse=False):
@@ -32,3 +32,28 @@ def train(src_dict_size, trg_dict_size, src_lang='en'):
 
 def test(src_dict_size, trg_dict_size, src_lang='en'):
     return _creator('test', 256, src_dict_size, trg_dict_size)
+
+
+def validation(src_dict_size, trg_dict_size, src_lang='en'):
+    """Validation split reader (reference wmt16.py:243)."""
+    return _creator('val', 256, src_dict_size, trg_dict_size)
+
+
+def fetch():
+    """Prefetch hook (reference wmt16.py:320 downloads the tar). The
+    synthetic corpus needs no fetch; kept so scripts calling
+    dataset.wmt16.fetch() run unmodified."""
+    return None
+
+
+def convert(path, src_dict_size=30000, trg_dict_size=30000,
+            src_lang='en'):
+    """Write train/test/validation to RecordIO shards under `path`
+    (reference wmt16.py:330)."""
+    common.convert(path, train(src_dict_size, trg_dict_size, src_lang),
+                   1000, 'wmt16_train')
+    common.convert(path, test(src_dict_size, trg_dict_size, src_lang),
+                   1000, 'wmt16_test')
+    common.convert(path,
+                   validation(src_dict_size, trg_dict_size, src_lang),
+                   1000, 'wmt16_validation')

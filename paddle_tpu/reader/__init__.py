@@ -5,7 +5,8 @@ Python -- identical contract to the reference."""
 from .decorator import (map_readers, buffered, shuffle, chain, compose,
                         firstn, xmap_readers, cache, multiprocess_reader,
                         PipeReader)
+from . import creator
 
 __all__ = ['map_readers', 'buffered', 'shuffle', 'chain', 'compose',
            'firstn', 'xmap_readers', 'cache', 'multiprocess_reader',
-           'PipeReader']
+           'PipeReader', 'creator']

@@ -1,0 +1,59 @@
+"""Reader creators (reference python/paddle/reader/creator.py): build
+readers from in-memory arrays, text files, and the RecordIO shards
+written by dataset.common.convert()."""
+from __future__ import annotations
+
+__all__ = ['np_array', 'text_file', 'recordio']
+
+
+def np_array(x):
+    """Reader over a numpy array: yields scalars of a vector, rows of
+    a matrix — any sub-hyperplane indexed by the leading dim."""
+
+    def reader():
+        if x.ndim < 1:
+            yield x
+            return
+        for e in x:
+            yield e
+
+    return reader
+
+
+def text_file(path):
+    """Reader yielding the file's lines with the trailing newline
+    stripped."""
+
+    def reader():
+        with open(path, 'r') as f:
+            for line in f:
+                yield line.rstrip('\n')
+
+    return reader
+
+
+def recordio(paths, buf_size=100):
+    """Reader over RecordIO files written by dataset.common.convert():
+    yields unpickled samples with `buf_size` read-ahead (the
+    reference wraps in reader.buffered the same way). `paths` is a
+    path or a comma-separated list / sequence of paths."""
+    import pickle
+
+    from ..recordio import RecordIOScanner
+    from .decorator import buffered
+
+    if isinstance(paths, str):
+        path_list = paths.split(',')
+    else:
+        path_list = list(paths)
+
+    def reader():
+        for path in path_list:
+            s = RecordIOScanner(path)
+            try:
+                for rec in s:
+                    yield pickle.loads(rec)
+            finally:
+                s.close()
+
+    return buffered(reader, buf_size)

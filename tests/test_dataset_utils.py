@@ -1,0 +1,92 @@
+"""Dataset utility surfaces added for reference parity: common.convert
+/ split / cluster_files_reader round-trips, reader.creator, the image
+transform pipeline, and the movielens catalog accessors (reference
+python/paddle/dataset/{common,image,movielens}.py,
+python/paddle/reader/creator.py)."""
+import os
+
+import numpy as np
+import pytest
+
+from paddle_tpu.dataset import common, image as dimg, mnist, movielens
+from paddle_tpu.reader import creator
+
+
+def test_convert_recordio_roundtrip(tmp_path):
+    d = str(tmp_path)
+    mnist.convert(d)
+    shards = sorted(f for f in os.listdir(d)
+                    if f.startswith('minist_test'))
+    assert shards
+    got = list(creator.recordio(
+        [os.path.join(d, s) for s in shards])())
+    want = list(mnist.test()())
+    assert len(got) == len(want)
+    np.testing.assert_allclose(got[0][0], want[0][0])
+    assert got[0][1] == want[0][1]
+
+
+def test_split_and_cluster_files_reader(tmp_path):
+    suffix = str(tmp_path / 'mn-%05d.pickle')
+    common.split(mnist.test(), 300, suffix=suffix)
+    files = sorted(os.listdir(tmp_path))
+    assert len(files) >= 2                      # 512 samples / 300
+    total = 0
+    seen_first = []
+    for tid in (0, 1):
+        for sample in common.cluster_files_reader(
+                str(tmp_path / 'mn-*.pickle'), 2, tid)():
+            total += 1
+            seen_first.append(sample[1])
+    assert total == sum(1 for _ in mnist.test()())
+
+
+def test_creator_np_array_and_text_file(tmp_path):
+    rows = list(creator.np_array(np.arange(6).reshape(3, 2))())
+    assert len(rows) == 3 and rows[1].tolist() == [2, 3]
+    p = tmp_path / 't.txt'
+    p.write_text('a\nbb\n')
+    assert list(creator.text_file(str(p))()) == ['a', 'bb']
+
+
+def test_image_transform_pipeline():
+    rng = np.random.RandomState(0)
+    im = (rng.rand(40, 60, 3) * 255).astype('uint8')
+    assert dimg.resize_short(im, 20).shape[0] == 20
+    assert dimg.resize_short(im.transpose(1, 0, 2), 20).shape[1] == 20
+    assert dimg.center_crop(im, 24).shape == (24, 24, 3)
+    assert dimg.random_crop(im, 24, rng=rng).shape == (24, 24, 3)
+    np.testing.assert_array_equal(dimg.left_right_flip(im),
+                                  im[:, ::-1])
+    out = dimg.simple_transform(im, 32, 24, is_train=True,
+                                mean=[1.0, 2.0, 3.0], rng=rng)
+    assert out.shape == (3, 24, 24) and out.dtype == np.float32
+    ev = dimg.simple_transform(im, 32, 24, is_train=False)
+    assert ev.shape == (3, 24, 24)
+
+
+def test_image_encode_decode_roundtrip(tmp_path):
+    PIL = pytest.importorskip('PIL.Image')
+    arr = (np.random.RandomState(1).rand(16, 16, 3) * 255) \
+        .astype('uint8')
+    p = str(tmp_path / 'x.png')
+    PIL.fromarray(arr).save(p)
+    back = dimg.load_image(p)
+    np.testing.assert_array_equal(back, arr)     # png is lossless
+    with open(p, 'rb') as f:
+        np.testing.assert_array_equal(
+            dimg.load_image_bytes(f.read()), arr)
+    gray = dimg.load_image(p, is_color=False)
+    assert gray.ndim == 2
+
+
+def test_movielens_catalogs():
+    mi = movielens.movie_info()
+    ui = movielens.user_info()
+    assert len(mi) == movielens.max_movie_id()
+    assert len(ui) == movielens.max_user_id()
+    # deterministic across calls
+    assert repr(movielens.movie_info()[7]) == repr(mi[7])
+    v = ui[3].value()
+    assert v[0] == 3 and v[1] in (0, 1)
+    assert 0 <= v[2] < len(movielens.age_table)

@@ -44,3 +44,49 @@ def test_module_surface_complete(mod):
     ours = importlib.import_module('paddle_tpu.' + mod)
     missing = [n for n in names if not hasattr(ours, n)]
     assert not missing, 'paddle_tpu.%s missing %s' % (mod, missing)
+
+
+REF_TOP = '/root/reference/python/paddle'
+DATASET_MODULES = ['cifar', 'common', 'conll05', 'image', 'imdb',
+                   'imikolov', 'mnist', 'movielens', 'sentiment',
+                   'uci_housing', 'wmt14', 'wmt16']
+
+
+def _ref_all_at(base, mod):
+    path = os.path.join(base, mod + '.py')
+    if not os.path.exists(path):
+        return None
+    tree = ast.parse(open(path).read())
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if getattr(t, 'id', '') == '__all__':
+                    if isinstance(node.value, ast.List):
+                        names = [e.value for e in node.value.elts
+                                 if isinstance(e, ast.Constant)]
+                        # the reference conll05 __all__ has a malformed
+                        # entry 'test, get_dict' (one string, comma
+                        # inside) — split such entries into real names
+                        out = []
+                        for n in names:
+                            out.extend(p.strip() for p in n.split(','))
+                        return out
+    return None
+
+
+@pytest.mark.parametrize('mod', DATASET_MODULES)
+def test_dataset_surface_complete(mod):
+    names = _ref_all_at(os.path.join(REF_TOP, 'dataset'), mod)
+    if names is None:
+        pytest.skip('reference dataset/%s.py has no __all__' % mod)
+    ours = importlib.import_module('paddle_tpu.dataset.' + mod)
+    missing = [n for n in names if not hasattr(ours, n)]
+    assert not missing, 'dataset.%s missing %s' % (mod, missing)
+
+
+def test_reader_creator_surface_complete():
+    names = _ref_all_at(os.path.join(REF_TOP, 'reader'), 'creator')
+    assert names
+    from paddle_tpu.reader import creator
+    missing = [n for n in names if not hasattr(creator, n)]
+    assert not missing, missing
