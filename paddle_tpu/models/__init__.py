@@ -5,3 +5,5 @@ paddle_tpu.layers — the same graph-building contract as the reference."""
 from . import resnet  # noqa: F401
 from . import mnist  # noqa: F401
 from . import vgg  # noqa: F401
+from . import alexnet  # noqa: F401
+from . import googlenet  # noqa: F401

@@ -93,9 +93,18 @@ def _register_elementwise(name, fn):
             elif yd == jnp.bfloat16 and xd == jnp.float32 \
                     and _is_param('X'):
                 x = x.astype(jnp.bfloat16)
-        ctx.set(op.single_output('Out'),
-                fn(x, _broadcast_y(x, y, axis,
-                                   _declared_rank(ctx, op, 'X'))))
+        res = fn(x, _broadcast_y(x, y, axis,
+                                 _declared_rank(ctx, op, 'X')))
+        # Paddle's elementwise contract is X-major: the IR declares
+        # Out.shape = X.shape. When Y has MORE dims than x but only
+        # size-1 extras (a [] mean meeting a [1] scale), numpy
+        # broadcasting widens the value past the declared shape and the
+        # vjp later rejects the cotangent — fold the pure-1 padding
+        # back to x's shape so declared == actual.
+        if jnp.shape(res) != jnp.shape(x) and \
+                int(np.prod(jnp.shape(res))) == int(np.prod(jnp.shape(x))):
+            res = res.reshape(jnp.shape(x))
+        ctx.set(op.single_output('Out'), res)
 
     def infer(op, block):
         x = block.var_recursive(op.single_input('X'))

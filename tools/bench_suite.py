@@ -40,7 +40,7 @@ import numpy as np
 def _build(model, full):
     import paddle_tpu as fluid
     from paddle_tpu.models import (mnist, resnet, vgg, transformer,
-                                   stacked_lstm)
+                                   stacked_lstm, alexnet, googlenet)
     d = {}
     if model == 'mnist':
         img = fluid.layers.data(name='img', shape=[1, 28, 28],
@@ -51,14 +51,21 @@ def _build(model, full):
             'img': rng.rand(bs, 1, 28, 28).astype('float32'),
             'label': rng.randint(0, 10, (bs, 1)).astype('int64')}
         bs = 64 if not full else 256
-    elif model in ('resnet', 'vgg'):
-        hw, classes = (224, 1000) if full else (32, 10)
+    elif model in ('resnet', 'vgg', 'alexnet', 'googlenet'):
+        # alexnet's stride-4 11x11 stem and googlenet's pool chain
+        # need more spatial extent than the 32px cifar shapes
+        small_hw = {'alexnet': 67, 'googlenet': 64}.get(model, 32)
+        hw, classes = (224, 1000) if full else (small_hw, 10)
         img = fluid.layers.data(name='img', shape=[3, hw, hw],
                                 dtype='float32')
         label = fluid.layers.data(name='label', shape=[1], dtype='int64')
-        mod = resnet if model == 'resnet' else vgg
+        mod = {'resnet': resnet, 'vgg': vgg, 'alexnet': alexnet,
+               'googlenet': googlenet}[model]
         kw = {'depth': 50} if (model == 'resnet' and full) else (
             {'depth': 18} if model == 'resnet' else {})
+        if model == 'googlenet' and not full:
+            kw = {'aux_heads': False}   # aux pool needs >=5 spatial at
+            #                             stage 4 (112px+); main head only
         _, loss, _ = mod.train_network(img, label, class_dim=classes,
                                        **kw)
         feed = lambda rng, bs: {
@@ -400,7 +407,8 @@ def _dist_worker():
           flush=True)
 
 
-MODELS = ['mnist', 'resnet', 'vgg', 'stacked_lstm', 'transformer']
+MODELS = ['mnist', 'resnet', 'vgg', 'alexnet', 'googlenet',
+          'stacked_lstm', 'transformer']
 
 
 def main():
