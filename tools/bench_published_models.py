@@ -1,0 +1,110 @@
+"""Train-throughput of the reference's PUBLISHED benchmark models
+(BASELINE.md tables: AlexNet / GoogleNet / VGG / ResNet-50) on the
+real chip, through the full framework path — the direct
+"reference's own headline benchmarks" comparison.
+
+Feeds are pre-placed device arrays (the tunnel uploads ~13-30 MB/s;
+a per-step 154 MB host feed would measure the transport, not the
+framework — bench.py measurement notes), timing is async N/2N
+differenced.
+
+    python tools/bench_published_models.py [--models alexnet googlenet]
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+# (batch, published img/s or ms/batch note) from BASELINE.md
+CONFIGS = {
+    'alexnet': dict(bs=128, published='334 ms/batch (383 img/s) K40m; '
+                                      '627 img/s 2xXeon6148'),
+    'googlenet': dict(bs=128, published='1149 ms/batch (111 img/s) '
+                                        'K40m; 270 img/s 2xXeon6148'),
+    'vgg': dict(bs=64, published='30.4 img/s (vgg19) 2xXeon6148'),
+    'resnet': dict(bs=256, published='84 img/s 2xXeon6148'),
+}
+
+
+def bench_model(model, bs, steps=12):
+    import jax
+    import paddle_tpu as fluid
+    from paddle_tpu import unique_name
+    from paddle_tpu.models import alexnet, googlenet, vgg, resnet
+
+    builders = {
+        'alexnet': lambda i, l: alexnet.train_network(
+            i, l, class_dim=1000),
+        'googlenet': lambda i, l: googlenet.train_network(
+            i, l, class_dim=1000),
+        'vgg': lambda i, l: vgg.train_network(i, l, class_dim=1000),
+        'resnet': lambda i, l: resnet.train_network(
+            i, l, class_dim=1000, depth=50),
+    }
+    with unique_name.guard():
+        main, start = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, start):
+            img = fluid.layers.data(name='img', shape=[3, 224, 224],
+                                    dtype='float32')
+            lbl = fluid.layers.data(name='lbl', shape=[1],
+                                    dtype='int64')
+            _, loss, _ = builders[model](img, lbl)
+            opt = fluid.optimizer.Momentum(learning_rate=1e-3,
+                                           momentum=0.9)
+            opt = fluid.contrib.mixed_precision.decorate(opt)
+            opt.minimize(loss)
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe = fluid.Executor(fluid.TPUPlace())
+            exe.run(start)
+            pe = fluid.ParallelExecutor(use_cuda=True,
+                                        loss_name=loss.name,
+                                        main_program=main, scope=scope)
+            rng = np.random.RandomState(0)
+            feed = {
+                'img': jax.device_put(
+                    rng.rand(bs, 3, 224, 224).astype('f4')),
+                'lbl': jax.device_put(
+                    rng.randint(0, 1000, (bs, 1)).astype('int64')),
+            }
+            for _ in range(3):
+                lv = pe.run(fetch_list=[loss.name], feed=feed,
+                            return_numpy=False)
+            float(np.asarray(lv[0]))
+
+            def timed(n):
+                t0 = time.perf_counter()
+                for _ in range(n):
+                    lv = pe.run(fetch_list=[loss.name], feed=feed,
+                                return_numpy=False)
+                float(np.asarray(lv[0]))
+                return time.perf_counter() - t0
+
+            w1, w2 = timed(steps), timed(2 * steps)
+            step_s = max(w2 - w1, 1e-9) / steps
+    return bs / step_s, step_s * 1e3
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument('--models', nargs='+', default=['alexnet',
+                                                    'googlenet'])
+    args = ap.parse_args()
+    print('| model | bs | img/s (this chip) | ms/batch | published |')
+    print('|---|---|---|---|---|')
+    for m in args.models:
+        cfg = CONFIGS[m]
+        ips, ms = bench_model(m, cfg['bs'])
+        print('| %s | %d | %.0f | %.1f | %s |'
+              % (m, cfg['bs'], ips, ms, cfg['published']), flush=True)
+
+
+if __name__ == '__main__':
+    main()
