@@ -23,17 +23,21 @@ one q block live in VMEM scratch across the ki sweep; causal q-blocks
 stop their sweep at the diagonal (pl.when skips both compute and the
 write until the final valid ki).
 
-Backward (round-5): delta = rowsum(dO·O) in plain JAX, then the
-two-kernel SPLIT backward (dq sweep + dk/dv sweep, 7 block-matmuls) —
-measured fastest at EVERY size on this chip. A ONE-PASS kernel also
-exists (grid (bh, qi, ki), both inner dims sequential, S/P/dP/dS
-computed once = the 5-matmul minimum, dk/dv accumulated in
-full-sequence VMEM scratch): built for round-5 VERDICT #3 and measured
-honestly — it LOSES 10-50% here because its ~12 MB of resident
-accumulators starve Mosaic's double-buffering (same tradeoff as the
-round-3 conv+BN epilogue kernel). PADDLE_FLASH_ONEPASS=1 selects it
-for chips where the balance differs; both arms carry grad-parity
-tests.
+Backward: delta = rowsum(dO·O) in plain JAX, then the KV-MAJOR
+single-pass kernel (grid (bh, ki, qi), both inner dims sequential;
+S/P/dP/dS computed once per visited pair = the 5-matmul + 1-exp
+minimum; dk/dv in small per-ki scratch, dq accumulated across the
+whole sweep in a full-sequence fp32 scratch written once) — measured
+−25-31% vs the two-kernel split backward at T≥2048 and at parity at
+T=512 (PERF.md round-5). Two alternates stay available via
+PADDLE_FLASH_BWD and carry their own grad-parity tests: `split` (dq
+sweep + dk/dv sweep, 7 block-matmuls + 2 exp streams — also the
+automatic fallback when the kv-major scoped-VMEM request would pass
+the measured-safe 64 MB ceiling, i.e. beyond T=64k/d=128) and
+`onepass` (the qi-major transpose whose ~12 MB
+of resident dk/dv accumulators starve Mosaic's double-buffering — it
+LOSES 10-50% here; kept for chips where the balance differs, same
+lesson as the round-3 conv+BN epilogue kernel).
 """
 from __future__ import annotations
 
@@ -48,17 +52,36 @@ __all__ = ['flash_attention']
 
 _NEG_INF = -1e30
 
-# Backward-arm selection. The two-kernel SPLIT backward is the default:
-# on this chip it beats the 5-matmul one-pass kernel at EVERY size
-# (isolated: 0.83-0.98x; whole-bench transformer 67.7% vs 65.4% MFU —
-# the one-pass kernel's 12 MB of resident dk/dv accumulators starve
-# Mosaic's double-buffering, the same lesson as the round-3 conv+BN
-# epilogue kernel). The one-pass kernel stays available (parity-tested)
-# for chips where the tradeoff differs: PADDLE_FLASH_ONEPASS=1 or the
-# _FORCE_ONEPASS test hook.
+# Backward-arm selection. Three arms, all grad-parity-tested:
+#   split    — dq kernel + dk/dv kernel (7 block-matmuls, 2 exp streams)
+#   onepass  — grid (bh, qi, ki), dk/dv in full-sequence VMEM scratch
+#              (5 matmuls, 1 exp; ~12 MB resident — measured 10-50%
+#              SLOWER here: the residency starves Mosaic's
+#              double-buffering, same lesson as the round-3 conv+BN
+#              epilogue kernel)
+#   kvmajor  — grid (bh, ki, qi): the transpose of onepass. dk/dv live
+#              in small per-ki scratch; dq accumulates in a
+#              full-sequence fp32 scratch (T·d·4 = 4 MB at 8k/128 —
+#              HALF the onepass residency) written once at the end.
+#              Same 5-matmul + 1-exp minimum per visited pair.
+# PADDLE_FLASH_BWD=split|onepass|kvmajor forces an arm;
+# PADDLE_FLASH_ONEPASS=1 is the legacy spelling of onepass.
+# Default dispatch is measured per grid size in _bwd below.
 import os as _os
-_FORCE_ONEPASS = _os.environ.get('PADDLE_FLASH_ONEPASS', '') in (
-    '1', 'true', 'yes')
+_BWD_ARMS = ('', 'split', 'onepass', 'kvmajor')
+_FORCE_ARM = _os.environ.get('PADDLE_FLASH_BWD', '').strip().lower()
+if _FORCE_ARM not in _BWD_ARMS:
+    # a typo silently benchmarking the default arm is exactly the
+    # sweep corruption _block_sizes already guards against
+    raise ValueError('PADDLE_FLASH_BWD=%r: expected one of %s'
+                     % (_FORCE_ARM, _BWD_ARMS[1:]))
+if not _FORCE_ARM and _os.environ.get('PADDLE_FLASH_ONEPASS', '') in (
+        '1', 'true', 'yes'):
+    _FORCE_ARM = 'onepass'
+# the arm _bwd actually dispatched at its last trace — the residency
+# guards may silently swap a forced arm for 'split', so measurement
+# tools must check this rather than trust the arm they requested
+_RESOLVED_ARM = ''
 
 
 def _mask_if_straddling(s, qi, ki, block_q, block_k):
@@ -148,17 +171,9 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
     @pl.when(ki <= last_ki)
     def _step():
-        q = q_ref[0] * sm_scale
-        k = k_ref[0]
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)
-        if causal:
-            s = _mask_if_straddling(s, qi, ki, block_q, block_k)
-        p = jnp.exp(s - lse_ref[0])                   # [bq, bk]
-        dp = jax.lax.dot_general(
-            do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)       # [bq, bk]
-        ds = p * (dp - delta_ref[0])
+        _, k, _, _, ds = _pair_grads(
+            q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+            qi, ki, sm_scale, causal, block_q, block_k)
         acc_scr[:] += jax.lax.dot_general(
             ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -166,6 +181,26 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
     @pl.when(ki == last_ki)
     def _finalize():
         dq_ref[0] = (acc_scr[:] * sm_scale).astype(dq_ref.dtype)
+
+
+def _pair_grads(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                qi, ki, sm_scale, causal, block_q, block_k):
+    """Shared per-(qi, ki)-pair backward math: recompute S (masked only
+    on diagonal-straddling blocks), P from the stored lse, dP, dS.
+    Consumed by the split dkv kernel and the kv-major kernel so the
+    core gradient algebra lives in exactly one place."""
+    q = q_ref[0] * sm_scale
+    k = k_ref[0]
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+    if causal:
+        s = _mask_if_straddling(s, qi, ki, block_q, block_k)
+    p = jnp.exp(s - lse_ref[0])                       # [bq, bk]
+    do = do_ref[0]
+    dp = jax.lax.dot_general(do, v_ref[0], (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+    ds = p * (dp - delta_ref[0])                      # [bq, bk]
+    return q, k, do, p, ds
 
 
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
@@ -186,20 +221,12 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     @pl.when(qi >= first_qi)
     def _step():
-        q = q_ref[0] * sm_scale
-        k = k_ref[0]
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)
-        if causal:
-            s = _mask_if_straddling(s, qi, ki, block_q, block_k)
-        p = jnp.exp(s - lse_ref[0])                   # [bq, bk]
-        do = do_ref[0]
+        q, k, do, p, ds = _pair_grads(
+            q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+            qi, ki, sm_scale, causal, block_q, block_k)
         dv_scr[:] += jax.lax.dot_general(
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)       # [bk, d]
-        dp = jax.lax.dot_general(do, v_ref[0], (((1,), (1,)), ((), ())),
-                                 preferred_element_type=jnp.float32)
-        ds = p * (dp - delta_ref[0])                  # [bq, bk]
         dk_scr[:] += jax.lax.dot_general(
             ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)       # [bk, d]
@@ -221,8 +248,10 @@ def _onepass_vmem_bytes(T, d, bq, bk, out_itemsize):
     outs = 2 * T * d * out_itemsize
     blocks = 2 * (3 * bq * d + 2 * bk * d) * 2 + bq * d * 4
     # Mosaic's own stack accounting runs ~1 MB above this estimate at
-    # T=8192 (measured 17.75M vs 16.9M); a 4 MB margin absorbs it
-    return int(acc + outs + 3 * blocks) + 4 * 1024 * 1024
+    # T=8192 (measured 17.75M vs 16.9M); the margin absorbs it (4 MB
+    # sufficed when first measured; 6 MB after a libtpu stack-
+    # accounting drift re-OOMed the 8k/BH=16 shape)
+    return int(acc + outs + 3 * blocks) + 6 * 1024 * 1024
 
 
 def _bwd_onepass_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
@@ -254,17 +283,9 @@ def _bwd_onepass_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     @pl.when(ki <= last_ki)
     def _step():
-        q = q_ref[0] * sm_scale
-        k = k_ref[0]
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)
-        if causal:
-            s = _mask_if_straddling(s, qi, ki, block_q, block_k)
-        p = jnp.exp(s - lse_ref[0])                   # [bq, bk]
-        do = do_ref[0]
-        dp = jax.lax.dot_general(do, v_ref[0], (((1,), (1,)), ((), ())),
-                                 preferred_element_type=jnp.float32)
-        ds = p * (dp - delta_ref[0])                  # [bq, bk]
+        q, k, do, p, ds = _pair_grads(
+            q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+            qi, ki, sm_scale, causal, block_q, block_k)
         dq_scr[:] += jax.lax.dot_general(
             ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -286,6 +307,79 @@ def _bwd_onepass_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             .astype(dk_ref.dtype)
         dv_ref[0] = dv_scr[:].reshape(dv_ref.shape[1:]) \
             .astype(dv_ref.dtype)
+
+
+def _kvmajor_vmem_bytes(T, d, bq, bk, out_itemsize):
+    """Scoped-VMEM request for the kv-major backward: full-sequence
+    fp32 dq accumulator + its resident output buffer + per-ki dk/dv
+    scratch + double-buffered working blocks."""
+    dq_acc = T * d * 4
+    dq_out = T * d * out_itemsize
+    kv_scr = 2 * bk * d * 4
+    # streaming traffic at the I/O dtype: q/do (bq,d) + k/v (bk,d) +
+    # dk/dv output blocks (bk,d), plus fp32 lse/delta (bq,1) — triple-
+    # buffered as the worst case Mosaic schedules
+    stream = (2 * bq * d + 4 * bk * d) * out_itemsize + 2 * bq * 4
+    # Mosaic's stack accounting ran 436K above a 4 MB-margin estimate
+    # at T=8192/d=128/BH=16 (measured OOM: 15.94M vs 15.51M granted);
+    # a 6 MB margin absorbs that drift class with room
+    return int(dq_acc + dq_out + kv_scr + 3 * stream) + 6 * 1024 * 1024
+
+
+def _bwd_kvmajor_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                        dq_ref, dk_ref, dv_ref, dq_scr, dk_scr, dv_scr,
+                        *, sm_scale, causal, block_q, block_k, nq, nk):
+    """kv-major single-pass backward: grid (bh, ki, qi), both inner
+    dims sequential. Each visited (ki, qi) pair computes S, P, dP, dS
+    once — the 5-matmul + 1-exp minimum (the split arm pays 7 + 2).
+    dk/dv accumulate in per-ki scratch flushed at each row's end (as in
+    the split dkv kernel); dq accumulates across the WHOLE sweep in a
+    full-sequence (nq, bq, d) fp32 scratch — T·d·4 = 4 MB at 8k/128,
+    HALF the residency of the onepass arm whose 12 MB starved Mosaic's
+    double-buffering — and is written to HBM once at the final grid
+    step (dq's output block spans the sequence, index-mapped constant,
+    so Pallas keeps one live buffer)."""
+    ki = pl.program_id(1)
+    qi = pl.program_id(2)
+    first_qi = 0
+    if causal:
+        first_qi = (ki * block_k) // block_q
+
+    @pl.when((ki == 0) & (qi == 0))
+    def _init_dq():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    @pl.when(qi == 0)
+    def _init_kv():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    @pl.when(qi >= first_qi)
+    def _step():
+        q, k, do, p, ds = _pair_grads(
+            q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+            qi, ki, sm_scale, causal, block_q, block_k)
+        dv_scr[:] += jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)       # [bk, d]
+        dk_scr[:] += jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)       # [bk, d]
+        dq_scr[qi] += jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)       # [bq, d]
+
+    @pl.when(qi == nq - 1)
+    def _fin_kv():
+        # dk needs no extra sm_scale: the accumulation used the
+        # already-scaled q, which carries the factor
+        dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
+
+    @pl.when((ki == nk - 1) & (qi == nq - 1))
+    def _fin_dq():
+        dq_ref[0] = (dq_scr[:] * sm_scale) \
+            .reshape(dq_ref.shape[1:]).astype(dq_ref.dtype)
 
 
 # (T, d) -> (block_q, block_k) overrides. The round-4 one-process-per-
@@ -377,12 +471,29 @@ def _bwd(q, k, v, o, lse, do, causal, sm_scale, interpret=False):
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
                     axis=-1, keepdims=True)            # [BH, T, 1]
 
-    # One-pass only on request (see _FORCE_ONEPASS above), and only
-    # when the dk/dv full-sequence fp32 accumulators + VMEM-resident
-    # output buffers fit beside the working blocks (T=8k/d=128 ~ 18 MB
-    # total, measured compile-able with the raised scoped-vmem limit).
+    # Arm selection: forced via PADDLE_FLASH_BWD, else kv-major — the
+    # measured default (−25% vs split at T=2048..16384, parity at
+    # T=512; PERF.md round-5 kv-major section). Residency guards:
+    # onepass needs its dk/dv full-sequence fp32 accumulators +
+    # resident outputs to fit (T=8k/d=128 ~ 18 MB with the raised
+    # scoped-vmem limit); kvmajor guards its whole scoped-VMEM request
+    # (dq accumulator + resident output + blocks) against a 64 MB
+    # ceiling — T=64k/d=128 (~57 MB) measured compile-able on v5e,
+    # so single-chip shapes through 64k keep the fast arm and only
+    # beyond does split take over.
+    arm = _FORCE_ARM or 'kvmajor'
     kv_bytes = 2 * T * d * (4 + k.dtype.itemsize)
-    if not _FORCE_ONEPASS or kv_bytes > 12 * 1024 * 1024:
+    if arm == 'onepass' and kv_bytes > 12 * 1024 * 1024:
+        arm = 'split'
+    if arm == 'kvmajor' and _kvmajor_vmem_bytes(
+            T, d, bq, bk, q.dtype.itemsize) > 64 * 1024 * 1024:
+        arm = 'split'
+    global _RESOLVED_ARM
+    _RESOLVED_ARM = arm
+    if arm == 'kvmajor':
+        return _bwd_kvmajor(q, k, v, do, lse, delta, causal, sm_scale,
+                            interpret, bq, bk, nq, nk)
+    if arm != 'onepass':
         return _bwd_split(q, k, v, do, lse, delta, causal, sm_scale,
                           interpret, bq, bk, nq, nk)
     dq, dk, dv = pl.pallas_call(
@@ -502,6 +613,64 @@ def _bwd_split(q, k, v, do, lse, delta, causal, sm_scale, interpret,
                         pltpu.VMEM((bk, d), jnp.float32)],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=('parallel', 'parallel', 'arbitrary')),
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+    return dq, dk, dv
+
+
+def _bwd_kvmajor(q, k, v, do, lse, delta, causal, sm_scale, interpret,
+                 bq, bk, nq, nk):
+    """Single-launch 5-matmul backward with dq (not dk/dv) as the
+    resident accumulator — see _bwd_kvmajor_kernel. k/v blocks are
+    indexed by the middle grid dim, so Mosaic fetches them once per ki
+    row; q-side blocks stream per step as in the split dkv kernel."""
+    BH, T, d = q.shape
+
+    def qmap(b, j, i):
+        # During causally-skipped steps (i < first_qi(j)) clamp the
+        # q-side fetch to the first visited block: the block index is
+        # then unchanged step-to-step, so Mosaic elides the dead DMA.
+        if causal:
+            i = jnp.maximum(i, (j * bk) // bq)
+        return (b, i, 0)
+
+    dq, dk, dv = pl.pallas_call(
+        functools.partial(_bwd_kvmajor_kernel, sm_scale=sm_scale,
+                          causal=causal, block_q=bq, block_k=bk,
+                          nq=nq, nk=nk),
+        grid=(BH, nk, nq),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), qmap, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bq, d), qmap, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bq, 1), qmap, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bq, 1), qmap, memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            # dq's block spans the whole sequence, index-mapped
+            # constant: one live buffer, flushed once at the end
+            pl.BlockSpec((1, T, d), lambda b, j, i: (b, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, T, d), q.dtype),
+            jax.ShapeDtypeStruct((BH, T, d), k.dtype),
+            jax.ShapeDtypeStruct((BH, T, d), v.dtype),
+        ],
+        scratch_shapes=[pltpu.VMEM((nq, bq, d), jnp.float32),
+                        pltpu.VMEM((bk, d), jnp.float32),
+                        pltpu.VMEM((bk, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=('parallel', 'arbitrary', 'arbitrary'),
+            vmem_limit_bytes=_kvmajor_vmem_bytes(
+                T, d, bq, bk, q.dtype.itemsize)),
         interpret=interpret,
     )(q, k, v, do, lse, delta)
     return dq, dk, dv

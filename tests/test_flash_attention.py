@@ -174,28 +174,43 @@ def test_transformer_model_flash_config_trains():
         fluid.set_flags({'pallas_interpret': False})
 
 
+@pytest.mark.parametrize('arm,T,bq,bk',
+                         [('split', 896, 128, 128),
+                          ('split', 897, 128, 128),
+                          ('onepass', 640, 128, 128),
+                          ('onepass', 641, 128, 128),
+                          ('kvmajor', 768, 128, 128),
+                          ('kvmajor', 769, 128, 128),
+                          # the tuned-table shape class (bk > bq, cf.
+                          # _BLOCK_TABLE's (512, 1024)): pins kvmajor's
+                          # causal qmap clamp + first_qi arithmetic
+                          ('kvmajor', 1024, 128, 256)])
 @pytest.mark.parametrize('causal', [False, True])
-def test_onepass_backward_grads_match_naive(causal):
-    """The SPLIT backward is the measured-default arm (covered by every
-    other grad test); the one-pass kernel stays available for chips
-    where its 5-matmul schedule wins — force it via the _FORCE_ONEPASS
-    hook so it keeps grad parity coverage. A UNIQUE T is used because
-    _bwd's jit cache keys on shapes+static args, not on the hook/flag
-    state at trace time."""
+def test_alt_backward_arms_grads_match_naive(causal, arm, T, bq, bk):
+    """The kv-major backward is the measured-default arm (covered by
+    every other grad test); split and one-pass stay available via
+    PADDLE_FLASH_BWD (split is also the automatic fallback when the
+    kv-major dq accumulator would not fit) — force each via the
+    _FORCE_ARM hook so all arms keep grad parity coverage. A UNIQUE T
+    per arm is used because _bwd's jit cache keys on shapes+static
+    args, not on the hook/flag state at trace time (the odd-T cases
+    fall back to the naive path end to end, pinning that the hook does
+    not break unsupported shapes)."""
     import paddle_tpu as fluid
     from paddle_tpu.pallas import flash_attention as fa
+    from paddle_tpu.pallas.flash_attention import flash_attention
     rng = np.random.RandomState(2)
-    BH, T, d = 2, 640, 128
+    BH, d = 2, 128
     q = jnp.asarray(rng.randn(BH, T, d).astype('float32')) * 0.3
     k = jnp.asarray(rng.randn(BH, T, d).astype('float32')) * 0.3
     v = jnp.asarray(rng.randn(BH, T, d).astype('float32'))
     scale = d ** -0.5
-    fluid.set_flags({'flash_block_q': 128, 'flash_block_k': 128})
-    fa._FORCE_ONEPASS = True
+    fluid.set_flags({'flash_block_q': bq, 'flash_block_k': bk,
+                     'pallas_interpret': INTERPRET})
+    fa._FORCE_ARM = arm
     try:
         def loss_k(q, k, v):
-            return jnp.sum(_flash(q, k, v, causal, scale,
-                                  INTERPRET) ** 2)
+            return jnp.sum(flash_attention(q, k, v, causal, scale) ** 2)
 
         def loss_n(q, k, v):
             return jnp.sum(_naive(q, k, v, causal, scale) ** 2)
@@ -203,8 +218,9 @@ def test_onepass_backward_grads_match_naive(causal):
         gk = jax.grad(loss_k, argnums=(0, 1, 2))(q, k, v)
         gn = jax.grad(loss_n, argnums=(0, 1, 2))(q, k, v)
     finally:
-        fa._FORCE_ONEPASS = False
-        fluid.set_flags({'flash_block_q': 0, 'flash_block_k': 0})
+        fa._FORCE_ARM = ''
+        fluid.set_flags({'flash_block_q': 0, 'flash_block_k': 0,
+                         'pallas_interpret': False})
     for name, a, b in zip('qkv', gk, gn):
         scale_ref = float(jnp.abs(b).max()) + 1e-9
         rel = float(jnp.abs(a - b).max()) / scale_ref

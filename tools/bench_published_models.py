@@ -94,8 +94,8 @@ def bench_model(model, bs, steps=12):
 
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument('--models', nargs='+', default=['alexnet',
-                                                    'googlenet'])
+    ap.add_argument('--models', nargs='+', choices=sorted(CONFIGS),
+                    default=['alexnet', 'googlenet'])
     args = ap.parse_args()
     print('| model | bs | img/s (this chip) | ms/batch | published |')
     print('|---|---|---|---|---|')

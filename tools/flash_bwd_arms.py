@@ -1,0 +1,114 @@
+"""Interleaved in-process A/B of the flash backward arms.
+
+Round-5 follow-up to the one-pass-vs-split measurement (PERF.md): the
+kv-major arm transposes the one-pass grid so dq (4 MB) rather than
+dk/dv (12 MB) is the resident accumulator, keeping the 5-matmul +
+1-exp minimum per visited pair at half the residency. This tool ranks
+the arms with the same discipline as tools/flash_autotune.py: every
+arm in ONE process, alternated across rounds, in-jit N/2N loops
+differenced to cancel per-sync constants.
+
+    python tools/flash_bwd_arms.py [--T 8192] [--bh 16] [--rounds 3]
+        [--arms split kvmajor] [--blocks-q 0] [--blocks-k 0]
+
+--blocks-q/--blocks-k force a block config (0 = the tuned table).
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import statistics
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+from flash_autotune import measure  # noqa: E402 — same harness
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument('--T', type=int, default=8192)
+    ap.add_argument('--d', type=int, default=128)
+    ap.add_argument('--bh', type=int, default=16)
+    ap.add_argument('--rounds', type=int, default=3)
+    ap.add_argument('--arms', nargs='+',
+                    default=['split', 'kvmajor'])
+    ap.add_argument('--blocks-q', type=int, default=0)
+    ap.add_argument('--blocks-k', type=int, default=0)
+    args = ap.parse_args()
+
+    import paddle_tpu as fluid
+    from paddle_tpu.pallas import flash_attention as flash
+
+    bad = [a for a in args.arms if a not in flash._BWD_ARMS[1:]]
+    if bad:
+        raise SystemExit('unknown arm(s) %s: expected %s'
+                         % (bad, list(flash._BWD_ARMS[1:])))
+
+    if args.blocks_q or args.blocks_k:
+        fluid.flags.set_flags({'FLAGS_flash_block_q': args.blocks_q,
+                               'FLAGS_flash_block_k': args.blocks_k})
+
+    rng = np.random.RandomState(0)
+    q = jnp.asarray(rng.randn(args.bh, args.T, args.d), jnp.bfloat16)
+    k = jnp.asarray(rng.randn(args.bh, args.T, args.d), jnp.bfloat16)
+    v = jnp.asarray(rng.randn(args.bh, args.T, args.d), jnp.bfloat16)
+
+    results = {a: [] for a in args.arms}
+    failed = set()
+    for rnd in range(args.rounds):
+        for arm in args.arms:
+            if arm in failed:
+                continue
+            # force every arm by NAME — '' would mean "default", which
+            # dispatches kvmajor, so a '' spelling of split would rank
+            # kvmajor against itself
+            flash._FORCE_ARM = arm
+            # the arm binds at TRACE time — stale traces must go
+            flash._fwd.clear_cache()
+            flash._bwd.clear_cache()
+            try:
+                ms = measure(flash, q, k, v)
+            except Exception as e:   # noqa: BLE001 — e.g. VMEM OOM
+                failed.add(arm)
+                print('round %d  %-8s FAILED (%.80s)'
+                      % (rnd, arm, str(e)), flush=True)
+                continue
+            if flash._RESOLVED_ARM != arm:
+                # a residency guard swapped the forced arm — ranking
+                # the substitute under this label would corrupt the
+                # table (e.g. onepass>12MB silently becomes split)
+                failed.add(arm)
+                print('round %d  %-8s SKIPPED (guard dispatched %r '
+                      'for this shape)' % (rnd, arm,
+                                           flash._RESOLVED_ARM),
+                      flush=True)
+                continue
+            results[arm].append(ms)
+            print('round %d  %-8s %.2f ms' % (rnd, arm, ms),
+                  flush=True)
+    flash._FORCE_ARM = ''
+    arms = [a for a in args.arms if results[a] and a not in failed]
+    if not arms:
+        print('\nevery arm failed — nothing to rank')
+        return
+    ranked = sorted(arms, key=lambda a: statistics.median(results[a]))
+    base = statistics.median(results[arms[0]])
+    print('\n| arm | median ms | spread | vs %s |' % arms[0])
+    print('|---|---|---|---|')
+    for a in ranked:
+        ms = results[a]
+        print('| %s | %.2f | %.2f-%.2f | %+.1f%% |'
+              % (a, statistics.median(ms), min(ms), max(ms),
+                 (statistics.median(ms) / base - 1) * 100))
+
+
+if __name__ == '__main__':
+    main()
