@@ -217,10 +217,16 @@ def _lstmp_emit(ctx, op):
     act_h = _ACT[op.attr('candidate_activation', 'tanh')]
     act_p = _ACT[op.attr('proj_activation', 'identity')]
 
-    gate_b = b[:, :4 * H]
+    # AMP stream convention (sequence_ops._lstm_emit): fp32 params cast
+    # DOWN to the activation dtype so the scan carry keeps its type and
+    # the per-timestep matmuls run at the bf16 MXU rate
+    w = w.astype(x.dtype)
+    proj = proj.astype(x.dtype)
+    gate_b = b[:, :4 * H].astype(x.dtype)
     if use_peepholes:
-        w_ic, w_fc, w_oc = (b[:, 4 * H:5 * H], b[:, 5 * H:6 * H],
-                            b[:, 6 * H:7 * H])
+        w_ic, w_fc, w_oc = (b[:, 4 * H:5 * H].astype(x.dtype),
+                            b[:, 5 * H:6 * H].astype(x.dtype),
+                            b[:, 6 * H:7 * H].astype(x.dtype))
 
     r0 = jnp.zeros((B, P), x.dtype)
     c0 = jnp.zeros((B, H), x.dtype)
@@ -229,7 +235,7 @@ def _lstmp_emit(ctx, op):
         r0 = jnp.matmul(ctx.get(op.single_input('H0')), proj,
                         preferred_element_type=x.dtype)
     if op.input('C0'):
-        c0 = ctx.get(op.single_input('C0'))
+        c0 = ctx.get(op.single_input('C0')).astype(x.dtype)
 
     xs = jnp.swapaxes(x, 0, 1)
     ts = jnp.arange(T)

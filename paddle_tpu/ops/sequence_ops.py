@@ -219,17 +219,24 @@ def _lstm_emit(ctx, op):
     act_c = _ACT[op.attr('cell_activation', 'tanh')]
     act_h = _ACT[op.attr('candidate_activation', 'tanh')]
 
-    gate_b = b[:, :4 * H]
+    # AMP stream convention (ops/math_ops.py round-4): fp32 params are
+    # cast DOWN to the activation dtype instead of promoting — a fp32
+    # bias would otherwise promote the whole recurrence (breaking the
+    # scan carry typecheck), and a fp32 recurrent weight would run the
+    # per-timestep matmul in fp32, forfeiting AMP's MXU rate
+    w = w.astype(x.dtype)
+    gate_b = b[:, :4 * H].astype(x.dtype)
     if use_peepholes:
-        w_ic, w_fc, w_oc = (b[:, 4 * H:5 * H], b[:, 5 * H:6 * H],
-                            b[:, 6 * H:7 * H])
+        w_ic, w_fc, w_oc = (b[:, 4 * H:5 * H].astype(x.dtype),
+                            b[:, 5 * H:6 * H].astype(x.dtype),
+                            b[:, 6 * H:7 * H].astype(x.dtype))
 
     h0 = jnp.zeros((B, H), x.dtype)
     c0 = jnp.zeros((B, H), x.dtype)
     if op.input('H0'):
-        h0 = ctx.get(op.single_input('H0'))
+        h0 = ctx.get(op.single_input('H0')).astype(x.dtype)
     if op.input('C0'):
-        c0 = ctx.get(op.single_input('C0'))
+        c0 = ctx.get(op.single_input('C0')).astype(x.dtype)
 
     xs = jnp.swapaxes(x, 0, 1)                   # [T, B, 4H]
     ts = jnp.arange(T)
@@ -299,13 +306,15 @@ def _gru_emit(ctx, op):
     is_reverse = op.attr('is_reverse', False)
     act_g = _ACT[op.attr('gate_activation', 'sigmoid')]
     act_c = _ACT[op.attr('activation', 'tanh')]
-    b = ctx.get(op.single_input('Bias')) if op.input('Bias') \
-        else jnp.zeros((1, 3 * H), x.dtype)
+    # AMP stream convention: cast fp32 params down (see _lstm_emit)
+    w = w.astype(x.dtype)
+    b = ctx.get(op.single_input('Bias')).astype(x.dtype) \
+        if op.input('Bias') else jnp.zeros((1, 3 * H), x.dtype)
     w_g = w[:, :2 * H]     # update+reset recurrent weights
     w_c = w[:, 2 * H:]     # candidate recurrent weights
 
-    h0 = ctx.get(op.single_input('H0')) if op.input('H0') \
-        else jnp.zeros((B, H), x.dtype)
+    h0 = ctx.get(op.single_input('H0')).astype(x.dtype) \
+        if op.input('H0') else jnp.zeros((B, H), x.dtype)
 
     xs = jnp.swapaxes(x, 0, 1)
     ts = jnp.arange(T)

@@ -82,6 +82,9 @@ if not _FORCE_ARM and _os.environ.get('PADDLE_FLASH_ONEPASS', '') in (
 # guards may silently swap a forced arm for 'split', so measurement
 # tools must check this rather than trust the arm they requested
 _RESOLVED_ARM = ''
+# clamp block index maps during causally-skipped grid steps so the
+# dead prefetch DMAs are elided (trace-time; off only for A/B)
+_CLAMP_SKIPPED_DMA = True
 
 
 def _mask_if_straddling(s, qi, ki, block_q, block_k):
@@ -429,16 +432,24 @@ def _fwd(q, k, v, causal, sm_scale, interpret=False):
     kern = functools.partial(_fwd_kernel, sm_scale=sm_scale,
                              causal=causal, block_q=bq, block_k=bk,
                              nk=nk)
+
+    def kvmap(b, i, j):
+        # During causally-skipped steps (j > last_ki(i)) clamp the k/v
+        # fetch to the last visited block: the block index is then
+        # unchanged step-to-step, so Mosaic elides the dead DMA.
+        # (_CLAMP_SKIPPED_DMA is the trace-time A/B hook.)
+        if causal and _CLAMP_SKIPPED_DMA:
+            j = jnp.minimum(j, ((i + 1) * bq - 1) // bk)
+        return (b, j, 0)
+
     o, lse = pl.pallas_call(
         kern,
         grid=(BH, nq, nk),
         in_specs=[
             pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0),
-                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bk, d), kvmap, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bk, d), kvmap, memory_space=pltpu.VMEM),
         ],
         out_specs=[
             pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0),
@@ -630,7 +641,8 @@ def _bwd_kvmajor(q, k, v, do, lse, delta, causal, sm_scale, interpret,
         # During causally-skipped steps (i < first_qi(j)) clamp the
         # q-side fetch to the first visited block: the block index is
         # then unchanged step-to-step, so Mosaic elides the dead DMA.
-        if causal:
+        # (_CLAMP_SKIPPED_DMA is the trace-time A/B hook.)
+        if causal and _CLAMP_SKIPPED_DMA:
             i = jnp.maximum(i, (j * bk) // bq)
         return (b, i, 0)
 

@@ -71,3 +71,47 @@ def test_bf16_guard_marks_program():
     with fluid.contrib.mixed_precision.bf16_guard(prog):
         pass
     assert prog._use_bf16
+
+
+def test_bf16_recurrent_ops_train():
+    """Regression: under AMP the recurrent scans (lstm/gru/lstmp) must
+    keep their carry at the bf16 stream dtype — fp32 bias/peephole
+    params used to promote the body output and break the scan's carry
+    typecheck (found by the published-models LSTM bench)."""
+    import paddle_tpu.layers as layers
+    rng = np.random.RandomState(7)
+    for build in ('lstm_peep', 'gru', 'lstmp'):
+        prog, startup = Program(), Program()
+        with program_guard(prog, startup):
+            words = fluid.layers.data(name='w', shape=[1], dtype='int64',
+                                      lod_level=1)
+            label = fluid.layers.data(name='y', shape=[1], dtype='int64')
+            emb = fluid.layers.embedding(input=words, size=[50, 16])
+            if build == 'lstm_peep':
+                proj = fluid.layers.fc(input=emb, size=4 * 24)
+                seq, _ = fluid.layers.dynamic_lstm(
+                    input=proj, size=4 * 24, use_peepholes=True)
+            elif build == 'gru':
+                proj = fluid.layers.fc(input=emb, size=3 * 24)
+                seq = fluid.layers.dynamic_gru(input=proj, size=24)
+            else:
+                proj = fluid.layers.fc(input=emb, size=4 * 24)
+                seq, _ = layers.dynamic_lstmp(
+                    input=proj, size=4 * 24, proj_size=12,
+                    use_peepholes=True)
+            last = fluid.layers.sequence_pool(input=seq, pool_type='last')
+            predict = fluid.layers.fc(input=last, size=2, act='softmax')
+            cost = fluid.layers.cross_entropy(input=predict, label=label)
+            avg = fluid.layers.mean(cost)
+            opt = fluid.contrib.mixed_precision.decorate(
+                fluid.optimizer.SGD(learning_rate=0.1))
+            opt.minimize(avg)
+        exe = fluid.Executor(fluid.CPUPlace())
+        with fluid.scope_guard(fluid.executor.Scope()):
+            exe.run(startup)
+            ids = rng.randint(0, 50, (4, 6, 1)).astype('int64')
+            lens = np.array([6, 4, 6, 3], 'int32')
+            lbl = rng.randint(0, 2, (4, 1)).astype('int64')
+            l, = exe.run(prog, feed={'w': (ids, lens), 'y': lbl},
+                         fetch_list=[avg])
+            assert np.isfinite(float(l)), build

@@ -30,6 +30,12 @@ CONFIGS = {
                                         'K40m; 270 img/s 2xXeon6148'),
     'vgg': dict(bs=64, published='30.4 img/s (vgg19) 2xXeon6148'),
     'resnet': dict(bs=256, published='84 img/s 2xXeon6148'),
+    # benchmark/README.md:113-120 "RNN / LSTM in Text Classification":
+    # IMDB padded to T=100, dict 30000, 2 lstm layers + fc, peepholes,
+    # hidden 512, bs 64 -> 184 ms/batch on the v0.9 K40m stack
+    # (reference net: benchmark/paddle/rnn/rnn.py — emb 128,
+    # lstm_num x simple_lstm, last_seq, fc softmax)
+    'lstm': dict(bs=64, published='184 ms/batch K40m (h=512 bs=64)'),
 }
 
 
@@ -48,13 +54,35 @@ def bench_model(model, bs, steps=12):
         'resnet': lambda i, l: resnet.train_network(
             i, l, class_dim=1000, depth=50),
     }
+    def lstm_text_class(words, lbl, hidden=512, lstm_num=2,
+                        vocab=30000):
+        """The published RNN row's net (reference
+        benchmark/paddle/rnn/rnn.py): emb(128) -> lstm_num x
+        [input proj + lstmemory(peepholes)] -> last_seq -> fc(2,
+        softmax). simple_lstm's full-matrix input projection maps to
+        the fluid-style fc(4*hidden) + dynamic_lstm pair."""
+        net = fluid.layers.embedding(input=words, size=[vocab, 128])
+        for _ in range(lstm_num):
+            proj = fluid.layers.fc(input=net, size=4 * hidden)
+            net, _ = fluid.layers.dynamic_lstm(
+                input=proj, size=4 * hidden, use_peepholes=True)
+        last = fluid.layers.sequence_pool(input=net, pool_type='last')
+        predict = fluid.layers.fc(input=last, size=2, act='softmax')
+        cost = fluid.layers.cross_entropy(input=predict, label=lbl)
+        return None, fluid.layers.mean(cost), None
+
     with unique_name.guard():
         main, start = fluid.Program(), fluid.Program()
         with fluid.program_guard(main, start):
-            img = fluid.layers.data(name='img', shape=[3, 224, 224],
-                                    dtype='float32')
+            if model == 'lstm':
+                img = fluid.layers.data(name='img', shape=[1],
+                                        dtype='int64', lod_level=1)
+            else:
+                img = fluid.layers.data(name='img', shape=[3, 224, 224],
+                                        dtype='float32')
             lbl = fluid.layers.data(name='lbl', shape=[1],
                                     dtype='int64')
+            builders['lstm'] = lstm_text_class
             _, loss, _ = builders[model](img, lbl)
             opt = fluid.optimizer.Momentum(learning_rate=1e-3,
                                            momentum=0.9)
@@ -68,12 +96,23 @@ def bench_model(model, bs, steps=12):
                                         loss_name=loss.name,
                                         main_program=main, scope=scope)
             rng = np.random.RandomState(0)
-            feed = {
-                'img': jax.device_put(
-                    rng.rand(bs, 3, 224, 224).astype('f4')),
-                'lbl': jax.device_put(
-                    rng.randint(0, 1000, (bs, 1)).astype('int64')),
-            }
+            if model == 'lstm':
+                # IMDB-shaped synthetic: padded T=100 (the published
+                # row pads too), dict 30000. Tiny feed (~50 KB) — the
+                # tunnel upload is negligible at this size.
+                feed = {
+                    'img': (rng.randint(0, 30000, (bs, 100, 1))
+                            .astype('int64'),
+                            np.full((bs,), 100, 'int32')),
+                    'lbl': rng.randint(0, 2, (bs, 1)).astype('int64'),
+                }
+            else:
+                feed = {
+                    'img': jax.device_put(
+                        rng.rand(bs, 3, 224, 224).astype('f4')),
+                    'lbl': jax.device_put(
+                        rng.randint(0, 1000, (bs, 1)).astype('int64')),
+                }
             for _ in range(3):
                 lv = pe.run(fetch_list=[loss.name], feed=feed,
                             return_numpy=False)
