@@ -140,6 +140,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
                        - safe_m)
         # no second mask on p: masked s = -1e30, and exp(-1e30 - m)
         # underflows to exactly 0 for any finite (or zeroed) safe_m
+        # (an MXU p@1 rewrite of this lane-axis sum was A/B'd and
+        # LOSES ~10% — PERF.md round-5 fwd-kernel probe)
         p = jnp.exp(s - safe_m[:, None])
         l_new = l_scr[:] * corr + jnp.sum(p, axis=1)
         acc = acc_scr[:] * corr[:, None] + jax.lax.dot_general(
