@@ -402,14 +402,24 @@ _BLOCK_TABLE = {
     (8192, 128): (512, 1024),
 }
 
+# The forward and backward only share (o, lse), which are block-size
+# independent — so each direction keeps its own tuned table. The fwd's
+# per-block corr/rescale chain amortizes with bigger blocks: fwd-only
+# sweep at T=8192 ranks (1024, 1024) 5.26 ms vs the shared-table
+# (512, 1024) 5.88 ms (~10%, 3 interleaved rounds; PERF.md round-5).
+_BLOCK_TABLE_FWD = {
+    (8192, 128): (1024, 1024),
+}
 
-def _block_sizes(T, d):
+
+def _block_sizes(T, d, fwd=False):
     from ..flags import get_flag
     fq = int(get_flag('flash_block_q', 0) or 0)
     fk = int(get_flag('flash_block_k', 0) or 0)
     if fq or fk:
         # a half-set or non-dividing override silently benchmarking the
         # default kernel is exactly the sweep corruption to avoid
+        # (the override binds BOTH directions so sweeps stay coherent)
         if not (fq and fk):
             raise ValueError('set BOTH FLAGS_flash_block_q and '
                              'FLAGS_flash_block_k (got q=%d k=%d)'
@@ -418,6 +428,8 @@ def _block_sizes(T, d):
             raise ValueError('flash block override (%d, %d) does not '
                              'divide T=%d' % (fq, fk, T))
         return fq, fk
+    if fwd and (T, d) in _BLOCK_TABLE_FWD:
+        return _BLOCK_TABLE_FWD[(T, d)]
     if (T, d) in _BLOCK_TABLE:
         return _BLOCK_TABLE[(T, d)]
     bq = min(512, T)
@@ -433,7 +445,7 @@ def _block_sizes(T, d):
                                              'interpret'))
 def _fwd(q, k, v, causal, sm_scale, interpret=False):
     BH, T, d = q.shape
-    bq, bk = _block_sizes(T, d)
+    bq, bk = _block_sizes(T, d, fwd=True)
     nq, nk = T // bq, T // bk
     kern = functools.partial(_fwd_kernel, sm_scale=sm_scale,
                              causal=causal, block_q=bq, block_k=bk,
