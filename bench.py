@@ -331,10 +331,13 @@ def bench_inference(on_tpu):
     inference numbers in benchmark/IntelOptimizedPaddle.md:81-87 and
     ships per-model inference tests in inference/tests/book/).
 
-    Both legs go through the full serving path: save_inference_model ->
-    AnalysisPredictor (offline BN fold) -> predictor.run(). Latencies
-    are wall time through the remoted transport and therefore include
-    infer_transport_rtt_ms per call; subtract it for device-side time.
+    All legs go through the full serving path: save_inference_model ->
+    AnalysisPredictor (offline BN fold) -> the predictor's program.
+    Latencies are wall time through the remoted transport and therefore
+    include infer_transport_rtt_ms per call; the resnet
+    device-throughput leg drives the predictor's folded program async
+    (device-resident feed, N/2N differenced) so the chip's serving
+    throughput is separable from the tunnel.
     """
     import tempfile
     from paddle_tpu.inference import AnalysisConfig, AnalysisPredictor
@@ -370,6 +373,36 @@ def bench_inference(on_tpu):
             round(bs / mean, 1),
         'infer_resnet%d_bs%d_p50_ms' % (depth, bs): round(p50, 1),
         'infer_resnet%d_bs%d_p99_ms' % (depth, bs): round(p99, 1)})
+
+    # Device-THROUGHPUT leg: the per-call numbers above are dominated
+    # by the remoted transport (RTT + 9.6 MB feed upload per call); the
+    # reference's published 217.69 img/s (IntelOptimizedPaddle.md:81-87)
+    # is a throughput number, so measure ours the same way — the
+    # predictor's own (BN-folded) serving program driven async with a
+    # device-resident feed, fetch once, N/2N differenced.
+    imgd = jax.device_put(img)
+
+    def _loop(n):
+        t0 = time.perf_counter()
+        r = None
+        for _ in range(n):
+            r = predictor._exe.run(predictor._program,
+                                   feed={predictor._feed_names[0]: imgd},
+                                   fetch_list=predictor._fetch_vars,
+                                   scope=predictor._scope,
+                                   return_numpy=False)
+        np.asarray(r[0])
+        return time.perf_counter() - t0
+    _loop(3)
+    w1, w2 = _loop(iters), _loop(2 * iters)
+    if w2 - w1 > 0.5 * w1:
+        out['infer_resnet%d_bs%d_device_images_per_sec' % (depth, bs)] \
+            = round(bs * iters / (w2 - w1), 1)
+    else:
+        # timer noise / transient stall made the differencing invalid —
+        # an absurd clamped value must not enter the artifact
+        out['infer_resnet%d_bs%d_device_images_per_sec' % (depth, bs)] \
+            = None
 
     # --- Transformer decode step (next-token logits for a T-prefix) ---
     if on_tpu:
