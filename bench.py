@@ -326,6 +326,31 @@ def _latency_stats(fn, iters):
     return p50 * 1e3, p99 * 1e3, sum(lats) / len(lats)
 
 
+def serving_throughput(predictor, feed, batch, iters):
+    """Device throughput of a predictor's (BN-folded) serving program:
+    async predictor.run(return_numpy=False) on a device-resident feed,
+    fetch once, N/2N differenced. Shared by bench_inference and
+    tools/bench_published_models so the measurement cannot drift.
+    Returns (per_sec, ms_per_batch), or (None, None) when the
+    differencing is noise-invalid — the guard rejects near-zero
+    differences (an absurd clamped value must never enter an artifact)
+    while accepting RTT-dominated-but-real ones (w2−w1 legitimately
+    shrinks toward N·step as the per-sync constant grows)."""
+    def _loop(n):
+        t0 = time.perf_counter()
+        r = None
+        for _ in range(n):
+            r = predictor.run(feed, return_numpy=False)
+        np.asarray(r[0])
+        return time.perf_counter() - t0
+    _loop(3)
+    w1, w2 = _loop(iters), _loop(2 * iters)
+    d = w2 - w1
+    if d <= max(0.05 * w1, 1e-3):
+        return None, None
+    return batch * iters / d, d / iters * 1e3
+
+
 def bench_inference(on_tpu):
     """Inference perf series (round-5 VERDICT #6; reference publishes
     inference numbers in benchmark/IntelOptimizedPaddle.md:81-87 and
@@ -377,32 +402,12 @@ def bench_inference(on_tpu):
     # Device-THROUGHPUT leg: the per-call numbers above are dominated
     # by the remoted transport (RTT + 9.6 MB feed upload per call); the
     # reference's published 217.69 img/s (IntelOptimizedPaddle.md:81-87)
-    # is a throughput number, so measure ours the same way — the
-    # predictor's own (BN-folded) serving program driven async with a
-    # device-resident feed, fetch once, N/2N differenced.
-    imgd = jax.device_put(img)
-
-    def _loop(n):
-        t0 = time.perf_counter()
-        r = None
-        for _ in range(n):
-            r = predictor._exe.run(predictor._program,
-                                   feed={predictor._feed_names[0]: imgd},
-                                   fetch_list=predictor._fetch_vars,
-                                   scope=predictor._scope,
-                                   return_numpy=False)
-        np.asarray(r[0])
-        return time.perf_counter() - t0
-    _loop(3)
-    w1, w2 = _loop(iters), _loop(2 * iters)
-    if w2 - w1 > 0.5 * w1:
-        out['infer_resnet%d_bs%d_device_images_per_sec' % (depth, bs)] \
-            = round(bs * iters / (w2 - w1), 1)
-    else:
-        # timer noise / transient stall made the differencing invalid —
-        # an absurd clamped value must not enter the artifact
-        out['infer_resnet%d_bs%d_device_images_per_sec' % (depth, bs)] \
-            = None
+    # is a throughput number, so measure ours the same way.
+    thr, _ = serving_throughput(predictor,
+                                {predictor.get_input_names()[0]:
+                                 jax.device_put(img)}, bs, iters)
+    out['infer_resnet%d_bs%d_device_images_per_sec' % (depth, bs)] = \
+        None if thr is None else round(thr, 1)
 
     # --- Transformer decode step (next-token logits for a T-prefix) ---
     if on_tpu:

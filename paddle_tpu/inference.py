@@ -69,9 +69,12 @@ class Predictor(object):
     def get_output_names(self):
         return [v.name for v in self._fetch_vars]
 
-    def run(self, inputs):
+    def run(self, inputs, return_numpy=True):
         """inputs: dict name->array, or list matching get_input_names()
-        order. Returns list of np.ndarray outputs."""
+        order. Returns list of np.ndarray outputs — or, with
+        return_numpy=False, device arrays without a host sync (the
+        async serving/throughput path: dispatches pipeline, and the
+        caller fetches when it actually needs values)."""
         if not isinstance(inputs, dict):
             if len(inputs) != len(self._feed_names):
                 raise ValueError(
@@ -83,7 +86,10 @@ class Predictor(object):
         # threads, and the guard swaps a process-global
         outs = self._exe.run(self._program, feed=inputs,
                              fetch_list=self._fetch_vars,
-                             scope=self._scope)
+                             scope=self._scope,
+                             return_numpy=return_numpy)
+        if not return_numpy:
+            return outs
         return [np.asarray(o) for o in outs]
 
     def clone(self):

@@ -92,3 +92,28 @@ def test_elementwise_scalar_vs_unit_shape_grad():
         out, = exe.run(main, feed={'x': np.ones((2, 4), 'f4')},
                        fetch_list=[total])
     assert np.isfinite(np.asarray(out)).all()
+
+
+def test_vgg19_depth_groups_build_and_train():
+    """VGG-19 (the published-rows depth: 2-2-4-4-4 conv groups,
+    benchmark/IntelOptimizedPaddle.md) builds and trains; the graph
+    must contain the 16 conv layers that distinguish it from VGG-16's
+    13."""
+    from paddle_tpu.models import vgg
+    with fluid.unique_name.guard():
+        main, start = Program(), Program()
+        with program_guard(main, start):
+            img = fluid.layers.data(name='img', shape=[3, 32, 32],
+                                    dtype='float32')
+            lbl = fluid.layers.data(name='lbl', shape=[1],
+                                    dtype='int64')
+            _, loss, _ = vgg.train_network(img, lbl, class_dim=4,
+                                           is_test=True, depth=19)
+        n_convs = sum(1 for op in main.global_block().ops
+                      if op.type == 'conv2d')
+        assert n_convs == 16, n_convs
+    losses = _train(
+        lambda i, l: vgg.train_network(i, l, class_dim=4,
+                                       is_test=True, depth=19),
+        hw=32, steps=10, lr=1e-4)
+    assert np.isfinite(losses).all()

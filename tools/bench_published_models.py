@@ -29,6 +29,7 @@ CONFIGS = {
     'googlenet': dict(bs=128, published='1149 ms/batch (111 img/s) '
                                         'K40m; 270 img/s 2xXeon6148'),
     'vgg': dict(bs=64, published='30.4 img/s (vgg19) 2xXeon6148'),
+    'vgg19': dict(bs=64, published='30.44 img/s 2xXeon6148'),
     'resnet': dict(bs=256, published='84 img/s 2xXeon6148'),
     # benchmark/README.md:113-120 "RNN / LSTM in Text Classification":
     # IMDB padded to T=100, dict 30000, 2 lstm layers + fc, peepholes,
@@ -51,6 +52,8 @@ def bench_model(model, bs, steps=12):
         'googlenet': lambda i, l: googlenet.train_network(
             i, l, class_dim=1000),
         'vgg': lambda i, l: vgg.train_network(i, l, class_dim=1000),
+        'vgg19': lambda i, l: vgg.train_network(i, l, class_dim=1000,
+                                                depth=19),
         'resnet': lambda i, l: resnet.train_network(
             i, l, class_dim=1000, depth=50),
     }
@@ -131,10 +134,63 @@ def bench_model(model, bs, steps=12):
     return bs / step_s, step_s * 1e3
 
 
+# the reference's published INFERENCE rows
+# (benchmark/IntelOptimizedPaddle.md:72-87, bs=16, 2xXeon 6148)
+INFER_CONFIGS = {
+    'resnet': dict(bs=16, published='217.69 img/s'),
+    'vgg19': dict(bs=16, published='96.75 img/s'),
+}
+
+
+def infer_model(model, bs, steps=16):
+    """Serving-path device throughput (save_inference_model ->
+    AnalysisPredictor BN fold -> bench.serving_throughput's async
+    N/2N-differenced loop) — the SAME measurement as bench.py's
+    infer_*_device_images_per_sec leg, shared so it cannot drift."""
+    import tempfile
+    import jax
+    import paddle_tpu as fluid
+    from paddle_tpu import unique_name
+    from paddle_tpu.inference import AnalysisConfig, AnalysisPredictor
+    from paddle_tpu.models import resnet, vgg
+    from bench import serving_throughput
+
+    builders = {
+        'resnet': lambda i: resnet.resnet_imagenet(
+            i, class_dim=1000, depth=50, is_test=True),
+        'vgg19': lambda i: vgg.vgg19(i, class_dim=1000, is_test=True),
+    }
+    with unique_name.guard():
+        main, start = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, start):
+            img = fluid.layers.data(name='img', shape=[3, 224, 224],
+                                    dtype='float32')
+            pred = builders[model](img)
+        scope = fluid.Scope()
+        exe = fluid.Executor(fluid.TPUPlace())
+        with tempfile.TemporaryDirectory() as tmp:
+            with fluid.scope_guard(scope):
+                exe.run(start)
+                fluid.io.save_inference_model(tmp, ['img'], [pred], exe,
+                                              main_program=main)
+            p = AnalysisPredictor(AnalysisConfig(tmp,
+                                                 place=fluid.TPUPlace()))
+        rng = np.random.RandomState(0)
+        feed = {p.get_input_names()[0]: jax.device_put(
+            rng.rand(bs, 3, 224, 224).astype('f4'))}
+        per_sec, ms = serving_throughput(p, feed, bs, steps)
+        if per_sec is None:
+            return float('nan'), float('nan')
+        return per_sec, ms
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument('--models', nargs='+', choices=sorted(CONFIGS),
                     default=['alexnet', 'googlenet'])
+    ap.add_argument('--infer', nargs='*', choices=sorted(INFER_CONFIGS),
+                    help='also run the published INFERENCE rows '
+                         '(no args = all)')
     args = ap.parse_args()
     print('| model | bs | img/s (this chip) | ms/batch | published |')
     print('|---|---|---|---|---|')
@@ -142,6 +198,13 @@ def main():
         cfg = CONFIGS[m]
         ips, ms = bench_model(m, cfg['bs'])
         print('| %s | %d | %.0f | %.1f | %s |'
+              % (m, cfg['bs'], ips, ms, cfg['published']), flush=True)
+    infer = args.infer if args.infer else (
+        sorted(INFER_CONFIGS) if args.infer is not None else [])
+    for m in infer:
+        cfg = INFER_CONFIGS[m]
+        ips, ms = infer_model(m, cfg['bs'])
+        print('| %s INFER | %d | %.0f | %.2f | %s |'
               % (m, cfg['bs'], ips, ms, cfg['published']), flush=True)
 
 
