@@ -331,11 +331,12 @@ def serving_throughput(predictor, feed, batch, iters):
     async predictor.run(return_numpy=False) on a device-resident feed,
     fetch once, N/2N differenced. Shared by bench_inference and
     tools/bench_published_models so the measurement cannot drift.
-    Returns (per_sec, ms_per_batch), or (None, None) when the
-    differencing is noise-invalid — the guard rejects near-zero
-    differences (an absurd clamped value must never enter an artifact)
-    while accepting RTT-dominated-but-real ones (w2−w1 legitimately
-    shrinks toward N·step as the per-sync constant grows)."""
+    Returns (per_sec, ms_per_batch), or (None, None) when no valid
+    measurement was reached. Validity requires the differenced step
+    work to DOMINATE the run (d > 0.5·w1): in the sync-constant-
+    dominated regime, constant jitter can masquerade as step time, so
+    instead of loosening acceptance the loop self-sizes — N doubles
+    until step work out-weighs the constant (or a cap is hit)."""
     def _loop(n):
         t0 = time.perf_counter()
         r = None
@@ -344,11 +345,13 @@ def serving_throughput(predictor, feed, batch, iters):
         np.asarray(r[0])
         return time.perf_counter() - t0
     _loop(3)
-    w1, w2 = _loop(iters), _loop(2 * iters)
-    d = w2 - w1
-    if d <= max(0.05 * w1, 1e-3):
-        return None, None
-    return batch * iters / d, d / iters * 1e3
+    for _ in range(4):
+        w1, w2 = _loop(iters), _loop(2 * iters)
+        d = w2 - w1
+        if d > 0.5 * w1:
+            return batch * iters / d, d / iters * 1e3
+        iters *= 2
+    return None, None
 
 
 def bench_inference(on_tpu):

@@ -28,9 +28,15 @@ CONFIGS = {
                                       '627 img/s 2xXeon6148'),
     'googlenet': dict(bs=128, published='1149 ms/batch (111 img/s) '
                                         'K40m; 270 img/s 2xXeon6148'),
-    'vgg': dict(bs=64, published='30.4 img/s (vgg19) 2xXeon6148'),
+    # 'vgg' is the depth-16 benchmark-suite model — NOT head-to-head
+    # with the published number (which is VGG-19; see the vgg19 row)
+    'vgg': dict(bs=64, published='(vgg16; published row is vgg19)'),
     'vgg19': dict(bs=64, published='30.44 img/s 2xXeon6148'),
     'resnet': dict(bs=256, published='84 img/s 2xXeon6148'),
+    # benchmark/README.md:53-59 "SmallNet" (the caffe cifar10_quick
+    # net, benchmark/paddle/image/smallnet_mnist_cifar.py): 32x32x3,
+    # conv5/32 maxpool conv5/32 avgpool conv3/64 avgpool fc64 fc10
+    'smallnet': dict(bs=256, published='33.1 ms/batch K40m (bs=256)'),
     # benchmark/README.md:113-120 "RNN / LSTM in Text Classification":
     # IMDB padded to T=100, dict 30000, 2 lstm layers + fc, peepholes,
     # hidden 512, bs 64 -> 184 ms/batch on the v0.9 K40m stack
@@ -74,18 +80,42 @@ def bench_model(model, bs, steps=12):
         cost = fluid.layers.cross_entropy(input=predict, label=lbl)
         return None, fluid.layers.mean(cost), None
 
+    def smallnet(img, lbl):
+        """benchmark/paddle/image/smallnet_mnist_cifar.py (the caffe
+        cifar10_quick shape)."""
+        net = fluid.layers.conv2d(input=img, num_filters=32,
+                                  filter_size=5, padding=2, act='relu')
+        net = fluid.layers.pool2d(input=net, pool_size=3, pool_stride=2,
+                                  pool_padding=1, pool_type='max')
+        net = fluid.layers.conv2d(input=net, num_filters=32,
+                                  filter_size=5, padding=2, act='relu')
+        net = fluid.layers.pool2d(input=net, pool_size=3, pool_stride=2,
+                                  pool_padding=1, pool_type='avg')
+        net = fluid.layers.conv2d(input=net, num_filters=64,
+                                  filter_size=3, padding=1, act='relu')
+        net = fluid.layers.pool2d(input=net, pool_size=3, pool_stride=2,
+                                  pool_padding=1, pool_type='avg')
+        net = fluid.layers.fc(input=net, size=64, act='relu')
+        predict = fluid.layers.fc(input=net, size=10, act='softmax')
+        cost = fluid.layers.cross_entropy(input=predict, label=lbl)
+        return None, fluid.layers.mean(cost), None
+
     with unique_name.guard():
         main, start = fluid.Program(), fluid.Program()
         with fluid.program_guard(main, start):
             if model == 'lstm':
                 img = fluid.layers.data(name='img', shape=[1],
                                         dtype='int64', lod_level=1)
+            elif model == 'smallnet':
+                img = fluid.layers.data(name='img', shape=[3, 32, 32],
+                                        dtype='float32')
             else:
                 img = fluid.layers.data(name='img', shape=[3, 224, 224],
                                         dtype='float32')
             lbl = fluid.layers.data(name='lbl', shape=[1],
                                     dtype='int64')
             builders['lstm'] = lstm_text_class
+            builders['smallnet'] = smallnet
             _, loss, _ = builders[model](img, lbl)
             opt = fluid.optimizer.Momentum(learning_rate=1e-3,
                                            momentum=0.9)
@@ -99,7 +129,14 @@ def bench_model(model, bs, steps=12):
                                         loss_name=loss.name,
                                         main_program=main, scope=scope)
             rng = np.random.RandomState(0)
-            if model == 'lstm':
+            if model == 'smallnet':
+                feed = {
+                    'img': jax.device_put(
+                        rng.rand(bs, 3, 32, 32).astype('f4')),
+                    'lbl': jax.device_put(
+                        rng.randint(0, 10, (bs, 1)).astype('int64')),
+                }
+            elif model == 'lstm':
                 # IMDB-shaped synthetic: padded T=100 (the published
                 # row pads too), dict 30000. Tiny feed (~50 KB) — the
                 # tunnel upload is negligible at this size.
