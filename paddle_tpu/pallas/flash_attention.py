@@ -82,6 +82,14 @@ if not _FORCE_ARM and _os.environ.get('PADDLE_FLASH_ONEPASS', '') in (
 # guards may silently swap a forced arm for 'split', so measurement
 # tools must check this rather than trust the arm they requested
 _RESOLVED_ARM = ''
+# There is deliberately NO forward-arm choice: a 'boundmax' fwd
+# (precomputed Cauchy-Schwarz row bound M ≥ max(s_row) replacing the
+# online max/corr/rescale chain — softmax is shift-invariant, so o and
+# lse = M + log Σ exp(s−M) stay exact in exact arithmetic) was built
+# and measured in round 5: ≲10% faster, UNRESOLVED inside the chip's
+# noise band, while dq parity degraded 4x (2.2e-2 → 9e-2 vs naive —
+# the bound-shifted accumulation loses mantissa). Dropped; the online
+# kernel stands (PERF.md round-5 boundmax note).
 # clamp block index maps during causally-skipped grid steps so the
 # dead prefetch DMAs are elided (trace-time; off only for A/B)
 _CLAMP_SKIPPED_DMA = True
