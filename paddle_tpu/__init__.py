@@ -68,7 +68,8 @@ from . import channels
 from .channels import make_channel
 from . import trainer
 from .trainer import (Trainer, CheckpointConfig, BeginEpochEvent,
-                      EndEpochEvent, BeginStepEvent, EndStepEvent)
+                      EndEpochEvent, BeginStepEvent, EndStepEvent,
+                      FaultEvent)
 from . import average
 from . import evaluator
 from . import inferencer

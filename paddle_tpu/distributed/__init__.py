@@ -15,9 +15,13 @@ The wire format ships SelectedRows natively (rows + values) so sparse
 embedding gradients cost O(touched rows), not O(vocab) — the bandwidth
 win that motivates the parameter-server design for CTR models.
 """
-from .rpc import PSClient, PSServer, get_client, close_all_clients
+from .rpc import (PSClient, PSServer, get_client, close_all_clients,
+                  RetryableRPCError, FatalRPCError)
+from .resilience import FaultPlan, RetryPolicy
 from .param_service import ParameterService
 from .env import ClusterEnv, cluster_from_env
 
 __all__ = ['PSClient', 'PSServer', 'ParameterService', 'get_client',
-           'close_all_clients', 'ClusterEnv', 'cluster_from_env']
+           'close_all_clients', 'ClusterEnv', 'cluster_from_env',
+           'RetryableRPCError', 'FatalRPCError', 'FaultPlan',
+           'RetryPolicy']

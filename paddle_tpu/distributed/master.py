@@ -24,13 +24,16 @@ into an ordinary sample generator, so the whole data stack
 """
 from __future__ import annotations
 
+import binascii
 import json
 import os
 import socket
 import threading
 import time
+from collections import deque
 
 from . import wire
+from .resilience import FatalRPCError, RetryableRPCError, RetryPolicy
 
 __all__ = ['TaskMaster', 'MasterServer', 'MasterClient', 'task_reader']
 
@@ -189,11 +192,24 @@ class TaskMaster(object):
 
 
 class MasterServer(object):
-    """TCP front end over a TaskMaster (wire.py framing, JSON meta)."""
+    """TCP front end over a TaskMaster (wire.py framing, JSON meta).
+
+    Replay idempotency: every reply is cached under the request's
+    (incarnation, seq) token. A MasterClient that lost a reply to a
+    dropped connection replays the request on a fresh connection and
+    receives the ORIGINAL reply — a replayed GET_TASK does not lease a
+    second task, and a replayed TASK_FINISHED does not read as a stale
+    lease (the at-most-once contract the Go master gets from net/rpc
+    call sequencing)."""
+
+    _REPLY_CACHE_MAX = 1024
 
     def __init__(self, endpoint, master=None, bind_retry_secs=10.0,
                  **master_kwargs):
         self.master = master or TaskMaster(**master_kwargs)
+        self._replies = {}            # (cli, seq) -> reply meta
+        self._reply_order = deque()
+        self._reply_lock = threading.Lock()
         host, port = endpoint.rsplit(':', 1)
         self._lsock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._lsock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -241,34 +257,57 @@ class MasterServer(object):
             t.start()
             self._threads.append(t)
 
+    def _cached_reply(self, key):
+        if key is None:
+            return None
+        with self._reply_lock:
+            return self._replies.get(key)
+
+    def _remember_reply(self, key, reply):
+        if key is None:
+            return
+        with self._reply_lock:
+            if key in self._replies:
+                return
+            self._replies[key] = reply
+            self._reply_order.append(key)
+            while len(self._reply_order) > self._REPLY_CACHE_MAX:
+                self._replies.pop(self._reply_order.popleft(), None)
+
     def _serve_conn(self, conn):
         try:
             while not self._stop.is_set():
                 msg_type, meta, _ = wire.read_msg(conn)
+                seq = meta.get('seq')
+                key = (meta.get('cli'), seq) if seq is not None else None
+                reply = self._cached_reply(key)
+                if reply is not None:   # replay: resend, don't re-apply
+                    wire.write_msg(conn, wire.REPLY_OK, reply)
+                    continue
                 if msg_type == GET_TASK:
                     tid, payload, lease = self.master.get_task(
                         meta.get('worker', '?'))
-                    wire.write_msg(conn, wire.REPLY_OK,
-                                   {'task_id': tid, 'payload': payload,
-                                    'lease_id': lease,
-                                    'drained': self.master.all_done()})
+                    reply = {'task_id': tid, 'payload': payload,
+                             'lease_id': lease,
+                             'drained': self.master.all_done()}
                 elif msg_type == TASK_FINISHED:
-                    ok = self.master.task_finished(
-                        meta['task_id'], meta.get('lease_id'))
-                    wire.write_msg(conn, wire.REPLY_OK, {'ok': ok})
+                    reply = {'ok': self.master.task_finished(
+                        meta['task_id'], meta.get('lease_id'))}
                 elif msg_type == TASK_FAILED:
-                    ok = self.master.task_failed(
-                        meta['task_id'], meta.get('lease_id'))
-                    wire.write_msg(conn, wire.REPLY_OK, {'ok': ok})
+                    reply = {'ok': self.master.task_failed(
+                        meta['task_id'], meta.get('lease_id'))}
                 elif msg_type == SET_DATASET:
-                    p = self.master.set_dataset(meta['payloads'])
-                    wire.write_msg(conn, wire.REPLY_OK, {'pass': p})
+                    reply = {'pass': self.master.set_dataset(
+                        meta['payloads'])}
                 elif msg_type == MASTER_STATUS:
-                    wire.write_msg(conn, wire.REPLY_OK,
-                                   self.master.status())
+                    reply = self.master.status()
                 else:
                     wire.write_msg(conn, wire.REPLY_ERR,
-                                   {'error': 'unknown msg %d' % msg_type})
+                                   {'error': 'unknown msg %d' % msg_type,
+                                    'retryable': False})
+                    continue
+                self._remember_reply(key, reply)
+                wire.write_msg(conn, wire.REPLY_OK, reply)
         except (ConnectionError, OSError):
             pass
         finally:
@@ -308,27 +347,79 @@ class MasterServer(object):
 
 
 class MasterClient(object):
+    """Worker-side client with the same reconnect/replay discipline as
+    PSClient: seq-numbered requests, transparent reconnect under the
+    shared RetryPolicy, and replay against the master's reply cache (a
+    restarted MASTER also re-serves: connect retries cover its re-bind
+    window, and TaskMaster recovery re-queues leases)."""
+
     def __init__(self, endpoint, worker='worker', timeout=60.0,
-                 connect_retry_secs=60.0):
+                 connect_retry_secs=60.0, retry_policy=None):
         self.worker = worker
+        self.timeout = timeout
         host, port = endpoint.rsplit(':', 1)
-        deadline = time.monotonic() + connect_retry_secs
+        self._addr = (host, int(port))
+        self._retry = retry_policy or RetryPolicy.from_flags()
+        self._incarnation = binascii.hexlify(os.urandom(6)).decode()
+        self._seq = 0
+        self._sock = None
+        self._lock = threading.Lock()
+        self._connect(connect_retry_secs)
+
+    def _connect(self, retry_secs):
+        deadline = time.monotonic() + retry_secs
         while True:
             try:
                 self._sock = socket.create_connection(
-                    (host, int(port)), timeout=timeout)
-                break
+                    self._addr, timeout=self.timeout)
+                return
             except (ConnectionRefusedError, OSError):
                 if time.monotonic() >= deadline:
                     raise
                 time.sleep(0.2)
-        self._lock = threading.Lock()
+
+    def _drop_socket(self):
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
 
     def _call(self, msg_type, meta):
+        meta = dict(meta)
+        meta['worker'] = self.worker
         with self._lock:
-            wire.write_msg(self._sock, msg_type, meta)
-            _, reply, _ = wire.read_msg(self._sock)
-            return reply
+            self._seq += 1
+            meta['seq'] = self._seq
+            meta['cli'] = self._incarnation
+            last_err = None
+            for delay in self._retry.schedule():
+                if delay:
+                    time.sleep(delay)
+                try:
+                    if self._sock is None:
+                        self._connect(self._retry.reconnect_secs)
+                    wire.write_msg(self._sock, msg_type, meta)
+                    rtype, reply, _ = wire.read_msg(self._sock)
+                except FatalRPCError:
+                    self._drop_socket()
+                    raise
+                except (ConnectionError, OSError) as e:
+                    last_err = e
+                    self._drop_socket()
+                    continue
+                if rtype == wire.REPLY_ERR:
+                    err = 'master: %s' % reply.get('error')
+                    if reply.get('retryable'):
+                        last_err = RetryableRPCError(err)
+                        continue
+                    raise FatalRPCError(err)
+                return reply
+            raise RetryableRPCError(
+                'master unreachable after %d attempts (%s: %s)'
+                % (self._retry.max_attempts, type(last_err).__name__,
+                   last_err)) from last_err
 
     def set_dataset(self, payloads):
         return self._call(SET_DATASET, {'payloads': list(payloads)})
@@ -336,7 +427,7 @@ class MasterClient(object):
     def get_task(self):
         """(task_id, payload, drained); remembers the lease id for
         the matching task_finished/task_failed call."""
-        r = self._call(GET_TASK, {'worker': self.worker})
+        r = self._call(GET_TASK, {})
         tid = r.get('task_id')
         if tid is not None:
             self._leases = getattr(self, '_leases', {})
@@ -357,10 +448,7 @@ class MasterClient(object):
         return self._call(MASTER_STATUS, {})
 
     def close(self):
-        try:
-            self._sock.close()
-        except OSError:
-            pass
+        self._drop_socket()
 
 
 def task_reader(client, make_samples, poll_secs=0.5):

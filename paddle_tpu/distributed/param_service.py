@@ -35,10 +35,21 @@ accounted for (completed or dead).
 Sparse merge: SelectedRows from several trainers concatenate rows/values
 (duplicate rows are legal — optimizer scatter-adds merge them), then
 values scale by 1/num_trainers in sync mode.
+
+Idempotent replay: a reconnecting PSClient replays a request whose
+reply was lost (see distributed/rpc.py). Every mutating handler
+(SEND_VAR / BATCH_BARRIER / CHECKPOINT) consults a bounded per-trainer
+dedup window keyed on the request's (incarnation, seq) token: an
+already-applied mutation is acknowledged WITHOUT re-applying, so a
+retried gradient or barrier never double-counts in a sync round
+(`FLAGS_rpc_dedup_window` bounds the memory). Read-only handlers
+(GET_VAR / PREFETCH) simply re-execute; COMPLETE is naturally
+idempotent.
 """
 from __future__ import annotations
 
 import threading
+from collections import deque
 
 import numpy as np
 
@@ -87,6 +98,11 @@ class ParameterService(object):
         self._start = time.monotonic()
         self._last_seen = {}          # tid -> monotonic last message
         self._barrier_ever = set()    # tids past their FIRST barrier
+        # replay dedup: per-trainer window of applied (cli, seq) tokens
+        from ..flags import get_flag
+        self._dedup_window = int(get_flag('rpc_dedup_window', 512))
+        self._seq_seen = {}           # tid -> set of tokens
+        self._seq_order = {}          # tid -> deque (eviction order)
 
     # -- helpers -----------------------------------------------------------
     def _live_count(self):
@@ -214,21 +230,48 @@ class ParameterService(object):
         self._last_seen[tid] = time.monotonic()
         self._check_not_dead(tid)
 
+    def _is_replay_locked(self, tid, token):
+        """Has this (cli, seq) token already been applied for tid?"""
+        return token is not None and token in self._seq_seen.get(tid, ())
+
+    def _record_seq_locked(self, tid, token):
+        """Record an APPLIED mutation token; evict the oldest past the
+        window. Recording happens after the mutation so a handler that
+        raised leaves the token unrecorded — the client's replay gets a
+        real re-attempt, not a phantom ack."""
+        if token is None:
+            return
+        seen = self._seq_seen.setdefault(tid, set())
+        if token in seen:
+            return
+        order = self._seq_order.setdefault(tid, deque())
+        seen.add(token)
+        order.append(token)
+        while len(order) > self._dedup_window:
+            seen.discard(order.popleft())
+
     # -- service interface (called from PSServer threads) ------------------
-    def on_send_var(self, name, tid, value):
+    def on_send_var(self, name, tid, value, seq=None):
         with self._lock:
             self._enter_locked(tid)
+            if self._is_replay_locked(tid, seq):
+                return   # applied already; the lost reply is re-acked
             if not self.sync_mode and self._run_one_grad is not None:
                 self._run_one_grad(name, value)
+                self._record_seq_locked(tid, seq)
                 return
             self._pending.setdefault(name, {})[tid] = value
+            self._record_seq_locked(tid, seq)
 
-    def on_batch_barrier(self, tid):
+    def on_batch_barrier(self, tid, seq=None):
         with self._lock:
             self._enter_locked(tid)
+            if self._is_replay_locked(tid, seq):
+                return   # the round this barrier closed already ran
             self._barrier_ever.add(tid)
             self._barrier_tids.add(tid)
             self._trainer_rounds[tid] = self._trainer_rounds.get(tid, 0) + 1
+            self._record_seq_locked(tid, seq)
             self._maybe_run_round_locked()
 
     def on_get_var(self, name, tid):
@@ -247,14 +290,17 @@ class ParameterService(object):
                 self._wait_for_trainer_round_locked(tid)
             return self._prefetch(name, np.asarray(ids))
 
-    def on_checkpoint(self, dirname, tid):
+    def on_checkpoint(self, dirname, tid, seq=None):
         if self._save_params is None:
             raise RuntimeError('this pserver has no checkpoint support')
         with self._lock:
             self._enter_locked(tid)
+            if self._is_replay_locked(tid, seq):
+                return   # shard already saved for this request
             if self.sync_mode:
                 self._wait_for_trainer_round_locked(tid)
             self._save_params(dirname)
+            self._record_seq_locked(tid, seq)
 
     def on_fetch_barrier(self, tid):
         self._touch(tid)  # round already closed by the on_get_var wait

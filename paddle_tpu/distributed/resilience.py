@@ -1,0 +1,390 @@
+"""Resilience layer for the distributed stack: deterministic fault
+injection, retry policy, and the RPC failure taxonomy.
+
+The reference stack survives failures with dedicated machinery
+(GRPCClient channel retry, the Go master's lease/TaskFailed cycle) but
+offers no way to *provoke* those failures deterministically in tests.
+This module provides both halves:
+
+**Failure taxonomy** — every RPC failure is either
+
+- `RetryableRPCError` (subclass of ConnectionError): transport-level or
+  explicitly transient — a reconnect + idempotent replay is safe and is
+  performed transparently by `PSClient`/`MasterClient`;
+- `FatalRPCError` (subclass of RuntimeError): the server executed the
+  request and rejected it (zombie trainer, optimize failure, bad
+  message) — replaying cannot help; `Trainer.train` reacts by rolling
+  back to the last SUCCESS-marked checkpoint.
+
+`REPLY_ERR` wire metas carry a `retryable` bool so the classification
+crosses the wire.
+
+**RetryPolicy** — shared exponential-backoff-plus-jitter schedule used
+by every reconnecting client (flags: `rpc_max_retries`,
+`rpc_retry_backoff`, `rpc_retry_max_backoff`, `rpc_reconnect_secs`).
+
+**FaultPlan** — a declarative, seeded description of faults to inject
+at the wire layer (hooks in `wire.write_msg`/`read_msg`) and at the
+Trainer step boundary. Enabled per-process via `FLAGS_fault_plan`
+(a JSON plan, or ``seed:N`` for a generated plan), so a subprocess
+cluster test can fault exactly one role. Schema::
+
+    {"rules": [
+       {"when": "send",           # send | recv | step
+        "type": "SEND_VAR",       # wire/master msg-type name, or "*"
+        "nth": 3,                 # fire on the Nth matching event
+        "action": "drop",         # drop | close | delay | error
+        "secs": 0.2,              # delay only
+        "retryable": true}]}      # error only (default true)
+
+Counting is per-process and per (when, type): the plan is fully
+deterministic given the message sequence, which host-side RPC ops emit
+in deterministic order. Actions:
+
+- ``drop``  (send): the message is never sent; the connection is closed
+  so the failure surfaces immediately (a TCP message is only ever
+  "lost" because its connection died) — replay must re-apply it.
+- ``close`` (send): the message IS sent, then the connection closes
+  before the reply — replay of an applied mutation must be deduped.
+- ``delay``: sleep `secs`, then proceed normally.
+- ``error``: raise `RetryableRPCError` or `FatalRPCError` in place.
+
+On the recv side, ``drop`` discards the parsed message and reads the
+next one; ``close``/``delay``/``error`` mirror the send side. ``step``
+rules fire in `Trainer.train` just before a step executes.
+"""
+from __future__ import annotations
+
+import json
+import os
+import random
+import threading
+import time
+
+__all__ = ['RetryableRPCError', 'FatalRPCError', 'TransientError',
+           'RetryPolicy', 'FaultRule', 'FaultPlan', 'install_plan',
+           'clear_plan', 'active_plan', 'current_plan', 'fired_faults',
+           'on_send', 'on_recv', 'on_step']
+
+
+class RetryableRPCError(ConnectionError):
+    """Transient RPC failure: reconnect + idempotent replay is safe."""
+
+
+# alias: injected transient faults and server-side transient rejections
+TransientError = RetryableRPCError
+
+
+class FatalRPCError(RuntimeError):
+    """Non-retryable RPC failure: the server rejected the request (or
+    retries were escalated); replay cannot help."""
+
+
+# ---------------------------------------------------------------------------
+# retry policy
+# ---------------------------------------------------------------------------
+
+class RetryPolicy(object):
+    """Exponential backoff with jitter, shared by every RPC client.
+
+    `schedule()` yields the sleep-before-attempt time for each attempt:
+    0.0 for the first try, then backoff * multiplier^k (capped at
+    max_backoff) with up to `jitter` fractional randomization so a
+    cluster of replaying trainers doesn't thundering-herd the pserver.
+    """
+
+    def __init__(self, max_attempts=5, backoff=0.05, max_backoff=2.0,
+                 multiplier=2.0, jitter=0.25, reconnect_secs=3.0,
+                 seed=None):
+        self.max_attempts = max(1, int(max_attempts))
+        self.backoff = float(backoff)
+        self.max_backoff = float(max_backoff)
+        self.multiplier = float(multiplier)
+        self.jitter = float(jitter)
+        self.reconnect_secs = float(reconnect_secs)
+        self.seed = seed
+
+    @classmethod
+    def from_flags(cls):
+        from ..flags import get_flag
+        return cls(max_attempts=int(get_flag('rpc_max_retries', 5)),
+                   backoff=float(get_flag('rpc_retry_backoff', 0.05)),
+                   max_backoff=float(get_flag('rpc_retry_max_backoff',
+                                              2.0)),
+                   reconnect_secs=float(get_flag('rpc_reconnect_secs',
+                                                 3.0)))
+
+    def schedule(self):
+        rng = random.Random(self.seed)
+        delay = self.backoff
+        for attempt in range(self.max_attempts):
+            if attempt == 0:
+                yield 0.0
+            else:
+                yield delay * (1.0 + self.jitter * rng.random())
+                delay = min(delay * self.multiplier, self.max_backoff)
+
+
+# ---------------------------------------------------------------------------
+# fault plan
+# ---------------------------------------------------------------------------
+
+_ACTIONS = ('drop', 'close', 'delay', 'error')
+_WHENS = ('send', 'recv', 'step')
+
+
+def _type_names():
+    """Message-type name -> int over the wire + master namespaces."""
+    from . import wire, master
+    names = {'*': '*'}
+    for mod in (wire, master):
+        for k, v in vars(mod).items():
+            if not k.startswith('_') and k.isupper() and isinstance(v, int):
+                names[k] = v
+    return names
+
+
+class FaultRule(object):
+    def __init__(self, when, nth, action, type='*', secs=0.1,
+                 retryable=True):
+        if when not in _WHENS:
+            raise ValueError('bad when %r (one of %s)' % (when, _WHENS))
+        if action not in _ACTIONS:
+            raise ValueError('bad action %r (one of %s)'
+                             % (action, _ACTIONS))
+        self.when = when
+        self.type = type
+        self.nth = int(nth)
+        self.action = action
+        self.secs = float(secs)
+        self.retryable = bool(retryable)
+
+    def to_dict(self):
+        d = {'when': self.when, 'type': self.type, 'nth': self.nth,
+             'action': self.action}
+        if self.action == 'delay':
+            d['secs'] = self.secs
+        if self.action == 'error':
+            d['retryable'] = self.retryable
+        return d
+
+
+class FaultPlan(object):
+    """An ordered set of FaultRules; see the module docstring schema."""
+
+    def __init__(self, rules, seed=None):
+        self.rules = list(rules)
+        self.seed = seed
+
+    # -- construction ------------------------------------------------------
+    @classmethod
+    def from_dict(cls, d):
+        return cls([FaultRule(**r) for r in d.get('rules', [])],
+                   seed=d.get('seed'))
+
+    @classmethod
+    def from_json(cls, s):
+        return cls.from_dict(json.loads(s))
+
+    @classmethod
+    def from_spec(cls, spec):
+        """``seed:N`` | a JSON object string | a path to a JSON file."""
+        spec = spec.strip()
+        if spec.startswith('seed:'):
+            return cls.from_seed(int(spec[len('seed:'):]))
+        if spec.startswith('{'):
+            return cls.from_json(spec)
+        with open(spec) as f:
+            return cls.from_json(f.read())
+
+    @classmethod
+    def from_seed(cls, seed, max_rules=3, max_nth=10):
+        """Deterministically generate a plan from a seed: 1..max_rules
+        send-side faults over the trainer->pserver message types, mostly
+        transient (drop/close/delay/retryable error) with a small chance
+        of a fatal error — the chaos_sweep distribution."""
+        rng = random.Random(seed)
+        types = ['SEND_VAR', 'BATCH_BARRIER', 'GET_VAR', 'FETCH_BARRIER']
+        rules = []
+        for _ in range(rng.randint(1, max_rules)):
+            roll = rng.random()
+            if roll < 0.30:
+                action, kw = 'drop', {}
+            elif roll < 0.60:
+                action, kw = 'close', {}
+            elif roll < 0.80:
+                action, kw = 'delay', {'secs': round(
+                    0.05 + 0.25 * rng.random(), 3)}
+            elif roll < 0.95:
+                action, kw = 'error', {'retryable': True}
+            else:
+                action, kw = 'error', {'retryable': False}
+            rules.append(FaultRule('send', rng.randint(1, max_nth),
+                                   action, type=rng.choice(types), **kw))
+        return cls(rules, seed=seed)
+
+    def to_json(self):
+        d = {'rules': [r.to_dict() for r in self.rules]}
+        if self.seed is not None:
+            d['seed'] = self.seed
+        return json.dumps(d)
+
+
+# ---------------------------------------------------------------------------
+# per-process installation + hook implementation
+# ---------------------------------------------------------------------------
+
+_lock = threading.Lock()
+_plan = None          # active FaultPlan or None
+_counts = {}          # (when, type_key) -> messages seen
+_fired = []           # audit log of fired rules
+_names = None         # msg-type name map, resolved lazily
+
+
+def install_plan(plan):
+    """Activate `plan` process-wide and reset the event counters."""
+    global _plan
+    with _lock:
+        _plan = plan
+        _counts.clear()
+        del _fired[:]
+
+
+def clear_plan():
+    install_plan(None)
+
+
+def current_plan():
+    return _plan
+
+
+def fired_faults():
+    """Audit log: [{'when','type','nth','action'}, ...] fired so far."""
+    with _lock:
+        return [dict(f) for f in _fired]
+
+
+class active_plan(object):
+    """Context manager: install a plan for the block, then restore."""
+
+    def __init__(self, plan):
+        self.plan = plan
+
+    def __enter__(self):
+        self._prev = _plan
+        install_plan(self.plan)
+        return self.plan
+
+    def __exit__(self, *exc):
+        install_plan(self._prev)
+
+
+def _match_locked(when, msg_type):
+    """Advance counters for one event; return the rule to fire or None.
+    Must run under _lock so concurrent connections count atomically."""
+    global _names
+    if _names is None:
+        _names = _type_names()
+    hit = None
+    keys = (msg_type, '*') if msg_type != '*' else ('*',)
+    for key in keys:
+        n = _counts.get((when, key), 0) + 1
+        _counts[(when, key)] = n
+        for rule in _plan.rules:
+            if rule.when != when or rule.nth != n:
+                continue
+            rtype = _names.get(rule.type, rule.type)
+            if rtype != key:
+                continue
+            hit = rule
+    if hit is not None:
+        _fired.append({'when': when, 'type': hit.type, 'nth': hit.nth,
+                       'action': hit.action})
+    return hit
+
+
+def _close_quietly(sock):
+    try:
+        sock.close()
+    except OSError:
+        pass
+
+
+def _raise_for(rule, where):
+    msg = 'fault injection: %s at %s (rule %s)' % (rule.action, where,
+                                                   rule.to_dict())
+    if rule.action == 'error' and not rule.retryable:
+        raise FatalRPCError(msg)
+    raise RetryableRPCError(msg)
+
+
+def on_send(sock, msg_type, meta):
+    """wire.write_msg hook, called BEFORE the frame hits the socket.
+    Returns None, or a callable to run AFTER the frame was sent (the
+    'close' action: message delivered, connection then dies)."""
+    if _plan is None:
+        return None
+    with _lock:
+        rule = _match_locked('send', msg_type)
+    if rule is None:
+        return None
+    if rule.action == 'delay':
+        time.sleep(rule.secs)
+        return None
+    if rule.action == 'drop':
+        _close_quietly(sock)
+        raise RetryableRPCError(
+            'fault injection: dropped msg type %s (rule %s)'
+            % (msg_type, rule.to_dict()))
+    if rule.action == 'close':
+        return lambda: _close_quietly(sock)
+    _raise_for(rule, 'send of msg type %s' % msg_type)
+
+
+def on_recv(sock, msg_type, meta):
+    """wire.read_msg hook, called AFTER a full frame was parsed (framing
+    stays intact). Returns 'drop' to discard the message and read the
+    next one, else None."""
+    if _plan is None:
+        return None
+    with _lock:
+        rule = _match_locked('recv', msg_type)
+    if rule is None:
+        return None
+    if rule.action == 'delay':
+        time.sleep(rule.secs)
+        return None
+    if rule.action == 'drop':
+        return 'drop'
+    if rule.action == 'close':
+        _close_quietly(sock)
+        raise ConnectionError(
+            'fault injection: closed on recv of msg type %s' % msg_type)
+    _raise_for(rule, 'recv of msg type %s' % msg_type)
+
+
+def on_step():
+    """Trainer step hook: fires 'step' rules (delay sleeps; drop/close/
+    error all raise per the rule's retryable classification)."""
+    if _plan is None:
+        return
+    with _lock:
+        rule = _match_locked('step', '*')
+    if rule is None:
+        return
+    if rule.action == 'delay':
+        time.sleep(rule.secs)
+        return
+    _raise_for(rule, 'trainer step')
+
+
+def _install_from_flags():
+    """FLAGS_fault_plan (env-bootstrapped) activates a plan for this
+    process — how subprocess cluster tests fault exactly one role."""
+    from ..flags import get_flag
+    spec = get_flag('fault_plan', '') or ''
+    if spec:
+        install_plan(FaultPlan.from_spec(spec))
+
+
+_install_from_flags()

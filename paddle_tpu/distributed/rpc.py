@@ -8,51 +8,132 @@ wire format in wire.py. Each trainer holds one persistent connection per
 pserver; the server runs one thread per connection and dispatches into a
 service object (param_service.ParameterService) — the threading shape of
 the reference's RunSyncLoop server.
+
+Resilience (see distributed/resilience.py): a PSClient survives a dropped
+connection mid-training. Every request carries a `seq` number plus a
+per-client incarnation nonce; on any transport failure the client closes
+the poisoned socket, reconnects under the shared RetryPolicy
+(exponential backoff + jitter), and REPLAYS the request with the SAME
+seq. The ParameterService keeps a per-trainer dedup window, so a replay
+of an already-applied mutation (SEND_VAR / BATCH_BARRIER / CHECKPOINT)
+is acknowledged without being applied twice — a retried gradient never
+double-counts in a sync round. REPLY_ERR metas carry `retryable`:
+transient server rejections re-enter the retry loop, fatal ones raise
+FatalRPCError (the reference GRPCClient's channel-retry/backoff model
+plus at-most-once semantics that gRPC got from request ids).
 """
 from __future__ import annotations
 
+import binascii
+import os
 import socket
 import threading
 import time
 
 from . import wire
+from .resilience import FatalRPCError, RetryableRPCError, RetryPolicy
 
-__all__ = ['PSClient', 'PSServer', 'get_client', 'close_all_clients']
+__all__ = ['PSClient', 'PSServer', 'get_client', 'close_all_clients',
+           'RetryableRPCError', 'FatalRPCError']
 
 
 class PSClient(object):
-    """One trainer's connection to one pserver endpoint."""
+    """One trainer's (self-healing) connection to one pserver endpoint."""
 
     def __init__(self, endpoint, trainer_id=0, timeout=120.0,
-                 connect_retry_secs=60.0):
+                 connect_retry_secs=60.0, retry_policy=None):
         self.endpoint = endpoint
         self.trainer_id = trainer_id
+        self.timeout = timeout
         host, port = endpoint.rsplit(':', 1)
+        self._addr = (host, int(port))
+        self._retry = retry_policy or RetryPolicy.from_flags()
+        # incarnation nonce: a RESTARTED trainer process re-using this
+        # trainer_id must not collide with seqs the server already saw
+        self._incarnation = binascii.hexlify(os.urandom(6)).decode()
+        self._seq = 0
+        self._sock = None
+        self._lock = threading.Lock()
         # trainers routinely start before their pservers finish binding
         # (reference GRPC clients block on channel readiness) — retry
-        deadline = time.monotonic() + connect_retry_secs
+        self._connect(connect_retry_secs)
+
+    # -- connection lifecycle ---------------------------------------------
+    def _connect(self, retry_secs):
+        deadline = time.monotonic() + retry_secs
         while True:
             try:
-                self._sock = socket.create_connection(
-                    (host, int(port)), timeout=timeout)
+                sock = socket.create_connection(self._addr,
+                                                timeout=self.timeout)
                 break
             except (ConnectionRefusedError, OSError):
                 if time.monotonic() >= deadline:
                     raise
                 time.sleep(0.2)
-        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        self._lock = threading.Lock()
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._sock = sock
 
+    def _drop_socket(self):
+        """Close a (possibly half-framed) socket; the next attempt
+        reconnects fresh. Never reuse a connection whose framing state
+        is unknown."""
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+
+    def _invalidate(self):
+        """Connection is beyond saving: close it AND evict this client
+        from the module pool so no later get_client() hands out a
+        poisoned instance."""
+        self._drop_socket()
+        _evict_client(self)
+
+    # -- request path ------------------------------------------------------
     def _call(self, msg_type, meta=None, value=None):
         meta = dict(meta or {})
         meta['trainer_id'] = self.trainer_id
         with self._lock:
-            wire.write_msg(self._sock, msg_type, meta, value)
-            rtype, rmeta, rvalue = wire.read_msg(self._sock)
-        if rtype == wire.REPLY_ERR:
-            raise RuntimeError('pserver %s: %s'
-                               % (self.endpoint, rmeta.get('error')))
-        return rmeta, rvalue
+            self._seq += 1
+            meta['seq'] = self._seq
+            meta['cli'] = self._incarnation
+            return self._call_locked(msg_type, meta, value)
+
+    def _call_locked(self, msg_type, meta, value):
+        last_err = None
+        for delay in self._retry.schedule():
+            if delay:
+                time.sleep(delay)
+            try:
+                if self._sock is None:
+                    self._connect(self._retry.reconnect_secs)
+                wire.write_msg(self._sock, msg_type, meta, value)
+                rtype, rmeta, rvalue = wire.read_msg(self._sock)
+            except FatalRPCError:
+                self._invalidate()
+                raise
+            except (ConnectionError, OSError) as e:
+                # transport failure mid-frame (socket.timeout included):
+                # the socket may hold misframed garbage — drop it and
+                # replay this request (same seq) on a fresh connection
+                last_err = e
+                self._drop_socket()
+                continue
+            if rtype == wire.REPLY_ERR:
+                err = 'pserver %s: %s' % (self.endpoint,
+                                          rmeta.get('error'))
+                if rmeta.get('retryable'):
+                    last_err = RetryableRPCError(err)
+                    continue
+                raise FatalRPCError(err)
+            return rmeta, rvalue
+        self._invalidate()
+        raise RetryableRPCError(
+            'pserver %s unreachable after %d attempts (%s: %s)'
+            % (self.endpoint, self._retry.max_attempts,
+               type(last_err).__name__, last_err)) from last_err
 
     def send_var(self, name, value):
         """Push a gradient (dense array or SelectedRows)."""
@@ -85,50 +166,62 @@ class PSClient(object):
         self._call(wire.COMPLETE)
 
     def close(self):
-        try:
-            self._sock.close()
-        except OSError:
-            pass
+        self._drop_socket()
 
 
-# module-level client pool: one PSClient per endpoint for this process
-# (the analog of GRPCClient's channel cache); Executor.close() drains it.
+# module-level client pool: one PSClient per (endpoint, trainer_id) for
+# this process (the analog of GRPCClient's channel cache);
+# Executor.close() drains it.
 _clients = {}
 _clients_lock = threading.Lock()
 
 
 def get_client(endpoint, trainer_id=0):
+    key = (endpoint, trainer_id)
     with _clients_lock:
-        c = _clients.get(endpoint)
+        c = _clients.get(key)
         if c is None:
-            c = _clients[endpoint] = PSClient(endpoint, trainer_id)
+            c = _clients[key] = PSClient(endpoint, trainer_id)
         return c
+
+
+def _evict_client(client):
+    """Drop a poisoned client from the pool (called by the client itself
+    while holding its own lock — take only the pool lock here)."""
+    with _clients_lock:
+        for key, c in list(_clients.items()):
+            if c is client:
+                del _clients[key]
 
 
 def close_all_clients(send_complete=True):
     """Notify every connected pserver this trainer is done and drop the
     connections (reference Executor::Close -> SendComplete)."""
     with _clients_lock:
-        for c in _clients.values():
-            if send_complete:
-                try:
-                    c.complete()
-                except (RuntimeError, OSError, ConnectionError):
-                    pass
-            c.close()
+        clients = list(_clients.values())
         _clients.clear()
+    # complete() takes each client's own lock and may evict from the
+    # pool — keep the pool lock released to avoid lock-order inversion
+    for c in clients:
+        if send_complete:
+            try:
+                c.complete()
+            except (RuntimeError, OSError, ConnectionError):
+                pass
+        c.close()
 
 
 class PSServer(object):
     """Threaded TCP server dispatching wire messages into a service.
 
-    service interface (see param_service.ParameterService):
-      on_send_var(name, trainer_id, value)
+    service interface (see param_service.ParameterService); `seq` is an
+    opaque replay-dedup token threaded from the request meta:
+      on_send_var(name, trainer_id, value, seq=None)
       on_get_var(name, trainer_id) -> value
       on_prefetch(name, trainer_id, ids) -> rows
-      on_batch_barrier(trainer_id)
+      on_batch_barrier(trainer_id, seq=None)
       on_fetch_barrier(trainer_id)
-      on_checkpoint(dirname, trainer_id)
+      on_checkpoint(dirname, trainer_id, seq=None)
       on_complete(trainer_id)  -> True when ALL trainers completed
     """
 
@@ -197,15 +290,16 @@ class PSServer(object):
         svc = self.service
         try:
             while True:
-                try:
-                    msg_type, meta, value = wire.read_msg(conn)
-                except (ConnectionError, OSError):
-                    return
+                msg_type, meta, value = wire.read_msg(conn)
                 tid = int(meta.get('trainer_id', 0))
                 name = meta.get('name')
+                # replay-dedup token: (incarnation, seq) — None for
+                # legacy clients that don't number their requests
+                seq = meta.get('seq')
+                key = (meta.get('cli'), seq) if seq is not None else None
                 try:
                     if msg_type == wire.SEND_VAR:
-                        svc.on_send_var(name, tid, value)
+                        svc.on_send_var(name, tid, value, seq=key)
                         wire.write_msg(conn, wire.REPLY_OK)
                     elif msg_type == wire.GET_VAR:
                         out = svc.on_get_var(name, tid)
@@ -214,13 +308,14 @@ class PSServer(object):
                         out = svc.on_prefetch(name, tid, value)
                         wire.write_msg(conn, wire.REPLY_VAR, value=out)
                     elif msg_type == wire.BATCH_BARRIER:
-                        svc.on_batch_barrier(tid)
+                        svc.on_batch_barrier(tid, seq=key)
                         wire.write_msg(conn, wire.REPLY_OK)
                     elif msg_type == wire.FETCH_BARRIER:
                         svc.on_fetch_barrier(tid)
                         wire.write_msg(conn, wire.REPLY_OK)
                     elif msg_type == wire.CHECKPOINT:
-                        svc.on_checkpoint(meta.get('dirname'), tid)
+                        svc.on_checkpoint(meta.get('dirname'), tid,
+                                          seq=key)
                         wire.write_msg(conn, wire.REPLY_OK)
                     elif msg_type == wire.COMPLETE:
                         all_done = svc.on_complete(tid)
@@ -229,9 +324,19 @@ class PSServer(object):
                             self.shutdown()
                     else:
                         wire.write_msg(conn, wire.REPLY_ERR,
-                                       {'error': 'bad msg type %d' % msg_type})
+                                       {'error': 'bad msg type %d'
+                                        % msg_type, 'retryable': False})
+                except (ConnectionError, OSError):
+                    return   # peer vanished mid-dispatch
                 except Exception as e:   # surface server-side op errors
-                    wire.write_msg(conn, wire.REPLY_ERR, {'error': str(e)})
+                    # classification crosses the wire: transient errors
+                    # invite a replay, everything else is fatal
+                    wire.write_msg(conn, wire.REPLY_ERR,
+                                   {'error': str(e),
+                                    'retryable': isinstance(
+                                        e, RetryableRPCError)})
+        except (ConnectionError, OSError):
+            return   # read failed / reply write failed: connection dead
         finally:
             try:
                 conn.close()

@@ -35,6 +35,19 @@ REPLY_ERR = 9       # pserver -> trainer: error (meta['error'])
 
 _HDR = struct.Struct('<IBI')   # body_len, msg_type, meta_len
 
+_resilience = None
+
+
+def _faults():
+    """Fault-injection hook module (resilience.py), resolved lazily so
+    wire stays import-light; the hooks are no-ops without an active
+    FaultPlan (FLAGS_fault_plan)."""
+    global _resilience
+    if _resilience is None:
+        from . import resilience
+        _resilience = resilience
+    return _resilience
+
 
 def _payload_of(value):
     """(meta_fields, payload_bytes) for a dense array or SelectedRows."""
@@ -72,7 +85,12 @@ def write_msg(sock, msg_type, meta=None, value=None, payload=b''):
         meta.update(vmeta)
     mb = json.dumps(meta).encode('utf-8')
     body_len = 1 + 4 + len(mb) + len(payload)
+    # fault hook BEFORE any bytes hit the wire: an injected drop/error
+    # never leaves a half-written frame on the socket
+    post_send = _faults().on_send(sock, msg_type, meta)
     sock.sendall(_HDR.pack(body_len, msg_type, len(mb)) + mb + payload)
+    if post_send is not None:
+        post_send()   # 'close' action: frame delivered, connection dies
 
 
 def _read_exact(sock, n):
@@ -89,10 +107,16 @@ def _read_exact(sock, n):
 def read_msg(sock):
     """-> (msg_type, meta dict, value or None). value is a numpy array or
     SelectedRows when the meta describes one."""
-    hdr = _read_exact(sock, _HDR.size)
-    body_len, msg_type, meta_len = _HDR.unpack(hdr)
-    body = _read_exact(sock, body_len - 1 - 4) if body_len > 5 else b''
-    meta = json.loads(body[:meta_len].decode('utf-8')) if meta_len else {}
-    payload = body[meta_len:]
-    value = _value_of(meta, payload) if 'dtype' in meta else None
-    return msg_type, meta, value
+    while True:
+        hdr = _read_exact(sock, _HDR.size)
+        body_len, msg_type, meta_len = _HDR.unpack(hdr)
+        body = _read_exact(sock, body_len - 1 - 4) if body_len > 5 else b''
+        meta = json.loads(body[:meta_len].decode('utf-8')) if meta_len \
+            else {}
+        payload = body[meta_len:]
+        # fault hook AFTER the full frame was consumed (framing stays
+        # intact); 'drop' discards this message and reads the next
+        if _faults().on_recv(sock, msg_type, meta) == 'drop':
+            continue
+        value = _value_of(meta, payload) if 'dtype' in meta else None
+        return msg_type, meta, value

@@ -24,6 +24,22 @@ Known flags:
                          falls back to the naive contraction otherwise
   pallas_interpret       run Pallas kernels in interpreter mode off-TPU
                          (numerics tests on CPU)
+  fault_plan             deterministic fault injection for the RPC layer
+                         (distributed/resilience.py): a JSON FaultPlan,
+                         a path to one, or "seed:N" for a generated
+                         plan. Per-process via FLAGS_fault_plan env.
+  rpc_max_retries / rpc_retry_backoff / rpc_retry_max_backoff /
+  rpc_reconnect_secs     shared RetryPolicy for PSClient/MasterClient
+                         transparent reconnect (attempts, initial and
+                         max backoff seconds, per-attempt reconnect
+                         budget)
+  rpc_dedup_window       per-trainer replayed-request dedup window on
+                         the ParameterService (entries, not seconds)
+  trainer_step_retries / trainer_max_rollbacks
+                         Trainer.train fault handling: re-run a step
+                         this many times on retryable RPC failure, and
+                         roll back to the last SUCCESS checkpoint at
+                         most this many times on fatal failure
 """
 from __future__ import annotations
 
@@ -64,6 +80,24 @@ _DEFAULTS = {
     # operators/distributed/rpc_client.cc — applied server-side here
     # where the round state lives)
     'rpc_deadline': 180.0,
+    # resilience layer (distributed/resilience.py): declarative fault
+    # injection plan ('' = none; JSON, file path, or "seed:N")
+    'fault_plan': '',
+    # shared exponential-backoff RetryPolicy for the reconnecting RPC
+    # clients (PSClient / MasterClient)
+    'rpc_max_retries': 5,
+    'rpc_retry_backoff': 0.05,
+    'rpc_retry_max_backoff': 2.0,
+    'rpc_reconnect_secs': 3.0,
+    # per-trainer replay-dedup window on the ParameterService: replayed
+    # SEND_VAR/BATCH_BARRIER/CHECKPOINT requests inside the window are
+    # acked without re-applying
+    'rpc_dedup_window': 512,
+    # Trainer.train fault handling: step re-runs on retryable RPC
+    # failure before escalating, and checkpoint rollbacks on fatal
+    # failure before giving up
+    'trainer_step_retries': 2,
+    'trainer_max_rollbacks': 2,
     # store the Momentum velocity accumulator in bf16 (halves the
     # optimizer's dominant HBM stream; one rounding per step; master
     # params stay fp32). Off by default for exact-fp32 parity.

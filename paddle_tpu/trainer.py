@@ -32,7 +32,8 @@ from .framework import Program, program_guard, default_main_program, \
     default_startup_program
 
 __all__ = ['Trainer', 'CheckpointConfig', 'BeginEpochEvent',
-           'EndEpochEvent', 'BeginStepEvent', 'EndStepEvent']
+           'EndEpochEvent', 'BeginStepEvent', 'EndStepEvent',
+           'FaultEvent']
 
 _CHECKPOINT_PREFIX = 'checkpoint'
 _METADATA_FILE = 'TRAINER_METADATA'
@@ -61,6 +62,21 @@ class EndStepEvent(object):
         self.epoch = epoch_id
         self.step = step_id
         self.metrics = metrics
+
+
+class FaultEvent(object):
+    """A step hit an RPC/runtime fault (distributed/resilience.py
+    taxonomy). action is 'retry' (the step will re-run in place after a
+    retryable failure) or 'rollback' (fatal failure: scope + RNG state
+    restored from the last SUCCESS-marked checkpoint and training
+    resumes from there); attempt counts retries resp. rollbacks."""
+
+    def __init__(self, epoch_id, step_id, error, action, attempt=1):
+        self.epoch = epoch_id
+        self.step = step_id
+        self.error = error
+        self.action = action
+        self.attempt = attempt
 
 
 class CheckpointConfig(object):
@@ -180,28 +196,43 @@ class Trainer(object):
             shutil.rmtree(self._ckpt_path(old), ignore_errors=True)
 
     def _maybe_resume(self):
+        """Restore from the newest VALID checkpoint. A dir with no
+        SUCCESS marker is never considered (_checkpoint_ids); one whose
+        metadata is corrupt/truncated or whose tensors fail to load is
+        skipped with a warning, falling back to the next-newest — a
+        single bad checkpoint (partial write, disk corruption) must not
+        make the whole run unrecoverable."""
         cfg = self.checkpoint_cfg
         if cfg is None or not cfg.checkpoint_dir:
             return False
-        ids = _checkpoint_ids(cfg.checkpoint_dir)
-        if not ids:
-            return False
-        path = self._ckpt_path(ids[-1])
-        with scope_guard(self.scope):
-            io_mod.load_persistables(self.exe, path,
-                                     main_program=self.train_program)
-        with open(os.path.join(path, _METADATA_FILE)) as f:
-            meta = json.load(f)
-        self.epoch_id = int(meta['epoch_id'])
-        self.step_id = int(meta['step_id']) + 1   # resume AFTER that step
-        # restore the RNG step counter AND base key: dropout streams
-        # continue exactly (also applied to the ParallelExecutor when
-        # one is created)
-        self._restored_step = int(meta.get('exe_step', 0))
-        self._restored_rng = (meta.get('rng_seed'),
-                              meta.get('rng_seed_used'))
-        self._apply_rng_state(self.exe)
-        return True
+        for ckpt_id in reversed(_checkpoint_ids(cfg.checkpoint_dir)):
+            path = self._ckpt_path(ckpt_id)
+            try:
+                with open(os.path.join(path, _METADATA_FILE)) as f:
+                    meta = json.load(f)
+                epoch_id = int(meta['epoch_id'])
+                step_id = int(meta['step_id'])
+                with scope_guard(self.scope):
+                    io_mod.load_persistables(
+                        self.exe, path, main_program=self.train_program)
+            except Exception as e:
+                import sys
+                print('skipping unusable checkpoint %s: %r' % (path, e),
+                      file=sys.stderr)
+                continue
+            self.epoch_id = epoch_id
+            self.step_id = step_id + 1   # resume AFTER that step
+            # restore the RNG step counter AND base key: dropout streams
+            # continue exactly (also applied to the ParallelExecutor
+            # when one is created)
+            self._restored_step = int(meta.get('exe_step', 0))
+            self._restored_rng = (meta.get('rng_seed'),
+                                  meta.get('rng_seed_used'))
+            self._apply_rng_state(self.exe)
+            if self._pe is not None:
+                self._apply_rng_state(self._pe)
+            return True
+        return False
 
     def _apply_rng_state(self, executor):
         executor._step = getattr(self, '_restored_step', 0)
@@ -227,7 +258,57 @@ class Trainer(object):
     def train(self, num_epochs, event_handler, reader=None,
               feed_order=None):
         """reader(): generator of feed-able batches; feed_order: the
-        data-var names, matched positionally against each batch item."""
+        data-var names, matched positionally against each batch item.
+
+        Fault handling (distributed/resilience.py taxonomy): a step that
+        raises RetryableRPCError re-runs in place up to
+        FLAGS_trainer_step_retries times, then escalates; a fatal RPC
+        failure rolls training back to the last SUCCESS-marked
+        checkpoint (at most FLAGS_trainer_max_rollbacks times). Both
+        paths emit a FaultEvent to the event handler first."""
+        from .distributed.resilience import FatalRPCError
+        from .flags import get_flag
+        max_rollbacks = int(get_flag('trainer_max_rollbacks', 2))
+        rollbacks = 0
+        while True:
+            try:
+                return self._train_loop(num_epochs, event_handler,
+                                        reader, feed_order)
+            except FatalRPCError as e:
+                cfg = self.checkpoint_cfg
+                if cfg is None or not cfg.checkpoint_dir or \
+                        rollbacks >= max_rollbacks:
+                    raise
+                rollbacks += 1
+                event_handler(FaultEvent(self.epoch_id, self.step_id, e,
+                                         'rollback', rollbacks))
+                if not self._maybe_resume():
+                    raise   # no SUCCESS-marked checkpoint to fall to
+
+    def _run_step(self, pe, fetch, feed, epoch_id, step_id,
+                  event_handler):
+        from .distributed import resilience
+        from .flags import get_flag
+        retries = int(get_flag('trainer_step_retries', 2))
+        attempt = 0
+        while True:
+            try:
+                resilience.on_step()   # deterministic fault injection
+                with scope_guard(self.scope):
+                    if pe is not None:
+                        return pe.run(fetch_list=fetch, feed=feed)
+                    return self.exe.run(self.train_program, feed=feed,
+                                        fetch_list=fetch)
+            except resilience.RetryableRPCError as e:
+                attempt += 1
+                if attempt > retries:
+                    raise resilience.FatalRPCError(
+                        'step (%d, %d) failed after %d retries: %s'
+                        % (epoch_id, step_id, retries, e)) from e
+                event_handler(FaultEvent(epoch_id, step_id, e, 'retry',
+                                         attempt))
+
+    def _train_loop(self, num_epochs, event_handler, reader, feed_order):
         cfg = self.checkpoint_cfg
         start_epoch, start_step = self.epoch_id, self.step_id
         pe = self._executor()
@@ -243,13 +324,8 @@ class Trainer(object):
                 if self._stop_requested:
                     return
                 feed = dict(zip(feed_order, data))
-                with scope_guard(self.scope):
-                    if pe is not None:
-                        metrics = pe.run(fetch_list=fetch, feed=feed)
-                    else:
-                        metrics = self.exe.run(self.train_program,
-                                               feed=feed,
-                                               fetch_list=fetch)
+                metrics = self._run_step(pe, fetch, feed, epoch_id,
+                                         step_id, event_handler)
                 event_handler(EndStepEvent(epoch_id, step_id, metrics))
                 self.epoch_id, self.step_id = epoch_id, step_id
                 if cfg and cfg.checkpoint_dir and \

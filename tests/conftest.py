@@ -30,6 +30,15 @@ def pytest_configure(config):
         'markers',
         'timeout(seconds): subprocess-test budget (enforced by '
         'communicate() timeouts; informational without pytest-timeout)')
+    config.addinivalue_line(
+        'markers',
+        'slow: long-running tests excluded from the tier-1 run '
+        "(-m 'not slow')")
+    config.addinivalue_line(
+        'markers',
+        'chaos: deterministic fault-injection tests '
+        '(distributed/resilience.py harness). Deliberately NOT slow: '
+        'tier-1 must prove the stack survives faults')
 
 
 @pytest.fixture(autouse=True)

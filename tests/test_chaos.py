@@ -1,0 +1,388 @@
+"""Chaos suite: deterministic fault injection over the resilient RPC
+layer (distributed/resilience.py).
+
+What the reference stack only promises (GRPCClient channel retry, the
+Go master's lease machinery), this suite PROVES, deterministically:
+
+- a seeded FaultPlan that kills a trainer->pserver connection mid-round
+  and drops a SEND_VAR leaves sync training with EXACTLY the fault-free
+  final weights (transparent reconnect + seq-numbered idempotent
+  replay);
+- a replayed mutation is applied at most once (ParameterService dedup
+  window, MasterServer reply cache);
+- Trainer.train retries a step on retryable failure and rolls back to
+  the last SUCCESS-marked checkpoint on fatal failure, emitting
+  FaultEvents — and the post-recovery trajectory is bit-identical to an
+  undisturbed run.
+"""
+import json
+import os
+import socket
+import subprocess
+import sys
+import threading
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.distributed import resilience, wire
+from paddle_tpu.distributed.param_service import ParameterService
+from paddle_tpu.distributed.resilience import (FaultPlan, RetryPolicy,
+                                               RetryableRPCError)
+from paddle_tpu.distributed.rpc import PSClient, PSServer
+
+pytestmark = pytest.mark.chaos
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_WORKER = os.path.join(_HERE, 'ps_worker.py')
+sys.path.insert(0, _HERE)
+
+
+# ---------------------------------------------------------------------------
+# the harness itself is deterministic
+# ---------------------------------------------------------------------------
+
+def test_fault_plan_seed_determinism():
+    for seed in range(8):
+        assert FaultPlan.from_seed(seed).to_json() == \
+            FaultPlan.from_seed(seed).to_json()
+    plans = {FaultPlan.from_seed(s).to_json() for s in range(16)}
+    assert len(plans) > 4   # seeds actually vary the plan
+
+
+def test_fault_plan_roundtrip_and_fires_on_nth():
+    """The Nth SEND_VAR write raises; writes before/after pass through,
+    and the fired-fault audit log records exactly one event."""
+    plan = FaultPlan.from_json(json.dumps({'rules': [
+        {'when': 'send', 'type': 'SEND_VAR', 'nth': 2,
+         'action': 'error', 'retryable': True}]}))
+    assert FaultPlan.from_json(plan.to_json()).to_json() == plan.to_json()
+    a, b = socket.socketpair()
+    try:
+        with resilience.active_plan(plan):
+            wire.write_msg(a, wire.SEND_VAR, {'name': 'g'},
+                           np.ones(2, 'f4'))
+            with pytest.raises(RetryableRPCError):
+                wire.write_msg(a, wire.SEND_VAR, {'name': 'g'},
+                               np.ones(2, 'f4'))
+            wire.write_msg(a, wire.SEND_VAR, {'name': 'g'},
+                           np.ones(2, 'f4'))
+            # BATCH_BARRIER counts independently of SEND_VAR
+            wire.write_msg(a, wire.BATCH_BARRIER)
+            fired = resilience.fired_faults()
+        assert [f['action'] for f in fired] == ['error']
+        for _ in range(3):   # frames 1 and 3 + barrier arrived intact
+            t, meta, _ = wire.read_msg(b)
+    finally:
+        a.close()
+        b.close()
+
+
+# ---------------------------------------------------------------------------
+# server-side idempotency primitives
+# ---------------------------------------------------------------------------
+
+def _mini_service(sync_mode=True, num_trainers=1):
+    params = {'w': np.zeros(4, 'f4')}
+    rounds = []
+    singles = []
+
+    def run_round(merged):
+        rounds.append(sorted(merged))
+        for v in merged.values():
+            params['w'] = params['w'] - np.asarray(v)
+
+    def run_one_grad(name, value):
+        singles.append(name)
+        params['w'] = params['w'] - np.asarray(value)
+
+    svc = ParameterService(
+        num_trainers=num_trainers, sync_mode=sync_mode,
+        get_param=lambda name: params[name], run_round=run_round,
+        run_one_grad=run_one_grad, rpc_deadline=60.0)
+    return svc, params, rounds, singles
+
+
+def test_param_service_replayed_send_var_applies_once():
+    """Async mode applies each SEND_VAR on arrival — a replay with the
+    same (cli, seq) token must be acked without a second apply."""
+    svc, params, _, singles = _mini_service(sync_mode=False)
+    g = np.ones(4, 'f4')
+    svc.on_send_var('w@GRAD', 0, g, seq=('c1', 1))
+    svc.on_send_var('w@GRAD', 0, g, seq=('c1', 1))   # replay
+    assert singles == ['w@GRAD']
+    np.testing.assert_allclose(params['w'], -g)
+    # a NEW seq is a new request
+    svc.on_send_var('w@GRAD', 0, g, seq=('c1', 2))
+    assert singles == ['w@GRAD', 'w@GRAD']
+
+
+def test_param_service_replayed_barrier_closes_one_round():
+    """A replayed BATCH_BARRIER must not re-arm the round counter — the
+    double-applied-gradient bug the dedup window exists to prevent."""
+    svc, params, rounds, _ = _mini_service(sync_mode=True)
+    g = np.ones(4, 'f4')
+    svc.on_send_var('w@GRAD', 0, g, seq=('c1', 1))
+    svc.on_batch_barrier(0, seq=('c1', 2))
+    assert len(rounds) == 1
+    svc.on_batch_barrier(0, seq=('c1', 2))   # replay: round already ran
+    assert len(rounds) == 1
+    assert svc._trainer_rounds[0] == 1
+    np.testing.assert_allclose(params['w'], -g)
+
+
+# ---------------------------------------------------------------------------
+# client reconnect + replay, end to end over real sockets
+# ---------------------------------------------------------------------------
+
+def _fast_retry():
+    return RetryPolicy(max_attempts=5, backoff=0.01, max_backoff=0.05,
+                       reconnect_secs=5.0)
+
+
+def test_psclient_reconnects_and_replays_exactly_once():
+    """Round 1's SEND_VAR is dropped (never sent: replay must APPLY it);
+    round 2's SEND_VAR is delivered then the connection closes before
+    the reply (replay must be DEDUPED). Both rounds apply exactly once."""
+    svc, params, rounds, _ = _mini_service(sync_mode=True)
+    srv = PSServer('127.0.0.1:0', svc)
+    st = threading.Thread(target=srv.serve_forever, daemon=True)
+    st.start()
+    plan = FaultPlan([
+        resilience.FaultRule('send', 1, 'drop', type='SEND_VAR'),
+        resilience.FaultRule('send', 3, 'close', type='SEND_VAR'),
+    ])
+    g1 = np.ones(4, 'f4')
+    g2 = 2 * np.ones(4, 'f4')
+    with resilience.active_plan(plan):
+        cli = PSClient('127.0.0.1:%d' % srv.port, trainer_id=0,
+                       retry_policy=_fast_retry())
+        cli.send_var('w@GRAD', g1)     # send #1 dropped, #2 replays it
+        cli.batch_barrier()
+        np.testing.assert_allclose(cli.get_var('w'), -g1)
+        cli.send_var('w@GRAD', g2)     # send #3 delivered, conn closed,
+        cli.batch_barrier()            # replay #4 deduped server-side
+        np.testing.assert_allclose(cli.get_var('w'), -(g1 + g2))
+        cli.complete()
+        fired = resilience.fired_faults()
+    st.join(timeout=10.0)
+    assert not st.is_alive()
+    assert len(rounds) == 2            # each barrier closed ONE round
+    assert [f['action'] for f in fired] == ['drop', 'close']
+
+
+def test_master_replayed_finish_returns_cached_reply():
+    """TASK_FINISHED is delivered, then the connection dies before the
+    reply. The replay must get the ORIGINAL 'ok': True from the reply
+    cache — without it the client would read its own successful finish
+    as a stale lease."""
+    from paddle_tpu.distributed.master import MasterClient, MasterServer
+    srv = MasterServer('127.0.0.1:0', timeout_secs=30.0).start()
+    try:
+        plan = FaultPlan([
+            resilience.FaultRule('send', 1, 'close',
+                                 type='TASK_FINISHED')])
+        with resilience.active_plan(plan):
+            cli = MasterClient('127.0.0.1:%d' % srv.port, worker='w0',
+                               retry_policy=_fast_retry())
+            cli.set_dataset(['shard0'])
+            tid, payload, _ = cli.get_task()
+            assert payload == 'shard0'
+            assert cli.task_finished(tid) is True
+        status = cli.status()
+        assert status['done'] == 1 and status['pending'] == 0
+        cli.close()
+    finally:
+        srv.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# acceptance: faulted cluster == fault-free weights (subprocess, sockets)
+# ---------------------------------------------------------------------------
+
+def _free_ports(n):
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(('127.0.0.1', 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+def _run_cluster(model='mlp', steps=4, trainers=2, pservers=2,
+                 trainer0_env=None):
+    """test_dist_pserver's subprocess harness, with extra env for
+    trainer 0 only — the faulted role."""
+    eps = ','.join('127.0.0.1:%d' % p for p in _free_ports(pservers))
+    base_env = dict(os.environ)
+    base_env.pop('JAX_PLATFORMS', None)
+    base_env.pop('XLA_FLAGS', None)
+    base_env.update({'PS_MODEL': model, 'PS_ENDPOINTS': eps,
+                     'PS_TRAINERS': str(trainers), 'PS_STEPS': str(steps),
+                     'PS_SYNC': '1', 'PS_OPTIMIZER': 'sgd'})
+    procs = []
+    for i in range(pservers):
+        env = dict(base_env, PS_ROLE='pserver', PS_PSERVER_ID=str(i))
+        procs.append(subprocess.Popen(
+            [sys.executable, _WORKER], env=env, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True))
+    tprocs = []
+    for i in range(trainers):
+        env = dict(base_env, PS_ROLE='trainer', PS_TRAINER_ID=str(i))
+        if i == 0 and trainer0_env:
+            env.update(trainer0_env)
+        tprocs.append(subprocess.Popen(
+            [sys.executable, _WORKER], env=env, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True))
+    outs = []
+    for p in tprocs:
+        out, _ = p.communicate(timeout=420)
+        outs.append(out)
+    for p in procs:
+        out, _ = p.communicate(timeout=120)
+        outs.append(out)
+    for p, out in zip(tprocs + procs, outs):
+        assert p.returncode == 0, out[-4000:]
+    results = []
+    for out in outs[:trainers]:
+        line = [ln for ln in out.splitlines() if ln.startswith('RESULT ')]
+        assert line, out[-4000:]
+        results.append(json.loads(line[-1][len('RESULT '):]))
+    return results
+
+
+# mlp, 2x2, sync: 4 SEND_VARs + 2 BATCH_BARRIERs per trainer step.
+# Rule 1 loses grad #6 entirely (step 1, never sent — replay must apply
+# it); rule 2 delivers step 0's second barrier then kills the connection
+# mid-round (reply lost — replay must be deduped or the round
+# double-counts); rule 3 stalls a reply read for flavor.
+_CHAOS_PLAN = json.dumps({'rules': [
+    {'when': 'send', 'type': 'SEND_VAR', 'nth': 6, 'action': 'drop'},
+    {'when': 'send', 'type': 'BATCH_BARRIER', 'nth': 2,
+     'action': 'close'},
+    {'when': 'recv', 'type': 'REPLY_VAR', 'nth': 3, 'action': 'delay',
+     'secs': 0.05},
+]})
+
+
+@pytest.mark.timeout(600)
+def test_chaos_cluster_converges_to_fault_free_weights():
+    """THE acceptance bar: with trainer 0 under a FaultPlan that closes
+    its pserver connection mid-round and drops one SEND_VAR, sync
+    training must land on the SAME final weights as fault-free training
+    (== the local single-process baseline, the parity the fault-free
+    suite already pins). Any double-applied replay or lost gradient
+    shows up as a weight divergence here."""
+    import ps_worker
+    _, local_w = ps_worker.local_train('mlp', 4, 'sgd', 2)
+    results = _run_cluster(
+        'mlp', trainer0_env={'FLAGS_fault_plan': _CHAOS_PLAN})
+    for p, lw in local_w.items():
+        np.testing.assert_allclose(
+            np.asarray(results[0]['weights'][p]), np.asarray(lw),
+            rtol=1e-4, atol=1e-5,
+            err_msg='param %s diverged under faults' % p)
+    # both trainers still agree with each other
+    for p in local_w:
+        np.testing.assert_allclose(
+            np.asarray(results[0]['weights'][p]),
+            np.asarray(results[1]['weights'][p]), rtol=1e-6)
+    assert all(np.isfinite(results[0]['losses']))
+
+
+# ---------------------------------------------------------------------------
+# Trainer-level fault handling: step retry + checkpoint rollback
+# ---------------------------------------------------------------------------
+
+def _train_func():
+    x = fluid.layers.data(name='x', shape=[4], dtype='float32')
+    y = fluid.layers.data(name='y', shape=[1], dtype='float32')
+    pred = fluid.layers.fc(input=x, size=1,
+                           param_attr=fluid.ParamAttr(
+                               name='cw',
+                               initializer=fluid.initializer.Normal(
+                                   scale=0.1, seed=3)))
+    return fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+
+
+def _reader():
+    rng = np.random.RandomState(7)
+    w = np.linspace(-1, 1, 4).astype('float32')[:, None]
+    for _ in range(10):
+        x = rng.randn(8, 4).astype('float32')
+        yield [x, x @ w]
+
+
+def _run_trainer(ckpt_dir, plan=None):
+    from paddle_tpu import unique_name
+    unique_name.switch()
+    losses = {}
+    faults = []
+
+    def handler(event):
+        if isinstance(event, fluid.EndStepEvent):
+            losses[(event.epoch, event.step)] = float(
+                np.asarray(event.metrics[0]))
+        elif isinstance(event, fluid.FaultEvent):
+            faults.append((event.action, event.attempt))
+
+    with resilience.active_plan(plan):
+        trainer = fluid.Trainer(
+            _train_func, lambda: fluid.optimizer.Adam(0.02),
+            place=fluid.CPUPlace(),
+            checkpoint_config=fluid.CheckpointConfig(
+                checkpoint_dir=ckpt_dir, max_num_checkpoints=2,
+                step_interval=3))
+        trainer.train(num_epochs=1, event_handler=handler,
+                      reader=_reader, feed_order=['x', 'y'])
+    return losses, faults
+
+
+def test_trainer_retries_step_on_retryable_fault(tmp_path):
+    baseline, base_faults = _run_trainer(str(tmp_path / 'base'))
+    assert base_faults == []
+    plan = FaultPlan([resilience.FaultRule('step', 4, 'error',
+                                           retryable=True)])
+    losses, faults = _run_trainer(str(tmp_path / 'retry'), plan)
+    assert faults == [('retry', 1)]
+    assert set(losses) == set(baseline)        # every step completed
+    for key, v in baseline.items():
+        np.testing.assert_allclose(losses[key], v, rtol=1e-6,
+                                   err_msg='step %s' % (key,))
+
+
+def test_trainer_rolls_back_to_last_success_checkpoint(tmp_path):
+    """Fatal fault at step 7 (checkpoints exist at steps 2 and 5):
+    Trainer must emit a rollback FaultEvent, restore the step-5 SUCCESS
+    checkpoint, and replay to completion with losses bit-identical to
+    an undisturbed run — the exact-resume guarantee under faults."""
+    baseline, _ = _run_trainer(str(tmp_path / 'base'))
+    plan = FaultPlan([resilience.FaultRule('step', 8, 'error',
+                                           retryable=False)])
+    losses, faults = _run_trainer(str(tmp_path / 'roll'), plan)
+    assert ('rollback', 1) in faults
+    assert set(losses) == set(baseline)        # finished all 10 steps
+    for key, v in baseline.items():
+        np.testing.assert_allclose(losses[key], v, rtol=1e-6,
+                                   err_msg='step %s' % (key,))
+
+
+def test_trainer_fatal_without_checkpoint_raises(tmp_path):
+    """No checkpoint dir -> nothing to roll back to: the fatal fault
+    must surface, not be swallowed."""
+    from paddle_tpu import unique_name
+    from paddle_tpu.distributed.resilience import FatalRPCError
+    unique_name.switch()
+    plan = FaultPlan([resilience.FaultRule('step', 2, 'error',
+                                           retryable=False)])
+    with resilience.active_plan(plan):
+        trainer = fluid.Trainer(_train_func,
+                                lambda: fluid.optimizer.Adam(0.02),
+                                place=fluid.CPUPlace())
+        with pytest.raises(FatalRPCError):
+            trainer.train(num_epochs=1, event_handler=lambda e: None,
+                          reader=_reader, feed_order=['x', 'y'])

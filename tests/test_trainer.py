@@ -36,7 +36,7 @@ class _Abort(Exception):
     pass
 
 
-def _run(ckpt_dir, epochs=2, abort_at=None):
+def _run(ckpt_dir, epochs=2, abort_at=None, max_num_checkpoints=2):
     """One Trainer life; abort_at=(epoch, step) simulates a kill. Each
     life gets a fresh name generator, as a real process restart would."""
     from paddle_tpu import unique_name
@@ -44,7 +44,8 @@ def _run(ckpt_dir, epochs=2, abort_at=None):
     trainer = fluid.Trainer(
         _train_func, _optimizer_func, place=fluid.CPUPlace(),
         checkpoint_config=fluid.CheckpointConfig(
-            checkpoint_dir=ckpt_dir, max_num_checkpoints=2,
+            checkpoint_dir=ckpt_dir,
+            max_num_checkpoints=max_num_checkpoints,
             step_interval=3))
     seen = []
 
@@ -120,3 +121,44 @@ def test_trainer_refuses_partial_checkpoint(tmp_path):
     with open(os.path.join(ckpt, dirs[-2], 'TRAINER_METADATA')) as f:
         import json
         assert t.step_id == json.load(f)['step_id'] + 1
+
+
+def test_trainer_resume_skips_all_unusable_checkpoints(tmp_path):
+    """Resume walks newest->oldest past EVERY unusable checkpoint — one
+    missing its SUCCESS marker (killed mid-write) AND one with corrupted
+    metadata (torn disk write) — and restores the newest VALID one."""
+    import json
+    ckpt = str(tmp_path / 'ck4')
+    _run(ckpt, epochs=1, max_num_checkpoints=3)
+    dirs = sorted(d for d in os.listdir(ckpt)
+                  if d.startswith('checkpoint'))
+    assert len(dirs) == 3, dirs
+    # newest: no SUCCESS marker; 2nd-newest: garbage metadata
+    os.remove(os.path.join(ckpt, dirs[-1], '_SUCCESS'))
+    with open(os.path.join(ckpt, dirs[-2], 'TRAINER_METADATA'), 'w') as f:
+        f.write('{not json')
+    from paddle_tpu import unique_name
+    unique_name.switch()
+    t = fluid.Trainer(
+        _train_func, _optimizer_func, place=fluid.CPUPlace(),
+        checkpoint_config=fluid.CheckpointConfig(checkpoint_dir=ckpt))
+    assert t._resumed
+    with open(os.path.join(ckpt, dirs[-3], 'TRAINER_METADATA')) as f:
+        assert t.step_id == json.load(f)['step_id'] + 1
+
+
+def test_trainer_no_valid_checkpoint_starts_fresh(tmp_path):
+    """When every checkpoint is unusable, training starts from scratch
+    instead of crashing on the corrupt state."""
+    ckpt = str(tmp_path / 'ck5')
+    _run(ckpt, epochs=1)
+    for d in os.listdir(ckpt):
+        if d.startswith('checkpoint'):
+            os.remove(os.path.join(ckpt, d, '_SUCCESS'))
+    from paddle_tpu import unique_name
+    unique_name.switch()
+    t = fluid.Trainer(
+        _train_func, _optimizer_func, place=fluid.CPUPlace(),
+        checkpoint_config=fluid.CheckpointConfig(checkpoint_dir=ckpt))
+    assert not t._resumed
+    assert t.epoch_id == 0 and t.step_id == 0

@@ -1,0 +1,170 @@
+"""Chaos sweep: run the dist-training smoke under many seeded
+FaultPlans and classify each outcome.
+
+For every seed in the range, `FaultPlan.from_seed(seed)` generates a
+deterministic plan (1-3 rules over SEND_VAR / BATCH_BARRIER / GET_VAR /
+FETCH_BARRIER with drop / close / delay / error actions), trainer 0 of
+a 2x2 sync cluster runs under it via FLAGS_fault_plan, and the final
+weights are compared against the local single-process baseline. A seed
+is:
+
+  ok        faulted cluster matched the baseline weights (replay +
+            dedup held)
+  diverged  cluster finished but weights differ (a replay was lost or
+            double-applied -- a REAL bug, report the seed)
+  fatal     a worker exited non-zero (a plan with a non-retryable
+            error rule, or retries exhausted -- expected for the ~5%
+            of rules that are fatal errors)
+  hung      the cluster blew the per-seed time budget and was killed
+
+Usage:
+    python tools/chaos_sweep.py                     # seeds 0..19
+    python tools/chaos_sweep.py --seeds 100 --steps 4
+    python tools/chaos_sweep.py --seed-start 7 --seeds 1 --verbose
+
+Exit status is non-zero iff any seed DIVERGED: fatal/hung seeds are
+plan-dependent outcomes, weight divergence is never acceptable.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_ROOT = os.path.dirname(_HERE)
+sys.path.insert(0, _ROOT)
+sys.path.insert(0, os.path.join(_ROOT, 'tests'))
+
+_WORKER = os.path.join(_ROOT, 'tests', 'ps_worker.py')
+
+
+def _free_ports(n):
+    socks = []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(('127.0.0.1', 0))
+        socks.append(s)
+    ports = [s.getsockname()[1] for s in socks]
+    for s in socks:
+        s.close()
+    return ports
+
+
+def _run_seed(plan_json, model, steps, trainers, pservers, budget):
+    eps = ','.join('127.0.0.1:%d' % p for p in _free_ports(pservers))
+    base_env = dict(os.environ)
+    base_env.pop('JAX_PLATFORMS', None)
+    base_env.pop('XLA_FLAGS', None)
+    base_env.update({'PS_MODEL': model, 'PS_ENDPOINTS': eps,
+                     'PS_TRAINERS': str(trainers), 'PS_STEPS': str(steps),
+                     'PS_SYNC': '1', 'PS_OPTIMIZER': 'sgd'})
+    pprocs = []
+    for i in range(pservers):
+        env = dict(base_env, PS_ROLE='pserver', PS_PSERVER_ID=str(i))
+        pprocs.append(subprocess.Popen(
+            [sys.executable, _WORKER], env=env, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True))
+    tprocs = []
+    for i in range(trainers):
+        env = dict(base_env, PS_ROLE='trainer', PS_TRAINER_ID=str(i))
+        if i == 0:
+            env['FLAGS_fault_plan'] = plan_json
+        tprocs.append(subprocess.Popen(
+            [sys.executable, _WORKER], env=env, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True))
+    deadline = time.monotonic() + budget
+    outs, hung = [], False
+    # drain TRAINERS first: a trainer's RESULT line (full weights) can
+    # exceed the 64 KB pipe buffer, and it is written before the
+    # trainer's COMPLETE teardown -- waiting on a pserver while trainer
+    # pipes are full deadlocks the whole cluster
+    for p in tprocs + pprocs:
+        left = deadline - time.monotonic()
+        try:
+            out, _ = p.communicate(timeout=max(left, 0.1))
+        except subprocess.TimeoutExpired:
+            hung = True
+            p.kill()
+            out, _ = p.communicate()
+        outs.append(out)
+    if hung:
+        for p in tprocs + pprocs:     # reap anything still up
+            if p.poll() is None:
+                p.kill()
+                p.communicate()
+        return 'hung', None, outs
+    if any(p.returncode != 0 for p in tprocs + pprocs):
+        return 'fatal', None, outs
+    weights = None
+    for ln in outs[0].splitlines():   # trainer 0's RESULT
+        if ln.startswith('RESULT '):
+            weights = json.loads(ln[len('RESULT '):])['weights']
+    return ('ok', weights, outs) if weights else ('fatal', None, outs)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument('--seeds', type=int, default=20,
+                    help='number of seeds to sweep (default 20)')
+    ap.add_argument('--seed-start', type=int, default=0)
+    ap.add_argument('--model', default='mlp')
+    ap.add_argument('--steps', type=int, default=3)
+    ap.add_argument('--trainers', type=int, default=2)
+    ap.add_argument('--pservers', type=int, default=2)
+    ap.add_argument('--budget', type=float, default=180.0,
+                    help='per-seed wall-clock budget in seconds')
+    ap.add_argument('--verbose', action='store_true',
+                    help='dump worker output for non-ok seeds')
+    args = ap.parse_args(argv)
+
+    import numpy as np
+
+    import ps_worker
+    from paddle_tpu.distributed.resilience import FaultPlan
+
+    print('baseline: local %s x %d steps ...' % (args.model, args.steps))
+    _, local_w = ps_worker.local_train(args.model, args.steps, 'sgd',
+                                       args.trainers)
+
+    tally = {'ok': 0, 'diverged': 0, 'fatal': 0, 'hung': 0}
+    bad_seeds = []
+    for seed in range(args.seed_start, args.seed_start + args.seeds):
+        plan = FaultPlan.from_seed(seed)
+        t0 = time.monotonic()
+        verdict, weights, outs = _run_seed(
+            plan.to_json(), args.model, args.steps, args.trainers,
+            args.pservers, args.budget)
+        if verdict == 'ok':
+            for p, lw in local_w.items():
+                if not np.allclose(np.asarray(weights[p]),
+                                   np.asarray(lw),
+                                   rtol=1e-4, atol=1e-5):
+                    verdict = 'diverged'
+                    break
+        tally[verdict] += 1
+        if verdict == 'diverged':
+            bad_seeds.append(seed)
+        print('seed %4d  %-8s  %5.1fs  %s'
+              % (seed, verdict, time.monotonic() - t0, plan.to_json()))
+        if args.verbose and verdict not in ('ok',):
+            for out in outs:
+                print('  | ' + '\n  | '.join(out.splitlines()[-15:]))
+
+    total = sum(tally.values())
+    print('\nswept %d seeds: %d ok, %d diverged, %d fatal, %d hung'
+          % (total, tally['ok'], tally['diverged'], tally['fatal'],
+             tally['hung']))
+    if bad_seeds:
+        print('DIVERGED seeds (reproduce with --seed-start N --seeds 1 '
+              '--verbose): %s' % bad_seeds)
+        return 1
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())
