@@ -17,11 +17,13 @@ win that motivates the parameter-server design for CTR models.
 """
 from .rpc import (PSClient, PSServer, get_client, close_all_clients,
                   RetryableRPCError, FatalRPCError)
-from .resilience import FaultPlan, RetryPolicy
+from .resilience import FaultPlan, RetryPolicy, StaleIncarnationError
 from .param_service import ParameterService
+from .supervisor import Supervisor
 from .env import ClusterEnv, cluster_from_env
 
 __all__ = ['PSClient', 'PSServer', 'ParameterService', 'get_client',
            'close_all_clients', 'ClusterEnv', 'cluster_from_env',
-           'RetryableRPCError', 'FatalRPCError', 'FaultPlan',
-           'RetryPolicy']
+           'RetryableRPCError', 'FatalRPCError',
+           'StaleIncarnationError', 'FaultPlan', 'RetryPolicy',
+           'Supervisor']

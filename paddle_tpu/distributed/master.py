@@ -174,10 +174,8 @@ class TaskMaster(object):
                  'failures': {str(k): v
                               for k, v in self._failures.items()},
                  'next_id': self._next_id, 'pass_id': self._pass_id}
-        tmp = '%s.%d.tmp' % (self.snapshot_path, os.getpid())
-        with open(tmp, 'w') as f:
-            json.dump(state, f)
-        os.replace(tmp, self.snapshot_path)   # atomic (service.go:346)
+        from .statefile import atomic_write_json
+        atomic_write_json(self.snapshot_path, state)   # (service.go:346)
 
     def _recover(self):
         with open(self.snapshot_path) as f:

@@ -34,7 +34,8 @@ accounted for (completed or dead).
 
 Sparse merge: SelectedRows from several trainers concatenate rows/values
 (duplicate rows are legal — optimizer scatter-adds merge them), then
-values scale by 1/num_trainers in sync mode.
+values scale by 1/num_trainers in sync mode (or 1/live_count under
+FLAGS_ps_average_live — see _merge).
 
 Idempotent replay: a reconnecting PSClient replays a request whose
 reply was lost (see distributed/rpc.py). Every mutating handler
@@ -45,9 +46,44 @@ retried gradient or barrier never double-counts in a sync round
 (`FLAGS_rpc_dedup_window` bounds the memory). Read-only handlers
 (GET_VAR / PREFETCH) simply re-execute; COMPLETE is naturally
 idempotent.
+
+Elastic recovery (this PR) — either side of the connection may DIE and
+come back:
+
+**Trainer rejoin with incarnation fencing.** Every message carries the
+trainer's *incarnation* number (`FLAGS_trainer_incarnation`, bumped by
+the supervisor on each restart). A message whose incarnation is LOWER
+than the registered one is a zombie from before a restart and is
+rejected with the non-retryable `StaleIncarnationError`; one with a
+HIGHER incarnation triggers `_rejoin_locked` — the permanent
+`dead_tids` ban is lifted, the trainer's stale pending grads and
+barrier are scrubbed, its dedup window is reset, and it re-enters the
+live set at the next round boundary. The REGISTER handshake tells the
+restarted trainer which step to resume from (`_trainer_rounds[tid]`);
+SEND_VAR / BATCH_BARRIER additionally carry the trainer's step index
+(`round_idx`) so a server that already closed that round ack-ignores
+the replayed contribution instead of double-counting it — that is what
+makes recovery land on bit-exact weights.
+
+**Pserver durability.** With a `snapshot_path`, the service snapshots
+params + round counters + dedup windows + incarnations to an atomic
+on-disk file every `snapshot_every` rounds (statefile.atomic_replace,
+mirroring Master.save_state), and journals every applied mutation
+between snapshots as raw wire frames (wire.pack_msg) to
+`<snapshot_path>.journal`, flushed per record. A restarted server
+restores the snapshot, replays the journal through the same handlers
+(`_replaying` suppresses re-journaling and re-snapshotting), and is
+bit-exactly back at the kill point: the only in-flight request the
+journal can miss is the one whose reply was never sent, and PR 1's
+client retry layer replays exactly that one. A crash BETWEEN the
+snapshot replace and the journal truncate is safe too — replayed
+pre-snapshot records are absorbed by the snapshotted dedup windows and
+round tags.
 """
 from __future__ import annotations
 
+import json
+import os
 import threading
 from collections import deque
 
@@ -59,15 +95,25 @@ __all__ = ['ParameterService']
 class ParameterService(object):
     def __init__(self, num_trainers, sync_mode, get_param, run_round,
                  run_one_grad=None, prefetch=None, save_params=None,
-                 rpc_deadline=None):
+                 rpc_deadline=None, snapshot_path=None,
+                 snapshot_every=None, dump_state=None, load_state=None,
+                 average_live=None):
         """get_param(name) -> value; run_round(merged: {grad: value});
         run_one_grad(grad_name, value) for async; prefetch(table, ids);
         save_params(dirname) checkpoints this server's shard (the
         reference's RequestCheckpointHandler running the save block —
         listen_and_serv_op.cc:251 checkpoint_point_block_id).
         rpc_deadline: seconds of silence after which a trainer is
-        declared dead and retired (None -> FLAGS_rpc_deadline)."""
+        declared dead and retired (None -> FLAGS_rpc_deadline).
+        snapshot_path (None -> FLAGS_ps_state_path): enables crash
+        durability — dump_state() -> {name: array} and
+        load_state({name: array}) must then round-trip this shard's
+        persistable scope; snapshot_every (None ->
+        FLAGS_ps_snapshot_every) is the round period. average_live
+        (None -> FLAGS_ps_average_live) switches _merge to the live-set
+        denominator."""
         import time
+        from ..flags import get_flag
         self.num_trainers = num_trainers
         self.sync_mode = sync_mode
         self._get_param = get_param
@@ -76,13 +122,15 @@ class ParameterService(object):
         self._run_one_grad = run_one_grad
         self._prefetch = prefetch
         if rpc_deadline is None:
-            from ..flags import get_flag
             rpc_deadline = float(get_flag('rpc_deadline', 180.0))
         self.rpc_deadline = rpc_deadline
         # a trainer that has NEVER connected gets the larger of the
         # deadline and this grace: process spawn + jit compile of the
         # first step must not count as "silent death"
         self.first_contact_grace = max(rpc_deadline, 120.0)
+        if average_live is None:
+            average_live = bool(get_flag('ps_average_live', False))
+        self.average_live = average_live
 
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
@@ -92,6 +140,7 @@ class ParameterService(object):
         self._completed_rounds = 0
         self._done_tids = set()
         self.dead_tids = set()        # retired by the liveness deadline
+        self._incarnations = {}       # tid -> highest registered inc
         self._error = None
         # every expected trainer's clock starts now: one that NEVER
         # connects must still be retireable
@@ -99,10 +148,24 @@ class ParameterService(object):
         self._last_seen = {}          # tid -> monotonic last message
         self._barrier_ever = set()    # tids past their FIRST barrier
         # replay dedup: per-trainer window of applied (cli, seq) tokens
-        from ..flags import get_flag
         self._dedup_window = int(get_flag('rpc_dedup_window', 512))
         self._seq_seen = {}           # tid -> set of tokens
         self._seq_order = {}          # tid -> deque (eviction order)
+        # -- durability ----------------------------------------------------
+        if snapshot_path is None:
+            snapshot_path = get_flag('ps_state_path', '') or None
+        self.snapshot_path = snapshot_path
+        if snapshot_every is None:
+            snapshot_every = int(get_flag('ps_snapshot_every', 1))
+        self.snapshot_every = max(1, int(snapshot_every))
+        self._dump_state = dump_state
+        self._load_state = load_state
+        self._replaying = False
+        self._journal_f = None
+        self._async_applied = 0       # async mode: sends since snapshot
+        if self.snapshot_path:
+            self._restore()
+            self._journal_open()
 
     # -- helpers -----------------------------------------------------------
     def _live_count(self):
@@ -152,27 +215,86 @@ class ParameterService(object):
         """Reject messages from a trainer already retired by the
         deadline: a slow-but-alive 'zombie' must fail loudly (the
         client surfaces the REPLY_ERR) instead of silently joining
-        rounds whose live set no longer counts it."""
+        rounds whose live set no longer counts it. Rejoining is
+        possible, but only as a FRESH incarnation (restart with a
+        higher FLAGS_trainer_incarnation and re-register)."""
         if tid in self.dead_tids:
             raise RuntimeError(
                 'trainer %d was retired by the liveness deadline '
-                '(%.0f s silent) and may not rejoin this sync session'
+                '(%.0f s silent) and may not rejoin this sync session '
+                'with the same incarnation; restart it with a higher '
+                'FLAGS_trainer_incarnation to re-register'
                 % (tid, self.rpc_deadline))
+
+    def _fence_locked(self, tid, inc):
+        """Incarnation fence. A LOWER incarnation than the registered
+        one is a zombie process from before a restart: reject it
+        non-retryably. A HIGHER one is the restarted trainer announcing
+        itself — rejoin it on the spot (REGISTER is the polite path,
+        but any message may arrive first after a restart)."""
+        from .resilience import StaleIncarnationError
+        inc = int(inc or 0)
+        cur = self._incarnations.get(tid, 0)
+        if inc < cur:
+            raise StaleIncarnationError(
+                'trainer %d message carries incarnation %d but '
+                'incarnation %d is registered: stale pre-restart '
+                'zombie, not retryable' % (tid, inc, cur))
+        if inc > cur:
+            self._rejoin_locked(tid, inc)
+            return True
+        return False
+
+    def _rejoin_locked(self, tid, inc):
+        """Re-admit a restarted trainer under a new incarnation: lift
+        the dead ban, scrub every trace of the previous incarnation
+        (pending grads, barrier membership, dedup window), and restart
+        the first-contact grace clock — the fresh process has to re-jit
+        before its first barrier, exactly like a cold start. The
+        trainer re-enters the live set immediately, so the next round
+        boundary waits for its barrier. Returns whether the tid had
+        been retired as dead."""
+        import time
+        was_dead = tid in self.dead_tids
+        self._incarnations[tid] = int(inc)
+        self.dead_tids.discard(tid)
+        self._done_tids.discard(tid)
+        self._barrier_tids.discard(tid)
+        for per_tid in self._pending.values():
+            per_tid.pop(tid, None)
+        self._barrier_ever.discard(tid)
+        self._last_seen[tid] = time.monotonic()
+        self._seq_seen.pop(tid, None)
+        self._seq_order.pop(tid, None)
+        self._cond.notify_all()
+        return was_dead
 
     def check_liveness(self):
         """Periodic liveness sweep (PSServer reaper thread). Returns
         True when every trainer is accounted for (completed or dead) —
-        the server's shutdown condition."""
+        the server's shutdown condition. A retired-then-rejoined
+        trainer is live again (rejoin removed it from _done_tids), so
+        the server keeps serving while its new incarnation is in
+        flight."""
         with self._lock:
             self._retire_dead_locked()
             return len(self._done_tids) >= self.num_trainers
 
     def _merge(self, values):
-        """Merge one grad's per-trainer values: sum, then average over the
-        ORIGINAL trainer count (a retired trainer's mean-grad contribution
-        is treated as zero for the remaining steps)."""
+        """Merge one grad's per-trainer values: sum, then average.
+
+        Default denominator is the ORIGINAL `num_trainers` — a retired
+        trainer's mean-grad contribution is treated as zero, which
+        silently SHRINKS the effective LR as trainers die but keeps
+        surviving-set runs bit-comparable to the full-set run.
+        `FLAGS_ps_average_live` switches to the live-set denominator:
+        the update stays a true mean of the contributions (constant
+        effective LR), at the cost of weights diverging from the
+        full-set baseline the moment a trainer dies."""
         from ..selected_rows import SelectedRows
-        scale = 1.0 / float(self.num_trainers)
+        denom = self._live_count() if self.average_live \
+            else self.num_trainers
+        scale = 1.0 / float(max(1, denom))
         vs = list(values)
         if isinstance(vs[0], SelectedRows):
             rows = np.concatenate([np.asarray(v.rows) for v in vs])
@@ -200,6 +322,10 @@ class ParameterService(object):
             self._pending.clear()
             self._barrier_tids.clear()
             self._completed_rounds += 1
+            # pending is empty RIGHT NOW — the cheapest instant for a
+            # consistent snapshot; the barrier that closed this round
+            # is acked only after the snapshot is durable
+            self._maybe_snapshot_locked()
             self._cond.notify_all()
 
     def _wait_for_trainer_round_locked(self, tid):
@@ -221,12 +347,13 @@ class ParameterService(object):
                 break
             self._cond.wait(timeout=1.0)
 
-    def _enter_locked(self, tid):
-        """Touch + liveness check under the CALLER's lock: check and
-        state mutation must be one atomic section, or a handler thread
-        descheduled between them can re-insert a retired trainer's
-        state after the reaper cleaned it."""
+    def _enter_locked(self, tid, inc=None):
+        """Fence + touch + liveness check under the CALLER's lock:
+        check and state mutation must be one atomic section, or a
+        handler thread descheduled between them can re-insert a retired
+        trainer's state after the reaper cleaned it."""
         import time
+        self._fence_locked(tid, inc)
         self._last_seen[tid] = time.monotonic()
         self._check_not_dead(tid)
 
@@ -241,6 +368,7 @@ class ParameterService(object):
         real re-attempt, not a phantom ack."""
         if token is None:
             return
+        token = tuple(token)
         seen = self._seq_seen.setdefault(tid, set())
         if token in seen:
             return
@@ -250,67 +378,272 @@ class ParameterService(object):
         while len(order) > self._dedup_window:
             seen.discard(order.popleft())
 
+    def _stale_round_locked(self, tid, round_idx):
+        """True when a SEND_VAR/BATCH_BARRIER carries a step index from
+        a round this server already closed for the trainer — a
+        restarted trainer resuming at the min-across-servers step
+        replays rounds an ahead server has applied; ack-ignoring them
+        (rather than erroring) lets the trainer's step counter catch up
+        to every shard without double-counting anywhere."""
+        return (round_idx is not None
+                and int(round_idx) < self._trainer_rounds.get(tid, 0))
+
+    # -- durability --------------------------------------------------------
+    def _journal_path(self):
+        return self.snapshot_path + '.journal'
+
+    def _journal_open(self):
+        self._journal_f = open(self._journal_path(), 'ab')
+
+    def _journal_reset_locked(self):
+        """Truncate the journal: everything before this instant is in
+        the snapshot that was just atomically replaced."""
+        if self._journal_f is not None:
+            self._journal_f.close()
+        self._journal_f = open(self._journal_path(), 'wb')
+
+    def _journal_locked(self, msg_type, meta, value=None):
+        """Append one applied mutation as a wire frame, flushed to the
+        OS before the handler returns (and therefore before the client
+        sees the ack). flush — not fsync — is deliberate: os._exit /
+        kill -9 cannot lose kernel page-cache data, and process death
+        is the failure mode this journal exists for."""
+        if self._journal_f is None or self._replaying:
+            return
+        from . import wire
+        self._journal_f.write(wire.pack_msg(msg_type, meta, value=value))
+        self._journal_f.flush()
+
+    def _maybe_snapshot_locked(self):
+        if (self.snapshot_path and self._dump_state is not None
+                and not self._replaying
+                and self._completed_rounds % self.snapshot_every == 0):
+            self._snapshot_locked()
+
+    def _snapshot_locked(self):
+        """Atomically persist params + every piece of round/replay state
+        a restarted server needs to keep serving mid-session."""
+        from .statefile import atomic_replace
+        state = {
+            'completed_rounds': self._completed_rounds,
+            'trainer_rounds': {str(k): v
+                               for k, v in self._trainer_rounds.items()},
+            'done_tids': sorted(self._done_tids),
+            'dead_tids': sorted(self.dead_tids),
+            'barrier_ever': sorted(self._barrier_ever),
+            'incarnations': {str(k): v
+                             for k, v in self._incarnations.items()},
+            'seq_order': {str(k): [list(t) for t in v]
+                          for k, v in self._seq_order.items()},
+        }
+        arrays = {'p:' + name: np.asarray(val)
+                  for name, val in self._dump_state().items()}
+        arrays['__state__'] = np.frombuffer(
+            json.dumps(state).encode('utf-8'), dtype=np.uint8)
+        # np.savez appends '.npz' to a path STRING but writes an open
+        # handle verbatim — go through the handle so the atomic-replace
+        # target name is exact
+        with atomic_replace(self.snapshot_path) as f:
+            np.savez(f, **arrays)
+        self._journal_reset_locked()
+
+    def _restore(self):
+        """Snapshot + journal replay: called once from __init__, before
+        any connection is accepted."""
+        if os.path.exists(self.snapshot_path):
+            with np.load(self.snapshot_path) as z:
+                state = json.loads(bytes(z['__state__'].data)
+                                   .decode('utf-8'))
+                params = {k[len('p:'):]: np.array(z[k])
+                          for k in z.files if k.startswith('p:')}
+            if self._load_state is not None:
+                self._load_state(params)
+            self._completed_rounds = int(state['completed_rounds'])
+            self._trainer_rounds = {int(k): v for k, v
+                                    in state['trainer_rounds'].items()}
+            self._done_tids = set(state['done_tids'])
+            self.dead_tids = set(state['dead_tids'])
+            self._barrier_ever = set(state['barrier_ever'])
+            self._incarnations = {int(k): v for k, v
+                                  in state['incarnations'].items()}
+            for k, toks in state['seq_order'].items():
+                tid = int(k)
+                self._seq_order[tid] = deque(tuple(t) for t in toks)
+                self._seq_seen[tid] = set(self._seq_order[tid])
+        jpath = self._journal_path()
+        if not os.path.exists(jpath):
+            return
+        with open(jpath, 'rb') as f:
+            buf = f.read()
+        from . import wire
+        self._replaying = True
+        try:
+            for msg_type, meta, value in wire.unpack_msgs(buf):
+                self._replay_msg(msg_type, meta, value)
+        finally:
+            self._replaying = False
+
+    def _replay_msg(self, msg_type, meta, value):
+        """Re-dispatch one journaled mutation through the live
+        handlers. CHECKPOINT replays token-only (re-saving the shard
+        to a possibly-gone dirname is a side effect, not state)."""
+        from . import wire
+        tid = int(meta['tid'])
+        tok = tuple(meta['tok']) if meta.get('tok') else None
+        inc = meta.get('inc')
+        if msg_type == wire.SEND_VAR:
+            self.on_send_var(meta['name'], tid, value, seq=tok, inc=inc,
+                             round_idx=meta.get('round'))
+        elif msg_type == wire.BATCH_BARRIER:
+            self.on_batch_barrier(tid, seq=tok, inc=inc,
+                                  round_idx=meta.get('round'))
+        elif msg_type == wire.COMPLETE:
+            self.on_complete(tid, inc=inc)
+        elif msg_type == wire.REGISTER:
+            self.on_register(tid, inc=inc)
+        elif msg_type == wire.CHECKPOINT:
+            with self._lock:
+                self._record_seq_locked(tid, tok)
+
+    @staticmethod
+    def _tok_meta(tid, seq, inc, round_idx=None, name=None):
+        meta = {'tid': tid, 'tok': list(seq) if seq else None}
+        if inc is not None:
+            meta['inc'] = int(inc)
+        if round_idx is not None:
+            meta['round'] = int(round_idx)
+        if name is not None:
+            meta['name'] = name
+        return meta
+
     # -- service interface (called from PSServer threads) ------------------
-    def on_send_var(self, name, tid, value, seq=None):
+    def on_send_var(self, name, tid, value, seq=None, inc=None,
+                    round_idx=None):
+        from . import wire
         with self._lock:
-            self._enter_locked(tid)
+            self._enter_locked(tid, inc)
             if self._is_replay_locked(tid, seq):
                 return   # applied already; the lost reply is re-acked
+            if self._stale_round_locked(tid, round_idx):
+                return   # a resumed trainer replaying a closed round
+            self._journal_locked(
+                wire.SEND_VAR,
+                self._tok_meta(tid, seq, inc, round_idx, name), value)
             if not self.sync_mode and self._run_one_grad is not None:
                 self._run_one_grad(name, value)
                 self._record_seq_locked(tid, seq)
+                self._async_applied += 1
+                # async has no round boundary; snapshot on a send count
+                if (self.snapshot_path and not self._replaying
+                        and self._async_applied % 256 == 0):
+                    self._snapshot_locked()
                 return
             self._pending.setdefault(name, {})[tid] = value
             self._record_seq_locked(tid, seq)
 
-    def on_batch_barrier(self, tid, seq=None):
+    def on_batch_barrier(self, tid, seq=None, inc=None, round_idx=None):
+        from . import wire
         with self._lock:
-            self._enter_locked(tid)
+            self._enter_locked(tid, inc)
             if self._is_replay_locked(tid, seq):
                 return   # the round this barrier closed already ran
+            if self._stale_round_locked(tid, round_idx):
+                return   # ahead of a resumed trainer: round already ran
+            self._journal_locked(
+                wire.BATCH_BARRIER,
+                self._tok_meta(tid, seq, inc, round_idx))
             self._barrier_ever.add(tid)
             self._barrier_tids.add(tid)
-            self._trainer_rounds[tid] = self._trainer_rounds.get(tid, 0) + 1
+            if round_idx is not None:
+                self._trainer_rounds[tid] = max(
+                    self._trainer_rounds.get(tid, 0), int(round_idx) + 1)
+            else:
+                self._trainer_rounds[tid] = \
+                    self._trainer_rounds.get(tid, 0) + 1
             self._record_seq_locked(tid, seq)
             self._maybe_run_round_locked()
 
-    def on_get_var(self, name, tid):
+    def on_get_var(self, name, tid, inc=None):
         with self._lock:
-            self._enter_locked(tid)
+            self._enter_locked(tid, inc)
             if self.sync_mode:
                 self._wait_for_trainer_round_locked(tid)
             return self._get_param(name)
 
-    def on_prefetch(self, name, tid, ids):
+    def on_prefetch(self, name, tid, ids, inc=None):
         if self._prefetch is None:
             raise RuntimeError('this pserver hosts no lookup table')
         with self._lock:
-            self._enter_locked(tid)
+            self._enter_locked(tid, inc)
             if self.sync_mode:
                 self._wait_for_trainer_round_locked(tid)
             return self._prefetch(name, np.asarray(ids))
 
-    def on_checkpoint(self, dirname, tid, seq=None):
+    def on_checkpoint(self, dirname, tid, seq=None, inc=None):
+        from . import wire
         if self._save_params is None:
             raise RuntimeError('this pserver has no checkpoint support')
         with self._lock:
-            self._enter_locked(tid)
+            self._enter_locked(tid, inc)
             if self._is_replay_locked(tid, seq):
                 return   # shard already saved for this request
             if self.sync_mode:
                 self._wait_for_trainer_round_locked(tid)
             self._save_params(dirname)
+            self._journal_locked(wire.CHECKPOINT,
+                                 self._tok_meta(tid, seq, inc))
             self._record_seq_locked(tid, seq)
 
-    def on_fetch_barrier(self, tid):
-        self._touch(tid)  # round already closed by the on_get_var wait
+    def on_fetch_barrier(self, tid, inc=None):
+        # the round already closed by the on_get_var wait, but a zombie
+        # or stale-incarnation FETCH_BARRIER must still fail loudly —
+        # same _enter_locked gate as every other handler
+        with self._lock:
+            self._enter_locked(tid, inc)
 
-    def on_complete(self, tid):
+    def on_register(self, tid, inc=None, seq=None):
+        """The (re)join handshake. Reply tells the trainer where it
+        stands on THIS shard: `round` (server rounds applied),
+        `expected` (the step index this server expects from the trainer
+        next — its resume point), `rejoined` (whether the tid had been
+        retired as dead). A restarted trainer resumes at the MINIMUM
+        `expected` across shards and relies on the stale-round
+        ack-ignore to catch the ahead ones up."""
+        import time
+        from . import wire
+        from .resilience import StaleIncarnationError
+        with self._lock:
+            inc = int(inc or 0)
+            cur = self._incarnations.get(tid, 0)
+            if inc < cur:
+                raise StaleIncarnationError(
+                    'trainer %d REGISTER carries incarnation %d but '
+                    'incarnation %d is registered: stale pre-restart '
+                    'zombie, not retryable' % (tid, inc, cur))
+            rejoined = False
+            if inc > cur:
+                self._journal_locked(wire.REGISTER,
+                                     self._tok_meta(tid, seq, inc))
+                rejoined = self._rejoin_locked(tid, inc)
+            else:
+                # first contact (inc == cur == 0) or a replayed
+                # REGISTER whose rejoin already happened: idempotent
+                self._check_not_dead(tid)
+                self._incarnations.setdefault(tid, inc)
+                self._last_seen[tid] = time.monotonic()
+            return {'round': self._completed_rounds,
+                    'expected': self._trainer_rounds.get(tid, 0),
+                    'rejoined': rejoined}
+
+    def on_complete(self, tid, inc=None):
+        from . import wire
         with self._lock:
             # same zombie rejection as every other handler: a
             # deadline-retired trainer's COMPLETE must fail loudly, not
             # silently shrink the expected-completions set
-            self._enter_locked(tid)
+            self._enter_locked(tid, inc)
+            self._journal_locked(wire.COMPLETE,
+                                 self._tok_meta(tid, None, inc))
             self._done_tids.add(tid)
             self._barrier_tids.discard(tid)
             # a straggler-free round may now be unblocked

@@ -33,9 +33,10 @@ cluster test can fault exactly one role. Schema::
        {"when": "send",           # send | recv | step
         "type": "SEND_VAR",       # wire/master msg-type name, or "*"
         "nth": 3,                 # fire on the Nth matching event
-        "action": "drop",         # drop | close | delay | error
+        "action": "drop",         # drop | close | delay | error | exit
         "secs": 0.2,              # delay only
-        "retryable": true}]}      # error only (default true)
+        "retryable": true,        # error only (default true)
+        "code": 137}]}            # exit only (default 137, = kill -9)
 
 Counting is per-process and per (when, type): the plan is fully
 deterministic given the message sequence, which host-side RPC ops emit
@@ -48,6 +49,10 @@ in deterministic order. Actions:
   before the reply — replay of an applied mutation must be deduped.
 - ``delay``: sleep `secs`, then proceed normally.
 - ``error``: raise `RetryableRPCError` or `FatalRPCError` in place.
+- ``exit``: `os._exit(code)` — the process dies instantly with no
+  cleanup, no atexit, no socket shutdown: the deterministic analog of
+  `kill -9` at an exact point in the message sequence, used by the
+  elastic-recovery chaos tests to kill a trainer or pserver mid-round.
 
 On the recv side, ``drop`` discards the parsed message and reads the
 next one; ``close``/``delay``/``error`` mirror the send side. ``step``
@@ -62,9 +67,10 @@ import threading
 import time
 
 __all__ = ['RetryableRPCError', 'FatalRPCError', 'TransientError',
-           'RetryPolicy', 'FaultRule', 'FaultPlan', 'install_plan',
-           'clear_plan', 'active_plan', 'current_plan', 'fired_faults',
-           'on_send', 'on_recv', 'on_step']
+           'StaleIncarnationError', 'RetryPolicy', 'FaultRule',
+           'FaultPlan', 'install_plan', 'clear_plan', 'active_plan',
+           'current_plan', 'fired_faults', 'on_send', 'on_recv',
+           'on_step']
 
 
 class RetryableRPCError(ConnectionError):
@@ -78,6 +84,14 @@ TransientError = RetryableRPCError
 class FatalRPCError(RuntimeError):
     """Non-retryable RPC failure: the server rejected the request (or
     retries were escalated); replay cannot help."""
+
+
+class StaleIncarnationError(FatalRPCError):
+    """A message carried an incarnation older than the one the pserver
+    has registered for that trainer id: a zombie process from before a
+    restart. Non-retryable by definition — the fresh incarnation owns
+    the trainer id now, and replaying a stale message can only corrupt
+    its rounds."""
 
 
 # ---------------------------------------------------------------------------
@@ -129,7 +143,7 @@ class RetryPolicy(object):
 # fault plan
 # ---------------------------------------------------------------------------
 
-_ACTIONS = ('drop', 'close', 'delay', 'error')
+_ACTIONS = ('drop', 'close', 'delay', 'error', 'exit')
 _WHENS = ('send', 'recv', 'step')
 
 
@@ -146,7 +160,7 @@ def _type_names():
 
 class FaultRule(object):
     def __init__(self, when, nth, action, type='*', secs=0.1,
-                 retryable=True):
+                 retryable=True, code=137):
         if when not in _WHENS:
             raise ValueError('bad when %r (one of %s)' % (when, _WHENS))
         if action not in _ACTIONS:
@@ -158,6 +172,7 @@ class FaultRule(object):
         self.action = action
         self.secs = float(secs)
         self.retryable = bool(retryable)
+        self.code = int(code)
 
     def to_dict(self):
         d = {'when': self.when, 'type': self.type, 'nth': self.nth,
@@ -166,6 +181,8 @@ class FaultRule(object):
             d['secs'] = self.secs
         if self.action == 'error':
             d['retryable'] = self.retryable
+        if self.action == 'exit':
+            d['code'] = self.code
         return d
 
 
@@ -188,14 +205,27 @@ class FaultPlan(object):
 
     @classmethod
     def from_spec(cls, spec):
-        """``seed:N`` | a JSON object string | a path to a JSON file."""
+        """``seed:N`` | ``kill:ROLE:N`` | a JSON object string | a path
+        to a JSON file.
+
+        A malformed spec fails HERE, loudly, with the offending text —
+        install time is the only moment anyone is looking; a deferred
+        parse error would surface mid-training as a mystery."""
         spec = spec.strip()
-        if spec.startswith('seed:'):
-            return cls.from_seed(int(spec[len('seed:'):]))
-        if spec.startswith('{'):
-            return cls.from_json(spec)
-        with open(spec) as f:
-            return cls.from_json(f.read())
+        try:
+            if spec.startswith('seed:'):
+                return cls.from_seed(int(spec[len('seed:'):]))
+            if spec.startswith('kill:'):
+                role, seed = spec[len('kill:'):].split(':', 1)
+                return cls.from_kill_seed(int(seed), role)
+            if spec.startswith('{'):
+                return cls.from_json(spec)
+            with open(spec) as f:
+                return cls.from_json(f.read())
+        except (ValueError, KeyError, TypeError, OSError,
+                json.JSONDecodeError) as e:
+            raise ValueError('unparseable fault plan %r: %s: %s'
+                             % (spec, type(e).__name__, e))
 
     @classmethod
     def from_seed(cls, seed, max_rules=3, max_nth=10):
@@ -222,6 +252,38 @@ class FaultPlan(object):
             rules.append(FaultRule('send', rng.randint(1, max_nth),
                                    action, type=rng.choice(types), **kw))
         return cls(rules, seed=seed)
+
+    @classmethod
+    def from_kill_seed(cls, seed, role, max_nth=8):
+        """One seeded ``exit`` rule: kill the process at the Nth message
+        event of a randomly chosen type — the chaos_sweep --kill
+        distribution.
+
+        Kill points are limited to those from which recovery is EXACT:
+
+        - pserver: any inbound mutation (``recv`` of SEND_VAR /
+          BATCH_BARRIER / GET_VAR) — the journal + client replay
+          restore the precise pre-kill state.
+        - trainer: ``send`` of SEND_VAR / GET_VAR / FETCH_BARRIER. A
+          kill between the two per-pserver BATCH_BARRIER sends is
+          deliberately excluded: one shard would close the round while
+          the other waits, and the restarted trainer would pull
+          mixed-round params — recovery would converge but not
+          bit-exactly, which the sweep cannot distinguish from a bug.
+        """
+        rng = random.Random(('kill', role, seed).__repr__())
+        if role == 'pserver':
+            when = 'recv'
+            types = ['SEND_VAR', 'BATCH_BARRIER', 'GET_VAR']
+        elif role == 'trainer':
+            when = 'send'
+            types = ['SEND_VAR', 'GET_VAR', 'FETCH_BARRIER']
+        else:
+            raise ValueError('bad kill role %r (trainer | pserver)'
+                             % (role,))
+        rule = FaultRule(when, rng.randint(2, max_nth), 'exit',
+                         type=rng.choice(types))
+        return cls([rule], seed=seed)
 
     def to_json(self):
         d = {'rules': [r.to_dict() for r in self.rules]}
@@ -318,6 +380,18 @@ def _raise_for(rule, where):
     raise RetryableRPCError(msg)
 
 
+def _exit_for(rule, where):
+    """The 'exit' action: die NOW, with no cleanup of any kind.
+    sys.stderr is flushed (it carries the audit line chaos tests grep
+    for) but sockets, locks and atexit handlers are abandoned exactly
+    as kill -9 would abandon them."""
+    import sys
+    sys.stderr.write('fault injection: exit(%d) at %s (rule %s)\n'
+                     % (rule.code, where, rule.to_dict()))
+    sys.stderr.flush()
+    os._exit(rule.code)
+
+
 def on_send(sock, msg_type, meta):
     """wire.write_msg hook, called BEFORE the frame hits the socket.
     Returns None, or a callable to run AFTER the frame was sent (the
@@ -338,6 +412,8 @@ def on_send(sock, msg_type, meta):
             % (msg_type, rule.to_dict()))
     if rule.action == 'close':
         return lambda: _close_quietly(sock)
+    if rule.action == 'exit':
+        _exit_for(rule, 'send of msg type %s' % msg_type)
     _raise_for(rule, 'send of msg type %s' % msg_type)
 
 
@@ -360,6 +436,8 @@ def on_recv(sock, msg_type, meta):
         _close_quietly(sock)
         raise ConnectionError(
             'fault injection: closed on recv of msg type %s' % msg_type)
+    if rule.action == 'exit':
+        _exit_for(rule, 'recv of msg type %s' % msg_type)
     _raise_for(rule, 'recv of msg type %s' % msg_type)
 
 
@@ -375,6 +453,8 @@ def on_step():
     if rule.action == 'delay':
         time.sleep(rule.secs)
         return
+    if rule.action == 'exit':
+        _exit_for(rule, 'trainer step')
     _raise_for(rule, 'trainer step')
 
 

@@ -41,7 +41,8 @@ class PSClient(object):
     """One trainer's (self-healing) connection to one pserver endpoint."""
 
     def __init__(self, endpoint, trainer_id=0, timeout=120.0,
-                 connect_retry_secs=60.0, retry_policy=None):
+                 connect_retry_secs=60.0, retry_policy=None,
+                 incarnation=None):
         self.endpoint = endpoint
         self.trainer_id = trainer_id
         self.timeout = timeout
@@ -51,6 +52,18 @@ class PSClient(object):
         # incarnation nonce: a RESTARTED trainer process re-using this
         # trainer_id must not collide with seqs the server already saw
         self._incarnation = binascii.hexlify(os.urandom(6)).decode()
+        # LOGICAL incarnation: the supervisor bumps
+        # FLAGS_trainer_incarnation on every restart; the pserver fences
+        # lower values (zombie) and rejoins higher ones (see
+        # param_service._fence_locked)
+        if incarnation is None:
+            from ..flags import get_flag
+            incarnation = int(get_flag('trainer_incarnation', 0))
+        self.incarnation = int(incarnation)
+        # this trainer's step index, tagged onto SEND_VAR/BATCH_BARRIER
+        # so a pserver that already closed the round ack-ignores a
+        # resumed trainer's replay of it
+        self._round = 0
         self._seq = 0
         self._sock = None
         self._lock = threading.Lock()
@@ -99,6 +112,7 @@ class PSClient(object):
             self._seq += 1
             meta['seq'] = self._seq
             meta['cli'] = self._incarnation
+            meta['inc'] = self.incarnation
             return self._call_locked(msg_type, meta, value)
 
     def _call_locked(self, msg_type, meta, value):
@@ -137,7 +151,8 @@ class PSClient(object):
 
     def send_var(self, name, value):
         """Push a gradient (dense array or SelectedRows)."""
-        self._call(wire.SEND_VAR, {'name': name}, value)
+        self._call(wire.SEND_VAR, {'name': name, 'round': self._round},
+                   value)
 
     def get_var(self, name):
         """Pull a parameter value."""
@@ -152,7 +167,21 @@ class PSClient(object):
         return rows
 
     def batch_barrier(self):
-        self._call(wire.BATCH_BARRIER)
+        self._call(wire.BATCH_BARRIER, {'round': self._round})
+        self._round += 1
+
+    def register(self):
+        """(Re)join handshake: announce this incarnation and learn the
+        shard's round state. -> {'round', 'expected', 'rejoined'}; a
+        restarted trainer resumes at min('expected') across shards and
+        set_round()s each client there (elastic recovery)."""
+        rmeta, _ = self._call(wire.REGISTER)
+        return rmeta
+
+    def set_round(self, round_idx):
+        """Pin the step index tagged onto subsequent sends — the resume
+        point a restarted trainer computed from register() replies."""
+        self._round = int(round_idx)
 
     def fetch_barrier(self):
         self._call(wire.FETCH_BARRIER)
@@ -215,22 +244,39 @@ class PSServer(object):
     """Threaded TCP server dispatching wire messages into a service.
 
     service interface (see param_service.ParameterService); `seq` is an
-    opaque replay-dedup token threaded from the request meta:
-      on_send_var(name, trainer_id, value, seq=None)
-      on_get_var(name, trainer_id) -> value
-      on_prefetch(name, trainer_id, ids) -> rows
-      on_batch_barrier(trainer_id, seq=None)
-      on_fetch_barrier(trainer_id)
-      on_checkpoint(dirname, trainer_id, seq=None)
-      on_complete(trainer_id)  -> True when ALL trainers completed
+    opaque replay-dedup token threaded from the request meta, `inc` the
+    trainer's logical incarnation (fencing), `round_idx` the trainer's
+    step index (resume idempotency):
+      on_send_var(name, trainer_id, value, seq=None, inc=None,
+                  round_idx=None)
+      on_get_var(name, trainer_id, inc=None) -> value
+      on_prefetch(name, trainer_id, ids, inc=None) -> rows
+      on_batch_barrier(trainer_id, seq=None, inc=None, round_idx=None)
+      on_fetch_barrier(trainer_id, inc=None)
+      on_checkpoint(dirname, trainer_id, seq=None, inc=None)
+      on_register(trainer_id, inc=None, seq=None) -> reply meta dict
+      on_complete(trainer_id, inc=None) -> True when ALL completed
+
+    A restarted pserver re-binding its endpoint may race the dying
+    process's listener (or its TIME_WAIT): bind retries for
+    `bind_retry_secs` so supervisor restarts resume on the SAME
+    endpoint the trainers' retry layer is already reconnecting to.
     """
 
-    def __init__(self, endpoint, service):
+    def __init__(self, endpoint, service, bind_retry_secs=30.0):
         host, port = endpoint.rsplit(':', 1)
         self.service = service
         self._lsock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._lsock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        self._lsock.bind((host, int(port)))
+        deadline = time.monotonic() + bind_retry_secs
+        while True:
+            try:
+                self._lsock.bind((host, int(port)))
+                break
+            except OSError:
+                if time.monotonic() >= deadline:
+                    raise
+                time.sleep(0.2)
         self._lsock.listen(64)
         self.port = self._lsock.getsockname()[1]
         self._done = threading.Event()
@@ -297,28 +343,35 @@ class PSServer(object):
                 # legacy clients that don't number their requests
                 seq = meta.get('seq')
                 key = (meta.get('cli'), seq) if seq is not None else None
+                inc = meta.get('inc')
+                round_idx = meta.get('round')
                 try:
                     if msg_type == wire.SEND_VAR:
-                        svc.on_send_var(name, tid, value, seq=key)
+                        svc.on_send_var(name, tid, value, seq=key,
+                                        inc=inc, round_idx=round_idx)
                         wire.write_msg(conn, wire.REPLY_OK)
                     elif msg_type == wire.GET_VAR:
-                        out = svc.on_get_var(name, tid)
+                        out = svc.on_get_var(name, tid, inc=inc)
                         wire.write_msg(conn, wire.REPLY_VAR, value=out)
                     elif msg_type == wire.PREFETCH:
-                        out = svc.on_prefetch(name, tid, value)
+                        out = svc.on_prefetch(name, tid, value, inc=inc)
                         wire.write_msg(conn, wire.REPLY_VAR, value=out)
                     elif msg_type == wire.BATCH_BARRIER:
-                        svc.on_batch_barrier(tid, seq=key)
+                        svc.on_batch_barrier(tid, seq=key, inc=inc,
+                                             round_idx=round_idx)
                         wire.write_msg(conn, wire.REPLY_OK)
                     elif msg_type == wire.FETCH_BARRIER:
-                        svc.on_fetch_barrier(tid)
+                        svc.on_fetch_barrier(tid, inc=inc)
                         wire.write_msg(conn, wire.REPLY_OK)
                     elif msg_type == wire.CHECKPOINT:
                         svc.on_checkpoint(meta.get('dirname'), tid,
-                                          seq=key)
+                                          seq=key, inc=inc)
                         wire.write_msg(conn, wire.REPLY_OK)
+                    elif msg_type == wire.REGISTER:
+                        out = svc.on_register(tid, inc=inc, seq=key)
+                        wire.write_msg(conn, wire.REPLY_OK, out)
                     elif msg_type == wire.COMPLETE:
-                        all_done = svc.on_complete(tid)
+                        all_done = svc.on_complete(tid, inc=inc)
                         wire.write_msg(conn, wire.REPLY_OK)
                         if all_done:
                             self.shutdown()

@@ -1,0 +1,54 @@
+"""Atomic on-disk state files, shared by every durable role.
+
+Both the task master (`master.py`) and the parameter service
+(`param_service.py`) persist recovery state the same way: write the
+full new state to a temp file in the destination directory, fsync it,
+then `os.replace` over the target. A reader therefore always sees
+either the previous complete state or the new complete state — never a
+torn file — and a crash mid-write leaves the previous state intact.
+"""
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+
+
+@contextlib.contextmanager
+def atomic_replace(path, mode='wb'):
+    """Context manager yielding an open temp-file handle; on clean exit
+    the temp file is fsynced and atomically renamed onto `path`, on
+    exception it is removed and `path` is untouched.
+
+    The temp name carries the pid so two processes racing to snapshot
+    the same path (a restarted role overlapping its zombie) cannot
+    interleave writes; last `os.replace` wins with a complete file.
+    """
+    tmp = '%s.%d.tmp' % (path, os.getpid())
+    f = open(tmp, mode)
+    try:
+        yield f
+        f.flush()
+        os.fsync(f.fileno())
+        f.close()
+        os.replace(tmp, path)
+    except BaseException:
+        f.close()
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass
+        raise
+
+
+def atomic_write_json(path, obj):
+    with atomic_replace(path, 'w') as f:
+        json.dump(obj, f)
+
+
+def read_json(path, default=None):
+    """Load a JSON state file; `default` if it does not exist yet."""
+    if not os.path.exists(path):
+        return default
+    with open(path) as f:
+        return json.load(f)

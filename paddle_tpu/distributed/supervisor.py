@@ -1,0 +1,204 @@
+"""Process supervisor for elastic cluster recovery.
+
+Launches cluster roles (trainers, pservers, a master) as subprocesses,
+watches for exits, and restarts failed roles under a backoff +
+restart-budget policy — the local-process analog of what a k8s
+restartPolicy or the reference's paddlecloud supervisor does for a real
+cluster, sized for the subprocess cluster tests and tools/chaos_sweep.
+
+Restart semantics:
+
+- exit 0 is DONE: the role finished; it is never restarted.
+- nonzero exit is a FAILURE: the role is restarted after a backoff
+  (exponential per role, capped), until its restart budget
+  (`max_restarts`) is spent — then the role is FAILED and stays down.
+- every restart sets ``FLAGS_trainer_incarnation`` to the role's
+  restart count in the child's environment, so a restarted trainer
+  re-registers with a higher incarnation and the pserver's fence
+  admits it while rejecting its zombie predecessor
+  (param_service._fence_locked).
+- ``FLAGS_fault_plan`` is STRIPPED from the restart environment by
+  default: the plan that killed the process (the `exit` fault action)
+  would deterministically kill the restarted process at the same
+  message count again.
+
+Output handling: each role's stdout+stderr append to a per-role log
+file (pipes would deadlock once a 64 KB buffer fills with nobody
+draining it — the supervisor must keep watching, not reading).
+"""
+from __future__ import annotations
+
+import os
+import subprocess
+import threading
+import time
+
+__all__ = ['Supervisor']
+
+
+class _Role(object):
+    def __init__(self, name, argv, env, restartable, max_restarts):
+        self.name = name
+        self.argv = list(argv)
+        self.env = dict(env) if env is not None else None
+        self.restartable = restartable
+        self.max_restarts = max_restarts
+        self.proc = None
+        self.restarts = 0
+        self.state = 'pending'        # pending|running|done|failed
+        self.next_restart_at = None   # monotonic; backoff gate
+        self.log_path = None
+
+
+class Supervisor(object):
+    """Launch roles, restart the ones that die, report how it went.
+
+    usage::
+
+        sup = Supervisor(log_dir=tmpdir)
+        sup.add_role('pserver0', [sys.executable, worker], env=ps_env)
+        sup.add_role('trainer0', [sys.executable, worker], env=tr_env)
+        sup.start()
+        states = sup.wait(timeout=120)   # {'pserver0': 'done', ...}
+        sup.stop()
+    """
+
+    def __init__(self, max_restarts=3, backoff=0.5,
+                 backoff_multiplier=2.0, max_backoff=10.0, log_dir=None,
+                 clear_fault_plan_on_restart=True):
+        self.max_restarts = int(max_restarts)
+        self.backoff = float(backoff)
+        self.backoff_multiplier = float(backoff_multiplier)
+        self.max_backoff = float(max_backoff)
+        self.log_dir = log_dir
+        self.clear_fault_plan_on_restart = clear_fault_plan_on_restart
+        self._roles = []
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._monitor = None
+        self.events = []   # [(monotonic, role, event_str), ...]
+
+    # -- configuration -----------------------------------------------------
+    def add_role(self, name, argv, env=None, restartable=True,
+                 max_restarts=None):
+        """Register a role before start(). `env` replaces os.environ for
+        the child when given; restartable=False makes any nonzero exit
+        terminal (a role whose failure the test wants to SEE)."""
+        if max_restarts is None:
+            max_restarts = self.max_restarts
+        self._roles.append(_Role(name, argv, env, restartable,
+                                 int(max_restarts)))
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self):
+        for role in self._roles:
+            self._spawn(role)
+        self._monitor = threading.Thread(target=self._monitor_loop,
+                                         daemon=True)
+        self._monitor.start()
+
+    def _log_file(self, role):
+        if self.log_dir is None:
+            return subprocess.DEVNULL
+        if role.log_path is None:
+            role.log_path = os.path.join(self.log_dir,
+                                         '%s.log' % role.name)
+        return open(role.log_path, 'ab')
+
+    def _spawn(self, role):
+        env = dict(role.env if role.env is not None else os.environ)
+        if role.restarts:
+            env['FLAGS_trainer_incarnation'] = str(role.restarts)
+            if self.clear_fault_plan_on_restart:
+                env.pop('FLAGS_fault_plan', None)
+        logf = self._log_file(role)
+        try:
+            role.proc = subprocess.Popen(role.argv, env=env,
+                                         stdout=logf, stderr=logf)
+        finally:
+            if logf is not subprocess.DEVNULL:
+                logf.close()   # the child holds its own fd now
+        role.state = 'running'
+        self._event(role, 'spawned' if not role.restarts
+                    else 'restarted #%d' % role.restarts)
+
+    def _event(self, role, what):
+        with self._lock:
+            self.events.append((time.monotonic(), role.name, what))
+
+    def _monitor_loop(self):
+        while not self._stop.is_set():
+            all_settled = True
+            now = time.monotonic()
+            for role in self._roles:
+                if role.state == 'running':
+                    rc = role.proc.poll()
+                    if rc is None:
+                        all_settled = False
+                        continue
+                    if rc == 0:
+                        role.state = 'done'
+                        self._event(role, 'exit 0')
+                        continue
+                    self._event(role, 'exit %d' % rc)
+                    if (not role.restartable
+                            or role.restarts >= role.max_restarts):
+                        role.state = 'failed'
+                        continue
+                    role.restarts += 1
+                    delay = min(
+                        self.backoff * self.backoff_multiplier
+                        ** (role.restarts - 1), self.max_backoff)
+                    role.state = 'backoff'
+                    role.next_restart_at = now + delay
+                    all_settled = False
+                elif role.state == 'backoff':
+                    all_settled = False
+                    if now >= role.next_restart_at:
+                        self._spawn(role)
+            if all_settled:
+                return
+            self._stop.wait(timeout=0.05)
+
+    def wait(self, timeout=None):
+        """Block until every role settled (done/failed) or `timeout`
+        elapsed. -> {name: state} snapshot ('running'/'backoff' entries
+        mean the timeout hit first — the caller's hang verdict)."""
+        deadline = None if timeout is None \
+            else time.monotonic() + timeout
+        while True:
+            states = self.states()
+            if all(s in ('done', 'failed') for s in states.values()):
+                return states
+            if deadline is not None and time.monotonic() >= deadline:
+                return states
+            time.sleep(0.05)
+
+    def states(self):
+        return {r.name: r.state for r in self._roles}
+
+    @property
+    def restarts(self):
+        return {r.name: r.restarts for r in self._roles}
+
+    def output(self, name):
+        """Accumulated log of a role across all its incarnations."""
+        for r in self._roles:
+            if r.name == name and r.log_path \
+                    and os.path.exists(r.log_path):
+                with open(r.log_path, 'rb') as f:
+                    return f.read().decode('utf-8', 'replace')
+        return ''
+
+    def stop(self):
+        """Kill anything still running and stop the monitor."""
+        self._stop.set()
+        for role in self._roles:
+            if role.proc is not None and role.proc.poll() is None:
+                role.proc.kill()
+                try:
+                    role.proc.wait(timeout=10.0)
+                except subprocess.TimeoutExpired:
+                    pass
+        if self._monitor is not None:
+            self._monitor.join(timeout=5.0)

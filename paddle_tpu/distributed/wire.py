@@ -29,6 +29,10 @@ BATCH_BARRIER = 4   # trainer -> pserver: all grads for this step sent
 FETCH_BARRIER = 5   # trainer -> pserver: all params for this step fetched
 COMPLETE = 6        # trainer -> pserver: this trainer is done training
 CHECKPOINT = 10     # trainer -> pserver: save your param shard to dir
+REGISTER = 11       # trainer -> pserver: (re)join handshake — carries the
+                    # trainer's incarnation; reply meta reports the
+                    # server's round state so a restarted trainer knows
+                    # where to resume (elastic recovery)
 REPLY_VAR = 7       # pserver -> trainer: a variable value
 REPLY_OK = 8        # pserver -> trainer: ack
 REPLY_ERR = 9       # pserver -> trainer: error (meta['error'])
@@ -78,17 +82,48 @@ def _value_of(meta, payload):
     return np.frombuffer(payload[:n], dtype=dtype).reshape(shape)
 
 
-def write_msg(sock, msg_type, meta=None, value=None, payload=b''):
+def pack_msg(msg_type, meta=None, value=None, payload=b''):
+    """Serialize one frame to bytes. Shared by the socket path
+    (write_msg) and the pserver's on-disk mutation journal
+    (param_service) — a journal record IS a wire frame, so replay and
+    socket dispatch share one decoder."""
     meta = dict(meta or {})
     if value is not None:
         vmeta, payload = _payload_of(value)
         meta.update(vmeta)
     mb = json.dumps(meta).encode('utf-8')
     body_len = 1 + 4 + len(mb) + len(payload)
+    return _HDR.pack(body_len, msg_type, len(mb)) + mb + payload
+
+
+def unpack_msgs(buf):
+    """Yield (msg_type, meta, value) for each complete frame in `buf`.
+    A truncated trailing frame (a journal torn by a mid-write crash) is
+    silently ignored — everything before it was written whole."""
+    off, n = 0, len(buf)
+    while off + _HDR.size <= n:
+        body_len, msg_type, meta_len = _HDR.unpack_from(buf, off)
+        end = off + _HDR.size + body_len - 1 - 4
+        if end > n:
+            return
+        body = buf[off + _HDR.size:end]
+        meta = json.loads(body[:meta_len].decode('utf-8')) if meta_len \
+            else {}
+        payload = body[meta_len:]
+        value = _value_of(meta, payload) if 'dtype' in meta else None
+        yield msg_type, meta, value
+        off = end
+
+
+def write_msg(sock, msg_type, meta=None, value=None, payload=b''):
+    meta = dict(meta or {})
+    if value is not None:
+        vmeta, payload = _payload_of(value)
+        meta.update(vmeta)
     # fault hook BEFORE any bytes hit the wire: an injected drop/error
     # never leaves a half-written frame on the socket
     post_send = _faults().on_send(sock, msg_type, meta)
-    sock.sendall(_HDR.pack(body_len, msg_type, len(mb)) + mb + payload)
+    sock.sendall(pack_msg(msg_type, meta, payload=payload))
     if post_send is not None:
         post_send()   # 'close' action: frame delivered, connection dies
 

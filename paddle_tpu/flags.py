@@ -40,6 +40,19 @@ Known flags:
                          this many times on retryable RPC failure, and
                          roll back to the last SUCCESS checkpoint at
                          most this many times on fatal failure
+  trainer_incarnation    logical restart counter of this trainer
+                         process (elastic recovery): pservers fence
+                         messages from lower incarnations and rejoin
+                         higher ones; the supervisor bumps it per
+                         restart
+  ps_state_path          pserver durability: atomic snapshot file for
+                         params + round/replay state ('' = off);
+                         mutations since the snapshot journal to
+                         <path>.journal
+  ps_snapshot_every      rounds between pserver snapshots
+  ps_average_live        average merged gradients over the LIVE
+                         trainer set instead of the original
+                         num_trainers (see ParameterService._merge)
 """
 from __future__ import annotations
 
@@ -98,6 +111,22 @@ _DEFAULTS = {
     # failure before giving up
     'trainer_step_retries': 2,
     'trainer_max_rollbacks': 2,
+    # elastic recovery (distributed/param_service.py, supervisor.py):
+    # logical restart counter for THIS trainer process — the supervisor
+    # sets it to the restart count; pservers fence lower values and
+    # rejoin higher ones
+    'trainer_incarnation': 0,
+    # pserver durability: path of the atomic state snapshot ('' = no
+    # durability); the mutation journal lives at <path>.journal
+    'ps_state_path': '',
+    # rounds between pserver snapshots (sync mode; async snapshots on a
+    # send count instead)
+    'ps_snapshot_every': 1,
+    # _merge denominator: False (default) averages over the ORIGINAL
+    # num_trainers (dead trainers contribute zero — comparable to the
+    # full-set run), True averages over the live set (constant
+    # effective LR after a death)
+    'ps_average_live': False,
     # store the Momentum velocity accumulator in bf16 (halves the
     # optimizer's dominant HBM stream; one rounding per step; master
     # params stay fp32). Off by default for exact-fp32 parity.

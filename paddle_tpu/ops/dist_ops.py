@@ -278,6 +278,22 @@ def _listen_and_serv_emit(ctx, op):
             with open(os.path.join(dirname, name), 'wb') as f:
                 write_tensor(f, np.asarray(val))
 
+    def dump_state():
+        # elastic-recovery snapshot: every persistable non-grad var of
+        # this shard (the same save set as save_params, as arrays)
+        out = {}
+        for name, var in program.global_block().vars.items():
+            if not var.persistable or name in grad_to_block:
+                continue
+            val = scope.find_var(name)
+            if val is not None:
+                out[name] = np.asarray(val)
+        return out
+
+    def load_state(params):
+        for name, val in params.items():
+            scope.set_var(name, val)
+
     ckpt_dir = op.attr('checkpoint_dir', '')
     if ckpt_dir:
         # restore this shard from a checkpoint_notify save (the reload
@@ -288,12 +304,17 @@ def _listen_and_serv_emit(ctx, op):
             with open(os.path.join(ckpt_dir, fn), 'rb') as f:
                 scope.set_var(fn, read_tensor(f))
 
+    # elastic recovery: with FLAGS_ps_state_path the service restores
+    # its snapshot + journal in __init__ (AFTER the checkpoint_dir load
+    # above, so the newer mid-session state wins) and persists every
+    # round from here on
     service = ParameterService(
         num_trainers=num_trainers, sync_mode=sync_mode,
         get_param=get_param, run_round=run_round,
         run_one_grad=run_one_grad,
         prefetch=prefetch if op.attr('prefetch_table', '') else None,
-        save_params=save_params)
+        save_params=save_params,
+        dump_state=dump_state, load_state=load_state)
     server = PSServer(op.attr('endpoint'), service)
     server.serve_forever()
 

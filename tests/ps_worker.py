@@ -179,6 +179,32 @@ def main():
         exe.run(main_prog)       # blocks until all trainers COMPLETE
         return
 
+    # elastic recovery: a RESTARTED trainer (incarnation > 0, set by the
+    # supervisor) must re-register BEFORE the startup recv — the
+    # handshake lifts a dead-tid ban and reports each shard's round
+    # state. Resume point: min(expected) across shards when the servers
+    # were still waiting for us (fast restart — the stale-round
+    # ack-ignore catches any ahead shard up), max(round) when some shard
+    # had already retired us (rounds ran without us; re-align with the
+    # global round count). The startup recv below then pulls the
+    # authoritative post-round params, so recomputation at resume_step
+    # starts from exactly the state the dead incarnation saw.
+    from paddle_tpu.flags import get_flag
+    incarnation = int(get_flag('trainer_incarnation', 0) or 0)
+    resume_step = 0
+    if incarnation > 0:
+        from paddle_tpu.distributed.rpc import get_client
+        clients = [get_client(ep, trainer_id) for ep in eps.split(',')]
+        infos = [c.register() for c in clients]
+        if any(i.get('rejoined') for i in infos):
+            resume_step = max(int(i['round']) for i in infos)
+        else:
+            resume_step = min(int(i['expected']) for i in infos)
+        for c in clients:
+            c.set_round(resume_step)
+        print('REJOIN inc=%d resume_step=%d infos=%s'
+              % (incarnation, resume_step, infos), flush=True)
+
     exe.run(t.get_trainer_startup_program())
     prog = t.get_trainer_program()
     rng = np.random.RandomState(0)
@@ -194,6 +220,8 @@ def main():
         gbatch = make_batch(model, rng, BATCH_PER_TRAINER * trainers)
         lo = trainer_id * BATCH_PER_TRAINER
         batch = {k: v[lo:lo + BATCH_PER_TRAINER] for k, v in gbatch.items()}
+        if step < resume_step:
+            continue   # replayed history: batch drawn (RNG parity) only
         l, = exe.run(prog, feed=batch, fetch_list=[loss])
         losses.append(float(l))
     ckpt = os.environ.get('PS_CHECKPOINT')

@@ -13,7 +13,14 @@ Go master's lease machinery), this suite PROVES, deterministically:
 - Trainer.train retries a step on retryable failure and rolls back to
   the last SUCCESS-marked checkpoint on fatal failure, emitting
   FaultEvents — and the post-recovery trajectory is bit-identical to an
-  undisturbed run.
+  undisturbed run;
+- elastic recovery (this round): a trainer kill-9'd mid-round (the
+  `exit` fault action) is restarted by the Supervisor, re-registers
+  under a bumped incarnation, and the run lands on weights BIT-EXACTLY
+  equal to the fault-free cluster's; a pserver kill-9'd mid-round
+  restarts from its snapshot + mutation journal with the same
+  guarantee; stale-incarnation zombies are fenced with a non-retryable
+  error.
 """
 import json
 import os
@@ -28,13 +35,17 @@ import pytest
 import paddle_tpu as fluid
 from paddle_tpu.distributed import resilience, wire
 from paddle_tpu.distributed.param_service import ParameterService
-from paddle_tpu.distributed.resilience import (FaultPlan, RetryPolicy,
-                                               RetryableRPCError)
+from paddle_tpu.distributed.resilience import (FatalRPCError, FaultPlan,
+                                               RetryPolicy,
+                                               RetryableRPCError,
+                                               StaleIncarnationError)
 from paddle_tpu.distributed.rpc import PSClient, PSServer
+from paddle_tpu.distributed.supervisor import Supervisor
 
 pytestmark = pytest.mark.chaos
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
+_ROOT = os.path.dirname(_HERE)
 _WORKER = os.path.join(_HERE, 'ps_worker.py')
 sys.path.insert(0, _HERE)
 
@@ -386,3 +397,410 @@ def test_trainer_fatal_without_checkpoint_raises(tmp_path):
         with pytest.raises(FatalRPCError):
             trainer.train(num_epochs=1, event_handler=lambda e: None,
                           reader=_reader, feed_order=['x', 'y'])
+
+
+# ---------------------------------------------------------------------------
+# elastic recovery: the `exit` fault action (deterministic kill -9)
+# ---------------------------------------------------------------------------
+
+def _sub_env():
+    """Environment for python -c subprocesses that import paddle_tpu."""
+    env = dict(os.environ)
+    env['PYTHONPATH'] = _ROOT + os.pathsep + env.get('PYTHONPATH', '')
+    env.pop('FLAGS_fault_plan', None)
+    env.setdefault('JAX_PLATFORMS', 'cpu')
+    return env
+
+
+def test_exit_action_kills_process_at_nth_event():
+    """The Nth matching event terminates the process via os._exit with
+    the rule's code and an audit line on stderr — nothing after the
+    kill point runs. Default code is 137 (= kill -9's 128+SIGKILL)."""
+    assert resilience.FaultRule('send', 1, 'exit').code == 137
+    prog = (
+        "import json, socket\n"
+        "import numpy as np\n"
+        "from paddle_tpu.distributed import resilience, wire\n"
+        "plan = resilience.FaultPlan.from_json(json.dumps({'rules': [\n"
+        "    {'when': 'send', 'type': 'SEND_VAR', 'nth': 2,\n"
+        "     'action': 'exit', 'code': 41}]}))\n"
+        "resilience.install_plan(plan)\n"
+        "a, b = socket.socketpair()\n"
+        "wire.write_msg(a, wire.SEND_VAR, {'name': 'g'},"
+        " np.ones(2, 'f4'))\n"
+        "wire.write_msg(a, wire.SEND_VAR, {'name': 'g'},"
+        " np.ones(2, 'f4'))\n"
+        "print('UNREACHABLE')\n")
+    r = subprocess.run([sys.executable, '-c', prog], env=_sub_env(),
+                       capture_output=True, text=True, timeout=180)
+    assert r.returncode == 41, (r.stdout, r.stderr)
+    assert 'UNREACHABLE' not in r.stdout
+    assert 'fault injection: exit(41)' in r.stderr
+
+
+def test_malformed_fault_plan_fails_loudly():
+    """A bad FLAGS_fault_plan must fail at INSTALL time with the
+    offending text — not surface mid-training as a mystery."""
+    with pytest.raises(ValueError, match='unparseable fault plan'):
+        FaultPlan.from_spec('{"rules": [')
+    try:
+        FaultPlan.from_spec('{"rules": [')
+    except ValueError as e:
+        assert '{"rules": [' in str(e)      # the offending text is named
+    with pytest.raises(ValueError, match='unparseable fault plan'):
+        FaultPlan.from_spec('kill:nobody:3')
+    # the env-bootstrapped install path (what a faulted subprocess role
+    # actually exercises) dies at import, loudly
+    env = _sub_env()
+    env['FLAGS_fault_plan'] = '{oops'
+    r = subprocess.run(
+        [sys.executable, '-c',
+         'import paddle_tpu.distributed.resilience'],
+        env=env, capture_output=True, text=True, timeout=180)
+    assert r.returncode != 0
+    assert 'unparseable fault plan' in r.stderr
+    assert '{oops' in r.stderr
+
+
+# ---------------------------------------------------------------------------
+# incarnation fencing + rejoin (service level)
+# ---------------------------------------------------------------------------
+
+def _two_trainer_service(average_live):
+    params = {'w': np.zeros(4, 'f4')}
+
+    def run_round(merged):
+        for v in merged.values():
+            params['w'] = params['w'] - np.asarray(v)
+
+    svc = ParameterService(
+        num_trainers=2, sync_mode=True,
+        get_param=lambda name: params[name], run_round=run_round,
+        rpc_deadline=60.0, average_live=average_live)
+    return svc, params
+
+
+def test_merge_denominator_semantics():
+    """FLAGS_ps_average_live pins _merge's denominator: False (default)
+    averages over the ORIGINAL num_trainers (a dead trainer's share is
+    zero — effective LR shrinks, weights stay comparable), True over
+    the LIVE set (true mean, constant effective LR)."""
+    g = 4 * np.ones(4, 'f4')
+    for average_live, expect in ((False, -2.0), (True, -4.0)):
+        svc, params = _two_trainer_service(average_live)
+        svc.on_complete(1)                 # trainer 1 retires
+        svc.on_send_var('w@GRAD', 0, g, seq=('c', 1))
+        svc.on_batch_barrier(0, seq=('c', 2))
+        np.testing.assert_allclose(
+            params['w'], expect * np.ones(4, 'f4'),
+            err_msg='average_live=%s' % average_live)
+
+
+def test_fetch_barrier_rejects_zombie_and_stale_incarnation():
+    """FETCH_BARRIER goes through the same _enter_locked gate as every
+    other handler: a deadline-retired zombie and a stale incarnation
+    both fail loudly instead of silently ending a round."""
+    svc, _, _, _ = _mini_service()
+    svc.dead_tids.add(0)
+    svc._done_tids.add(0)
+    with pytest.raises(RuntimeError, match='retired by the liveness'):
+        svc.on_fetch_barrier(0)
+    svc2, _, _, _ = _mini_service()
+    svc2.on_register(0, inc=1)
+    with pytest.raises(StaleIncarnationError):
+        svc2.on_fetch_barrier(0, inc=0)
+
+
+def test_stale_incarnation_rejected_non_retryable():
+    """Over real sockets: a pre-restart zombie client (lower logical
+    incarnation) gets a NON-retryable rejection — the client raises
+    FatalRPCError instead of replaying into the fresh incarnation's
+    rounds — while the fresh incarnation keeps training normally."""
+    svc, params, rounds, _ = _mini_service(sync_mode=True)
+    srv = PSServer('127.0.0.1:0', svc)
+    st = threading.Thread(target=srv.serve_forever, daemon=True)
+    st.start()
+    g = np.ones(4, 'f4')
+    try:
+        fresh = PSClient('127.0.0.1:%d' % srv.port, trainer_id=0,
+                         retry_policy=_fast_retry(), incarnation=1)
+        info = fresh.register()
+        assert info == {'round': 0, 'expected': 0, 'rejoined': False}
+        zombie = PSClient('127.0.0.1:%d' % srv.port, trainer_id=0,
+                          retry_policy=_fast_retry(), incarnation=0)
+        with pytest.raises(FatalRPCError, match='incarnation'):
+            zombie.send_var('w@GRAD', g)
+        assert rounds == []               # the zombie mutated nothing
+        fresh.send_var('w@GRAD', g)
+        fresh.batch_barrier()
+        np.testing.assert_allclose(fresh.get_var('w'), -g)
+        fresh.complete()
+        fresh.close()
+    finally:
+        st.join(timeout=10.0)
+    assert not st.is_alive()
+    assert len(rounds) == 1
+
+
+def test_check_liveness_retired_then_rejoined():
+    """Shutdown condition vs rejoin: a silently-dead trainer is retired
+    (all accounted for -> True), but once its new incarnation registers
+    the server must KEEP SERVING (False) until a real COMPLETE."""
+    params = {'w': np.zeros(4, 'f4')}
+    svc = ParameterService(
+        num_trainers=1, sync_mode=True,
+        get_param=lambda name: params[name],
+        run_round=lambda merged: None, rpc_deadline=0.05)
+    svc._barrier_ever.add(0)
+    svc._last_seen[0] = -1e9              # silent far past the deadline
+    assert svc.check_liveness() is True   # retired: all accounted for
+    assert 0 in svc.dead_tids
+    info = svc.on_register(0, inc=1)
+    assert info['rejoined'] is True
+    assert svc.check_liveness() is False  # live again: keep serving
+    assert 0 not in svc.dead_tids
+    svc.on_complete(0, inc=1)
+    assert svc.check_liveness() is True
+
+
+# ---------------------------------------------------------------------------
+# pserver durability: snapshot + journal round trips
+# ---------------------------------------------------------------------------
+
+def _durable_service(path, snapshot_every=1):
+    params = {'w': np.zeros(4, 'f4')}
+
+    def run_round(merged):
+        for v in merged.values():
+            params['w'] = params['w'] - np.asarray(v)
+
+    svc = ParameterService(
+        num_trainers=1, sync_mode=True,
+        get_param=lambda name: params[name], run_round=run_round,
+        rpc_deadline=60.0, snapshot_path=path,
+        snapshot_every=snapshot_every,
+        dump_state=lambda: dict(params),
+        load_state=lambda p: params.update(
+            {k: np.asarray(v) for k, v in p.items()}))
+    return svc, params
+
+
+def test_snapshot_restore_round_trip(tmp_path):
+    """A fresh service on the same snapshot path resumes with params,
+    round counters AND dedup windows exactly equal — everything a
+    restarted pserver needs to keep serving mid-session."""
+    path = str(tmp_path / 'ps.state')
+    svc, params = _durable_service(path)
+    for r in range(3):
+        svc.on_send_var('w@GRAD', 0, (r + 1) * np.ones(4, 'f4'),
+                        seq=('c1', 2 * r + 1), inc=0, round_idx=r)
+        svc.on_batch_barrier(0, seq=('c1', 2 * r + 2), inc=0,
+                             round_idx=r)
+    expect = params['w'].copy()
+    svc2, params2 = _durable_service(path)
+    np.testing.assert_array_equal(params2['w'], expect)
+    assert svc2._completed_rounds == 3
+    assert svc2._trainer_rounds == {0: 3}
+    assert svc2._seq_seen[0] == svc._seq_seen[0]
+    # the restored window still dedups a pre-kill replay
+    svc2.on_send_var('w@GRAD', 0, 99 * np.ones(4, 'f4'),
+                     seq=('c1', 5), inc=0, round_idx=2)
+    assert 'w@GRAD' not in svc2._pending or \
+        0 not in svc2._pending.get('w@GRAD', {})
+
+
+def test_journal_replays_mid_round_mutations(tmp_path):
+    """Mutations since the last snapshot live in the journal: a restart
+    mid-round replays them through the real handlers and lands on the
+    precise pre-kill state — including half-pushed pending grads. A
+    torn trailing record (kill -9 mid-write) is tolerated."""
+    path = str(tmp_path / 'ps.state')
+    svc, params = _durable_service(path, snapshot_every=10)
+    svc.on_send_var('w@GRAD', 0, np.ones(4, 'f4'),
+                    seq=('c1', 1), inc=0, round_idx=0)
+    svc.on_batch_barrier(0, seq=('c1', 2), inc=0, round_idx=0)
+    # round 1 in flight: the send arrived, the barrier never did
+    svc.on_send_var('w@GRAD', 0, 2 * np.ones(4, 'f4'),
+                    seq=('c1', 3), inc=0, round_idx=1)
+    post_round0 = params['w'].copy()
+    with open(path + '.journal', 'ab') as f:
+        f.write(b'\x07\x00')              # torn tail
+    svc2, params2 = _durable_service(path, snapshot_every=10)
+    np.testing.assert_array_equal(params2['w'], post_round0)
+    assert svc2._completed_rounds == 1
+    np.testing.assert_array_equal(
+        np.asarray(svc2._pending['w@GRAD'][0]), 2 * np.ones(4, 'f4'))
+    # the dedup window replayed too: PR 1's client retry of the exact
+    # in-flight request is acked without double-applying
+    svc2.on_send_var('w@GRAD', 0, 2 * np.ones(4, 'f4'),
+                     seq=('c1', 3), inc=0, round_idx=1)
+    assert list(svc2._seq_order[0]) == [('c1', 1), ('c1', 2), ('c1', 3)]
+
+
+# ---------------------------------------------------------------------------
+# the Supervisor (unit level)
+# ---------------------------------------------------------------------------
+
+def test_supervisor_restart_budget_and_incarnation(tmp_path):
+    """Restart policy end to end: exit 0 is done, nonzero restarts with
+    a bumped FLAGS_trainer_incarnation until the budget is spent,
+    restartable=False is terminal, and FLAGS_fault_plan is stripped
+    from restart environments (or the same plan would kill the restart
+    at the same message count again)."""
+    sup = Supervisor(max_restarts=3, backoff=0.05,
+                     backoff_multiplier=1.0, log_dir=str(tmp_path))
+    py = sys.executable
+    flaky = ("import os, sys\n"
+             "inc = os.environ.get('FLAGS_trainer_incarnation', '0')\n"
+             "print('inc', inc, flush=True)\n"
+             "sys.exit(0 if inc == '2' else 3)\n")
+    planned = ("import os, sys\n"
+               "sys.exit(5 if os.environ.get('FLAGS_fault_plan')"
+               " else 0)\n")
+    sup.add_role('flaky', [py, '-c', flaky])
+    sup.add_role('clean', [py, '-c', 'pass'])
+    sup.add_role('hard', [py, '-c', 'raise SystemExit(4)'],
+                 restartable=False)
+    sup.add_role('planned', [py, '-c', planned],
+                 env=dict(os.environ, FLAGS_fault_plan='{"rules": []}'))
+    sup.add_role('budget', [py, '-c', 'raise SystemExit(6)'],
+                 max_restarts=1)
+    sup.start()
+    states = sup.wait(timeout=60)
+    sup.stop()
+    assert states == {'flaky': 'done', 'clean': 'done',
+                      'hard': 'failed', 'planned': 'done',
+                      'budget': 'failed'}
+    assert sup.restarts == {'flaky': 2, 'clean': 0, 'hard': 0,
+                            'planned': 1, 'budget': 1}
+    out = sup.output('flaky')
+    assert 'inc 0' in out and 'inc 2' in out
+
+
+# ---------------------------------------------------------------------------
+# acceptance: kill -9 a trainer / a pserver mid-round, recover EXACTLY
+# ---------------------------------------------------------------------------
+
+_ELASTIC_KNOBS = {
+    # cover the victim's death + supervisor backoff + restart without
+    # the liveness reaper retiring anyone as silently dead first
+    'FLAGS_rpc_deadline': '120',
+    'FLAGS_rpc_max_retries': '12',
+    'FLAGS_rpc_reconnect_secs': '10',
+}
+
+
+def _run_supervised(workdir, victim=None, plan_json=None, steps=3,
+                    trainers=2, pservers=2):
+    """mlp sync cluster under the Supervisor, pserver snapshots on.
+    -> (weights, restarts, trainer0 log, pserver0 log)."""
+    eps = ','.join('127.0.0.1:%d' % p for p in _free_ports(pservers))
+    base = dict(os.environ)
+    base.pop('JAX_PLATFORMS', None)
+    base.pop('XLA_FLAGS', None)
+    base.update({'PS_MODEL': 'mlp', 'PS_ENDPOINTS': eps,
+                 'PS_TRAINERS': str(trainers), 'PS_STEPS': str(steps),
+                 'PS_SYNC': '1', 'PS_OPTIMIZER': 'sgd'})
+    base.update(_ELASTIC_KNOBS)
+    sup = Supervisor(max_restarts=2, backoff=0.5, log_dir=workdir)
+    for i in range(pservers):
+        env = dict(base, PS_ROLE='pserver', PS_PSERVER_ID=str(i),
+                   FLAGS_ps_state_path=os.path.join(
+                       workdir, 'ps%d.state' % i))
+        if victim == 'pserver' and i == 0:
+            env['FLAGS_fault_plan'] = plan_json
+        sup.add_role('pserver%d' % i, [sys.executable, _WORKER],
+                     env=env)
+    for i in range(trainers):
+        env = dict(base, PS_ROLE='trainer', PS_TRAINER_ID=str(i))
+        if victim == 'trainer' and i == 0:
+            env['FLAGS_fault_plan'] = plan_json
+        sup.add_role('trainer%d' % i, [sys.executable, _WORKER],
+                     env=env)
+    sup.start()
+    try:
+        states = sup.wait(timeout=420)
+        t0 = sup.output('trainer0')
+        p0 = sup.output('pserver0')
+        assert all(s == 'done' for s in states.values()), \
+            (states, t0[-4000:], p0[-4000:])
+        weights = None
+        for ln in t0.splitlines():
+            if ln.startswith('RESULT '):
+                weights = json.loads(ln[len('RESULT '):])['weights']
+        assert weights is not None, t0[-4000:]
+        return weights, dict(sup.restarts), t0, p0
+    finally:
+        sup.stop()
+
+
+@pytest.fixture(scope='module')
+def clean_cluster_weights(tmp_path_factory):
+    """ONE fault-free supervised run, shared by both kill tests. The
+    exactness bar is the fault-free DISTRIBUTED run: local
+    single-process weights differ by float32 summation-order noise
+    (~1e-8), so bit-equality is only meaningful cluster-vs-cluster;
+    the local baseline is pinned with allclose here as a sanity rail."""
+    import ps_worker
+    wd = str(tmp_path_factory.mktemp('clean'))
+    weights, restarts, _, _ = _run_supervised(wd)
+    assert all(r == 0 for r in restarts.values())
+    _, local_w = ps_worker.local_train('mlp', 3, 'sgd', 2)
+    for p, lw in local_w.items():
+        np.testing.assert_allclose(
+            np.asarray(weights[p]), np.asarray(lw),
+            rtol=1e-4, atol=1e-5,
+            err_msg='clean cluster diverged from local baseline (%s)'
+                    % p)
+    return weights
+
+
+@pytest.mark.timeout(600)
+def test_trainer_kill_rejoins_and_matches_fault_free(
+        clean_cluster_weights, tmp_path):
+    """THE trainer-side acceptance bar: trainer 0 is kill-9'd at its
+    5th SEND_VAR (mid-round, grads half-pushed), the Supervisor
+    restarts it with incarnation 1, it re-registers, resumes, and the
+    run lands on weights BIT-EXACTLY equal to the fault-free
+    cluster's."""
+    plan = json.dumps({'rules': [
+        {'when': 'send', 'type': 'SEND_VAR', 'nth': 5,
+         'action': 'exit'}]})
+    weights, restarts, t0, _ = _run_supervised(
+        str(tmp_path), victim='trainer', plan_json=plan)
+    assert restarts['trainer0'] == 1
+    assert 'fault injection: exit' in t0
+    assert 'REJOIN inc=1' in t0
+    for p, cw in clean_cluster_weights.items():
+        assert np.array_equal(np.asarray(weights[p]), np.asarray(cw)), \
+            'param %s diverged after trainer kill + rejoin' % p
+
+
+@pytest.mark.timeout(600)
+def test_pserver_kill_restarts_from_snapshot_and_matches(
+        clean_cluster_weights, tmp_path):
+    """THE pserver-side acceptance bar: pserver 0 is kill-9'd on its
+    6th inbound SEND_VAR, the Supervisor restarts it, it re-binds the
+    same endpoint, restores snapshot + journal, the trainers' retry
+    layer reconnects — and the weights are BIT-EXACTLY fault-free."""
+    plan = json.dumps({'rules': [
+        {'when': 'recv', 'type': 'SEND_VAR', 'nth': 6,
+         'action': 'exit'}]})
+    weights, restarts, _, p0 = _run_supervised(
+        str(tmp_path), victim='pserver', plan_json=plan)
+    assert restarts['pserver0'] == 1
+    assert 'fault injection: exit' in p0
+    for p, cw in clean_cluster_weights.items():
+        assert np.array_equal(np.asarray(weights[p]), np.asarray(cw)), \
+            'param %s diverged after pserver kill + restart' % p
+
+
+@pytest.mark.slow
+@pytest.mark.timeout(1200)
+def test_chaos_sweep_kill_smoke():
+    """The full seeded kill sweep (tools/chaos_sweep.py --kill): every
+    seed must end recovered/nokill — never diverged."""
+    sys.path.insert(0, os.path.join(_ROOT, 'tools'))
+    import chaos_sweep
+    assert chaos_sweep.main(['--kill', '--seeds', '2', '--steps', '3',
+                             '--budget', '240']) == 0

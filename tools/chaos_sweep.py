@@ -17,10 +17,26 @@ is:
             of rules that are fatal errors)
   hung      the cluster blew the per-seed time budget and was killed
 
+`--kill` switches to the elastic-recovery sweep: each seed picks a
+victim role (trainer 0 or pserver 0) and a kill point
+(`FaultPlan.from_kill_seed` -- one `exit` rule, the deterministic
+kill -9 analog), and the cluster runs under `distributed.Supervisor`
+with pserver snapshots enabled, so the victim is RESTARTED: a trainer
+rejoins under a bumped incarnation, a pserver resumes from its
+snapshot + journal. Verdicts:
+
+  recovered  the victim died, was restarted, and final weights match
+             the fault-free baseline bit-exactly
+  diverged   cluster finished after the kill but weights differ (a
+             recovery bug -- report the seed)
+  fatal      a role exhausted its restart budget
+  hung       the supervised cluster blew the time budget
+
 Usage:
     python tools/chaos_sweep.py                     # seeds 0..19
     python tools/chaos_sweep.py --seeds 100 --steps 4
     python tools/chaos_sweep.py --seed-start 7 --seeds 1 --verbose
+    python tools/chaos_sweep.py --kill --seeds 10   # process-kill mode
 
 Exit status is non-zero iff any seed DIVERGED: fatal/hung seeds are
 plan-dependent outcomes, weight divergence is never acceptable.
@@ -107,6 +123,69 @@ def _run_seed(plan_json, model, steps, trainers, pservers, budget):
     return ('ok', weights, outs) if weights else ('fatal', None, outs)
 
 
+def _run_kill_seed(seed, model, steps, trainers, pservers, budget,
+                   workdir):
+    """One --kill seed under the Supervisor: returns (verdict, weights,
+    victim, plan_json, outs)."""
+    import random
+
+    from paddle_tpu.distributed.resilience import FaultPlan
+    from paddle_tpu.distributed.supervisor import Supervisor
+
+    role = random.Random(('victim', seed).__repr__()).choice(
+        ['trainer', 'pserver'])
+    plan = FaultPlan.from_kill_seed(seed, role)
+    eps = ','.join('127.0.0.1:%d' % p for p in _free_ports(pservers))
+    base_env = dict(os.environ)
+    base_env.pop('JAX_PLATFORMS', None)
+    base_env.pop('XLA_FLAGS', None)
+    base_env.update({'PS_MODEL': model, 'PS_ENDPOINTS': eps,
+                     'PS_TRAINERS': str(trainers), 'PS_STEPS': str(steps),
+                     'PS_SYNC': '1', 'PS_OPTIMIZER': 'sgd',
+                     # cover the victim's death + supervisor backoff +
+                     # restart without retiring anyone as silently dead
+                     'FLAGS_rpc_deadline': '120',
+                     'FLAGS_rpc_max_retries': '12',
+                     'FLAGS_rpc_reconnect_secs': '10'})
+    sup = Supervisor(max_restarts=2, backoff=0.5, log_dir=workdir)
+    for i in range(pservers):
+        env = dict(base_env, PS_ROLE='pserver', PS_PSERVER_ID=str(i),
+                   FLAGS_ps_state_path=os.path.join(
+                       workdir, 'ps%d_s%d.state' % (i, seed)))
+        if role == 'pserver' and i == 0:
+            env['FLAGS_fault_plan'] = plan.to_json()
+        sup.add_role('pserver%d' % i,
+                     [sys.executable, _WORKER], env=env)
+    for i in range(trainers):
+        env = dict(base_env, PS_ROLE='trainer', PS_TRAINER_ID=str(i))
+        if role == 'trainer' and i == 0:
+            env['FLAGS_fault_plan'] = plan.to_json()
+        sup.add_role('trainer%d' % i,
+                     [sys.executable, _WORKER], env=env)
+    sup.start()
+    states = sup.wait(timeout=budget)
+    outs = [sup.output(n) for n in sorted(states)]
+    victim = '%s0' % role
+    try:
+        if any(s in ('running', 'backoff') for s in states.values()):
+            return 'hung', None, victim, plan.to_json(), outs
+        if any(s == 'failed' for s in states.values()):
+            return 'fatal', None, victim, plan.to_json(), outs
+        weights = None
+        for ln in sup.output('trainer0').splitlines():
+            if ln.startswith('RESULT '):
+                weights = json.loads(ln[len('RESULT '):])['weights']
+        if weights is None:
+            return 'fatal', None, victim, plan.to_json(), outs
+        if sup.restarts[victim] == 0:
+            # the kill point never fired (nth beyond the run's message
+            # count) -- a clean run, counted ok but labeled
+            return 'nokill', weights, victim, plan.to_json(), outs
+        return 'recovered', weights, victim, plan.to_json(), outs
+    finally:
+        sup.stop()
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument('--seeds', type=int, default=20,
@@ -120,7 +199,12 @@ def main(argv=None):
                     help='per-seed wall-clock budget in seconds')
     ap.add_argument('--verbose', action='store_true',
                     help='dump worker output for non-ok seeds')
+    ap.add_argument('--kill', action='store_true',
+                    help='process-kill mode: seeded exit faults under '
+                         'the restarting Supervisor (elastic recovery)')
     args = ap.parse_args(argv)
+
+    import tempfile
 
     import numpy as np
 
@@ -131,15 +215,26 @@ def main(argv=None):
     _, local_w = ps_worker.local_train(args.model, args.steps, 'sgd',
                                        args.trainers)
 
-    tally = {'ok': 0, 'diverged': 0, 'fatal': 0, 'hung': 0}
+    ok_verdicts = ('recovered', 'nokill') if args.kill else ('ok',)
+    tally = {'ok': 0, 'recovered': 0, 'nokill': 0, 'diverged': 0,
+             'fatal': 0, 'hung': 0}
     bad_seeds = []
     for seed in range(args.seed_start, args.seed_start + args.seeds):
-        plan = FaultPlan.from_seed(seed)
         t0 = time.monotonic()
-        verdict, weights, outs = _run_seed(
-            plan.to_json(), args.model, args.steps, args.trainers,
-            args.pservers, args.budget)
-        if verdict == 'ok':
+        if args.kill:
+            with tempfile.TemporaryDirectory() as workdir:
+                verdict, weights, victim, plan_json, outs = \
+                    _run_kill_seed(seed, args.model, args.steps,
+                                   args.trainers, args.pservers,
+                                   args.budget, workdir)
+            label = '%s %s' % (victim, plan_json)
+        else:
+            plan = FaultPlan.from_seed(seed)
+            plan_json = label = plan.to_json()
+            verdict, weights, outs = _run_seed(
+                plan_json, args.model, args.steps, args.trainers,
+                args.pservers, args.budget)
+        if verdict in ok_verdicts:
             for p, lw in local_w.items():
                 if not np.allclose(np.asarray(weights[p]),
                                    np.asarray(lw),
@@ -149,16 +244,17 @@ def main(argv=None):
         tally[verdict] += 1
         if verdict == 'diverged':
             bad_seeds.append(seed)
-        print('seed %4d  %-8s  %5.1fs  %s'
-              % (seed, verdict, time.monotonic() - t0, plan.to_json()))
-        if args.verbose and verdict not in ('ok',):
+        print('seed %4d  %-9s  %5.1fs  %s'
+              % (seed, verdict, time.monotonic() - t0, label))
+        if args.verbose and verdict not in ok_verdicts:
             for out in outs:
                 print('  | ' + '\n  | '.join(out.splitlines()[-15:]))
 
     total = sum(tally.values())
-    print('\nswept %d seeds: %d ok, %d diverged, %d fatal, %d hung'
-          % (total, tally['ok'], tally['diverged'], tally['fatal'],
-             tally['hung']))
+    print('\nswept %d seeds: %d ok, %d recovered, %d nokill, '
+          '%d diverged, %d fatal, %d hung'
+          % (total, tally['ok'], tally['recovered'], tally['nokill'],
+             tally['diverged'], tally['fatal'], tally['hung']))
     if bad_seeds:
         print('DIVERGED seeds (reproduce with --seed-start N --seeds 1 '
               '--verbose): %s' % bad_seeds)
