@@ -351,9 +351,14 @@ class MasterClient(object):
     restarted MASTER also re-serves: connect retries cover its re-bind
     window, and TaskMaster recovery re-queues leases)."""
 
-    def __init__(self, endpoint, worker='worker', timeout=60.0,
+    def __init__(self, endpoint, worker='worker', timeout=None,
                  connect_retry_secs=60.0, retry_policy=None):
         self.worker = worker
+        if timeout is None:
+            # same read deadline as PSClient: a mute master surfaces as
+            # a retryable timeout, never a silent hang
+            from ..flags import get_flag
+            timeout = float(get_flag('rpc_read_deadline', 120.0))
         self.timeout = timeout
         host, port = endpoint.rsplit(':', 1)
         self._addr = (host, int(port))

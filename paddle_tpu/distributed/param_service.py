@@ -76,9 +76,38 @@ restores the snapshot, replays the journal through the same handlers
 bit-exactly back at the kill point: the only in-flight request the
 journal can miss is the one whose reply was never sent, and PR 1's
 client retry layer replays exactly that one. A crash BETWEEN the
-snapshot replace and the journal truncate is safe too — replayed
+snapshot replace and the journal rotation is safe too — replayed
 pre-snapshot records are absorbed by the snapshotted dedup windows and
 round tags.
+
+**Corruption defense.** Every snapshot carries a crc32 digest sidecar
+(statefile.write_digest) and every journal record carries the wire
+frame's own CRC. Snapshots rotate through two generations: writing
+snapshot S_k moves the previous one to `<path>.prev` and the journal
+(covering [S_{k-1}, S_k)) to `<path>.journal.prev`, so restore can fall
+back a full generation: a snapshot that fails its digest (or does not
+load) is quarantined to `<path>.corrupt` — kept on disk for
+post-mortem — and the `.prev` snapshot plus BOTH journals replay to the
+exact same state (pre-snapshot records are absorbed, same argument as
+the crash window above). A journal frame that fails its CRC ends
+replay at the last good record — the consistent prefix — with a loud
+warning, and the damaged file is quarantined. After any quarantine the
+service immediately persists a fresh verified snapshot and retires the
+older generation (its journal continuity is broken: pairing a stale
+snapshot with a later-era journal would silently lose the recovered
+prefix). If every generation is corrupt, the service starts from
+initial state LOUDLY rather than replay journal deltas against a lost
+base. A torn trailing journal record (mid-write crash) is truncated
+before the journal reopens for append — appending after torn bytes
+would corrupt the framing of everything that follows.
+
+With `check_grad_finite` (FLAGS_ps_check_grad_finite, default on), a
+SEND_VAR whose float payload contains NaN/Inf is rejected BEFORE it is
+journaled or applied, with a retryable error: a poisoned gradient (bit
+corruption that survived transport, or a diverging trainer) never
+enters the durable state, and the client's in-place retry re-sends the
+value it actually computed — if that one is clean (transient fault),
+training proceeds bit-exactly.
 """
 from __future__ import annotations
 
@@ -131,6 +160,8 @@ class ParameterService(object):
         if average_live is None:
             average_live = bool(get_flag('ps_average_live', False))
         self.average_live = average_live
+        self.check_grad_finite = bool(get_flag('ps_check_grad_finite',
+                                               True))
 
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
@@ -163,9 +194,18 @@ class ParameterService(object):
         self._replaying = False
         self._journal_f = None
         self._async_applied = 0       # async mode: sends since snapshot
+        self._restore_dirty = False   # restore quarantined corruption
         if self.snapshot_path:
             self._restore()
-            self._journal_open()
+            if self._restore_dirty and self._dump_state is not None:
+                # corruption was quarantined during restore: the
+                # in-memory state (surviving snapshot + journal prefix)
+                # is the only trustworthy copy — persist it as a fresh
+                # verified generation before serving
+                with self._lock:
+                    self._recover_generations_locked()
+            if self._journal_f is None:
+                self._journal_open()
 
     # -- helpers -----------------------------------------------------------
     def _live_count(self):
@@ -395,12 +435,17 @@ class ParameterService(object):
     def _journal_open(self):
         self._journal_f = open(self._journal_path(), 'ab')
 
-    def _journal_reset_locked(self):
-        """Truncate the journal: everything before this instant is in
-        the snapshot that was just atomically replaced."""
+    def _journal_rotate_locked(self):
+        """Move the journal to `.prev`, pairing it with the snapshot
+        generation that was just rotated to `.prev`: everything in it
+        is covered by the snapshot that was just written, and a
+        fallback restore replays it on the `.prev` snapshot."""
+        jpath = self._journal_path()
         if self._journal_f is not None:
             self._journal_f.close()
-        self._journal_f = open(self._journal_path(), 'wb')
+        if os.path.exists(jpath):
+            os.replace(jpath, jpath + '.prev')
+        self._journal_f = open(jpath, 'wb')
 
     def _journal_locked(self, msg_type, meta, value=None):
         """Append one applied mutation as a wire frame, flushed to the
@@ -420,9 +465,14 @@ class ParameterService(object):
                 and self._completed_rounds % self.snapshot_every == 0):
             self._snapshot_locked()
 
-    def _snapshot_locked(self):
+    def _snapshot_locked(self, rotate=True):
         """Atomically persist params + every piece of round/replay state
-        a restarted server needs to keep serving mid-session."""
+        a restarted server needs to keep serving mid-session, with a
+        crc32 digest sidecar. `rotate` keeps a `.prev` generation of
+        both the snapshot and the journal for corruption fallback;
+        recovery-time snapshots pass rotate=False because the retired
+        generations' journal continuity is broken."""
+        from . import statefile
         from .statefile import atomic_replace
         state = {
             'completed_rounds': self._completed_rounds,
@@ -442,20 +492,84 @@ class ParameterService(object):
             json.dumps(state).encode('utf-8'), dtype=np.uint8)
         # np.savez appends '.npz' to a path STRING but writes an open
         # handle verbatim — go through the handle so the atomic-replace
-        # target name is exact
-        with atomic_replace(self.snapshot_path) as f:
+        # target name is exact. Stage under `.next` so the generation
+        # rotation below is rename-only (no window where the current
+        # snapshot is gone and the new one is half-written).
+        staging = self.snapshot_path + '.next'
+        with atomic_replace(staging) as f:
             np.savez(f, **arrays)
-        self._journal_reset_locked()
+        statefile.write_digest(staging)
+        if rotate:
+            if os.path.exists(self.snapshot_path):
+                statefile.move_with_digest(self.snapshot_path,
+                                           self.snapshot_path + '.prev')
+            statefile.move_with_digest(staging, self.snapshot_path)
+            self._journal_rotate_locked()
+        else:
+            statefile.move_with_digest(staging, self.snapshot_path)
+            if self._journal_f is not None:
+                self._journal_f.close()
+            self._journal_f = open(self._journal_path(), 'wb')
+
+    def _recover_generations_locked(self):
+        """After a restore that quarantined corruption: retire every
+        older on-disk generation and persist the recovered in-memory
+        state as a fresh verified snapshot. The old `.prev`/journal
+        files must go — after recovery their continuity is broken, and
+        a stale snapshot paired with a later-era journal would
+        silently lose the recovered prefix on a future fallback."""
+        from . import statefile
+        jpath = self._journal_path()
+        for p in (self.snapshot_path + '.prev', jpath + '.prev', jpath):
+            for q in (p, statefile.digest_path(p)):
+                try:
+                    os.remove(q)
+                except OSError:
+                    pass
+        self._snapshot_locked(rotate=False)
 
     def _restore(self):
         """Snapshot + journal replay: called once from __init__, before
-        any connection is accepted."""
-        if os.path.exists(self.snapshot_path):
-            with np.load(self.snapshot_path) as z:
-                state = json.loads(bytes(z['__state__'].data)
-                                   .decode('utf-8'))
-                params = {k[len('p:'):]: np.array(z[k])
-                          for k in z.files if k.startswith('p:')}
+        any connection is accepted.
+
+        Corruption policy: a snapshot that fails its digest sidecar (or
+        does not load) is quarantined and restore falls back to the
+        `.prev` generation; replaying `.journal.prev` + `.journal` on
+        it reaches the exact same state (pre-snapshot records are
+        absorbed by the snapshotted dedup windows and round tags). If
+        every generation is corrupt, the journals are quarantined too
+        and the service starts from initial state LOUDLY — journal
+        records are deltas against a lost base, and replaying them on
+        fresh params would fabricate a state that never existed."""
+        import sys
+        from . import statefile
+        snap = self.snapshot_path
+        jpath = self._journal_path()
+        loaded, existed = None, False
+        for cand in (snap, snap + '.prev'):
+            if not os.path.exists(cand):
+                continue
+            existed = True
+            status = statefile.verify_digest(cand)
+            if status == 'mismatch':
+                statefile.quarantine(cand, 'snapshot digest mismatch')
+                self._restore_dirty = True
+                continue
+            if status == 'missing':
+                sys.stderr.write(
+                    'WARNING: snapshot %s has no digest sidecar '
+                    '(pre-digest file or a crash before the sidecar '
+                    'write); accepting it unverified\n' % cand)
+            try:
+                with np.load(cand) as z:
+                    state = json.loads(bytes(z['__state__'].data)
+                                       .decode('utf-8'))
+                    params = {k[len('p:'):]: np.array(z[k])
+                              for k in z.files if k.startswith('p:')}
+            except Exception as e:
+                statefile.quarantine(cand, 'unreadable snapshot: %r' % e)
+                self._restore_dirty = True
+                continue
             if self._load_state is not None:
                 self._load_state(params)
             self._completed_rounds = int(state['completed_rounds'])
@@ -470,18 +584,69 @@ class ParameterService(object):
                 tid = int(k)
                 self._seq_order[tid] = deque(tuple(t) for t in toks)
                 self._seq_seen[tid] = set(self._seq_order[tid])
-        jpath = self._journal_path()
-        if not os.path.exists(jpath):
+            loaded = cand
+            if cand != snap:
+                sys.stderr.write('WARNING: restored from previous '
+                                 'snapshot generation %s\n' % cand)
+            break
+        if existed and loaded is None:
+            sys.stderr.write(
+                'WARNING: every snapshot generation of %s is corrupt '
+                '(quarantined); the journals are deltas against the '
+                'lost snapshots and cannot be replayed — starting from '
+                'initial state\n' % snap)
+            for jp in (jpath + '.prev', jpath):
+                if os.path.exists(jp):
+                    statefile.quarantine(jp, 'journal without a '
+                                             'replayable base snapshot')
             return
-        with open(jpath, 'rb') as f:
-            buf = f.read()
+        # replay oldest-first; records already covered by the loaded
+        # snapshot are absorbed (dedup windows + round tags)
+        for jp in (jpath + '.prev', jpath):
+            if not os.path.exists(jp):
+                continue
+            if not self._replay_journal(jp):
+                # corruption ends replay at the consistent prefix: the
+                # damaged file AND anything after it (a later era that
+                # cannot be applied over the gap) are quarantined
+                self._restore_dirty = True
+                statefile.quarantine(jp, 'corrupt journal frame')
+                if jp != jpath and os.path.exists(jpath):
+                    statefile.quarantine(
+                        jpath, 'era follows a corrupt journal')
+                return
+
+    def _replay_journal(self, jp):
+        """Replay one journal file through the live handlers. Returns
+        False when a corrupt (CRC-failing) frame ended replay early; a
+        torn trailing record is truncated in place (appending after
+        torn bytes would corrupt the framing of every later record)."""
+        import sys
         from . import wire
+        with open(jp, 'rb') as f:
+            buf = f.read()
+        consumed = 0
         self._replaying = True
         try:
-            for msg_type, meta, value in wire.unpack_msgs(buf):
+            for msg_type, meta, value, end in wire.scan_msgs(buf):
                 self._replay_msg(msg_type, meta, value)
+                consumed = end
+        except wire.FrameCorruptError as e:
+            sys.stderr.write(
+                'WARNING: journal %s corrupt after %d clean bytes (%s); '
+                'keeping the consistent prefix, quarantining the file\n'
+                % (jp, consumed, e))
+            return False
         finally:
             self._replaying = False
+        if consumed < len(buf):
+            sys.stderr.write(
+                'WARNING: journal %s ends in a torn record (%d of %d '
+                'bytes replayed) — expected after a mid-write crash; '
+                'truncating the tail\n' % (jp, consumed, len(buf)))
+            with open(jp, 'r+b') as f:
+                f.truncate(consumed)
+        return True
 
     def _replay_msg(self, msg_type, meta, value):
         """Re-dispatch one journaled mutation through the live
@@ -520,6 +685,18 @@ class ParameterService(object):
     def on_send_var(self, name, tid, value, seq=None, inc=None,
                     round_idx=None):
         from . import wire
+        if (self.check_grad_finite and value is not None
+                and not wire.value_is_finite(value)):
+            # rejected BEFORE the journal write and BEFORE the dedup
+            # window records the token: a poisoned gradient never
+            # enters durable state, and the retryable classification
+            # makes the client re-send the value it actually computed
+            from .resilience import TransientError
+            raise TransientError(
+                'non-finite gradient %r from trainer %s rejected '
+                '(FLAGS_ps_check_grad_finite): corrupted or diverging '
+                'update; the retry resends the computed value'
+                % (name, tid))
         with self._lock:
             self._enter_locked(tid, inc)
             if self._is_replay_locked(tid, seq):

@@ -34,9 +34,11 @@ cluster test can fault exactly one role. Schema::
         "type": "SEND_VAR",       # wire/master msg-type name, or "*"
         "nth": 3,                 # fire on the Nth matching event
         "action": "drop",         # drop | close | delay | error | exit
+                                  #   | corrupt | nan
         "secs": 0.2,              # delay only
         "retryable": true,        # error only (default true)
-        "code": 137}]}            # exit only (default 137, = kill -9)
+        "code": 137,              # exit only (default 137, = kill -9)
+        "bits": 1}]}              # corrupt only: bits to flip (default 1)
 
 Counting is per-process and per (when, type): the plan is fully
 deterministic given the message sequence, which host-side RPC ops emit
@@ -53,10 +55,26 @@ in deterministic order. Actions:
   cleanup, no atexit, no socket shutdown: the deterministic analog of
   `kill -9` at an exact point in the message sequence, used by the
   elastic-recovery chaos tests to kill a trainer or pserver mid-round.
+- ``corrupt`` (send only): the frame is sent with `bits` bits flipped
+  inside its CRC-covered region — a deterministic wire bit-flip. The
+  receiver's CRC check must reject it (FrameCorruptError) and the
+  retry resends a clean copy: the corrupt payload is never applied.
+- ``nan`` (send or step): on send, the dense float payload is replaced
+  with NaNs BEFORE framing (valid CRC — a numeric fault, not a
+  transport fault) so the pserver's finite-gradient guard rejects it;
+  on step, the trainer poisons one feed value so the numeric-anomaly
+  guard (FLAGS_anomaly_action) sees a non-finite loss.
+
+The wire layer cooperates on ``close``/``corrupt``/``nan``: `on_send`
+returns a `SendEffect` whose `action` tells `wire.write_msg` what to do
+to the frame (flip bits after framing, poison the payload before it, or
+close the socket after sending). The hook fires exactly once per send,
+so the counters advance past a fired rule and the retry goes clean.
 
 On the recv side, ``drop`` discards the parsed message and reads the
 next one; ``close``/``delay``/``error`` mirror the send side. ``step``
-rules fire in `Trainer.train` just before a step executes.
+rules fire in `Trainer.train` just before a step executes (`on_step`
+returns ``'nan'`` when a nan step rule fires).
 """
 from __future__ import annotations
 
@@ -68,9 +86,9 @@ import time
 
 __all__ = ['RetryableRPCError', 'FatalRPCError', 'TransientError',
            'StaleIncarnationError', 'RetryPolicy', 'FaultRule',
-           'FaultPlan', 'install_plan', 'clear_plan', 'active_plan',
-           'current_plan', 'fired_faults', 'on_send', 'on_recv',
-           'on_step']
+           'FaultPlan', 'SendEffect', 'install_plan', 'clear_plan',
+           'active_plan', 'current_plan', 'fired_faults', 'on_send',
+           'on_recv', 'on_step']
 
 
 class RetryableRPCError(ConnectionError):
@@ -143,7 +161,7 @@ class RetryPolicy(object):
 # fault plan
 # ---------------------------------------------------------------------------
 
-_ACTIONS = ('drop', 'close', 'delay', 'error', 'exit')
+_ACTIONS = ('drop', 'close', 'delay', 'error', 'exit', 'corrupt', 'nan')
 _WHENS = ('send', 'recv', 'step')
 
 
@@ -160,12 +178,19 @@ def _type_names():
 
 class FaultRule(object):
     def __init__(self, when, nth, action, type='*', secs=0.1,
-                 retryable=True, code=137):
+                 retryable=True, code=137, bits=1):
         if when not in _WHENS:
             raise ValueError('bad when %r (one of %s)' % (when, _WHENS))
         if action not in _ACTIONS:
             raise ValueError('bad action %r (one of %s)'
                              % (action, _ACTIONS))
+        if action == 'corrupt' and when != 'send':
+            raise ValueError("action 'corrupt' requires when='send' "
+                             '(bits are flipped in the outbound frame)')
+        if action == 'nan' and when == 'recv':
+            raise ValueError("action 'nan' requires when='send' or "
+                             "'step' (the poison is injected at the "
+                             'producer)')
         self.when = when
         self.type = type
         self.nth = int(nth)
@@ -173,6 +198,7 @@ class FaultRule(object):
         self.secs = float(secs)
         self.retryable = bool(retryable)
         self.code = int(code)
+        self.bits = max(1, int(bits))
 
     def to_dict(self):
         d = {'when': self.when, 'type': self.type, 'nth': self.nth,
@@ -183,6 +209,8 @@ class FaultRule(object):
             d['retryable'] = self.retryable
         if self.action == 'exit':
             d['code'] = self.code
+        if self.action == 'corrupt':
+            d['bits'] = self.bits
         return d
 
 
@@ -205,8 +233,8 @@ class FaultPlan(object):
 
     @classmethod
     def from_spec(cls, spec):
-        """``seed:N`` | ``kill:ROLE:N`` | a JSON object string | a path
-        to a JSON file.
+        """``seed:N`` | ``kill:ROLE:N`` | ``corrupt:N`` | a JSON object
+        string | a path to a JSON file.
 
         A malformed spec fails HERE, loudly, with the offending text —
         install time is the only moment anyone is looking; a deferred
@@ -218,6 +246,8 @@ class FaultPlan(object):
             if spec.startswith('kill:'):
                 role, seed = spec[len('kill:'):].split(':', 1)
                 return cls.from_kill_seed(int(seed), role)
+            if spec.startswith('corrupt:'):
+                return cls.from_corrupt_seed(int(spec[len('corrupt:'):]))
             if spec.startswith('{'):
                 return cls.from_json(spec)
             with open(spec) as f:
@@ -284,6 +314,29 @@ class FaultPlan(object):
         rule = FaultRule(when, rng.randint(2, max_nth), 'exit',
                          type=rng.choice(types))
         return cls([rule], seed=seed)
+
+    @classmethod
+    def from_corrupt_seed(cls, seed, max_rules=2, max_nth=10):
+        """Seeded integrity faults: 1..max_rules send-side ``corrupt``
+        (bit flips in a frame — the CRC must catch them) and ``nan``
+        (poisoned gradient — the finite guard must catch it) rules, the
+        chaos_sweep --corrupt distribution. Every rule is recoverable
+        by design: the sweep expects bit-exact convergence, never
+        fatal."""
+        rng = random.Random(('corrupt', seed).__repr__())
+        types = ['SEND_VAR', 'BATCH_BARRIER', 'GET_VAR', 'FETCH_BARRIER']
+        rules = []
+        for _ in range(rng.randint(1, max_rules)):
+            if rng.random() < 0.7:
+                rules.append(FaultRule(
+                    'send', rng.randint(1, max_nth), 'corrupt',
+                    type=rng.choice(types), bits=rng.randint(1, 8)))
+            else:
+                # nan only makes sense on a gradient push
+                rules.append(FaultRule(
+                    'send', rng.randint(1, max_nth), 'nan',
+                    type='SEND_VAR'))
+        return cls(rules, seed=seed)
 
     def to_json(self):
         d = {'rules': [r.to_dict() for r in self.rules]}
@@ -372,6 +425,35 @@ def _close_quietly(sock):
         pass
 
 
+class SendEffect(object):
+    """Returned by on_send for the actions the wire layer must
+    cooperate on. `action` is one of 'close' (send the frame, then run
+    post_send), 'corrupt' (send mutate_frame(frame)) or 'nan' (poison
+    the float payload before framing)."""
+
+    def __init__(self, rule, sock):
+        self.action = rule.action
+        self.rule = rule
+        self._sock = sock
+
+    def post_send(self):
+        _close_quietly(self._sock)
+
+    def mutate_frame(self, frame, lo):
+        """Deterministically flip `rule.bits` bits in frame[lo:] — the
+        CRC-covered body region. (Flipping header length fields instead
+        would desync the stream, a failure the read deadline surfaces;
+        body flips are what the CRC exists to catch.)"""
+        rng = random.Random(
+            ('corrupt-bits', self.rule.type, self.rule.nth,
+             self.rule.bits).__repr__())
+        buf = bytearray(frame)
+        for _ in range(self.rule.bits):
+            pos = rng.randrange(lo, len(buf))
+            buf[pos] ^= 1 << rng.randrange(8)
+        return bytes(buf)
+
+
 def _raise_for(rule, where):
     msg = 'fault injection: %s at %s (rule %s)' % (rule.action, where,
                                                    rule.to_dict())
@@ -394,8 +476,10 @@ def _exit_for(rule, where):
 
 def on_send(sock, msg_type, meta):
     """wire.write_msg hook, called BEFORE the frame hits the socket.
-    Returns None, or a callable to run AFTER the frame was sent (the
-    'close' action: message delivered, connection then dies)."""
+    Returns None, or a SendEffect the wire layer applies ('close':
+    frame delivered then connection dies; 'corrupt': bits flipped in
+    the outbound frame; 'nan': float payload poisoned before
+    framing)."""
     if _plan is None:
         return None
     with _lock:
@@ -410,8 +494,8 @@ def on_send(sock, msg_type, meta):
         raise RetryableRPCError(
             'fault injection: dropped msg type %s (rule %s)'
             % (msg_type, rule.to_dict()))
-    if rule.action == 'close':
-        return lambda: _close_quietly(sock)
+    if rule.action in ('close', 'corrupt', 'nan'):
+        return SendEffect(rule, sock)
     if rule.action == 'exit':
         _exit_for(rule, 'send of msg type %s' % msg_type)
     _raise_for(rule, 'send of msg type %s' % msg_type)
@@ -442,17 +526,21 @@ def on_recv(sock, msg_type, meta):
 
 
 def on_step():
-    """Trainer step hook: fires 'step' rules (delay sleeps; drop/close/
-    error all raise per the rule's retryable classification)."""
+    """Trainer step hook: fires 'step' rules (delay sleeps; 'nan'
+    returns the string 'nan' so the Trainer poisons one feed value;
+    drop/close/error all raise per the rule's retryable
+    classification)."""
     if _plan is None:
-        return
+        return None
     with _lock:
         rule = _match_locked('step', '*')
     if rule is None:
-        return
+        return None
     if rule.action == 'delay':
         time.sleep(rule.secs)
-        return
+        return None
+    if rule.action == 'nan':
+        return 'nan'
     if rule.action == 'exit':
         _exit_for(rule, 'trainer step')
     _raise_for(rule, 'trainer step')

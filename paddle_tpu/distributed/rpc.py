@@ -40,11 +40,20 @@ __all__ = ['PSClient', 'PSServer', 'get_client', 'close_all_clients',
 class PSClient(object):
     """One trainer's (self-healing) connection to one pserver endpoint."""
 
-    def __init__(self, endpoint, trainer_id=0, timeout=120.0,
+    def __init__(self, endpoint, trainer_id=0, timeout=None,
                  connect_retry_secs=60.0, retry_policy=None,
                  incarnation=None):
         self.endpoint = endpoint
         self.trainer_id = trainer_id
+        if timeout is None:
+            # read deadline (FLAGS_rpc_read_deadline): create_connection
+            # leaves its timeout set on the socket, so every recv also
+            # times out — a peer that accepts but never replies (a wedged
+            # pserver) surfaces as socket.timeout, which _call_locked
+            # already treats as a retryable connection failure, instead
+            # of hanging the trainer forever
+            from ..flags import get_flag
+            timeout = float(get_flag('rpc_read_deadline', 120.0))
         self.timeout = timeout
         host, port = endpoint.rsplit(':', 1)
         self._addr = (host, int(port))
@@ -150,7 +159,17 @@ class PSClient(object):
                type(last_err).__name__, last_err)) from last_err
 
     def send_var(self, name, value):
-        """Push a gradient (dense array or SelectedRows)."""
+        """Push a gradient (dense array or SelectedRows). A non-finite
+        value fails fast HERE (retryable — the Trainer's step retry
+        recomputes it) rather than spending a round trip on the
+        pserver's rejection; the server-side guard still backstops
+        corruption introduced downstream of this check."""
+        from ..flags import get_flag
+        if (get_flag('ps_check_grad_finite', True)
+                and not wire.value_is_finite(value)):
+            raise RetryableRPCError(
+                'refusing to send non-finite gradient %r to %s '
+                '(FLAGS_ps_check_grad_finite)' % (name, self.endpoint))
         self._call(wire.SEND_VAR, {'name': name, 'round': self._round},
                    value)
 

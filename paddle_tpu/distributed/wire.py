@@ -6,9 +6,20 @@ compact JSON-header + raw-bytes framing — same capability (dense tensors
 and SelectedRows cross the wire; sparse ships rows+values only), no
 protobuf dependency.
 
-Frame layout (all integers little-endian):
+Frame layout, version 2 (all integers little-endian):
 
-    u32 body_len | u8 msg_type | u32 meta_len | meta (JSON, utf-8) | payload
+    u32 crc | u32 body_len | u8 version | u8 msg_type | u32 meta_len
+    | meta (JSON, utf-8) | payload
+
+`crc` is zlib crc32 (the same definition recordio chunks use, via
+`integrity.crc32`) over EVERYTHING after the crc field — the remaining
+header fields plus meta plus payload — so a flipped bit anywhere in the
+frame fails verification. `body_len` counts meta + payload bytes only.
+A frame that fails its CRC or carries an unknown version raises
+`FrameCorruptError`, a ConnectionError subclass: the RPC clients
+already treat ConnectionError as retryable (drop the socket, reconnect,
+replay the same seq), so a corrupted frame costs one round trip and the
+retry delivers a clean copy — it is never applied.
 
 Dense payload:        raw C-contiguous array bytes (dtype/shape in meta).
 SelectedRows payload: values bytes followed by int32 rows bytes
@@ -18,8 +29,11 @@ from __future__ import annotations
 
 import json
 import struct
+import sys
 
 import numpy as np
+
+from ..integrity import crc32
 
 # message types
 SEND_VAR = 1        # trainer -> pserver: push a gradient (dense or sparse)
@@ -37,7 +51,20 @@ REPLY_VAR = 7       # pserver -> trainer: a variable value
 REPLY_OK = 8        # pserver -> trainer: ack
 REPLY_ERR = 9       # pserver -> trainer: error (meta['error'])
 
-_HDR = struct.Struct('<IBI')   # body_len, msg_type, meta_len
+WIRE_VERSION = 2
+
+# crc, body_len, version, msg_type, meta_len
+_HDR = struct.Struct('<IIBBI')
+_CRC_SKIP = 4   # the crc field itself is excluded from its own coverage
+
+
+class FrameCorruptError(ConnectionError):
+    """A frame failed its CRC32 or version check. Subclassing
+    ConnectionError makes the existing retry machinery handle it: the
+    client drops the socket and replays the request (same seq), the
+    server closes the connection — a corrupt frame is never parsed past
+    its header, let alone applied."""
+
 
 _resilience = None
 
@@ -82,37 +109,90 @@ def _value_of(meta, payload):
     return np.frombuffer(payload[:n], dtype=dtype).reshape(shape)
 
 
+def value_is_finite(value):
+    """True iff every float element of a dense array / SelectedRows is
+    finite. Non-float dtypes are vacuously finite. Shared by the
+    client-side pre-send check and the pserver's gradient guard
+    (FLAGS_ps_check_grad_finite)."""
+    from ..selected_rows import SelectedRows
+    if isinstance(value, SelectedRows):
+        value = value.values
+    arr = np.asarray(value)
+    if arr.dtype.kind != 'f':
+        return True
+    return bool(np.isfinite(arr).all())
+
+
 def pack_msg(msg_type, meta=None, value=None, payload=b''):
     """Serialize one frame to bytes. Shared by the socket path
     (write_msg) and the pserver's on-disk mutation journal
     (param_service) — a journal record IS a wire frame, so replay and
-    socket dispatch share one decoder."""
+    socket dispatch share one decoder (and one CRC check)."""
     meta = dict(meta or {})
     if value is not None:
         vmeta, payload = _payload_of(value)
         meta.update(vmeta)
     mb = json.dumps(meta).encode('utf-8')
-    body_len = 1 + 4 + len(mb) + len(payload)
-    return _HDR.pack(body_len, msg_type, len(mb)) + mb + payload
+    rest = struct.pack('<IBBI', len(mb) + len(payload), WIRE_VERSION,
+                       msg_type, len(mb)) + mb + payload
+    return struct.pack('<I', crc32(rest)) + rest
+
+
+def _check_frame(buf, off, end, crc):
+    if crc32(bytes(buf[off + _CRC_SKIP:end])) != crc:
+        raise FrameCorruptError(
+            'frame at offset %d failed its CRC32 check (corrupt bytes '
+            'on the wire or on disk)' % off)
+
+
+def _parse_body(body, meta_len):
+    meta = json.loads(body[:meta_len].decode('utf-8')) if meta_len else {}
+    payload = body[meta_len:]
+    value = _value_of(meta, payload) if 'dtype' in meta else None
+    return meta, value
+
+
+def scan_msgs(buf):
+    """Yield (msg_type, meta, value, end_offset) for each complete,
+    CRC-verified frame in `buf`; `end_offset` is the byte offset just
+    past the frame (journal replay truncates a torn tail to the last
+    yielded end_offset before reopening for append).
+
+    A truncated trailing frame (a journal torn by a mid-write crash, or
+    a corrupt body_len that claims bytes past EOF — indistinguishable)
+    ends the scan without error: the caller sees end_offset < len(buf)
+    and decides how loudly to report it. A frame that is fully present
+    but fails its CRC, or carries an unknown wire version, raises
+    FrameCorruptError — everything yielded before it is a consistent
+    prefix; nothing after it can be trusted (framing is lost)."""
+    off, n = 0, len(buf)
+    while off + _HDR.size <= n:
+        crc, body_len, version, msg_type, meta_len = \
+            _HDR.unpack_from(buf, off)
+        end = off + _HDR.size + body_len
+        if end > n:
+            return          # torn tail
+        if version != WIRE_VERSION:
+            raise FrameCorruptError(
+                'frame at offset %d: wire version %d (expected %d) — '
+                'corrupt header or a file from an incompatible build'
+                % (off, version, WIRE_VERSION))
+        if meta_len > body_len:
+            raise FrameCorruptError(
+                'frame at offset %d: meta_len %d exceeds body_len %d'
+                % (off, meta_len, body_len))
+        _check_frame(buf, off, end, crc)
+        body = bytes(buf[off + _HDR.size:end])
+        meta, value = _parse_body(body, meta_len)
+        yield msg_type, meta, value, end
+        off = end
 
 
 def unpack_msgs(buf):
-    """Yield (msg_type, meta, value) for each complete frame in `buf`.
-    A truncated trailing frame (a journal torn by a mid-write crash) is
-    silently ignored — everything before it was written whole."""
-    off, n = 0, len(buf)
-    while off + _HDR.size <= n:
-        body_len, msg_type, meta_len = _HDR.unpack_from(buf, off)
-        end = off + _HDR.size + body_len - 1 - 4
-        if end > n:
-            return
-        body = buf[off + _HDR.size:end]
-        meta = json.loads(body[:meta_len].decode('utf-8')) if meta_len \
-            else {}
-        payload = body[meta_len:]
-        value = _value_of(meta, payload) if 'dtype' in meta else None
+    """Yield (msg_type, meta, value) for each complete, verified frame
+    in `buf` — scan_msgs without the offsets."""
+    for msg_type, meta, value, _ in scan_msgs(buf):
         yield msg_type, meta, value
-        off = end
 
 
 def write_msg(sock, msg_type, meta=None, value=None, payload=b''):
@@ -121,11 +201,51 @@ def write_msg(sock, msg_type, meta=None, value=None, payload=b''):
         vmeta, payload = _payload_of(value)
         meta.update(vmeta)
     # fault hook BEFORE any bytes hit the wire: an injected drop/error
-    # never leaves a half-written frame on the socket
-    post_send = _faults().on_send(sock, msg_type, meta)
-    sock.sendall(pack_msg(msg_type, meta, payload=payload))
-    if post_send is not None:
-        post_send()   # 'close' action: frame delivered, connection dies
+    # never leaves a half-written frame on the socket. The hook fires
+    # exactly once per send, so a retry of this message advances the
+    # plan's counters past the rule that faulted it.
+    effect = _faults().on_send(sock, msg_type, meta)
+    action = getattr(effect, 'action', None)
+    if action in ('corrupt', 'nan'):
+        # same stderr audit line the exit action leaves: corrupt/nan
+        # damage is meant to be INVISIBLE at the application layer
+        # (detected and retried), so chaos tests grep the log to prove
+        # the fault actually fired
+        sys.stderr.write('fault injection: %s on send of msg type %s '
+                         '(rule %s)\n' % (action, msg_type,
+                                          effect.rule.to_dict()))
+        sys.stderr.flush()
+    if action == 'nan':
+        # poison the float payload BEFORE framing: the frame carries a
+        # valid CRC — this is a numeric fault (a bad gradient), not a
+        # transport fault, and must get past the CRC check to exercise
+        # the finite-guard path
+        payload = _poison_payload(meta, payload)
+    frame = pack_msg(msg_type, meta, payload=payload)
+    if action == 'corrupt':
+        # flip bits AFTER framing, inside the CRC-covered region: the
+        # receiver must detect the damage and never apply the frame
+        frame = effect.mutate_frame(frame, _HDR.size)
+    sock.sendall(frame)
+    if action == 'close':
+        effect.post_send()   # frame delivered, connection then dies
+
+
+def _poison_payload(meta, payload):
+    """Replace the dense float region of a payload with NaNs of the
+    same dtype/length (the 'nan' FaultPlan action — a deterministic
+    stand-in for a diverging or corrupted gradient computation)."""
+    if 'dtype' not in meta:
+        return payload
+    dtype = np.dtype(meta['dtype'])
+    if dtype.kind != 'f':
+        return payload
+    count = int(np.prod(tuple(meta.get('shape', ())) or (0,)))
+    nval = min(count * dtype.itemsize, len(payload))
+    if nval <= 0:
+        return payload
+    bad = np.full(count, np.nan, dtype=dtype).tobytes()[:nval]
+    return bad + payload[nval:]
 
 
 def _read_exact(sock, n):
@@ -141,17 +261,29 @@ def _read_exact(sock, n):
 
 def read_msg(sock):
     """-> (msg_type, meta dict, value or None). value is a numpy array or
-    SelectedRows when the meta describes one."""
+    SelectedRows when the meta describes one. The frame's CRC is
+    verified before the meta is even parsed; a mismatch raises
+    FrameCorruptError (the stream may be desynced — the connection is
+    unusable either way)."""
     while True:
         hdr = _read_exact(sock, _HDR.size)
-        body_len, msg_type, meta_len = _HDR.unpack(hdr)
-        body = _read_exact(sock, body_len - 1 - 4) if body_len > 5 else b''
-        meta = json.loads(body[:meta_len].decode('utf-8')) if meta_len \
-            else {}
-        payload = body[meta_len:]
+        crc, body_len, version, msg_type, meta_len = _HDR.unpack(hdr)
+        if version != WIRE_VERSION:
+            raise FrameCorruptError(
+                'bad wire version %d (expected %d) — corrupt header or '
+                'desynced stream' % (version, WIRE_VERSION))
+        body = _read_exact(sock, body_len) if body_len else b''
+        if crc32(hdr[_CRC_SKIP:] + body) != crc:
+            raise FrameCorruptError(
+                'frame (msg type %d, %d body bytes) failed its CRC32 '
+                'check — corrupt bytes on the wire' % (msg_type, body_len))
+        if meta_len > body_len:
+            raise FrameCorruptError(
+                'frame meta_len %d exceeds body_len %d'
+                % (meta_len, body_len))
+        meta, value = _parse_body(body, meta_len)
         # fault hook AFTER the full frame was consumed (framing stays
         # intact); 'drop' discards this message and reads the next
         if _faults().on_recv(sock, msg_type, meta) == 'drop':
             continue
-        value = _value_of(meta, payload) if 'dtype' in meta else None
         return msg_type, meta, value

@@ -53,6 +53,23 @@ Known flags:
   ps_average_live        average merged gradients over the LIVE
                          trainer set instead of the original
                          num_trainers (see ParameterService._merge)
+  ps_check_grad_finite   pserver-side guard (default on): reject a
+                         SEND_VAR with NaN/Inf in its float payload
+                         with a retryable error BEFORE journaling or
+                         applying it — the client retry resends the
+                         value it actually computed
+  rpc_read_deadline      socket read deadline (seconds) for PSClient /
+                         MasterClient: a peer that accepts but never
+                         replies surfaces as RetryableRPCError instead
+                         of a silent hang
+  anomaly_action         Trainer numeric-anomaly guard: 'none' (off,
+                         default), 'rollback' (skip the step; after
+                         anomaly_skip_steps consecutive anomalies,
+                         roll back to the last SUCCESS checkpoint), or
+                         'fatal' (raise once the skip budget is spent)
+  anomaly_skip_steps     consecutive anomalous steps tolerated (as
+                         skipped steps) before the anomaly_action
+                         escalation fires
 """
 from __future__ import annotations
 
@@ -122,6 +139,22 @@ _DEFAULTS = {
     # rounds between pserver snapshots (sync mode; async snapshots on a
     # send count instead)
     'ps_snapshot_every': 1,
+    # pserver gradient integrity guard: reject non-finite SEND_VAR
+    # payloads with a retryable error before they reach the journal or
+    # the optimizer (wire bit-flips carry a valid CRC when the fault is
+    # upstream of framing — this is the numeric backstop)
+    'ps_check_grad_finite': True,
+    # socket read deadline for the RPC clients: silence from a
+    # connected peer for this long fails the attempt (retryable)
+    # instead of hanging the trainer forever
+    'rpc_read_deadline': 120.0,
+    # Trainer numeric-anomaly guard (trainer.py): 'none' | 'rollback' |
+    # 'fatal'. When enabled, a fused isfinite reduction over
+    # loss + gradients is fetched each step; an anomalous step is
+    # skipped (never checkpointed), and after anomaly_skip_steps
+    # consecutive anomalies the action escalates
+    'anomaly_action': 'none',
+    'anomaly_skip_steps': 1,
     # _merge denominator: False (default) averages over the ORIGINAL
     # num_trainers (dead trainers contribute zero — comparable to the
     # full-set run), True averages over the live set (constant

@@ -23,11 +23,39 @@ reader EOF contract: users catch, reset, and start the next pass).
 from __future__ import annotations
 
 import queue
+import sys
 import threading
 
 import numpy as np
 
-__all__ = ['PyReader', 'get_reader', 'EOFException']
+__all__ = ['PyReader', 'get_reader', 'EOFException', 'leaked_threads']
+
+# Worker threads that outlived their join timeout (a feeder blocked
+# inside a user generator cannot be interrupted from Python). They are
+# daemons holding dead queues, so they are harmless to the NEXT pass —
+# but each one pins the generator's frame (open files, sockets) until
+# it unblocks, so leaks deserve a loud trail, not silence.
+_leaked = 0
+_leak_lock = threading.Lock()
+
+
+def leaked_threads():
+    """Process-wide count of reader worker threads that missed their
+    join deadline (monotonic; see PyReader.join_timeout)."""
+    return _leaked
+
+
+def _note_leak(reader_name, thread):
+    global _leaked
+    with _leak_lock:
+        _leaked += 1
+        n = _leaked
+    sys.stderr.write(
+        'WARNING: py_reader %r worker %s did not exit within its join '
+        'timeout and was leaked (likely blocked in the user data '
+        'generator); it holds the generator frame until it unblocks '
+        '(%d leaked so far this process)\n'
+        % (reader_name, thread.name, n))
 
 
 class EOFException(Exception):
@@ -67,8 +95,9 @@ class PyReader(object):
     Variable (name attr) for fluid.layers.read_file(reader)."""
 
     def __init__(self, name, shapes, dtypes, lod_levels=None, capacity=64,
-                 use_double_buffer=True, device=None):
+                 use_double_buffer=True, device=None, join_timeout=10.0):
         self.name = name
+        self.join_timeout = float(join_timeout)
         self.shapes = [tuple(s) for s in shapes]
         self.dtypes = list(dtypes)
         self.lod_levels = list(lod_levels or [0] * len(shapes))
@@ -155,7 +184,9 @@ class PyReader(object):
                 except queue.Empty:
                     break
         for t in self._threads:
-            t.join(timeout=10.0)
+            t.join(timeout=self.join_timeout)
+            if t.is_alive():
+                _note_leak(self.name, t)
         self._threads = []
         self._started = False
 
@@ -175,7 +206,9 @@ class PyReader(object):
         if item is _EOF:
             self._started = False
             for t in self._threads:
-                t.join(timeout=10.0)
+                t.join(timeout=self.join_timeout)
+                if t.is_alive():
+                    _note_leak(self.name, t)
             self._threads = []
             raise EOFException('pass end in py_reader %r' % self.name)
         return item

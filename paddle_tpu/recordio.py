@@ -20,16 +20,18 @@ from __future__ import annotations
 import glob as _glob
 import io
 import struct
+import zlib
 
 import ctypes
 import numpy as np
 
+from .integrity import crc32
 from .native import load_library
 
 __all__ = ['Compressor', 'RecordIOWriter', 'RecordIOScanner',
            'ParallelRecordIOScanner', 'parallel_reader', 'reader',
            'convert_reader_to_recordio_file',
-           'convert_reader_to_recordio_files']
+           'convert_reader_to_recordio_files', 'verify_file']
 
 
 class Compressor(object):
@@ -172,6 +174,71 @@ class RecordIOScanner(object):
 
     def __exit__(self, *exc):
         self.close()
+
+
+# chunk header (native/recordio.cc): magic 'RUPT', version, compressor,
+# num_records, raw_len, stored_len, crc32(RAW payload), reserved
+_CHUNK_HDR = struct.Struct('<8I')
+_CHUNK_MAGIC = 0x54505552
+_CHUNK_VERSION = 1
+
+
+def verify_file(path):
+    """Audit every chunk of a recordio file without the native scanner:
+    header sanity, inflate, CRC over the raw payload (the same
+    integrity.crc32 the wire and statefile layers use), and record
+    framing. Raises IOError naming the byte offset of the first damaged
+    chunk; returns (num_chunks, num_records) when the file is clean."""
+    num_chunks = num_records = 0
+    with open(path, 'rb') as f:
+        while True:
+            off = f.tell()
+            hdr = f.read(_CHUNK_HDR.size)
+            if not hdr:
+                return num_chunks, num_records
+            if len(hdr) < _CHUNK_HDR.size:
+                raise IOError('%s: truncated chunk header at offset %d'
+                              % (path, off))
+            (magic, version, compressor, n_rec, raw_len, stored_len,
+             crc, _reserved) = _CHUNK_HDR.unpack(hdr)
+            if magic != _CHUNK_MAGIC:
+                raise IOError('%s: bad magic at offset %d: not a '
+                              'recordio chunk' % (path, off))
+            if version != _CHUNK_VERSION:
+                raise IOError('%s: unsupported chunk version %d at '
+                              'offset %d' % (path, version, off))
+            stored = f.read(stored_len)
+            if len(stored) < stored_len:
+                raise IOError('%s: truncated chunk payload at offset %d '
+                              '(%d of %d bytes)'
+                              % (path, off, len(stored), stored_len))
+            if compressor == Compressor.Deflate:
+                try:
+                    raw = zlib.decompress(stored)
+                except zlib.error as e:
+                    raise IOError('%s: inflate failed for chunk at '
+                                  'offset %d: %s' % (path, off, e))
+            else:
+                raw = stored
+            if len(raw) != raw_len:
+                raise IOError('%s: chunk at offset %d inflates to %d '
+                              'bytes, header says %d'
+                              % (path, off, len(raw), raw_len))
+            if crc32(raw) != crc:
+                raise IOError('%s: crc mismatch in chunk at offset %d'
+                              % (path, off))
+            rec_off = 0
+            for _ in range(n_rec):
+                if rec_off + 4 > len(raw):
+                    raise IOError('%s: record framing overruns chunk '
+                                  'at offset %d' % (path, off))
+                (rlen,) = _U32.unpack_from(raw, rec_off)
+                rec_off += 4 + rlen
+            if rec_off != len(raw):
+                raise IOError('%s: record framing does not cover chunk '
+                              'at offset %d' % (path, off))
+            num_chunks += 1
+            num_records += n_rec
 
 
 def reader(pattern):

@@ -38,6 +38,7 @@ __all__ = ['Trainer', 'CheckpointConfig', 'BeginEpochEvent',
 _CHECKPOINT_PREFIX = 'checkpoint'
 _METADATA_FILE = 'TRAINER_METADATA'
 _SUCCESS_FILE = '_SUCCESS'
+_DIGESTS_FILE = 'CHECKPOINT_DIGESTS'
 
 
 class BeginEpochEvent(object):
@@ -67,9 +68,12 @@ class EndStepEvent(object):
 class FaultEvent(object):
     """A step hit an RPC/runtime fault (distributed/resilience.py
     taxonomy). action is 'retry' (the step will re-run in place after a
-    retryable failure) or 'rollback' (fatal failure: scope + RNG state
+    retryable failure), 'rollback' (fatal failure: scope + RNG state
     restored from the last SUCCESS-marked checkpoint and training
-    resumes from there); attempt counts retries resp. rollbacks."""
+    resumes from there) or 'anomaly' (the numeric guard saw a
+    non-finite loss/gradient — FLAGS_anomaly_action; the step is
+    skipped, or escalates per the flag); attempt counts retries,
+    rollbacks, resp. the consecutive-anomaly streak."""
 
     def __init__(self, epoch_id, step_id, error, action, attempt=1):
         self.epoch = epoch_id
@@ -96,6 +100,21 @@ class CheckpointConfig(object):
         # the same op for manual loops)
         self.pserver_endpoints = list(pserver_endpoints or [])
         self.trainer_id = int(trainer_id)
+
+
+def _poison_feed(feed):
+    """The 'nan' step-fault action: NaN one element of the first float
+    feed (sorted order — deterministic) so the poison flows through the
+    real forward/backward into the loss and gradients."""
+    feed = dict(feed)
+    for key in sorted(feed):
+        arr = np.asarray(feed[key])
+        if arr.dtype.kind == 'f':
+            arr = arr.copy()
+            arr.flat[0] = np.nan
+            feed[key] = arr
+            break
+    return feed
 
 
 def _checkpoint_ids(ckpt_dir):
@@ -128,6 +147,12 @@ class Trainer(object):
         self.scope = Scope()
         self.train_program = Program()
         self.startup_program = Program()
+        from .flags import get_flag
+        self._anomaly_action = str(get_flag('anomaly_action', 'none')
+                                   or 'none')
+        self._anomaly_skip_steps = int(get_flag('anomaly_skip_steps', 1))
+        self._anomaly_streak = 0
+        self._guard_var = None
         with program_guard(self.train_program, self.startup_program):
             outs = train_func()
             if isinstance(outs, (list, tuple)):
@@ -136,7 +161,10 @@ class Trainer(object):
                 self.train_outputs = [outs]
             loss = self.train_outputs[0]
             optimizer = optimizer_func()
-            optimizer.minimize(loss)
+            _opt_ops, params_grads = optimizer.minimize(loss)
+            if self._anomaly_action != 'none':
+                self._guard_var = self._build_anomaly_guard(loss,
+                                                            params_grads)
         self.loss = loss
         self.exe = Executor(self.place)
         self._pe = None
@@ -150,6 +178,30 @@ class Trainer(object):
                 io_mod.load_persistables(self.exe, param_path,
                                          main_program=self.train_program)
         self._resumed = self._maybe_resume()
+
+    def _build_anomaly_guard(self, loss, params_grads):
+        """Append one fused `isfinite` reduction over the loss and
+        every dense float gradient (FLAGS_anomaly_action != 'none') —
+        a single scalar-bool fetch per step, evaluated inside the same
+        jitted program as the step itself, so the production-mode guard
+        costs one cheap reduction rather than the per-op eager scan of
+        FLAGS_check_nan_inf."""
+        from .framework import VarType
+        block = self.train_program.global_block()
+        xs = [loss.name]
+        for _param, grad in params_grads:
+            if grad is None or grad.type == VarType.SELECTED_ROWS:
+                continue
+            # dtype is the canonical string name ('float32',
+            # 'bfloat16', ...) — np.dtype would choke on bfloat16
+            if not str(grad.dtype or '').startswith(('float', 'bfloat')):
+                continue
+            xs.append(grad.name)
+        guard = block.create_var(name='_anomaly_finite_guard',
+                                 dtype='bool', shape=())
+        block.append_op(type='isfinite', inputs={'X': xs},
+                        outputs={'Out': [guard.name]})
+        return guard
 
     # -- checkpointing -----------------------------------------------------
     def _ckpt_path(self, ckpt_id):
@@ -188,12 +240,53 @@ class Trainer(object):
                 cfg.pserver_endpoints, cfg.trainer_id)
             with scope_guard(self.scope):
                 self.exe.run(notify)
+        # digest manifest next-to-last: it covers every payload file in
+        # the checkpoint (tensors, metadata, pserver shards) so resume
+        # can tell corruption from a clean save — the SUCCESS marker
+        # alone only proves the save COMPLETED, not that the bytes
+        # survived
+        self._write_digests(path)
         # SUCCESS marker last: a partial checkpoint must never be resumed
         with open(os.path.join(path, _SUCCESS_FILE), 'w') as f:
             f.write('')
         for old in _checkpoint_ids(cfg.checkpoint_dir)[
                 :-cfg.max_num_checkpoints]:
             shutil.rmtree(self._ckpt_path(old), ignore_errors=True)
+
+    @staticmethod
+    def _write_digests(path):
+        """CHECKPOINT_DIGESTS: {relpath: [crc32, size]} over every file
+        in the checkpoint dir (except the marker and the manifest)."""
+        from .integrity import crc32_file
+        digests = {}
+        for root, _dirs, files in os.walk(path):
+            for fn in files:
+                if fn in (_SUCCESS_FILE, _DIGESTS_FILE):
+                    continue
+                fp = os.path.join(root, fn)
+                crc, size = crc32_file(fp)
+                digests[os.path.relpath(fp, path)] = [crc, size]
+        with open(os.path.join(path, _DIGESTS_FILE), 'w') as f:
+            json.dump(digests, f)
+
+    @staticmethod
+    def _verify_checkpoint(path):
+        """None if every digest matches (or the checkpoint predates
+        digests — accepted for back-compat), else a reason string."""
+        from .integrity import crc32_file
+        manifest = os.path.join(path, _DIGESTS_FILE)
+        if not os.path.exists(manifest):
+            return None
+        with open(manifest) as f:
+            digests = json.load(f)
+        for rel, (crc, size) in digests.items():
+            fp = os.path.join(path, rel)
+            if not os.path.exists(fp):
+                return 'missing payload file %s' % rel
+            got_crc, got_size = crc32_file(fp)
+            if got_crc != int(crc) or got_size != int(size):
+                return 'digest mismatch on %s' % rel
+        return None
 
     def _maybe_resume(self):
         """Restore from the newest VALID checkpoint. A dir with no
@@ -207,6 +300,24 @@ class Trainer(object):
             return False
         for ckpt_id in reversed(_checkpoint_ids(cfg.checkpoint_dir)):
             path = self._ckpt_path(ckpt_id)
+            try:
+                reason = self._verify_checkpoint(path)
+            except Exception as e:
+                reason = 'unreadable digest manifest: %r' % e
+            if reason is not None:
+                # corrupt payload: quarantine the WHOLE checkpoint dir
+                # (renamed aside, kept for post-mortem — and no longer
+                # SUCCESS-listed, so it is never retried) and fall back
+                import sys
+                qpath = path + '.corrupt'
+                try:
+                    os.replace(path, qpath)
+                except OSError:
+                    qpath = '<unmovable>'
+                print('WARNING: quarantined corrupt checkpoint %s -> %s '
+                      '(%s); falling back to an older checkpoint'
+                      % (path, qpath, reason), file=sys.stderr)
+                continue
             try:
                 with open(os.path.join(path, _METADATA_FILE)) as f:
                     meta = json.load(f)
@@ -293,7 +404,11 @@ class Trainer(object):
         attempt = 0
         while True:
             try:
-                resilience.on_step()   # deterministic fault injection
+                # deterministic fault injection; 'nan' poisons one feed
+                # value so the numeric-anomaly guard sees a non-finite
+                # loss computed through the real step
+                if resilience.on_step() == 'nan':
+                    feed = _poison_feed(feed)
                 with scope_guard(self.scope):
                     if pe is not None:
                         return pe.run(fetch_list=fetch, feed=feed)
@@ -313,6 +428,10 @@ class Trainer(object):
         start_epoch, start_step = self.epoch_id, self.step_id
         pe = self._executor()
         fetch = [v.name for v in self.train_outputs]
+        if self._guard_var is not None:
+            # the guard is fetched alongside the metrics (one fused
+            # scalar reduction) and sliced off before events see them
+            fetch = fetch + [self._guard_var.name]
         self._stop_requested = False
         for epoch_id in range(start_epoch, num_epochs):
             event_handler(BeginEpochEvent(epoch_id))
@@ -326,6 +445,18 @@ class Trainer(object):
                 feed = dict(zip(feed_order, data))
                 metrics = self._run_step(pe, fetch, feed, epoch_id,
                                          step_id, event_handler)
+                if self._guard_var is not None:
+                    finite = bool(np.asarray(metrics[-1]))
+                    metrics = metrics[:-1]
+                    if not finite:
+                        self._on_anomaly(epoch_id, step_id,
+                                         event_handler)
+                        # skip: no EndStepEvent, no checkpoint — an
+                        # anomalous step must never become a rollback
+                        # target
+                        self.epoch_id, self.step_id = epoch_id, step_id
+                        continue
+                    self._anomaly_streak = 0
                 event_handler(EndStepEvent(epoch_id, step_id, metrics))
                 self.epoch_id, self.step_id = epoch_id, step_id
                 if cfg and cfg.checkpoint_dir and \
@@ -335,6 +466,7 @@ class Trainer(object):
                     return
             start_step = 0
             if cfg and cfg.checkpoint_dir and \
+                    self._anomaly_streak == 0 and \
                     (epoch_id + 1) % cfg.epoch_interval == 0:
                 # saved as (next epoch, step -1): resume starts cleanly at
                 # epoch E+1 step 0 instead of replaying epoch E's
@@ -344,6 +476,33 @@ class Trainer(object):
             event_handler(EndEpochEvent(epoch_id))
             if self._stop_requested:
                 return
+
+    def _on_anomaly(self, epoch_id, step_id, event_handler):
+        """Numeric guard tripped: emit a FaultEvent and either tolerate
+        (skip the step, up to FLAGS_anomaly_skip_steps consecutive
+        times — a transient bad batch resolves itself) or escalate per
+        FLAGS_anomaly_action. Escalation matters because a skipped
+        step's UPDATE may already have poisoned the parameters: if
+        every following step is anomalous too, skipping forever would
+        train nothing — 'rollback' restores the last SUCCESS checkpoint
+        (known-finite params) and replays from there."""
+        self._anomaly_streak += 1
+        err = FloatingPointError(
+            'non-finite loss/gradient at step (%d, %d) '
+            '(FLAGS_anomaly_action=%s, streak %d)'
+            % (epoch_id, step_id, self._anomaly_action,
+               self._anomaly_streak))
+        event_handler(FaultEvent(epoch_id, step_id, err, 'anomaly',
+                                 self._anomaly_streak))
+        if self._anomaly_streak > self._anomaly_skip_steps:
+            self._anomaly_streak = 0
+            if self._anomaly_action == 'rollback':
+                from .distributed.resilience import FatalRPCError
+                raise FatalRPCError(
+                    'numeric anomaly persisted past %d skipped steps; '
+                    'rolling back: %s'
+                    % (self._anomaly_skip_steps, err)) from err
+            raise err
 
     def stop(self):
         """Request the training loop exit at the next event boundary

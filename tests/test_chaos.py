@@ -28,6 +28,7 @@ import socket
 import subprocess
 import sys
 import threading
+import time
 
 import numpy as np
 import pytest
@@ -793,6 +794,120 @@ def test_pserver_kill_restarts_from_snapshot_and_matches(
     for p, cw in clean_cluster_weights.items():
         assert np.array_equal(np.asarray(weights[p]), np.asarray(cw)), \
             'param %s diverged after pserver kill + restart' % p
+
+
+# ---------------------------------------------------------------------------
+# acceptance: the integrity gauntlet — bit-flipped frames, a poisoned
+# gradient AND an on-disk snapshot corruption in ONE run, bit-exact
+# ---------------------------------------------------------------------------
+
+@pytest.mark.timeout(600)
+def test_integrity_gauntlet_bit_flip_nan_and_snapshot_corruption(
+        clean_cluster_weights, tmp_path):
+    """THE integrity acceptance bar: trainer 0 sends one SEND_VAR with
+    4 flipped bits (CRC rejects it, the reconnect replays clean bytes)
+    and one NaN-poisoned gradient (the pserver finite guard rejects it
+    retryably, the retry re-packs the clean value); pserver 0 is then
+    kill-9'd mid-round and — while the Supervisor backs off — its
+    CURRENT snapshot is corrupted on disk, so the restart must
+    quarantine it and restore the .prev generation + journals. The run
+    still lands BIT-EXACTLY on the fault-free cluster's weights, and
+    the damaged snapshot is left on disk for post-mortem."""
+    workdir = str(tmp_path)
+    trainer_plan = json.dumps({'rules': [
+        {'when': 'send', 'type': 'SEND_VAR', 'nth': 3,
+         'action': 'corrupt', 'bits': 4},
+        {'when': 'send', 'type': 'SEND_VAR', 'nth': 7, 'action': 'nan'},
+    ]})
+    # mlp 2x2 sync, snapshot_every=1: 2 BATCH_BARRIERs per pserver per
+    # round, so recv barrier #5 dies at the top of round 3 — with
+    # current=S2 and prev=S1 on disk. (SEND_VAR counts are no good as a
+    # round clock here: the corrupt frame's connection drop replays
+    # unacked sends and the NaN gradient is retried, both inflating the
+    # pserver's SEND_VAR recv counter; barrier counts are unaffected.)
+    pserver_plan = json.dumps({'rules': [
+        {'when': 'recv', 'type': 'BATCH_BARRIER', 'nth': 5,
+         'action': 'exit'}]})
+    eps = ','.join('127.0.0.1:%d' % p for p in _free_ports(2))
+    base = dict(os.environ)
+    base.pop('JAX_PLATFORMS', None)
+    base.pop('XLA_FLAGS', None)
+    base.update({'PS_MODEL': 'mlp', 'PS_ENDPOINTS': eps,
+                 'PS_TRAINERS': '2', 'PS_STEPS': '3', 'PS_SYNC': '1',
+                 'PS_OPTIMIZER': 'sgd'})
+    base.update(_ELASTIC_KNOBS)
+    state_path = os.path.join(workdir, 'ps0.state')
+    # backoff=3.0 opens a deterministic window to damage the snapshot
+    # between pserver 0's death and its respawn
+    sup = Supervisor(max_restarts=2, backoff=3.0, log_dir=workdir)
+    for i in range(2):
+        env = dict(base, PS_ROLE='pserver', PS_PSERVER_ID=str(i),
+                   FLAGS_ps_state_path=os.path.join(
+                       workdir, 'ps%d.state' % i))
+        if i == 0:
+            env['FLAGS_fault_plan'] = pserver_plan
+        sup.add_role('pserver%d' % i, [sys.executable, _WORKER],
+                     env=env)
+    for i in range(2):
+        env = dict(base, PS_ROLE='trainer', PS_TRAINER_ID=str(i))
+        if i == 0:
+            env['FLAGS_fault_plan'] = trainer_plan
+        sup.add_role('trainer%d' % i, [sys.executable, _WORKER],
+                     env=env)
+    sup.start()
+    try:
+        corrupted = False
+        deadline = time.monotonic() + 420
+        while time.monotonic() < deadline:
+            states = sup.states()
+            if not corrupted and states.get('pserver0') == 'backoff':
+                with open(state_path, 'r+b') as f:
+                    f.seek(os.path.getsize(state_path) // 2)
+                    b = f.read(1)
+                    f.seek(-1, 1)
+                    f.write(bytes([b[0] ^ 0xFF]))
+                corrupted = True
+            if all(s in ('done', 'failed') for s in states.values()):
+                break
+            time.sleep(0.05)
+        assert corrupted, 'pserver0 was never observed in backoff'
+        states = sup.wait(timeout=60)
+        t0 = sup.output('trainer0')
+        p0 = sup.output('pserver0')
+        assert all(s == 'done' for s in states.values()), \
+            (states, t0[-4000:], p0[-4000:])
+        assert sup.restarts['pserver0'] == 1
+        weights = None
+        for ln in t0.splitlines():
+            if ln.startswith('RESULT '):
+                weights = json.loads(ln[len('RESULT '):])['weights']
+        assert weights is not None, t0[-4000:]
+    finally:
+        sup.stop()
+    # all three faults actually fired...
+    assert 'fault injection: corrupt on send' in t0
+    assert 'fault injection: nan on send' in t0
+    assert 'fault injection: exit' in p0
+    # ...the restarted pserver quarantined the damaged snapshot and fell
+    # back to the previous generation...
+    assert 'quarantined corrupt file' in p0
+    assert 'previous snapshot generation' in p0
+    assert os.path.exists(state_path + '.corrupt')
+    # ...and the weights are BIT-EXACTLY the fault-free cluster's
+    for p, cw in clean_cluster_weights.items():
+        assert np.array_equal(np.asarray(weights[p]), np.asarray(cw)), \
+            'param %s diverged through the integrity gauntlet' % p
+
+
+@pytest.mark.timeout(900)
+def test_chaos_sweep_corrupt_smoke():
+    """The seeded corrupt sweep's CI shape (tools/chaos_sweep.py
+    --corrupt --quick): every corrupt/nan plan must end ok — under
+    --quick, fatal and hung fail the sweep too."""
+    sys.path.insert(0, os.path.join(_ROOT, 'tools'))
+    import chaos_sweep
+    assert chaos_sweep.main(['--corrupt', '--quick', '--seeds', '2',
+                             '--steps', '3', '--budget', '240']) == 0
 
 
 @pytest.mark.slow

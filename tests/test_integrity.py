@@ -1,0 +1,695 @@
+"""End-to-end integrity: frame CRCs, corrupt-bit fault injection,
+snapshot/checkpoint digests, and the numeric-anomaly guard.
+
+The contract this suite pins:
+
+- a wire frame without a valid CRC is NEVER applied — every single-bit
+  flip in a frame either raises FrameCorruptError (a retryable framing
+  error: the retry resends the clean bytes) or ends the scan as a torn
+  tail; no flip yields a successfully-parsed wrong message;
+- a NaN gradient is rejected at BOTH ends (client pre-send check,
+  pserver finite guard) with a retryable error, before it reaches the
+  journal or the dedup window;
+- a corrupt pserver snapshot / journal / trainer checkpoint is
+  QUARANTINED (renamed aside for post-mortem) and restore falls back to
+  the newest verified generation — worst case a loud fresh start, never
+  silently-loaded garbage;
+- the anomaly guard (FLAGS_anomaly_action) skips a non-finite step and
+  escalates to checkpoint rollback, landing bit-identical to an
+  undisturbed run;
+- a mute peer (accepts, never replies) surfaces as RetryableRPCError
+  via the FLAGS_rpc_read_deadline socket timeout instead of hanging.
+"""
+import json
+import os
+import shutil
+import socket
+import threading
+import time
+import zlib
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import integrity
+from paddle_tpu.distributed import resilience, statefile, wire
+from paddle_tpu.distributed.param_service import ParameterService
+from paddle_tpu.distributed.resilience import (FaultPlan, FaultRule,
+                                               RetryPolicy,
+                                               RetryableRPCError)
+from paddle_tpu.distributed.rpc import PSClient, PSServer
+from paddle_tpu.distributed.wire import FrameCorruptError
+
+pytestmark = pytest.mark.chaos
+
+
+# ---------------------------------------------------------------------------
+# the one CRC definition
+# ---------------------------------------------------------------------------
+
+def test_crc32_matches_zlib_and_chains():
+    data = b'the quick brown fox'
+    assert integrity.crc32(data) == zlib.crc32(data) & 0xFFFFFFFF
+    # chainable: crc of the whole == crc folded over pieces
+    assert integrity.crc32(data[7:], integrity.crc32(data[:7])) == \
+        integrity.crc32(data)
+    assert integrity.crc32(b'') == 0
+
+
+def test_crc32_file(tmp_path):
+    p = str(tmp_path / 'blob')
+    data = os.urandom(3 * 1024 * 1024 + 17)   # spans chunk boundaries
+    with open(p, 'wb') as f:
+        f.write(data)
+    crc, size = integrity.crc32_file(p)
+    assert crc == integrity.crc32(data)
+    assert size == len(data)
+
+
+# ---------------------------------------------------------------------------
+# wire framing: no flipped frame is ever applied
+# ---------------------------------------------------------------------------
+
+def test_wire_roundtrip():
+    val = np.arange(6, dtype='f4').reshape(2, 3)
+    frame = wire.pack_msg(wire.SEND_VAR, {'name': 'g'},
+                          payload=val.tobytes())
+    msgs = list(wire.scan_msgs(frame + frame))
+    assert [t for t, _, _, _ in msgs] == [wire.SEND_VAR] * 2
+    assert msgs[0][1]['name'] == 'g'
+    assert msgs[-1][3] == 2 * len(frame)
+    assert [t for t, _, _ in wire.unpack_msgs(frame)] == [wire.SEND_VAR]
+
+
+def test_every_single_bit_flip_is_detected():
+    """Flip one bit at EVERY byte offset of a frame followed by a clean
+    frame: the scan must raise FrameCorruptError or stop (torn tail) —
+    it must never yield the damaged first message as valid."""
+    val = np.arange(4, dtype='f4')
+    meta = {'name': 'w@GRAD', 'dtype': 'float32', 'shape': [4]}
+    frame = wire.pack_msg(wire.SEND_VAR, meta, payload=val.tobytes())
+    clean = wire.pack_msg(wire.BATCH_BARRIER, {})
+    outcomes = {'raised': 0, 'torn': 0}
+    for off in range(len(frame)):
+        for bit in (0, 7):
+            buf = bytearray(frame + clean)
+            buf[off] ^= 1 << bit
+            try:
+                msgs = list(wire.scan_msgs(bytes(buf)))
+            except FrameCorruptError:
+                outcomes['raised'] += 1
+                continue
+            # not raised: the only legal outcome is a torn-tail stop
+            # with NOTHING consumed — a flipped body_len that claims
+            # more bytes than the buffer holds
+            assert msgs == [], \
+                'flip at byte %d bit %d yielded msgs' % (off, bit)
+            outcomes['torn'] += 1
+    assert outcomes['raised'] > 0 and outcomes['torn'] > 0
+    # CRC flips themselves are detected too (covered above: off < 4)
+
+
+def test_torn_trailing_frame_ends_scan():
+    frame = wire.pack_msg(wire.SEND_VAR, {'name': 'g'}, payload=b'abcd')
+    msgs = list(wire.scan_msgs(frame + frame[:9]))
+    assert len(msgs) == 1 and msgs[0][3] == len(frame)
+
+
+def test_value_is_finite():
+    assert wire.value_is_finite(np.ones(3, 'f4'))
+    assert not wire.value_is_finite(np.array([1.0, np.nan], 'f4'))
+    assert not wire.value_is_finite(np.array([np.inf], 'f8'))
+    assert wire.value_is_finite(np.array([1, 2], 'i8'))   # vacuous
+
+
+# ---------------------------------------------------------------------------
+# corrupt / nan fault actions over real sockets: damage is detected,
+# the retry delivers the clean value, training state stays exact
+# ---------------------------------------------------------------------------
+
+def _mini_service(sync_mode=True):
+    params = {'w': np.zeros(4, 'f4')}
+    rounds = []
+
+    def run_round(merged):
+        rounds.append(sorted(merged))
+        for v in merged.values():
+            params['w'] = params['w'] - np.asarray(v)
+
+    svc = ParameterService(
+        num_trainers=1, sync_mode=sync_mode,
+        get_param=lambda name: params[name], run_round=run_round,
+        rpc_deadline=60.0)
+    return svc, params, rounds
+
+
+def _fast_retry():
+    return RetryPolicy(max_attempts=5, backoff=0.01, max_backoff=0.05,
+                       reconnect_secs=5.0)
+
+
+def test_corrupt_action_crc_rejects_and_retry_applies_clean():
+    """Bits flipped in SEND_VAR #1's frame: the server's CRC check kills
+    the connection, the client replays the CLEAN bytes, and the round
+    applies exactly the uncorrupted gradient."""
+    svc, params, rounds = _mini_service()
+    srv = PSServer('127.0.0.1:0', svc)
+    st = threading.Thread(target=srv.serve_forever, daemon=True)
+    st.start()
+    g = np.arange(1, 5, dtype='f4')
+    plan = FaultPlan([FaultRule('send', 1, 'corrupt', type='SEND_VAR',
+                                bits=4)])
+    with resilience.active_plan(plan):
+        cli = PSClient('127.0.0.1:%d' % srv.port, trainer_id=0,
+                       retry_policy=_fast_retry())
+        cli.send_var('w@GRAD', g)
+        cli.batch_barrier()
+        np.testing.assert_array_equal(cli.get_var('w'), -g)
+        cli.complete()
+        fired = resilience.fired_faults()
+    st.join(timeout=10.0)
+    assert not st.is_alive()
+    assert len(rounds) == 1
+    assert [f['action'] for f in fired] == ['corrupt']
+    np.testing.assert_array_equal(params['w'], -g)
+
+
+def test_nan_action_rejected_by_server_guard_then_clean_retry():
+    """SEND_VAR #1's float payload is poisoned AFTER the clean value was
+    handed to the client (a valid CRC — the numeric backstop's case):
+    the pserver finite guard rejects it retryably BEFORE journaling, and
+    the in-place retry re-packs the original clean value."""
+    svc, params, rounds = _mini_service()
+    srv = PSServer('127.0.0.1:0', svc)
+    st = threading.Thread(target=srv.serve_forever, daemon=True)
+    st.start()
+    g = np.arange(1, 5, dtype='f4')
+    plan = FaultPlan([FaultRule('send', 1, 'nan', type='SEND_VAR')])
+    with resilience.active_plan(plan):
+        cli = PSClient('127.0.0.1:%d' % srv.port, trainer_id=0,
+                       retry_policy=_fast_retry())
+        cli.send_var('w@GRAD', g)
+        cli.batch_barrier()
+        cli.complete()
+        fired = resilience.fired_faults()
+    st.join(timeout=10.0)
+    assert not st.is_alive()
+    assert [f['action'] for f in fired] == ['nan']
+    assert len(rounds) == 1
+    np.testing.assert_array_equal(params['w'], -g)     # the CLEAN value
+
+
+def test_client_refuses_locally_nonfinite_gradient():
+    """A gradient that is GENUINELY non-finite on the client (not
+    injected downstream of the API) is refused before a round trip —
+    the Trainer's step-retry machinery recomputes it."""
+    svc, params, rounds = _mini_service()
+    srv = PSServer('127.0.0.1:0', svc)
+    st = threading.Thread(target=srv.serve_forever, daemon=True)
+    st.start()
+    try:
+        cli = PSClient('127.0.0.1:%d' % srv.port, trainer_id=0,
+                       retry_policy=_fast_retry())
+        with pytest.raises(RetryableRPCError, match='non-finite'):
+            cli.send_var('w@GRAD', np.array([1.0, np.nan, 1.0, 1.0],
+                                            'f4'))
+        assert rounds == []
+        cli.complete()
+    finally:
+        st.join(timeout=10.0)
+    assert not st.is_alive()
+    np.testing.assert_array_equal(params['w'], np.zeros(4, 'f4'))
+
+
+def test_read_deadline_surfaces_mute_server():
+    """A peer that accepts the connection but never replies must fail
+    the call with RetryableRPCError after the read deadline — not hang
+    the trainer forever. (FLAGS_rpc_read_deadline is the default; the
+    explicit timeout arg pins the test's clock.)"""
+    lsock = socket.socket()
+    lsock.bind(('127.0.0.1', 0))
+    lsock.listen(4)
+    held = []
+    done = threading.Event()
+
+    def mute():
+        while not done.is_set():
+            try:
+                conn, _ = lsock.accept()
+            except OSError:
+                return
+            held.append(conn)           # accept, read nothing, say nothing
+
+    mt = threading.Thread(target=mute, daemon=True)
+    mt.start()
+    try:
+        cli = PSClient('127.0.0.1:%d' % lsock.getsockname()[1],
+                       trainer_id=0, timeout=0.3,
+                       retry_policy=RetryPolicy(max_attempts=2,
+                                                backoff=0.01,
+                                                max_backoff=0.02,
+                                                reconnect_secs=2.0))
+        t0 = time.monotonic()
+        with pytest.raises(RetryableRPCError):
+            cli.get_var('w')
+        assert time.monotonic() - t0 < 30.0
+    finally:
+        done.set()
+        lsock.close()
+        for c in held:
+            c.close()
+
+
+def test_read_deadline_flag_is_the_default():
+    fluid.set_flags({'FLAGS_rpc_read_deadline': 7.5})
+    try:
+        lsock = socket.socket()
+        lsock.bind(('127.0.0.1', 0))
+        lsock.listen(1)
+        accepted = []
+
+        def _accept():
+            try:
+                accepted.append(lsock.accept())
+            except OSError:
+                pass                      # listener closed at test end
+
+        at = threading.Thread(target=_accept, daemon=True)
+        at.start()
+        cli = PSClient('127.0.0.1:%d' % lsock.getsockname()[1],
+                       trainer_id=0, retry_policy=_fast_retry())
+        assert cli.timeout == 7.5
+        lsock.close()
+    finally:
+        fluid.set_flags({'FLAGS_rpc_read_deadline': 120.0})
+
+
+# ---------------------------------------------------------------------------
+# corrupt-seed plan generator (chaos_sweep --corrupt)
+# ---------------------------------------------------------------------------
+
+def test_from_corrupt_seed_deterministic_and_wellformed():
+    for seed in range(12):
+        a = FaultPlan.from_corrupt_seed(seed)
+        assert a.to_json() == FaultPlan.from_corrupt_seed(seed).to_json()
+        for rule in a.rules:
+            assert rule.action in ('corrupt', 'nan')
+            assert rule.when == 'send'
+    assert len({FaultPlan.from_corrupt_seed(s).to_json()
+                for s in range(12)}) > 4
+    # the spec spelling round-trips through FLAGS_fault_plan parsing
+    assert FaultPlan.from_spec('corrupt:3').to_json() == \
+        FaultPlan.from_corrupt_seed(3).to_json()
+
+
+# ---------------------------------------------------------------------------
+# pserver durability: digests, generations, quarantine — and the
+# torn-journal x corrupt-payload matrix
+# ---------------------------------------------------------------------------
+
+def _durable_service(path, snapshot_every=1):
+    params = {'w': np.zeros(4, 'f4')}
+
+    def run_round(merged):
+        for v in merged.values():
+            params['w'] = params['w'] - np.asarray(v)
+
+    svc = ParameterService(
+        num_trainers=1, sync_mode=True,
+        get_param=lambda name: params[name], run_round=run_round,
+        rpc_deadline=60.0, snapshot_path=path,
+        snapshot_every=snapshot_every,
+        dump_state=lambda: dict(params),
+        load_state=lambda p: params.update(
+            {k: np.asarray(v) for k, v in p.items()}))
+    return svc, params
+
+
+def _flip_byte(path, off):
+    with open(path, 'r+b') as f:
+        f.seek(off)
+        b = f.read(1)
+        f.seek(off)
+        f.write(bytes([b[0] ^ 0xFF]))
+
+
+def test_snapshot_digest_written_and_verified(tmp_path):
+    path = str(tmp_path / 'ps.state')
+    svc, params = _durable_service(path)
+    svc.on_send_var('w@GRAD', 0, np.ones(4, 'f4'), seq=('c', 1),
+                    inc=0, round_idx=0)
+    svc.on_batch_barrier(0, seq=('c', 2), inc=0, round_idx=0)
+    assert statefile.verify_digest(path) == 'ok'
+    _flip_byte(path, os.path.getsize(path) // 2)
+    assert statefile.verify_digest(path) == 'mismatch'
+
+
+def test_corrupt_snapshot_falls_back_to_prev_generation(tmp_path):
+    """Digest mismatch on the current snapshot: quarantine it, restore
+    the .prev generation, replay both journal eras — the state is EXACT,
+    and the damaged file is left on disk for post-mortem."""
+    path = str(tmp_path / 'ps.state')
+    svc, params = _durable_service(path)
+    for r in range(3):
+        svc.on_send_var('w@GRAD', 0, (r + 1) * np.ones(4, 'f4'),
+                        seq=('c', 2 * r + 1), inc=0, round_idx=r)
+        svc.on_batch_barrier(0, seq=('c', 2 * r + 2), inc=0, round_idx=r)
+    expect = params['w'].copy()
+    assert os.path.exists(path + '.prev')
+    _flip_byte(path, os.path.getsize(path) // 2)
+    svc2, params2 = _durable_service(path)
+    np.testing.assert_array_equal(params2['w'], expect)
+    assert svc2._completed_rounds == 3
+    assert os.path.exists(path + '.corrupt')
+    # the recovered service retired the old generations behind a FRESH
+    # verified snapshot (a stale .prev paired with a later-era journal
+    # would lose the recovered prefix on the next fallback)
+    assert statefile.verify_digest(path) == 'ok'
+
+
+def test_all_generations_corrupt_starts_fresh_loudly(tmp_path, capfd):
+    path = str(tmp_path / 'ps.state')
+    svc, params = _durable_service(path)
+    for r in range(2):
+        svc.on_send_var('w@GRAD', 0, np.ones(4, 'f4'),
+                        seq=('c', 2 * r + 1), inc=0, round_idx=r)
+        svc.on_batch_barrier(0, seq=('c', 2 * r + 2), inc=0, round_idx=r)
+    _flip_byte(path, os.path.getsize(path) // 2)
+    _flip_byte(path + '.prev', os.path.getsize(path + '.prev') // 2)
+    svc2, params2 = _durable_service(path)
+    np.testing.assert_array_equal(params2['w'], np.zeros(4, 'f4'))
+    assert svc2._completed_rounds == 0
+    err = capfd.readouterr().err
+    assert 'every snapshot generation' in err
+    # the journals were quarantined too: deltas against a lost base
+    assert os.path.exists(path + '.corrupt')
+
+
+def test_torn_journal_times_corrupt_payload_matrix(tmp_path):
+    """Truncate the journal at EVERY byte offset, and separately flip
+    the byte at EVERY offset: each restore must land on a PREFIX of the
+    true mutation sequence (params match one valid prefix state, seq
+    window is a prefix of the true window) or start fresh loudly —
+    never load garbage."""
+    base = str(tmp_path / 'gold')
+    os.makedirs(base)
+    path = os.path.join(base, 'ps.state')
+    svc, params = _durable_service(path, snapshot_every=10)
+    muts = [('send', ('c', 1), 1.0), ('barrier', ('c', 2), None),
+            ('send', ('c', 3), 2.0), ('barrier', ('c', 4), None)]
+    valid_w = [np.zeros(4, 'f4')]
+    valid_seqs = [[]]
+    for kind, seq, v in muts:
+        if kind == 'send':
+            svc.on_send_var('w@GRAD', 0, v * np.ones(4, 'f4'), seq=seq,
+                            inc=0, round_idx=0 if seq[1] < 3 else 1)
+        else:
+            svc.on_batch_barrier(0, seq=seq, inc=0,
+                                 round_idx=0 if seq[1] < 3 else 1)
+        valid_w.append(params['w'].copy())
+        valid_seqs.append(valid_seqs[-1] + [seq])
+    jpath = path + '.journal'
+    jsize = os.path.getsize(jpath)
+    assert jsize > 0
+
+    def check_prefix(tag, workdir):
+        svc2, params2 = _durable_service(
+            os.path.join(workdir, 'ps.state'), snapshot_every=10)
+        got_seqs = list(svc2._seq_order.get(0, []))
+        ok = any(np.array_equal(params2['w'], w) and got_seqs == s
+                 for w, s in zip(valid_w, valid_seqs))
+        assert ok, '%s: params %r seqs %r is not a valid prefix state' \
+            % (tag, params2['w'], got_seqs)
+
+    for off in range(jsize):
+        wd = str(tmp_path / ('t%d' % off))
+        shutil.copytree(base, wd)
+        with open(os.path.join(wd, 'ps.state.journal'), 'r+b') as f:
+            f.truncate(off)
+        check_prefix('truncate@%d' % off, wd)
+        shutil.rmtree(wd)
+    for off in range(jsize):
+        wd = str(tmp_path / ('f%d' % off))
+        shutil.copytree(base, wd)
+        _flip_byte(os.path.join(wd, 'ps.state.journal'), off)
+        check_prefix('flip@%d' % off, wd)
+        shutil.rmtree(wd)
+
+
+def test_torn_journal_tail_is_truncated_before_append(tmp_path):
+    """A torn trailing record is cut at the last verified frame boundary
+    BEFORE the journal is reopened for appends — without this, new
+    frames land after the partial bytes and the NEXT restore loses
+    everything from the tear onward."""
+    path = str(tmp_path / 'ps.state')
+    svc, params = _durable_service(path, snapshot_every=10)
+    svc.on_send_var('w@GRAD', 0, np.ones(4, 'f4'), seq=('c', 1),
+                    inc=0, round_idx=0)
+    svc.on_batch_barrier(0, seq=('c', 2), inc=0, round_idx=0)
+    with open(path + '.journal', 'ab') as f:
+        f.write(b'\x07\x00\x01')                    # torn tail
+    svc2, params2 = _durable_service(path, snapshot_every=10)
+    after_round0 = params2['w'].copy()
+    # append MORE mutations through the recovered service, then restore
+    # once more: the full sequence must replay
+    svc2.on_send_var('w@GRAD', 0, 2 * np.ones(4, 'f4'), seq=('c', 3),
+                     inc=0, round_idx=1)
+    svc2.on_batch_barrier(0, seq=('c', 4), inc=0, round_idx=1)
+    svc3, params3 = _durable_service(path, snapshot_every=10)
+    np.testing.assert_array_equal(params3['w'],
+                                  after_round0 - 2 * np.ones(4, 'f4'))
+    assert list(svc3._seq_order[0]) == [('c', 1), ('c', 2), ('c', 3),
+                                        ('c', 4)]
+
+# ---------------------------------------------------------------------------
+# trainer checkpoint digests: corrupt checkpoints are quarantined and
+# resume falls back to the newest VERIFIED one
+# ---------------------------------------------------------------------------
+
+def _train_func():
+    x = fluid.layers.data(name='x', shape=[4], dtype='float32')
+    y = fluid.layers.data(name='y', shape=[1], dtype='float32')
+    pred = fluid.layers.fc(input=x, size=1,
+                           param_attr=fluid.ParamAttr(
+                               name='iw',
+                               initializer=fluid.initializer.Normal(
+                                   scale=0.1, seed=3)))
+    return fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+
+
+def _reader():
+    rng = np.random.RandomState(7)
+    w = np.linspace(-1, 1, 4).astype('float32')[:, None]
+    for _ in range(10):
+        x = rng.randn(8, 4).astype('float32')
+        yield [x, x @ w]
+
+
+def _run_trainer(ckpt_dir, plan=None, epochs=1):
+    from paddle_tpu import unique_name
+    unique_name.switch()
+    losses, faults = {}, []
+
+    def handler(event):
+        if isinstance(event, fluid.EndStepEvent):
+            losses[(event.epoch, event.step)] = float(
+                np.asarray(event.metrics[0]))
+        elif isinstance(event, fluid.FaultEvent):
+            faults.append((event.action, event.attempt))
+
+    with resilience.active_plan(plan):
+        trainer = fluid.Trainer(
+            _train_func, lambda: fluid.optimizer.Adam(0.02),
+            place=fluid.CPUPlace(),
+            checkpoint_config=fluid.CheckpointConfig(
+                checkpoint_dir=ckpt_dir, max_num_checkpoints=2,
+                step_interval=3))
+        trainer.train(num_epochs=epochs, event_handler=handler,
+                      reader=_reader, feed_order=['x', 'y'])
+    return losses, faults
+
+
+def test_checkpoint_digest_manifest_written(tmp_path):
+    ckpt = str(tmp_path / 'ck')
+    _run_trainer(ckpt)
+    dirs = sorted(d for d in os.listdir(ckpt)
+                  if d.startswith('checkpoint'))
+    assert dirs
+    for d in dirs:
+        man = os.path.join(ckpt, d, 'CHECKPOINT_DIGESTS')
+        assert os.path.exists(man)
+        digests = json.load(open(man))
+        for rel, (crc, size) in digests.items():
+            p = os.path.join(ckpt, d, rel)
+            assert integrity.crc32_file(p) == (crc, size), rel
+
+
+def test_corrupt_checkpoint_quarantined_and_resume_falls_back(tmp_path):
+    """A flipped byte inside the newest checkpoint's payload: resume
+    must quarantine the dir (renamed .corrupt, kept for post-mortem)
+    and restore the older VERIFIED checkpoint."""
+    from paddle_tpu import unique_name
+    ckpt = str(tmp_path / 'ck')
+    _run_trainer(ckpt)
+    dirs = sorted(d for d in os.listdir(ckpt)
+                  if d.startswith('checkpoint'))
+    assert len(dirs) == 2
+    newest = os.path.join(ckpt, dirs[-1])
+    man = json.load(open(os.path.join(newest, 'CHECKPOINT_DIGESTS')))
+    victim = sorted(man)[0]
+    _flip_byte(os.path.join(newest, victim),
+               os.path.getsize(os.path.join(newest, victim)) // 2)
+    unique_name.switch()
+    t = fluid.Trainer(
+        _train_func, lambda: fluid.optimizer.Adam(0.02),
+        place=fluid.CPUPlace(),
+        checkpoint_config=fluid.CheckpointConfig(checkpoint_dir=ckpt))
+    assert t._resumed
+    assert os.path.exists(newest + '.corrupt')
+    assert not os.path.exists(newest)
+    with open(os.path.join(ckpt, dirs[-2], 'TRAINER_METADATA')) as f:
+        assert t.step_id == json.load(f)['step_id'] + 1
+
+
+# ---------------------------------------------------------------------------
+# the numeric-anomaly guard (FLAGS_anomaly_action)
+# ---------------------------------------------------------------------------
+
+def test_anomaly_guard_skips_then_rolls_back_bit_exact(tmp_path):
+    """A poisoned feed (the 'nan' step action) makes the fused isfinite
+    guard trip: the step is skipped (never checkpointed), the poison
+    persists in params so the streak escalates, and the rollback path
+    restores the last SUCCESS checkpoint — every surviving step's loss
+    is bit-identical to a fault-free run with the same flags."""
+    fluid.set_flags({'FLAGS_anomaly_action': 'rollback',
+                     'FLAGS_anomaly_skip_steps': 1})
+    try:
+        baseline, base_faults = _run_trainer(str(tmp_path / 'base'))
+        assert base_faults == []
+        assert len(baseline) == 10
+        plan = FaultPlan([FaultRule('step', 4, 'nan')])
+        losses, faults = _run_trainer(str(tmp_path / 'guard'), plan)
+        assert ('anomaly', 1) in faults
+        assert ('rollback', 1) in faults
+        assert set(losses) == set(baseline)
+        for key, v in baseline.items():
+            assert losses[key] == v, 'step %s not bit-identical' % (key,)
+    finally:
+        fluid.set_flags({'FLAGS_anomaly_action': 'none',
+                         'FLAGS_anomaly_skip_steps': 1})
+
+
+def test_anomaly_guard_off_by_default(tmp_path):
+    """With FLAGS_anomaly_action left at 'none' the guard op is not even
+    built — no fetch overhead on the happy path."""
+    from paddle_tpu import unique_name
+    unique_name.switch()
+    t = fluid.Trainer(_train_func, lambda: fluid.optimizer.Adam(0.02),
+                      place=fluid.CPUPlace())
+    assert t._guard_var is None
+
+
+def test_check_nan_inf_catches_seeded_nan():
+    """FLAGS_check_nan_inf (the debug-mode per-op scan): an op output
+    containing NaN raises OpExecutionError naming the op."""
+    from paddle_tpu import unique_name
+    from paddle_tpu.executor import OpExecutionError
+    fluid.set_flags({'FLAGS_check_nan_inf': True})
+    try:
+        unique_name.switch()
+        prog = fluid.Program()
+        with fluid.program_guard(prog, fluid.Program()):
+            x = fluid.layers.data(name='x', shape=[4], dtype='float32')
+            out = fluid.layers.mean(fluid.layers.log(x))
+        exe = fluid.Executor(fluid.CPUPlace())
+        with pytest.raises(OpExecutionError, match='NaN/Inf'):
+            exe.run(prog,
+                    feed={'x': np.array([[-1.0, 1.0, 1.0, 1.0]], 'f4')},
+                    fetch_list=[out])
+    finally:
+        fluid.set_flags({'FLAGS_check_nan_inf': False})
+
+
+# ---------------------------------------------------------------------------
+# recordio auditor (shares the wire/statefile CRC definition)
+# ---------------------------------------------------------------------------
+
+def test_recordio_verify_file(tmp_path):
+    from paddle_tpu import recordio
+
+    def samples():
+        rng = np.random.RandomState(3)
+        for _ in range(7):
+            yield (rng.randn(4).astype('f4'),
+                   np.array([1], 'i8'))
+
+    path = str(tmp_path / 'data.recordio')
+    n = recordio.convert_reader_to_recordio_file(path, samples,
+                                                 max_num_records=3)
+    assert n == 7
+    chunks, records = recordio.verify_file(path)
+    assert records == 7 and chunks >= 3
+
+    # flip one payload byte -> IOError naming the damaged offset
+    flipped = str(tmp_path / 'flipped.recordio')
+    shutil.copy(path, flipped)
+    _flip_byte(flipped, os.path.getsize(flipped) - 3)
+    with pytest.raises(IOError, match='offset'):
+        recordio.verify_file(flipped)
+
+    # truncated file -> IOError, not silence
+    torn = str(tmp_path / 'torn.recordio')
+    shutil.copy(path, torn)
+    with open(torn, 'r+b') as f:
+        f.truncate(os.path.getsize(torn) - 5)
+    with pytest.raises(IOError):
+        recordio.verify_file(torn)
+
+
+# ---------------------------------------------------------------------------
+# reader pipeline: a worker that outlives its join deadline is counted
+# and named, not silently leaked
+# ---------------------------------------------------------------------------
+
+def test_pipeline_leaked_worker_is_loud(capfd):
+    from paddle_tpu.reader import pipeline
+
+    release = threading.Event()
+
+    def blocked_source():
+        yield [np.zeros((2, 4), 'f4')]
+        release.wait()                   # stuck in the user generator
+        yield [np.zeros((2, 4), 'f4')]
+
+    r = pipeline.PyReader('leaky_reader_test', shapes=[[2, 4]],
+                          dtypes=['float32'], use_double_buffer=False,
+                          join_timeout=0.1)
+    r.decorate_tensor_provider(blocked_source)
+    before = pipeline.leaked_threads()
+    r.start()
+    r.read()
+    r.reset()                            # feeder is stuck: join expires
+    assert pipeline.leaked_threads() == before + 1
+    err = capfd.readouterr().err
+    assert 'leaky_reader_test' in err and 'leaked' in err
+    release.set()                        # let the thread exit for real
+
+
+def test_pipeline_clean_reset_does_not_count(capfd):
+    from paddle_tpu.reader import pipeline
+
+    def source():
+        for _ in range(2):
+            yield [np.zeros((2, 4), 'f4')]
+
+    r = pipeline.PyReader('clean_reader_test', shapes=[[2, 4]],
+                          dtypes=['float32'], use_double_buffer=False,
+                          join_timeout=5.0)
+    r.decorate_tensor_provider(source)
+    before = pipeline.leaked_threads()
+    r.start()
+    r.read()
+    r.reset()
+    assert pipeline.leaked_threads() == before
+    assert 'leaked' not in capfd.readouterr().err

@@ -32,13 +32,27 @@ snapshot + journal. Verdicts:
   fatal      a role exhausted its restart budget
   hung       the supervised cluster blew the time budget
 
+`--corrupt` switches the generator to `FaultPlan.from_corrupt_seed`:
+plans of bit-flip (`corrupt`) and poisoned-gradient (`nan`) rules on
+trainer 0's sends. Unlike the drop/close/error sweep, every corrupt
+plan should end `ok` — the wire CRC rejects flipped frames retryably
+and the pserver finite guard rejects NaN payloads retryably, so the
+retry resends the clean value in both cases; `fatal`/`hung` here means
+an integrity hole, not a plan-dependent outcome.
+
+`--quick` is the CI smoke shape: 3 seeds by default, and the exit
+status is ALSO non-zero on any fatal/hung seed (a quick sweep exists
+to gate regressions, so every non-ok outcome fails it).
+
 Usage:
     python tools/chaos_sweep.py                     # seeds 0..19
     python tools/chaos_sweep.py --seeds 100 --steps 4
     python tools/chaos_sweep.py --seed-start 7 --seeds 1 --verbose
     python tools/chaos_sweep.py --kill --seeds 10   # process-kill mode
+    python tools/chaos_sweep.py --corrupt --quick   # integrity smoke
 
-Exit status is non-zero iff any seed DIVERGED: fatal/hung seeds are
+Exit status is non-zero iff any seed DIVERGED (or, under --quick, any
+seed was fatal/hung): fatal/hung seeds of the full sweep are
 plan-dependent outcomes, weight divergence is never acceptable.
 """
 from __future__ import annotations
@@ -188,8 +202,9 @@ def _run_kill_seed(seed, model, steps, trainers, pservers, budget,
 
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument('--seeds', type=int, default=20,
-                    help='number of seeds to sweep (default 20)')
+    ap.add_argument('--seeds', type=int, default=None,
+                    help='number of seeds to sweep (default 20, '
+                         'or 3 under --quick)')
     ap.add_argument('--seed-start', type=int, default=0)
     ap.add_argument('--model', default='mlp')
     ap.add_argument('--steps', type=int, default=3)
@@ -202,7 +217,17 @@ def main(argv=None):
     ap.add_argument('--kill', action='store_true',
                     help='process-kill mode: seeded exit faults under '
                          'the restarting Supervisor (elastic recovery)')
+    ap.add_argument('--corrupt', action='store_true',
+                    help='integrity mode: seeded bit-flip (corrupt) and '
+                         'poisoned-gradient (nan) plans on trainer 0')
+    ap.add_argument('--quick', action='store_true',
+                    help='CI smoke: 3 seeds unless --seeds given, and '
+                         'fatal/hung seeds fail the sweep too')
     args = ap.parse_args(argv)
+    if args.kill and args.corrupt:
+        ap.error('--kill and --corrupt are mutually exclusive')
+    if args.seeds is None:
+        args.seeds = 3 if args.quick else 20
 
     import tempfile
 
@@ -229,7 +254,8 @@ def main(argv=None):
                                    args.budget, workdir)
             label = '%s %s' % (victim, plan_json)
         else:
-            plan = FaultPlan.from_seed(seed)
+            plan = (FaultPlan.from_corrupt_seed(seed) if args.corrupt
+                    else FaultPlan.from_seed(seed))
             plan_json = label = plan.to_json()
             verdict, weights, outs = _run_seed(
                 plan_json, args.model, args.steps, args.trainers,
@@ -258,6 +284,10 @@ def main(argv=None):
     if bad_seeds:
         print('DIVERGED seeds (reproduce with --seed-start N --seeds 1 '
               '--verbose): %s' % bad_seeds)
+        return 1
+    if args.quick and (tally['fatal'] or tally['hung']):
+        print('QUICK sweep failed: %d fatal, %d hung (quick mode gates '
+              'on every non-ok outcome)' % (tally['fatal'], tally['hung']))
         return 1
     return 0
 
