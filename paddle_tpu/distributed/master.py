@@ -34,6 +34,8 @@ from collections import deque
 
 from . import wire
 from .resilience import FatalRPCError, RetryableRPCError, RetryPolicy
+from ..obs import telemetry as _tm
+from ..obs import trace as _trace
 
 __all__ = ['TaskMaster', 'MasterServer', 'MasterClient', 'task_reader']
 
@@ -43,6 +45,18 @@ TASK_FINISHED = 21
 TASK_FAILED = 22
 SET_DATASET = 23
 MASTER_STATUS = 24
+
+_MSG_NAMES = {GET_TASK: 'GET_TASK', TASK_FINISHED: 'TASK_FINISHED',
+              TASK_FAILED: 'TASK_FAILED', SET_DATASET: 'SET_DATASET',
+              MASTER_STATUS: 'MASTER_STATUS'}
+
+# MasterClient shares the rpc.client.* series with PSClient — a
+# trainer's RPC health is one number regardless of which server it
+# talked to; the trace span name distinguishes them
+_CALLS = _tm.counter('rpc.client.calls')
+_RETRIES = _tm.counter('rpc.client.retries')
+_RECONNECTS = _tm.counter('rpc.client.reconnects')
+_DEADLINE_TIMEOUTS = _tm.counter('rpc.client.read_deadline_timeouts')
 
 
 class TaskMaster(object):
@@ -273,6 +287,7 @@ class MasterServer(object):
                 self._replies.pop(self._reply_order.popleft(), None)
 
     def _serve_conn(self, conn):
+        replay_hits = _tm.counter('master.reply_cache_hits')
         try:
             while not self._stop.is_set():
                 msg_type, meta, _ = wire.read_msg(conn)
@@ -280,32 +295,13 @@ class MasterServer(object):
                 key = (meta.get('cli'), seq) if seq is not None else None
                 reply = self._cached_reply(key)
                 if reply is not None:   # replay: resend, don't re-apply
+                    replay_hits.inc()
                     wire.write_msg(conn, wire.REPLY_OK, reply)
                     continue
-                if msg_type == GET_TASK:
-                    tid, payload, lease = self.master.get_task(
-                        meta.get('worker', '?'))
-                    reply = {'task_id': tid, 'payload': payload,
-                             'lease_id': lease,
-                             'drained': self.master.all_done()}
-                elif msg_type == TASK_FINISHED:
-                    reply = {'ok': self.master.task_finished(
-                        meta['task_id'], meta.get('lease_id'))}
-                elif msg_type == TASK_FAILED:
-                    reply = {'ok': self.master.task_failed(
-                        meta['task_id'], meta.get('lease_id'))}
-                elif msg_type == SET_DATASET:
-                    reply = {'pass': self.master.set_dataset(
-                        meta['payloads'])}
-                elif msg_type == MASTER_STATUS:
-                    reply = self.master.status()
-                else:
-                    wire.write_msg(conn, wire.REPLY_ERR,
-                                   {'error': 'unknown msg %d' % msg_type,
-                                    'retryable': False})
-                    continue
-                self._remember_reply(key, reply)
-                wire.write_msg(conn, wire.REPLY_OK, reply)
+                with _trace.server_span(
+                        _MSG_NAMES.get(msg_type, 'MSG%d' % msg_type),
+                        meta.get('trace')):
+                    self._dispatch_one(conn, msg_type, meta, key)
         except (ConnectionError, OSError):
             pass
         finally:
@@ -319,6 +315,32 @@ class MasterServer(object):
             except ValueError:
                 pass
             self._threads = [t for t in self._threads if t.is_alive()]
+
+    def _dispatch_one(self, conn, msg_type, meta, key):
+        if msg_type == GET_TASK:
+            tid, payload, lease = self.master.get_task(
+                meta.get('worker', '?'))
+            reply = {'task_id': tid, 'payload': payload,
+                     'lease_id': lease,
+                     'drained': self.master.all_done()}
+        elif msg_type == TASK_FINISHED:
+            reply = {'ok': self.master.task_finished(
+                meta['task_id'], meta.get('lease_id'))}
+        elif msg_type == TASK_FAILED:
+            reply = {'ok': self.master.task_failed(
+                meta['task_id'], meta.get('lease_id'))}
+        elif msg_type == SET_DATASET:
+            reply = {'pass': self.master.set_dataset(
+                meta['payloads'])}
+        elif msg_type == MASTER_STATUS:
+            reply = self.master.status()
+        else:
+            wire.write_msg(conn, wire.REPLY_ERR,
+                           {'error': 'unknown msg %d' % msg_type,
+                            'retryable': False})
+            return
+        self._remember_reply(key, reply)
+        wire.write_msg(conn, wire.REPLY_OK, reply)
 
     def shutdown(self):
         self._stop.set()
@@ -396,33 +418,50 @@ class MasterClient(object):
             self._seq += 1
             meta['seq'] = self._seq
             meta['cli'] = self._incarnation
-            last_err = None
-            for delay in self._retry.schedule():
-                if delay:
-                    time.sleep(delay)
-                try:
-                    if self._sock is None:
-                        self._connect(self._retry.reconnect_secs)
-                    wire.write_msg(self._sock, msg_type, meta)
-                    rtype, reply, _ = wire.read_msg(self._sock)
-                except FatalRPCError:
-                    self._drop_socket()
-                    raise
-                except (ConnectionError, OSError) as e:
-                    last_err = e
-                    self._drop_socket()
+            _CALLS.inc()
+            with _trace.span(
+                    'master.%s' % _MSG_NAMES.get(msg_type, msg_type),
+                    kind='client', seq=self._seq) as sp:
+                tr = _trace.wire_trace(sp)
+                if tr is not None:
+                    meta['trace'] = tr
+                return self._call_locked(msg_type, meta)
+
+    def _call_locked(self, msg_type, meta):
+        last_err = None
+        first = True
+        for delay in self._retry.schedule():
+            if not first:
+                _RETRIES.inc()
+            first = False
+            if delay:
+                time.sleep(delay)
+            try:
+                if self._sock is None:
+                    _RECONNECTS.inc()
+                    self._connect(self._retry.reconnect_secs)
+                wire.write_msg(self._sock, msg_type, meta)
+                rtype, reply, _ = wire.read_msg(self._sock)
+            except FatalRPCError:
+                self._drop_socket()
+                raise
+            except (ConnectionError, OSError) as e:
+                if isinstance(e, socket.timeout):
+                    _DEADLINE_TIMEOUTS.inc()
+                last_err = e
+                self._drop_socket()
+                continue
+            if rtype == wire.REPLY_ERR:
+                err = 'master: %s' % reply.get('error')
+                if reply.get('retryable'):
+                    last_err = RetryableRPCError(err)
                     continue
-                if rtype == wire.REPLY_ERR:
-                    err = 'master: %s' % reply.get('error')
-                    if reply.get('retryable'):
-                        last_err = RetryableRPCError(err)
-                        continue
-                    raise FatalRPCError(err)
-                return reply
-            raise RetryableRPCError(
-                'master unreachable after %d attempts (%s: %s)'
-                % (self._retry.max_attempts, type(last_err).__name__,
-                   last_err)) from last_err
+                raise FatalRPCError(err)
+            return reply
+        raise RetryableRPCError(
+            'master unreachable after %d attempts (%s: %s)'
+            % (self._retry.max_attempts, type(last_err).__name__,
+               last_err)) from last_err
 
     def set_dataset(self, payloads):
         return self._call(SET_DATASET, {'payloads': list(payloads)})

@@ -118,7 +118,21 @@ from collections import deque
 
 import numpy as np
 
+from ..obs import telemetry as _tm
+
 __all__ = ['ParameterService']
+
+# pserver durability + dedup health (no-ops while FLAGS_obs_dir is
+# unset): a chaos-run rollup with nonzero replay/journal/snapshot
+# counters is the evidence the recovery machinery actually fired
+_DEDUP_HITS = _tm.counter('ps.dedup_replay_hits')
+_STALE_ROUND_ACKS = _tm.counter('ps.stale_round_acks')
+_NONFINITE_REJECTED = _tm.counter('ps.nonfinite_grads_rejected')
+_ROUNDS = _tm.counter('ps.rounds_completed')
+_JOURNAL_APPENDS = _tm.counter('ps.journal.appends')
+_JOURNAL_REPLAYED = _tm.counter('ps.journal.replayed_frames')
+_SNAP_WRITES = _tm.counter('ps.snapshot.writes')
+_SNAP_RESTORES = _tm.counter('ps.snapshot.restores')
 
 
 class ParameterService(object):
@@ -362,6 +376,7 @@ class ParameterService(object):
             self._pending.clear()
             self._barrier_tids.clear()
             self._completed_rounds += 1
+            _ROUNDS.inc()
             # pending is empty RIGHT NOW — the cheapest instant for a
             # consistent snapshot; the barrier that closed this round
             # is acked only after the snapshot is durable
@@ -399,7 +414,10 @@ class ParameterService(object):
 
     def _is_replay_locked(self, tid, token):
         """Has this (cli, seq) token already been applied for tid?"""
-        return token is not None and token in self._seq_seen.get(tid, ())
+        hit = token is not None and token in self._seq_seen.get(tid, ())
+        if hit:
+            _DEDUP_HITS.inc()
+        return hit
 
     def _record_seq_locked(self, tid, token):
         """Record an APPLIED mutation token; evict the oldest past the
@@ -425,8 +443,11 @@ class ParameterService(object):
         replays rounds an ahead server has applied; ack-ignoring them
         (rather than erroring) lets the trainer's step counter catch up
         to every shard without double-counting anywhere."""
-        return (round_idx is not None
-                and int(round_idx) < self._trainer_rounds.get(tid, 0))
+        stale = (round_idx is not None
+                 and int(round_idx) < self._trainer_rounds.get(tid, 0))
+        if stale:
+            _STALE_ROUND_ACKS.inc()
+        return stale
 
     # -- durability --------------------------------------------------------
     def _journal_path(self):
@@ -458,6 +479,7 @@ class ParameterService(object):
         from . import wire
         self._journal_f.write(wire.pack_msg(msg_type, meta, value=value))
         self._journal_f.flush()
+        _JOURNAL_APPENDS.inc()
 
     def _maybe_snapshot_locked(self):
         if (self.snapshot_path and self._dump_state is not None
@@ -510,6 +532,7 @@ class ParameterService(object):
             if self._journal_f is not None:
                 self._journal_f.close()
             self._journal_f = open(self._journal_path(), 'wb')
+        _SNAP_WRITES.inc()
 
     def _recover_generations_locked(self):
         """After a restore that quarantined corruption: retire every
@@ -585,6 +608,7 @@ class ParameterService(object):
                 self._seq_order[tid] = deque(tuple(t) for t in toks)
                 self._seq_seen[tid] = set(self._seq_order[tid])
             loaded = cand
+            _SNAP_RESTORES.inc()
             if cand != snap:
                 sys.stderr.write('WARNING: restored from previous '
                                  'snapshot generation %s\n' % cand)
@@ -631,6 +655,7 @@ class ParameterService(object):
             for msg_type, meta, value, end in wire.scan_msgs(buf):
                 self._replay_msg(msg_type, meta, value)
                 consumed = end
+                _JOURNAL_REPLAYED.inc()
         except wire.FrameCorruptError as e:
             sys.stderr.write(
                 'WARNING: journal %s corrupt after %d clean bytes (%s); '
@@ -692,6 +717,7 @@ class ParameterService(object):
             # enters durable state, and the retryable classification
             # makes the client re-send the value it actually computed
             from .resilience import TransientError
+            _NONFINITE_REJECTED.inc()
             raise TransientError(
                 'non-finite gradient %r from trainer %s rejected '
                 '(FLAGS_ps_check_grad_finite): corrupted or diverging '

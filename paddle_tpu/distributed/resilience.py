@@ -415,6 +415,17 @@ def _match_locked(when, msg_type):
     if hit is not None:
         _fired.append({'when': when, 'type': hit.type, 'nth': hit.nth,
                        'action': hit.action})
+        from ..obs import telemetry
+        telemetry.counter('faults.injected').inc()
+        # snapshot NOW, before the action takes effect: an 'exit' rule
+        # (the kill -9 analog) dies with os._exit — no atexit, no final
+        # periodic export — and a short-lived incarnation would
+        # otherwise leave no metrics line at all. Firing a fault is the
+        # one moment a chaos run's counters must be durable.
+        try:
+            telemetry.flush()
+        except Exception:
+            pass   # observability must never alter the injected fault
     return hit
 
 

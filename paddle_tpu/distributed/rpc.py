@@ -32,9 +32,32 @@ import time
 
 from . import wire
 from .resilience import FatalRPCError, RetryableRPCError, RetryPolicy
+from ..obs import telemetry as _tm
+from ..obs import trace as _trace
 
 __all__ = ['PSClient', 'PSServer', 'get_client', 'close_all_clients',
            'RetryableRPCError', 'FatalRPCError']
+
+# client-side RPC health: every logical call, every replay of one
+# (retries), every fresh connection made to replace a dropped socket
+# (reconnects), and read-deadline expiries specifically — the silent
+# peer case (FLAGS_rpc_read_deadline)
+_CALLS = _tm.counter('rpc.client.calls')
+_RETRIES = _tm.counter('rpc.client.retries')
+_RECONNECTS = _tm.counter('rpc.client.reconnects')
+_DEADLINE_TIMEOUTS = _tm.counter('rpc.client.read_deadline_timeouts')
+_CALL_LATENCY = _tm.histogram('rpc.client.call_latency')
+
+_MSG_NAMES = {
+    wire.SEND_VAR: 'SEND_VAR', wire.GET_VAR: 'GET_VAR',
+    wire.PREFETCH: 'PREFETCH', wire.BATCH_BARRIER: 'BATCH_BARRIER',
+    wire.FETCH_BARRIER: 'FETCH_BARRIER', wire.COMPLETE: 'COMPLETE',
+    wire.CHECKPOINT: 'CHECKPOINT', wire.REGISTER: 'REGISTER',
+}
+
+
+def _msg_name(msg_type):
+    return _MSG_NAMES.get(msg_type, 'MSG%d' % msg_type)
 
 
 class PSClient(object):
@@ -122,15 +145,34 @@ class PSClient(object):
             meta['seq'] = self._seq
             meta['cli'] = self._incarnation
             meta['inc'] = self.incarnation
-            return self._call_locked(msg_type, meta, value)
+            # one client span per LOGICAL call (the span covers every
+            # retry); its id rides the optional meta 'trace' field so
+            # the server's handler span shares it — absent field means
+            # untraced, no wire-version bump
+            _CALLS.inc()
+            t0 = time.monotonic()
+            with _trace.span('rpc.%s' % _msg_name(msg_type),
+                             kind='client', endpoint=self.endpoint,
+                             seq=self._seq) as sp:
+                tr = _trace.wire_trace(sp)
+                if tr is not None:
+                    meta['trace'] = tr
+                out = self._call_locked(msg_type, meta, value)
+            _CALL_LATENCY.observe(time.monotonic() - t0)
+            return out
 
     def _call_locked(self, msg_type, meta, value):
         last_err = None
+        first = True
         for delay in self._retry.schedule():
+            if not first:
+                _RETRIES.inc()
+            first = False
             if delay:
                 time.sleep(delay)
             try:
                 if self._sock is None:
+                    _RECONNECTS.inc()
                     self._connect(self._retry.reconnect_secs)
                 wire.write_msg(self._sock, msg_type, meta, value)
                 rtype, rmeta, rvalue = wire.read_msg(self._sock)
@@ -141,6 +183,8 @@ class PSClient(object):
                 # transport failure mid-frame (socket.timeout included):
                 # the socket may hold misframed garbage — drop it and
                 # replay this request (same seq) on a fresh connection
+                if isinstance(e, socket.timeout):
+                    _DEADLINE_TIMEOUTS.inc()
                 last_err = e
                 self._drop_socket()
                 continue
@@ -365,39 +409,15 @@ class PSServer(object):
                 inc = meta.get('inc')
                 round_idx = meta.get('round')
                 try:
-                    if msg_type == wire.SEND_VAR:
-                        svc.on_send_var(name, tid, value, seq=key,
-                                        inc=inc, round_idx=round_idx)
-                        wire.write_msg(conn, wire.REPLY_OK)
-                    elif msg_type == wire.GET_VAR:
-                        out = svc.on_get_var(name, tid, inc=inc)
-                        wire.write_msg(conn, wire.REPLY_VAR, value=out)
-                    elif msg_type == wire.PREFETCH:
-                        out = svc.on_prefetch(name, tid, value, inc=inc)
-                        wire.write_msg(conn, wire.REPLY_VAR, value=out)
-                    elif msg_type == wire.BATCH_BARRIER:
-                        svc.on_batch_barrier(tid, seq=key, inc=inc,
-                                             round_idx=round_idx)
-                        wire.write_msg(conn, wire.REPLY_OK)
-                    elif msg_type == wire.FETCH_BARRIER:
-                        svc.on_fetch_barrier(tid, inc=inc)
-                        wire.write_msg(conn, wire.REPLY_OK)
-                    elif msg_type == wire.CHECKPOINT:
-                        svc.on_checkpoint(meta.get('dirname'), tid,
-                                          seq=key, inc=inc)
-                        wire.write_msg(conn, wire.REPLY_OK)
-                    elif msg_type == wire.REGISTER:
-                        out = svc.on_register(tid, inc=inc, seq=key)
-                        wire.write_msg(conn, wire.REPLY_OK, out)
-                    elif msg_type == wire.COMPLETE:
-                        all_done = svc.on_complete(tid, inc=inc)
-                        wire.write_msg(conn, wire.REPLY_OK)
-                        if all_done:
-                            self.shutdown()
-                    else:
-                        wire.write_msg(conn, wire.REPLY_ERR,
-                                       {'error': 'bad msg type %d'
-                                        % msg_type, 'retryable': False})
+                    # handler span shares the CLIENT's span id (meta
+                    # 'trace', when present and tracing is on here):
+                    # the cross-process link obs/report.py draws flow
+                    # events and clock-offset estimates from
+                    with _trace.server_span(_msg_name(msg_type),
+                                            meta.get('trace'),
+                                            trainer_id=tid):
+                        self._dispatch(conn, svc, msg_type, meta, value,
+                                       tid, name, key, inc, round_idx)
                 except (ConnectionError, OSError):
                     return   # peer vanished mid-dispatch
                 except Exception as e:   # surface server-side op errors
@@ -414,3 +434,39 @@ class PSServer(object):
                 conn.close()
             except OSError:
                 pass
+
+    def _dispatch(self, conn, svc, msg_type, meta, value, tid, name,
+                  key, inc, round_idx):
+        if msg_type == wire.SEND_VAR:
+            svc.on_send_var(name, tid, value, seq=key,
+                            inc=inc, round_idx=round_idx)
+            wire.write_msg(conn, wire.REPLY_OK)
+        elif msg_type == wire.GET_VAR:
+            out = svc.on_get_var(name, tid, inc=inc)
+            wire.write_msg(conn, wire.REPLY_VAR, value=out)
+        elif msg_type == wire.PREFETCH:
+            out = svc.on_prefetch(name, tid, value, inc=inc)
+            wire.write_msg(conn, wire.REPLY_VAR, value=out)
+        elif msg_type == wire.BATCH_BARRIER:
+            svc.on_batch_barrier(tid, seq=key, inc=inc,
+                                 round_idx=round_idx)
+            wire.write_msg(conn, wire.REPLY_OK)
+        elif msg_type == wire.FETCH_BARRIER:
+            svc.on_fetch_barrier(tid, inc=inc)
+            wire.write_msg(conn, wire.REPLY_OK)
+        elif msg_type == wire.CHECKPOINT:
+            svc.on_checkpoint(meta.get('dirname'), tid,
+                              seq=key, inc=inc)
+            wire.write_msg(conn, wire.REPLY_OK)
+        elif msg_type == wire.REGISTER:
+            out = svc.on_register(tid, inc=inc, seq=key)
+            wire.write_msg(conn, wire.REPLY_OK, out)
+        elif msg_type == wire.COMPLETE:
+            all_done = svc.on_complete(tid, inc=inc)
+            wire.write_msg(conn, wire.REPLY_OK)
+            if all_done:
+                self.shutdown()
+        else:
+            wire.write_msg(conn, wire.REPLY_ERR,
+                           {'error': 'bad msg type %d'
+                            % msg_type, 'retryable': False})

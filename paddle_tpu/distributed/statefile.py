@@ -122,6 +122,8 @@ def quarantine(path, reason):
         sys.stderr.write('WARNING: could not quarantine %s (%s): %s\n'
                          % (path, reason, e))
         return None
+    from ..obs import telemetry
+    telemetry.counter('ps.snapshot.quarantines').inc()
     sys.stderr.write('WARNING: quarantined corrupt file %s -> %s (%s); '
                      'kept for post-mortem\n' % (path, qpath, reason))
     sys.stderr.flush()

@@ -25,9 +25,19 @@ Restart semantics:
 Output handling: each role's stdout+stderr append to a per-role log
 file (pipes would deadlock once a 64 KB buffer fills with nobody
 draining it — the supervisor must keep watching, not reading).
+
+Observability: with ``obs_dir`` set, every role gets its OWN subdir
+planted into its environment as ``FLAGS_obs_dir`` (plus
+``FLAGS_obs_role`` = the role name), so each process's telemetry and
+trace JSONL land side by side and ``tools/obs_report.py`` can merge
+the whole run into one timeline. The supervisor itself appends its
+spawn/restart counters under ``<obs_dir>/supervisor/`` — written
+directly (not through the process-wide registry) so supervising from
+inside a test process never flips global telemetry state.
 """
 from __future__ import annotations
 
+import json
 import os
 import subprocess
 import threading
@@ -65,13 +75,14 @@ class Supervisor(object):
 
     def __init__(self, max_restarts=3, backoff=0.5,
                  backoff_multiplier=2.0, max_backoff=10.0, log_dir=None,
-                 clear_fault_plan_on_restart=True):
+                 clear_fault_plan_on_restart=True, obs_dir=None):
         self.max_restarts = int(max_restarts)
         self.backoff = float(backoff)
         self.backoff_multiplier = float(backoff_multiplier)
         self.max_backoff = float(max_backoff)
         self.log_dir = log_dir
         self.clear_fault_plan_on_restart = clear_fault_plan_on_restart
+        self.obs_dir = obs_dir
         self._roles = []
         self._lock = threading.Lock()
         self._stop = threading.Event()
@@ -111,6 +122,14 @@ class Supervisor(object):
             env['FLAGS_trainer_incarnation'] = str(role.restarts)
             if self.clear_fault_plan_on_restart:
                 env.pop('FLAGS_fault_plan', None)
+        if self.obs_dir:
+            # one obs subdir per role: each incarnation appends its own
+            # metrics-/events- files there (filenames carry the pid),
+            # and the role name becomes the timeline lane
+            role_obs = os.path.join(self.obs_dir, role.name)
+            os.makedirs(role_obs, exist_ok=True)
+            env['FLAGS_obs_dir'] = role_obs
+            env['FLAGS_obs_role'] = role.name
         logf = self._log_file(role)
         try:
             role.proc = subprocess.Popen(role.argv, env=env,
@@ -125,6 +144,38 @@ class Supervisor(object):
     def _event(self, role, what):
         with self._lock:
             self.events.append((time.monotonic(), role.name, what))
+        if self.obs_dir:
+            self._write_obs(role, what)
+
+    def _write_obs(self, role, what):
+        """Append the supervisor's own obs records: an instant event
+        per lifecycle transition plus a running metrics snapshot —
+        rewritten on every event so the counters survive even if the
+        supervising process is killed without a stop()."""
+        d = os.path.join(self.obs_dir, 'supervisor')
+        try:
+            os.makedirs(d, exist_ok=True)
+            pid = os.getpid()
+            now = time.time()
+            with open(os.path.join(
+                    d, 'events-supervisor-%d.jsonl' % pid), 'a') as f:
+                f.write(json.dumps(
+                    {'type': 'fault', 't': now, 'role': 'supervisor',
+                     'pid': pid, 'action': what,
+                     'target': role.name}) + '\n')
+            with self._lock:
+                restarts = sum(r.restarts for r in self._roles)
+                spawns = sum(1 for e in self.events
+                             if e[2].startswith(('spawned', 'restarted')))
+            with open(os.path.join(
+                    d, 'metrics-supervisor-%d.jsonl' % pid), 'a') as f:
+                f.write(json.dumps(
+                    {'ts': now, 'role': 'supervisor', 'pid': pid,
+                     'counters': {'supervisor.restarts': restarts,
+                                  'supervisor.spawns': spawns},
+                     'gauges': {}, 'hists': {}}) + '\n')
+        except OSError:
+            pass   # observability must never take the supervisor down
 
     def _monitor_loop(self):
         while not self._stop.is_set():

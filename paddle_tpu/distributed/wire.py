@@ -34,6 +34,16 @@ import sys
 import numpy as np
 
 from ..integrity import crc32
+from ..obs import telemetry as _tm
+
+# telemetry series (no-ops while FLAGS_obs_dir is unset): every frame
+# on the socket path counts here, both directions, plus every CRC
+# verification failure — wire or journal
+_FRAMES_OUT = _tm.counter('wire.frames_out')
+_BYTES_OUT = _tm.counter('wire.bytes_out')
+_FRAMES_IN = _tm.counter('wire.frames_in')
+_BYTES_IN = _tm.counter('wire.bytes_in')
+_CRC_FAILURES = _tm.counter('wire.crc_failures')
 
 # message types
 SEND_VAR = 1        # trainer -> pserver: push a gradient (dense or sparse)
@@ -140,6 +150,7 @@ def pack_msg(msg_type, meta=None, value=None, payload=b''):
 
 def _check_frame(buf, off, end, crc):
     if crc32(bytes(buf[off + _CRC_SKIP:end])) != crc:
+        _CRC_FAILURES.inc()
         raise FrameCorruptError(
             'frame at offset %d failed its CRC32 check (corrupt bytes '
             'on the wire or on disk)' % off)
@@ -227,6 +238,8 @@ def write_msg(sock, msg_type, meta=None, value=None, payload=b''):
         # receiver must detect the damage and never apply the frame
         frame = effect.mutate_frame(frame, _HDR.size)
     sock.sendall(frame)
+    _FRAMES_OUT.inc()
+    _BYTES_OUT.inc(len(frame))
     if action == 'close':
         effect.post_send()   # frame delivered, connection then dies
 
@@ -269,19 +282,24 @@ def read_msg(sock):
         hdr = _read_exact(sock, _HDR.size)
         crc, body_len, version, msg_type, meta_len = _HDR.unpack(hdr)
         if version != WIRE_VERSION:
+            _CRC_FAILURES.inc()
             raise FrameCorruptError(
                 'bad wire version %d (expected %d) — corrupt header or '
                 'desynced stream' % (version, WIRE_VERSION))
         body = _read_exact(sock, body_len) if body_len else b''
         if crc32(hdr[_CRC_SKIP:] + body) != crc:
+            _CRC_FAILURES.inc()
             raise FrameCorruptError(
                 'frame (msg type %d, %d body bytes) failed its CRC32 '
                 'check — corrupt bytes on the wire' % (msg_type, body_len))
         if meta_len > body_len:
+            _CRC_FAILURES.inc()
             raise FrameCorruptError(
                 'frame meta_len %d exceeds body_len %d'
                 % (meta_len, body_len))
         meta, value = _parse_body(body, meta_len)
+        _FRAMES_IN.inc()
+        _BYTES_IN.inc(len(hdr) + len(body))
         # fault hook AFTER the full frame was consumed (framing stays
         # intact); 'drop' discards this message and reads the next
         if _faults().on_recv(sock, msg_type, meta) == 'drop':

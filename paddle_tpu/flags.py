@@ -70,6 +70,20 @@ Known flags:
   anomaly_skip_steps     consecutive anomalous steps tolerated (as
                          skipped steps) before the anomaly_action
                          escalation fires
+  obs_dir                observability root (paddle_tpu/obs/): when set,
+                         the telemetry registry exports metric
+                         snapshots and the trace layer appends span /
+                         fault / RecordEvent records as JSONL under
+                         this directory ('' = observability off, the
+                         default — every instrument is a near-free
+                         no-op). The Supervisor gives each role its
+                         own subdir; tools/obs_report.py merges them.
+  obs_role               label stamped on every JSONL record this
+                         process writes (defaults to 'pid<pid>');
+                         becomes the timeline lane name
+  obs_flush_secs         seconds between periodic metric-snapshot
+                         export lines (a final line is flushed at
+                         clean exit regardless)
 """
 from __future__ import annotations
 
@@ -164,6 +178,11 @@ _DEFAULTS = {
     # optimizer's dominant HBM stream; one rounding per step; master
     # params stay fp32). Off by default for exact-fp32 parity.
     'bf16_momentum': False,
+    # observability (paddle_tpu/obs/): JSONL export root ('' = off),
+    # per-process lane label, and metric export cadence
+    'obs_dir': '',
+    'obs_role': '',
+    'obs_flush_secs': 2.0,
     # batch_norm under data parallelism: compute statistics per device
     # (the reference's semantics — multi_devices_graph_pass.cc replicates
     # batch_norm per device, so stats are local and un-synced) instead of

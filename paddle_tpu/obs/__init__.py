@@ -1,0 +1,20 @@
+"""Cluster observability: telemetry registry, cross-process trace
+spans, and the merged cluster timeline/report.
+
+Three pillars (see README "Observability"):
+
+- `obs.telemetry` — process-wide Counters/Gauges/Histograms with a
+  near-free disabled path, `snapshot()`, and periodic JSONL export.
+- `obs.trace` — span ids propagated through the wire meta dict's
+  optional `trace` field; spans, FaultEvents, and RecordEvent scopes
+  share one per-process JSONL event log.
+- `obs.report` — merges per-role logs into one chrome://tracing
+  timeline (clock offsets estimated from RPC midpoints) plus a
+  metrics rollup. CLI: `python tools/obs_report.py --obs_dir ...`.
+
+Everything is off unless `FLAGS_obs_dir` is set (the Supervisor plants
+a per-role subdir in each child's environment).
+"""
+from . import telemetry, trace, report
+
+__all__ = ['telemetry', 'trace', 'report']

@@ -15,6 +15,8 @@ import os
 import threading
 import time
 
+from .obs import trace as _obs_trace
+
 __all__ = ['RecordEvent', 'record_event', 'profiler', 'start_profiler',
            'stop_profiler', 'reset_profiler', 'cuda_profiler']
 
@@ -24,23 +26,40 @@ _events = []     # (name, thread_id, start_s, end_s)
 
 
 class RecordEvent(object):
-    """RAII timing scope (reference platform/profiler.h RecordEvent)."""
+    """RAII timing scope (reference platform/profiler.h RecordEvent).
+
+    Doubles as an observability source: when FLAGS_obs_dir is set
+    (obs/trace.py enabled), every scope also lands in the per-process
+    obs event log — independent of start_profiler/stop_profiler — so
+    executor segments share the merged cluster timeline with RPC spans
+    and FaultEvents."""
 
     def __init__(self, name):
         self.name = name
         self.start = None
+        self._obs_t0 = None
 
     def __enter__(self):
         if _enabled:
             self.start = time.perf_counter()
+        if _obs_trace.enabled():
+            self._obs_t0 = time.time()
         return self
 
     def __exit__(self, *exc):
-        if _enabled and self.start is not None:
+        if self.start is not None:
             end = time.perf_counter()
+            # snapshot the enabled flag UNDER the lock, atomically with
+            # the append: a concurrent reset_profiler()/stop_profiler()
+            # otherwise races the unsynchronized read — the event could
+            # land in a list the reset already replaced (or after a
+            # stop), corrupting the next session's table
             with _lock:
-                _events.append((self.name, threading.get_ident(),
-                                self.start, end))
+                if _enabled:
+                    _events.append((self.name, threading.get_ident(),
+                                    self.start, end))
+        if self._obs_t0 is not None:
+            _obs_trace.host_span(self.name, self._obs_t0, time.time())
         return False
 
 
@@ -60,12 +79,14 @@ def start_profiler(state='All'):
     if state not in ('CPU', 'GPU', 'All'):
         raise ValueError("state must be 'CPU', 'GPU' or 'All'")
     reset_profiler()
-    _enabled = True
+    with _lock:
+        _enabled = True
 
 
 def stop_profiler(sorted_key=None, profile_path='/tmp/profile'):
     global _enabled
-    _enabled = False
+    with _lock:
+        _enabled = False
     _print_summary(sorted_key)
     if profile_path:
         _write_chrome_trace(profile_path)

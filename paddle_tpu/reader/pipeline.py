@@ -28,7 +28,17 @@ import threading
 
 import numpy as np
 
+from ..obs import telemetry as _tm
+
 __all__ = ['PyReader', 'get_reader', 'EOFException', 'leaked_threads']
+
+# observability gauges mirroring this module's state: the leak count
+# (also kept as the `_leaked` module counter for leaked_threads()) and
+# the feed-queue depths sampled at every read() — a persistently empty
+# host queue means the data source is the bottleneck
+_LEAKED_GAUGE = _tm.gauge('reader.leaked_workers')
+_HOST_DEPTH = _tm.gauge('reader.host_queue_depth')
+_DEV_DEPTH = _tm.gauge('reader.device_queue_depth')
 
 # Worker threads that outlived their join timeout (a feeder blocked
 # inside a user generator cannot be interrupted from Python). They are
@@ -50,6 +60,7 @@ def _note_leak(reader_name, thread):
     with _leak_lock:
         _leaked += 1
         n = _leaked
+    _LEAKED_GAUGE.set(n)
     sys.stderr.write(
         'WARNING: py_reader %r worker %s did not exit within its join '
         'timeout and was leaked (likely blocked in the user data '
@@ -197,6 +208,10 @@ class PyReader(object):
         if not self._started:
             raise RuntimeError('py_reader %r: start() before running the '
                                'program' % self.name)
+        if self._host_q is not None:
+            _HOST_DEPTH.set(self._host_q.qsize())
+        if self._dev_q is not None:
+            _DEV_DEPTH.set(self._dev_q.qsize())
         q = self._dev_q if self.use_double_buffer else self._host_q
         item = q.get()
         if isinstance(item, _SourceError):

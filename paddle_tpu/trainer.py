@@ -23,6 +23,7 @@ from __future__ import annotations
 import json
 import os
 import shutil
+import time
 
 import numpy as np
 
@@ -30,6 +31,11 @@ from . import io as io_mod
 from .executor import Executor, TPUPlace, Scope, scope_guard
 from .framework import Program, program_guard, default_main_program, \
     default_startup_program
+from .obs import telemetry as _tm
+from .obs import trace as _obs_trace
+
+_STEPS = _tm.counter('trainer.steps')
+_STEP_LATENCY = _tm.histogram('trainer.step_latency')
 
 __all__ = ['Trainer', 'CheckpointConfig', 'BeginEpochEvent',
            'EndEpochEvent', 'BeginStepEvent', 'EndStepEvent',
@@ -81,6 +87,14 @@ class FaultEvent(object):
         self.error = error
         self.action = action
         self.attempt = attempt
+        # every FaultEvent construction site counts + lands in the obs
+        # event log (one place instead of three): the cluster timeline
+        # shows WHEN the retry/rollback/anomaly fired, the rollup how
+        # often
+        _tm.counter('trainer.fault.%s' % action).inc()
+        _obs_trace.event('fault', action=action, epoch=epoch_id,
+                         step=step_id, attempt=attempt,
+                         error=str(error)[:200])
 
 
 class CheckpointConfig(object):
@@ -443,8 +457,11 @@ class Trainer(object):
                 if self._stop_requested:
                     return
                 feed = dict(zip(feed_order, data))
+                _t0 = time.perf_counter()
                 metrics = self._run_step(pe, fetch, feed, epoch_id,
                                          step_id, event_handler)
+                _STEP_LATENCY.observe(time.perf_counter() - _t0)
+                _STEPS.inc()
                 if self._guard_var is not None:
                     finite = bool(np.asarray(metrics[-1]))
                     metrics = metrics[:-1]

@@ -85,7 +85,20 @@ def _free_ports(n):
     return ports
 
 
-def _run_seed(plan_json, model, steps, trainers, pservers, budget):
+def _obs_env(env, obs_dir, role_name):
+    """Plant the per-role observability env (same layout Supervisor
+    uses: one subdir per role, role name = timeline lane)."""
+    if obs_dir:
+        role_obs = os.path.join(obs_dir, role_name)
+        os.makedirs(role_obs, exist_ok=True)
+        env['FLAGS_obs_dir'] = role_obs
+        env['FLAGS_obs_role'] = role_name
+        env['FLAGS_obs_flush_secs'] = '0.5'
+    return env
+
+
+def _run_seed(plan_json, model, steps, trainers, pservers, budget,
+              obs_dir=None):
     eps = ','.join('127.0.0.1:%d' % p for p in _free_ports(pservers))
     base_env = dict(os.environ)
     base_env.pop('JAX_PLATFORMS', None)
@@ -96,12 +109,14 @@ def _run_seed(plan_json, model, steps, trainers, pservers, budget):
     pprocs = []
     for i in range(pservers):
         env = dict(base_env, PS_ROLE='pserver', PS_PSERVER_ID=str(i))
+        _obs_env(env, obs_dir, 'pserver%d' % i)
         pprocs.append(subprocess.Popen(
             [sys.executable, _WORKER], env=env, stdout=subprocess.PIPE,
             stderr=subprocess.STDOUT, text=True))
     tprocs = []
     for i in range(trainers):
         env = dict(base_env, PS_ROLE='trainer', PS_TRAINER_ID=str(i))
+        _obs_env(env, obs_dir, 'trainer%d' % i)
         if i == 0:
             env['FLAGS_fault_plan'] = plan_json
         tprocs.append(subprocess.Popen(
@@ -138,7 +153,7 @@ def _run_seed(plan_json, model, steps, trainers, pservers, budget):
 
 
 def _run_kill_seed(seed, model, steps, trainers, pservers, budget,
-                   workdir):
+                   workdir, obs_dir=None):
     """One --kill seed under the Supervisor: returns (verdict, weights,
     victim, plan_json, outs)."""
     import random
@@ -161,7 +176,10 @@ def _run_kill_seed(seed, model, steps, trainers, pservers, budget,
                      'FLAGS_rpc_deadline': '120',
                      'FLAGS_rpc_max_retries': '12',
                      'FLAGS_rpc_reconnect_secs': '10'})
-    sup = Supervisor(max_restarts=2, backoff=0.5, log_dir=workdir)
+    if obs_dir:
+        base_env['FLAGS_obs_flush_secs'] = '0.5'
+    sup = Supervisor(max_restarts=2, backoff=0.5, log_dir=workdir,
+                     obs_dir=obs_dir)
     for i in range(pservers):
         env = dict(base_env, PS_ROLE='pserver', PS_PSERVER_ID=str(i),
                    FLAGS_ps_state_path=os.path.join(
@@ -223,6 +241,14 @@ def main(argv=None):
     ap.add_argument('--quick', action='store_true',
                     help='CI smoke: 3 seeds unless --seeds given, and '
                          'fatal/hung seeds fail the sweep too')
+    ap.add_argument('--report', action='store_true',
+                    help='run every seed with per-role observability '
+                         'on, attach the metrics rollup to each row, '
+                         'and write sweep_report.json (+ per-seed '
+                         'chrome timelines) under --report-dir')
+    ap.add_argument('--report-dir', default=None,
+                    help='where --report keeps per-seed obs output '
+                         '(default: a ./chaos_report.<pid> dir)')
     args = ap.parse_args(argv)
     if args.kill and args.corrupt:
         ap.error('--kill and --corrupt are mutually exclusive')
@@ -240,18 +266,28 @@ def main(argv=None):
     _, local_w = ps_worker.local_train(args.model, args.steps, 'sgd',
                                        args.trainers)
 
+    report_root = None
+    if args.report:
+        from paddle_tpu.obs import report as obs_report
+        report_root = args.report_dir or ('chaos_report.%d' % os.getpid())
+        os.makedirs(report_root, exist_ok=True)
+
     ok_verdicts = ('recovered', 'nokill') if args.kill else ('ok',)
     tally = {'ok': 0, 'recovered': 0, 'nokill': 0, 'diverged': 0,
              'fatal': 0, 'hung': 0}
-    bad_seeds = []
+    bad_seeds, rows = [], []
     for seed in range(args.seed_start, args.seed_start + args.seeds):
         t0 = time.monotonic()
+        obs_dir = None
+        if report_root:
+            obs_dir = os.path.join(report_root, 'seed%04d' % seed)
+            os.makedirs(obs_dir, exist_ok=True)
         if args.kill:
             with tempfile.TemporaryDirectory() as workdir:
                 verdict, weights, victim, plan_json, outs = \
                     _run_kill_seed(seed, args.model, args.steps,
                                    args.trainers, args.pservers,
-                                   args.budget, workdir)
+                                   args.budget, workdir, obs_dir)
             label = '%s %s' % (victim, plan_json)
         else:
             plan = (FaultPlan.from_corrupt_seed(seed) if args.corrupt
@@ -259,7 +295,7 @@ def main(argv=None):
             plan_json = label = plan.to_json()
             verdict, weights, outs = _run_seed(
                 plan_json, args.model, args.steps, args.trainers,
-                args.pservers, args.budget)
+                args.pservers, args.budget, obs_dir)
         if verdict in ok_verdicts:
             for p, lw in local_w.items():
                 if not np.allclose(np.asarray(weights[p]),
@@ -270,6 +306,21 @@ def main(argv=None):
         tally[verdict] += 1
         if verdict == 'diverged':
             bad_seeds.append(seed)
+        row = {'seed': seed, 'verdict': verdict, 'plan': plan_json,
+               'secs': round(time.monotonic() - t0, 1)}
+        if obs_dir:
+            # merge this seed's per-role JSONL: timeline next to the
+            # obs output, nonzero rollup totals inline on the row
+            try:
+                _, ru = obs_report.write_report(
+                    obs_dir,
+                    timeline_path=os.path.join(obs_dir, 'timeline.json'),
+                    rollup_path=os.path.join(obs_dir, 'rollup.json'))
+                row['rollup'] = {n: v for n, v in
+                                 sorted(ru['totals'].items()) if v}
+            except Exception as e:   # noqa: BLE001 — report best-effort
+                row['rollup_error'] = str(e)
+        rows.append(row)
         print('seed %4d  %-9s  %5.1fs  %s'
               % (seed, verdict, time.monotonic() - t0, label))
         if args.verbose and verdict not in ok_verdicts:
@@ -281,6 +332,17 @@ def main(argv=None):
           '%d diverged, %d fatal, %d hung'
           % (total, tally['ok'], tally['recovered'], tally['nokill'],
              tally['diverged'], tally['fatal'], tally['hung']))
+    if report_root:
+        mode = ('kill' if args.kill
+                else 'corrupt' if args.corrupt else 'fault')
+        report_path = os.path.join(report_root, 'sweep_report.json')
+        with open(report_path, 'w') as f:
+            json.dump({'mode': mode, 'model': args.model,
+                       'steps': args.steps, 'trainers': args.trainers,
+                       'pservers': args.pservers, 'tally': tally,
+                       'rows': rows}, f, indent=2)
+        print('sweep report -> %s (per-seed timelines under %s/seedNNNN)'
+              % (report_path, report_root))
     if bad_seeds:
         print('DIVERGED seeds (reproduce with --seed-start N --seeds 1 '
               '--verbose): %s' % bad_seeds)

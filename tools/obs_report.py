@@ -1,0 +1,76 @@
+"""Merge a cluster's observability output into one report.
+
+Every process in an instrumented run (FLAGS_obs_dir set, usually
+planted per role by distributed.Supervisor) appends two JSONL streams
+under its own subdir: metrics-<role>-<pid>.jsonl snapshots from the
+telemetry registry, and events-<role>-<pid>.jsonl span/fault records
+from obs.trace. This tool walks the run's obs root, aligns the
+per-process clocks from client/server RPC span midpoints, and writes:
+
+- a chrome://tracing timeline (one pid lane per role-process, flow
+  arrows linking each client RPC span to its server handler span,
+  instant markers for injected faults and trainer FaultEvents), and
+- a metrics rollup (per-role counters/gauges/histograms plus cluster
+  totals summed across roles and incarnations).
+
+    python tools/obs_report.py --obs_dir /tmp/run_obs \
+        --timeline tl.json --rollup rollup.json
+
+With neither --timeline nor --rollup, prints the text rollup only.
+The timeline loads directly in chrome://tracing / perfetto, or can be
+round-tripped through tools/timeline.py (which preserves the flow
+events and the per-lane ordering).
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from paddle_tpu.obs import report  # noqa: E402
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument('--obs_dir', required=True,
+                    help='obs root to merge (walked recursively; the '
+                         'dir given to Supervisor(obs_dir=...) or set '
+                         'as FLAGS_obs_dir)')
+    ap.add_argument('--timeline', default=None,
+                    help='write the merged chrome trace here')
+    ap.add_argument('--rollup', default=None,
+                    help='write the metrics rollup JSON here')
+    ap.add_argument('--pretty', action='store_true')
+    ap.add_argument('--all', action='store_true',
+                    help='show zero-valued series in the text rollup '
+                         'too')
+    args = ap.parse_args(argv)
+    if not os.path.isdir(args.obs_dir):
+        ap.error('--obs_dir %s is not a directory' % args.obs_dir)
+
+    tl, ru = report.write_report(args.obs_dir,
+                                 timeline_path=args.timeline,
+                                 rollup_path=args.rollup,
+                                 pretty=args.pretty)
+    n_span = sum(1 for e in tl['traceEvents'] if e.get('ph') == 'X')
+    n_flow = sum(1 for e in tl['traceEvents'] if e.get('ph') == 's')
+    shifts = tl.get('metadata', {}).get('clock_shifts', {})
+    print(report.format_rollup_text(ru, nonzero_only=not args.all))
+    print('\ntimeline: %d spans, %d linked rpc pairs, %d role lanes'
+          % (n_span, n_flow, len(ru['roles'])))
+    if shifts:
+        print('clock shifts applied: %s' % ' '.join(
+            '%s=%+.1fms' % (r, s * 1e3)
+            for r, s in sorted(shifts.items()) if s))
+    for what, path in (('timeline', args.timeline),
+                       ('rollup', args.rollup)):
+        if path:
+            print('wrote %s -> %s' % (what, path))
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

@@ -10,6 +10,13 @@ It accepts either a file the profiler wrote (already chrome format —
 validated and passed through with sorted events) or a JSON list of
 {name, pid, tid, ts, dur} event dicts, which it wraps into the chrome
 trace envelope the way the reference's _ChromeTraceFormatter does.
+
+Merged multi-process traces (tools/obs_report.py output) pass through
+intact: events are stable-sorted by (ts, pid) so per-process order is
+preserved across interleaved lanes, and flow events (ph 's'/'f' — the
+client->server RPC arrows) keep their ph/id/bp fields untouched;
+list-form inputs may carry an explicit 'ph' per event, which wins over
+the default 'X' region.
 """
 from __future__ import annotations
 
@@ -52,8 +59,14 @@ def convert(profile_path, timeline_path, pretty=False):
     with open(profile_path) as f:
         data = json.load(f)
     if isinstance(data, dict) and 'traceEvents' in data:
-        # already chrome format (profiler.py native output): normalize
-        data['traceEvents'].sort(key=lambda e: e.get('ts', 0))
+        # already chrome format (profiler.py native output, or an
+        # obs_report.py cluster merge): normalize with a STABLE
+        # (ts, pid) sort — equal-timestamp events from one process stay
+        # in emission order instead of shuffling across lanes — and
+        # leave every event's fields alone (flow events ph 's'/'f'
+        # carry id/bp that must survive the round trip)
+        data['traceEvents'].sort(
+            key=lambda e: (e.get('ts', 0), e.get('pid', 0)))
         out = json.dumps(data, indent=4 if pretty else None)
     else:
         fmt = _ChromeTraceFormatter()
@@ -63,6 +76,11 @@ def convert(profile_path, timeline_path, pretty=False):
             if pid not in pids:
                 fmt.emit_pid(ev.get('process', 'process %d' % pid), pid)
                 pids[pid] = True
+            if ev.get('ph') and ev['ph'] != 'X':
+                # pre-formed phase (flow 's'/'f', instant 'i', counter
+                # 'C', ...): pass through unmangled
+                fmt._events.append(dict(ev))
+                continue
             fmt.emit_region(ev['ts'], ev.get('dur', 0), pid,
                             ev.get('tid', 0), ev.get('cat', 'Op'),
                             ev['name'], ev.get('args', {}))
@@ -113,7 +131,7 @@ def merge_device_stream(profile_path, timeline_path, xplane_dir,
         events.append({'name': label, 'cat': 'device', 'ph': 'X',
                        'ts': start_ns / 1e3 - dev_base,
                        'dur': dur_ns / 1e3, 'pid': 1, 'tid': 0})
-    events.sort(key=lambda e: e.get('ts', 0))
+    events.sort(key=lambda e: (e.get('ts', 0), e.get('pid', 0)))
     with open(timeline_path, 'w') as f:
         json.dump({'traceEvents': events}, f,
                   indent=4 if pretty else None)
