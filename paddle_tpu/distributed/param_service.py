@@ -744,6 +744,22 @@ class ParameterService(object):
             self._pending.setdefault(name, {})[tid] = value
             self._record_seq_locked(tid, seq)
 
+    def on_send_vars(self, tid, entries, values, cli=None, inc=None):
+        """Apply a batched SEND_VARS frame: each contained var carries
+        its OWN (cli, seq) dedup token and round tag and goes through
+        on_send_var exactly as an individual push would — including its
+        own journal record, so the journal format (and crash replay)
+        is unchanged. A replayed batch re-acks the already-applied vars
+        and applies the rest: per-var at-most-once. A non-finite var
+        rejects the whole frame (retryable); the vars applied before it
+        were journaled + token-recorded, so the client's replay of the
+        batch cannot double-apply them."""
+        for e, value in zip(entries, values):
+            tok = ((cli, e['seq']) if e.get('seq') is not None
+                   else None)
+            self.on_send_var(e['name'], tid, value, seq=tok, inc=inc,
+                             round_idx=e.get('round'))
+
     def on_batch_barrier(self, tid, seq=None, inc=None, round_idx=None):
         from . import wire
         with self._lock:

@@ -88,7 +88,7 @@ __all__ = ['RetryableRPCError', 'FatalRPCError', 'TransientError',
            'StaleIncarnationError', 'RetryPolicy', 'FaultRule',
            'FaultPlan', 'SendEffect', 'install_plan', 'clear_plan',
            'active_plan', 'current_plan', 'fired_faults', 'on_send',
-           'on_recv', 'on_step']
+           'on_recv', 'on_send_vars', 'on_recv_vars', 'on_step']
 
 
 class RetryableRPCError(ConnectionError):
@@ -442,9 +442,12 @@ class SendEffect(object):
     post_send), 'corrupt' (send mutate_frame(frame)) or 'nan' (poison
     the float payload before framing)."""
 
-    def __init__(self, rule, sock):
+    def __init__(self, rule, sock, index=0):
         self.action = rule.action
         self.rule = rule
+        # for batched sends: which contained var's rule fired ('nan'
+        # poisons only that var's bytes; frame-scoped actions ignore it)
+        self.index = index
         self._sock = sock
 
     def post_send(self):
@@ -510,6 +513,85 @@ def on_send(sock, msg_type, meta):
     if rule.action == 'exit':
         _exit_for(rule, 'send of msg type %s' % msg_type)
     _raise_for(rule, 'send of msg type %s' % msg_type)
+
+
+def on_send_vars(sock, msg_type, entries):
+    """wire.write_vars_msg hook: a SEND_VARS batch advances the 'send'
+    counters once PER CONTAINED VAR — the exact logical firing points a
+    per-var send loop would hit — so a seeded plan faults the same Nth
+    gradient whether or not batching is on. The frame is a single
+    physical send, so frame-scoped actions apply once to the whole
+    batch, with precedence when several rules land in one batch: every
+    'exit' first (kill determinism), 'delay' sleeps accumulate, then
+    the first drop/close/corrupt/nan/error in entry order wins. A 'nan'
+    SendEffect carries the index of the entry whose rule fired so only
+    that var's payload is poisoned."""
+    if _plan is None:
+        return None
+    fired = []
+    with _lock:
+        for i in range(len(entries)):
+            rule = _match_locked('send', msg_type)
+            if rule is not None:
+                fired.append((i, rule))
+    if not fired:
+        return None
+    for i, rule in fired:
+        if rule.action == 'exit':
+            _exit_for(rule, 'send of msg type %s (batch var %d)'
+                      % (msg_type, i))
+    for i, rule in fired:
+        if rule.action == 'delay':
+            time.sleep(rule.secs)
+    for i, rule in fired:
+        if rule.action == 'drop':
+            _close_quietly(sock)
+            raise RetryableRPCError(
+                'fault injection: dropped batch of %d (msg type %s, '
+                'rule %s)' % (len(entries), msg_type, rule.to_dict()))
+        if rule.action in ('close', 'corrupt', 'nan'):
+            return SendEffect(rule, sock, index=i)
+        if rule.action == 'error':
+            _raise_for(rule, 'send of msg type %s (batch var %d)'
+                       % (msg_type, i))
+    return None
+
+
+def on_recv_vars(sock, msg_type, count):
+    """wire.read_msg hook for an inbound SEND_VARS frame: advances the
+    'recv' counters once per contained var (mirroring on_send_vars).
+    'drop' discards the WHOLE batch frame — per-var dedup tokens make
+    the client's replay apply each var at-most-once; exit/delay/close/
+    error follow the same precedence as on_send_vars."""
+    if _plan is None:
+        return None
+    fired = []
+    with _lock:
+        for i in range(count):
+            rule = _match_locked('recv', msg_type)
+            if rule is not None:
+                fired.append((i, rule))
+    if not fired:
+        return None
+    for i, rule in fired:
+        if rule.action == 'exit':
+            _exit_for(rule, 'recv of msg type %s (batch var %d)'
+                      % (msg_type, i))
+    for i, rule in fired:
+        if rule.action == 'delay':
+            time.sleep(rule.secs)
+    for i, rule in fired:
+        if rule.action == 'drop':
+            return 'drop'
+        if rule.action == 'close':
+            _close_quietly(sock)
+            raise ConnectionError(
+                'fault injection: closed on recv of msg type %s '
+                '(batch var %d)' % (msg_type, i))
+        if rule.action == 'error':
+            _raise_for(rule, 'recv of msg type %s (batch var %d)'
+                       % (msg_type, i))
+    return None
 
 
 def on_recv(sock, msg_type, meta):

@@ -48,6 +48,14 @@ _CRC_FAILURES = _tm.counter('wire.crc_failures')
 # message types
 SEND_VAR = 1        # trainer -> pserver: push a gradient (dense or sparse)
 GET_VAR = 2         # trainer -> pserver: pull a parameter
+SEND_VARS = 12      # trainer -> pserver: MANY small dense gradients in
+                    # one frame (meta['vars'] lists per-var name/dtype/
+                    # shape/len/seq/round; payload is their concatenated
+                    # bytes). One CRC + one JSON header + one reply
+                    # covers the whole batch; each contained var keeps
+                    # its OWN (cli, seq) dedup token and round tag, so a
+                    # replayed batch is applied per-var at-most-once
+                    # exactly like individual SEND_VARs
 PREFETCH = 3        # trainer -> pserver: distributed-lookup-table row fetch
 BATCH_BARRIER = 4   # trainer -> pserver: all grads for this step sent
 FETCH_BARRIER = 5   # trainer -> pserver: all params for this step fetched
@@ -90,19 +98,35 @@ def _faults():
     return _resilience
 
 
+def _bytes_view(arr):
+    """A flat byte view over a C-contiguous array WITHOUT copying —
+    tobytes() duplicates the tensor before the frame build copies it
+    again, so the hot send path skips it. Falls back to tobytes() for
+    the shapes memoryview.cast cannot flatten (0-d, exotic buffers)."""
+    try:
+        return memoryview(arr).cast('B')
+    except (TypeError, ValueError):
+        return arr.tobytes()
+
+
 def _payload_of(value):
-    """(meta_fields, payload_bytes) for a dense array or SelectedRows."""
+    """(meta_fields, payload_bytes) for a dense array or SelectedRows.
+    The payload may be a memoryview aliasing the array's buffer (dense,
+    already-contiguous case) — every consumer (crc32, len, b''.join,
+    sendall) speaks the buffer protocol."""
     from ..selected_rows import SelectedRows
     if isinstance(value, SelectedRows):
         vals = np.ascontiguousarray(np.asarray(value.values))
         rows = np.ascontiguousarray(np.asarray(value.rows, dtype=np.int32))
         meta = {'sparse': True, 'dtype': vals.dtype.name,
                 'shape': list(vals.shape), 'height': int(value.height)}
-        return meta, vals.tobytes() + rows.tobytes()
-    arr = np.ascontiguousarray(np.asarray(value))
+        return meta, b''.join((_bytes_view(vals), _bytes_view(rows)))
+    arr = np.asarray(value)
+    if not arr.flags.c_contiguous:
+        arr = np.ascontiguousarray(arr)
     meta = {'sparse': False, 'dtype': arr.dtype.name,
             'shape': list(arr.shape)}
-    return meta, arr.tobytes()
+    return meta, _bytes_view(arr)
 
 
 def _value_of(meta, payload):
@@ -143,8 +167,9 @@ def pack_msg(msg_type, meta=None, value=None, payload=b''):
         vmeta, payload = _payload_of(value)
         meta.update(vmeta)
     mb = json.dumps(meta).encode('utf-8')
-    rest = struct.pack('<IBBI', len(mb) + len(payload), WIRE_VERSION,
-                       msg_type, len(mb)) + mb + payload
+    rest = b''.join((struct.pack('<IBBI', len(mb) + len(payload),
+                                 WIRE_VERSION, msg_type, len(mb)),
+                     mb, payload))
     return struct.pack('<I', crc32(rest)) + rest
 
 
@@ -156,9 +181,26 @@ def _check_frame(buf, off, end, crc):
             'on the wire or on disk)' % off)
 
 
+def _values_of_batch(meta, payload):
+    """Decode a SEND_VARS body: meta['vars'] entries each carry their
+    own dtype/shape plus 'len' (payload byte count); the payload is the
+    vars' bytes back to back. Returns the values in entry order."""
+    values, off = [], 0
+    for e in meta['vars']:
+        n = int(e['len'])
+        values.append(_value_of(e, payload[off:off + n]))
+        off += n
+    return values
+
+
 def _parse_body(body, meta_len):
-    meta = json.loads(body[:meta_len].decode('utf-8')) if meta_len else {}
+    # body may be bytes (journal scans) or a memoryview (socket path) —
+    # only the JSON meta is copied out; tensor payloads decode zero-copy
+    meta = (json.loads(bytes(body[:meta_len]).decode('utf-8'))
+            if meta_len else {})
     payload = body[meta_len:]
+    if 'vars' in meta:
+        return meta, _values_of_batch(meta, payload)
     value = _value_of(meta, payload) if 'dtype' in meta else None
     return meta, value
 
@@ -244,6 +286,52 @@ def write_msg(sock, msg_type, meta=None, value=None, payload=b''):
         effect.post_send()   # frame delivered, connection then dies
 
 
+def write_vars_msg(sock, frame_meta, items):
+    """Write ONE SEND_VARS frame carrying many dense vars.
+
+    `items` is a list of (entry_meta, value) pairs: entry_meta holds the
+    per-var fields (name/seq/round), and the value's dtype/shape/len are
+    filled in here; `frame_meta` holds the frame-level fields
+    (trainer_id/cli/inc/trace). Fault hooks advance once PER LOGICAL VAR
+    — a batch of 8 vars steps a `send SEND_VAR` rule's counter 8 times —
+    so seeded plans fire at the same logical points whether or not
+    batching is on. Frame-scoped actions (drop/close/corrupt) hit the
+    whole batch; the per-var (cli, seq) dedup tokens make the replay
+    apply each contained var at-most-once. A `nan` action poisons only
+    the matched var's bytes (valid CRC, numeric fault).
+    """
+    entries, chunks = [], []
+    for emeta, value in items:
+        vmeta, payload = _payload_of(value)
+        e = dict(emeta)
+        e.update(vmeta)
+        e['len'] = len(payload)
+        entries.append(e)
+        chunks.append(payload)
+    effect = _faults().on_send_vars(sock, SEND_VAR, entries)
+    action = getattr(effect, 'action', None)
+    if action in ('corrupt', 'nan'):
+        sys.stderr.write('fault injection: %s on send of msg type %s '
+                         '(rule %s, batch of %d)\n'
+                         % (action, SEND_VARS, effect.rule.to_dict(),
+                            len(entries)))
+        sys.stderr.flush()
+    if action == 'nan':
+        i = effect.index or 0
+        chunks[i] = _poison_payload(entries[i], chunks[i])
+    meta = dict(frame_meta)
+    meta['vars'] = entries
+    frame = pack_msg(SEND_VARS, meta, payload=b''.join(chunks))
+    if action == 'corrupt':
+        frame = effect.mutate_frame(frame, _HDR.size)
+    sock.sendall(frame)
+    _FRAMES_OUT.inc()
+    _BYTES_OUT.inc(len(frame))
+    if action == 'close':
+        effect.post_send()
+    return len(frame)
+
+
 def _poison_payload(meta, payload):
     """Replace the dense float region of a payload with NaNs of the
     same dtype/length (the 'nan' FaultPlan action — a deterministic
@@ -258,18 +346,23 @@ def _poison_payload(meta, payload):
     if nval <= 0:
         return payload
     bad = np.full(count, np.nan, dtype=dtype).tobytes()[:nval]
-    return bad + payload[nval:]
+    return bad + bytes(payload[nval:])
 
 
 def _read_exact(sock, n):
-    chunks = []
-    while n > 0:
-        b = sock.recv(min(n, 1 << 20))
-        if not b:
+    """Read exactly n bytes straight into one freshly allocated buffer
+    via recv_into — no per-chunk bytes objects, no b''.join copy.
+    Returns a memoryview over the buffer; decoded tensors alias it
+    zero-copy, so the buffer is never reused across calls."""
+    buf = bytearray(n)
+    view = memoryview(buf)
+    got = 0
+    while got < n:
+        r = sock.recv_into(view[got:], n - got)
+        if not r:
             raise ConnectionError('peer closed the connection')
-        chunks.append(b)
-        n -= len(b)
-    return b''.join(chunks)
+        got += r
+    return view
 
 
 def read_msg(sock):
@@ -287,7 +380,9 @@ def read_msg(sock):
                 'bad wire version %d (expected %d) — corrupt header or '
                 'desynced stream' % (version, WIRE_VERSION))
         body = _read_exact(sock, body_len) if body_len else b''
-        if crc32(hdr[_CRC_SKIP:] + body) != crc:
+        # incremental CRC (crc32 chains): covers header-after-crc then
+        # body without materializing their concatenation
+        if crc32(body, crc32(hdr[_CRC_SKIP:])) != crc:
             _CRC_FAILURES.inc()
             raise FrameCorruptError(
                 'frame (msg type %d, %d body bytes) failed its CRC32 '
@@ -301,7 +396,13 @@ def read_msg(sock):
         _FRAMES_IN.inc()
         _BYTES_IN.inc(len(hdr) + len(body))
         # fault hook AFTER the full frame was consumed (framing stays
-        # intact); 'drop' discards this message and reads the next
-        if _faults().on_recv(sock, msg_type, meta) == 'drop':
+        # intact); 'drop' discards this message and reads the next. A
+        # SEND_VARS batch advances the counters once per contained var
+        # (same logical firing points whether or not batching is on).
+        if msg_type == SEND_VARS:
+            act = _faults().on_recv_vars(sock, SEND_VAR, len(meta['vars']))
+        else:
+            act = _faults().on_recv(sock, msg_type, meta)
+        if act == 'drop':
             continue
         return msg_type, meta, value

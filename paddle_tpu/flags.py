@@ -62,6 +62,15 @@ Known flags:
                          MasterClient: a peer that accepts but never
                          replies surfaces as RetryableRPCError instead
                          of a silent hang
+  rpc_inflight_window    pipelined PSClient: max unacked requests
+                         riding one connection (the *_async APIs);
+                         1 degrades to stop-and-wait
+  rpc_batch_bytes        dense gradients up to this many bytes bound
+                         for one endpoint coalesce into a single
+                         SEND_VARS frame (0 disables batching)
+  rpc_batch_max_bytes /
+  rpc_batch_max_vars     flush thresholds for one SEND_VARS frame
+                         (total payload bytes / contained vars)
   anomaly_action         Trainer numeric-anomaly guard: 'none' (off,
                          default), 'rollback' (skip the step; after
                          anomaly_skip_steps consecutive anomalies,
@@ -162,6 +171,19 @@ _DEFAULTS = {
     # connected peer for this long fails the attempt (retryable)
     # instead of hanging the trainer forever
     'rpc_read_deadline': 120.0,
+    # pipelined transport (distributed/rpc.py *_async APIs): how many
+    # unacked requests may ride one connection before submit blocks;
+    # every unacked request is replayed in seq order after a transport
+    # failure (the server dedup window makes that at-most-once)
+    'rpc_inflight_window': 32,
+    # small-tensor coalescing: dense gradients up to rpc_batch_bytes
+    # each are packed into one SEND_VARS frame per endpoint (one CRC +
+    # one header + one reply for dozens of BN scales/biases); a frame
+    # flushes at rpc_batch_max_bytes total payload or
+    # rpc_batch_max_vars entries. rpc_batch_bytes=0 turns batching off.
+    'rpc_batch_bytes': 65536,
+    'rpc_batch_max_bytes': 1 << 20,
+    'rpc_batch_max_vars': 64,
     # Trainer numeric-anomaly guard (trainer.py): 'none' | 'rollback' |
     # 'fatal'. When enabled, a fused isfinite reduction over
     # loss + gradients is fetched each step; an anomalous step is

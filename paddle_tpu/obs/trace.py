@@ -32,8 +32,9 @@ import os
 import threading
 import time
 
-__all__ = ['span', 'server_span', 'host_span', 'event', 'wire_trace',
-           'current_sid', 'new_id', 'enabled', 'enable', 'disable']
+__all__ = ['span', 'server_span', 'host_span', 'record_span', 'event',
+           'wire_trace', 'current_sid', 'new_id', 'enabled', 'enable',
+           'disable']
 
 _lock = threading.Lock()
 _enabled = False
@@ -142,6 +143,21 @@ def host_span(name, t0, t1, **attrs):
         return
     rec = {'type': 'span', 'kind': 'host', 'name': name,
            'sid': new_id(), 'psid': current_sid(), 't0': t0, 't1': t1,
+           'tid': threading.get_ident() & 0xffff}
+    rec.update(attrs)
+    _emit(rec)
+
+
+def record_span(name, kind, sid, t0, t1, **attrs):
+    """Record a span whose start and end were observed on DIFFERENT
+    threads (the pipelined RPC client: t0 when the submit thread writes
+    the request, t1 when the reader thread matches the reply) — a
+    contextmanager cannot straddle that split. `sid` rides the wire meta
+    exactly like span()'s, so server correlation is unchanged."""
+    if not _enabled:
+        return
+    rec = {'type': 'span', 'kind': kind, 'name': name,
+           'sid': sid, 'psid': None, 't0': t0, 't1': t1,
            'tid': threading.get_ident() & 0xffff}
     rec.update(attrs)
     _emit(rec)

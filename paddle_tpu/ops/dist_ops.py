@@ -33,15 +33,42 @@ def _client(ctx_op, endpoint):
     return get_client(endpoint, trainer_id=ctx_op.attr('trainer_id', 0))
 
 
+def _drain(futs, err=None):
+    """Wait for every future, re-raising the first failure only AFTER
+    all have settled — a trainer step retry must not race requests that
+    are still landing on the pservers."""
+    for f in futs:
+        try:
+            f.result()
+        except BaseException as e:
+            if err is None:
+                err = e
+    if err is not None:
+        raise err
+
+
 # -- send / recv / barriers -------------------------------------------------
 
 def _send_emit(ctx, op):
-    """Push each input var to its pserver (epmap aligned with X).
-    Var names are identical on both sides — the service keys arrivals by
+    """Push each input var to its pserver (epmap aligned with X),
+    pipelined: vars are grouped by endpoint (small dense grads coalesce
+    into SEND_VARS frames), streamed to every pserver concurrently, and
+    the futures drained before the barrier op that follows — the step
+    pays ~1 RTT per endpoint instead of one per var. Var names are
+    identical on both sides — the service keys arrivals by
     (name, trainer_id), so no '.trainer_%d' renaming is needed."""
-    epmap = op.attr('epmap')
-    for name, ep in zip(op.input('X'), epmap):
-        _client(op, ep).send_var(name, _to_host(ctx.get_raw(name)))
+    by_ep = {}
+    for name, ep in zip(op.input('X'), op.attr('epmap')):
+        by_ep.setdefault(ep, []).append(
+            (name, _to_host(ctx.get_raw(name))))
+    futs, err = [], None
+    for ep, pairs in by_ep.items():
+        try:
+            futs.extend(_client(op, ep).send_vars_async(pairs))
+        except BaseException as e:   # e.g. non-finite pre-send refusal
+            err = e
+            break
+    _drain(futs, err)
 
 
 register_op('send', emit=_send_emit, host=True, no_grad=True)
@@ -49,8 +76,17 @@ register_op('send', emit=_send_emit, host=True, no_grad=True)
 
 def _recv_emit(ctx, op):
     epmap = op.attr('epmap')
-    for name, ep in zip(op.output('Out'), epmap):
-        ctx.set(name, _client(op, ep).get_var(name))
+    pending = [(name, _client(op, ep).get_var_async(name))
+               for name, ep in zip(op.output('Out'), epmap)]
+    err = None
+    for name, fut in pending:
+        try:
+            ctx.set(name, fut.result())
+        except BaseException as e:
+            if err is None:
+                err = e
+    if err is not None:
+        raise err
 
 
 register_op('recv', emit=_recv_emit, host=True, no_grad=True)
@@ -58,11 +94,12 @@ register_op('recv', emit=_recv_emit, host=True, no_grad=True)
 
 def _checkpoint_notify_emit(ctx, op):
     """Tell every pserver to checkpoint its shard (reference
-    checkpoint_notify_op.cc:28); each saves into dirname/<endpoint>."""
+    checkpoint_notify_op.cc:28); each saves into dirname/<endpoint>.
+    The notifies fan out concurrently — shards snapshot in parallel."""
     dirname = op.attr('dirname')
-    for ep in op.attr('endpoints'):
-        _client(op, ep).checkpoint_notify(
-            '%s/%s' % (dirname, ep.replace(':', '_')))
+    _drain([_client(op, ep).checkpoint_notify_async(
+                '%s/%s' % (dirname, ep.replace(':', '_')))
+            for ep in op.attr('endpoints')])
 
 
 register_op('checkpoint_notify', emit=_checkpoint_notify_emit, host=True,
@@ -70,16 +107,18 @@ register_op('checkpoint_notify', emit=_checkpoint_notify_emit, host=True,
 
 
 def _send_barrier_emit(ctx, op):
-    for ep in op.attr('endpoints'):
-        _client(op, ep).batch_barrier()
+    # concurrent fan-out: every shard sees the barrier ~immediately
+    # instead of shard k waiting on shard k-1's round trip
+    _drain([_client(op, ep).batch_barrier_async()
+            for ep in op.attr('endpoints')])
 
 
 register_op('send_barrier', emit=_send_barrier_emit, host=True, no_grad=True)
 
 
 def _fetch_barrier_emit(ctx, op):
-    for ep in op.attr('endpoints'):
-        _client(op, ep).fetch_barrier()
+    _drain([_client(op, ep).fetch_barrier_async()
+            for ep in op.attr('endpoints')])
 
 
 register_op('fetch_barrier', emit=_fetch_barrier_emit, host=True,
@@ -183,12 +222,24 @@ def _prefetch_emit(ctx, op):
     flat = shaped.reshape(-1)
     width = int(op.attr('emb_dim'))
     out = np.zeros((flat.size, width), dtype=op.attr('dtype', 'float32'))
+    # shard fan-out is pipelined: every pserver looks its rows up
+    # concurrently, the step pays the slowest shard's RTT once
+    pending = []
     for i, ep in enumerate(epmap):
         m = (flat % n) == i
         if not m.any():
             continue
-        rows = _client(op, ep).prefetch(table, flat[m] // n)
-        out[m] = rows
+        pending.append(
+            (m, _client(op, ep).prefetch_async(table, flat[m] // n)))
+    err = None
+    for m, fut in pending:
+        try:
+            out[m] = fut.result()
+        except BaseException as e:
+            if err is None:
+                err = e
+    if err is not None:
+        raise err
     ctx.set(op.single_output('Out'),
             out.reshape(shaped.shape + (width,)))
 

@@ -1,0 +1,413 @@
+"""Pipelined transport suite (distributed/rpc.py async engine).
+
+What PR 5 adds over the sync stop-and-wait client — and what this file
+proves about it:
+
+- up to FLAGS_rpc_inflight_window requests ride one connection; replies
+  match by the seq the server echoes, so out-of-order completion (a
+  dropped reply followed by a later one) resolves the right futures;
+- a transport failure mid-window replays EVERY unacked request in seq
+  order on the fresh connection, and the server's (cli, seq) dedup
+  window makes that at-most-once — sync training under close / corrupt /
+  drop faults lands on BIT-EXACT fault-free weights, window > 1;
+- small dense gradients coalesce into one SEND_VARS frame (wire msg 12)
+  whose per-var seq tokens dedup individually on replay;
+- the seq echo doubles as a stream-desync detector on the sync path: a
+  reply carrying someone else's seq raises FrameCorruptError instead of
+  silently handing the caller the wrong tensor;
+- the zero-copy wire paths (recv_into framing, memoryview payloads)
+  round-trip values bit-exactly, single and batched;
+- a real 2x2 subprocess cluster with a small window and batching on
+  trains to the same weights as local single-process SGD (parallel
+  pserver fan-out + pipelined barriers preserve sync-round semantics).
+"""
+import contextlib
+import json
+import os
+import socket
+import subprocess
+import sys
+import threading
+
+import numpy as np
+import pytest
+
+from paddle_tpu import flags
+from paddle_tpu.distributed import resilience, wire
+from paddle_tpu.distributed.param_service import ParameterService
+from paddle_tpu.distributed.resilience import (FaultPlan, FaultRule,
+                                               RetryPolicy,
+                                               RetryableRPCError)
+from paddle_tpu.distributed.rpc import PSClient, PSServer
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_WORKER = os.path.join(_HERE, 'ps_worker.py')
+sys.path.insert(0, _HERE)
+
+
+@contextlib.contextmanager
+def _flags(**kw):
+    old = {k: flags.get_flag(k) for k in kw}
+    flags.set_flags(kw)
+    try:
+        yield
+    finally:
+        flags.set_flags(old)
+
+
+def _fast_retry():
+    return RetryPolicy(max_attempts=6, backoff=0.01, max_backoff=0.05,
+                       reconnect_secs=5.0)
+
+
+# ---------------------------------------------------------------------------
+# zero-copy wire paths
+# ---------------------------------------------------------------------------
+
+def test_wire_zero_copy_roundtrip():
+    """read_msg's recv_into framing and memoryview payload decode hand
+    back bit-exact values for dense, non-contiguous, and empty-meta
+    frames."""
+    a, b = socket.socketpair()
+    try:
+        dense = np.arange(24, dtype='f4').reshape(4, 6)
+        strided = np.arange(40, dtype='f8').reshape(5, 8)[::2, ::2]
+        wire.write_msg(a, wire.SEND_VAR, {'name': 'd'}, dense)
+        wire.write_msg(a, wire.SEND_VAR, {'name': 's'}, strided)
+        wire.write_msg(a, wire.BATCH_BARRIER)
+        for expect in (dense, strided):
+            t, meta, val = wire.read_msg(b)
+            assert t == wire.SEND_VAR
+            got = np.asarray(val)
+            assert got.dtype == expect.dtype and got.shape == expect.shape
+            np.testing.assert_array_equal(got, expect)
+        t, meta, val = wire.read_msg(b)
+        assert t == wire.BATCH_BARRIER and val is None
+    finally:
+        a.close()
+        b.close()
+
+
+def test_wire_send_vars_roundtrip_and_journal_scan():
+    """A SEND_VARS frame decodes to the contained values in entry order
+    on BOTH decoders: the socket path (read_msg) and the journal path
+    (scan_msgs over the packed bytes)."""
+    vals = [np.full(3, i, 'f4') for i in range(4)]
+    items = [({'name': 'g%d' % i, 'seq': 100 + i, 'round': 0}, v)
+             for i, v in enumerate(vals)]
+    a, b = socket.socketpair()
+    try:
+        wire.write_vars_msg(a, {'seq': 999, 'trainer_id': 0}, items)
+        t, meta, values = wire.read_msg(b)
+    finally:
+        a.close()
+        b.close()
+    assert t == wire.SEND_VARS
+    assert meta['seq'] == 999
+    assert [e['name'] for e in meta['vars']] == ['g0', 'g1', 'g2', 'g3']
+    assert [e['seq'] for e in meta['vars']] == [100, 101, 102, 103]
+    for got, expect in zip(values, vals):
+        np.testing.assert_array_equal(np.asarray(got), expect)
+    # journal decoder sees the same frame the socket decoder does
+    entries, chunks = [], []
+    for e, v in items:
+        em, payload = wire._payload_of(v)
+        em = dict(e, **em)
+        em['len'] = len(payload)
+        entries.append(em)
+        chunks.append(payload)
+    frame = wire.pack_msg(wire.SEND_VARS, {'vars': entries},
+                          payload=b''.join(chunks))
+    decoded = list(wire.unpack_msgs(frame))
+    assert len(decoded) == 1
+    t, meta, values = decoded[0]
+    assert t == wire.SEND_VARS
+    for got, expect in zip(values, vals):
+        np.testing.assert_array_equal(np.asarray(got), expect)
+
+
+# ---------------------------------------------------------------------------
+# in-process pipelined training: bit-exact under mid-window faults
+# ---------------------------------------------------------------------------
+
+def _mini_service(sync_mode=True, num_trainers=1):
+    params = {'w': np.zeros(4, 'f4')}
+    rounds = []
+    singles = []
+
+    def run_round(merged):
+        rounds.append(sorted(merged))
+        for v in merged.values():
+            params['w'] = params['w'] - np.asarray(v)
+
+    def run_one_grad(name, value):
+        singles.append(name)
+        params['w'] = params['w'] - np.asarray(value)
+
+    svc = ParameterService(
+        num_trainers=num_trainers, sync_mode=sync_mode,
+        get_param=lambda name: params[name], run_round=run_round,
+        run_one_grad=run_one_grad, rpc_deadline=60.0)
+    return svc, params, rounds, singles
+
+
+def _grad(step, i):
+    return np.full(4, 0.01 * (step * 31 + i + 1), 'f4')
+
+
+def _run_steps(plan=None, batch=True, nvars=12, steps=2, window=8):
+    """Train `steps` sync rounds of `nvars` pipelined sends + barrier
+    against one in-process pserver; returns (final w, rounds, fired)."""
+    svc, params, rounds, _ = _mini_service(sync_mode=True)
+    srv = PSServer('127.0.0.1:0', svc)
+    st = threading.Thread(target=srv.serve_forever, daemon=True)
+    st.start()
+    ctx = (resilience.active_plan(plan) if plan is not None
+           else contextlib.nullcontext())
+    fired = []
+    with _flags(FLAGS_rpc_inflight_window=window,
+                FLAGS_rpc_batch_bytes=(65536 if batch else 0)):
+        with ctx:
+            cli = PSClient('127.0.0.1:%d' % srv.port, trainer_id=0,
+                           retry_policy=_fast_retry())
+            for step in range(steps):
+                pairs = [('g%d' % i, _grad(step, i)) for i in range(nvars)]
+                futs = cli.send_vars_async(pairs)
+                for f in futs:       # drain sends before the barrier,
+                    f.result()       # exactly as ops/dist_ops.py does
+                cli.batch_barrier_async().result()
+                w = np.asarray(cli.get_var('w'))
+            cli.complete()
+            cli.close()
+            if plan is not None:
+                fired = resilience.fired_faults()
+    st.join(timeout=10.0)
+    assert not st.is_alive()
+    return w, rounds, fired
+
+
+@pytest.mark.chaos
+def test_pipelined_faults_bit_exact():
+    """Mid-window close and corrupt faults (batched frames) replay the
+    whole unacked window and land on BIT-EXACT fault-free weights."""
+    base_w, base_rounds, _ = _run_steps()
+    assert len(base_rounds) == 2
+
+    close_plan = FaultPlan([
+        FaultRule('send', 3, 'close', type='SEND_VAR')])
+    w, rounds, fired = _run_steps(plan=close_plan)
+    np.testing.assert_array_equal(w, base_w)
+    assert len(rounds) == 2
+    assert [f['action'] for f in fired] == ['close']
+
+    corrupt_plan = FaultPlan([
+        FaultRule('send', 5, 'corrupt', type='SEND_VAR', bits=3)])
+    w, rounds, fired = _run_steps(plan=corrupt_plan)
+    np.testing.assert_array_equal(w, base_w)
+    assert len(rounds) == 2
+    assert [f['action'] for f in fired] == ['corrupt']
+
+    drop_plan = FaultPlan([
+        FaultRule('send', 2, 'drop', type='SEND_VAR')])
+    w, rounds, fired = _run_steps(plan=drop_plan)
+    np.testing.assert_array_equal(w, base_w)
+    assert len(rounds) == 2
+    assert [f['action'] for f in fired] == ['drop']
+
+
+@pytest.mark.chaos
+def test_out_of_order_reply_matching_under_recv_drop():
+    """A dropped REPLY mid-window: the next reply that DOES arrive
+    carries a higher seq, which proves the server consumed the earlier
+    request without answering — the engine infers the recv-drop,
+    replays, and the run stays bit-exact (batching off so independent
+    SEND_VARs ride the window and a later reply exists to trigger the
+    inference)."""
+    base_w, base_rounds, _ = _run_steps(batch=False)
+    assert len(base_rounds) == 2
+    plan = FaultPlan([
+        FaultRule('recv', 2, 'drop', type='REPLY_OK')])
+    w, rounds, fired = _run_steps(plan=plan, batch=False)
+    np.testing.assert_array_equal(w, base_w)
+    assert len(rounds) == 2
+    assert [f['action'] for f in fired] == ['drop']
+
+
+@pytest.mark.chaos
+def test_batched_send_vars_dedup_on_replay():
+    """The connection closes right after a multi-var SEND_VARS frame is
+    delivered; the replayed frame must be acked per-var from the dedup
+    window WITHOUT a second apply."""
+    svc, params, _, singles = _mini_service(sync_mode=False)
+    srv = PSServer('127.0.0.1:0', svc)
+    st = threading.Thread(target=srv.serve_forever, daemon=True)
+    st.start()
+    grads = [('g%d' % i, np.full(4, float(i + 1), 'f4'))
+             for i in range(6)]
+    plan = FaultPlan([FaultRule('send', 1, 'close', type='SEND_VAR')])
+    with _flags(FLAGS_rpc_inflight_window=8, FLAGS_rpc_batch_bytes=65536):
+        with resilience.active_plan(plan):
+            cli = PSClient('127.0.0.1:%d' % srv.port, trainer_id=0,
+                           retry_policy=_fast_retry())
+            for f in cli.send_vars_async(grads):
+                f.result()
+            cli.complete()
+            cli.close()
+            fired = resilience.fired_faults()
+    st.join(timeout=10.0)
+    assert [f['action'] for f in fired] == ['close']
+    # every var applied EXACTLY once despite the whole-frame replay
+    assert sorted(singles) == sorted(n for n, _ in grads)
+    expect = -np.sum([v for _, v in grads], axis=0)
+    np.testing.assert_array_equal(params['w'], expect)
+
+
+@pytest.mark.chaos
+def test_window_one_degrades_to_stop_and_wait():
+    """FLAGS_rpc_inflight_window=1 serializes the async API into
+    stop-and-wait — same weights, still correct under a close fault."""
+    base_w, _, _ = _run_steps(window=1)
+    plan = FaultPlan([FaultRule('send', 4, 'close', type='SEND_VAR')])
+    w, rounds, fired = _run_steps(plan=plan, window=1)
+    np.testing.assert_array_equal(w, base_w)
+    assert [f['action'] for f in fired] == ['close']
+
+
+def test_prefetch_async_matches_sync():
+    """prefetch_async returns the same rows the sync prefetch does, and
+    many in-flight prefetches resolve to their OWN ids (reply matching
+    under a shared connection)."""
+    table = np.arange(40, dtype='f4').reshape(10, 4)
+    svc, params, _, _ = _mini_service(sync_mode=False)
+    svc._prefetch = lambda name, ids: table[np.asarray(ids)]
+    srv = PSServer('127.0.0.1:0', svc)
+    st = threading.Thread(target=srv.serve_forever, daemon=True)
+    st.start()
+    with _flags(FLAGS_rpc_inflight_window=8):
+        cli = PSClient('127.0.0.1:%d' % srv.port, trainer_id=0,
+                       retry_policy=_fast_retry())
+        id_sets = [np.array([i, (i + 3) % 10], 'i4') for i in range(8)]
+        futs = [cli.prefetch_async('emb', ids) for ids in id_sets]
+        for ids, fut in zip(id_sets, futs):
+            np.testing.assert_array_equal(np.asarray(fut.result()),
+                                          table[ids])
+        cli.complete()
+        cli.close()
+    st.join(timeout=10.0)
+
+
+# ---------------------------------------------------------------------------
+# stream-desync detector (echoed seq)
+# ---------------------------------------------------------------------------
+
+def test_echoed_seq_desync_raises():
+    """A server that echoes the WRONG seq is answering some other
+    request — the sync client must refuse the reply (FrameCorruptError
+    per attempt, RetryableRPCError once the budget is spent) instead of
+    returning a misattributed value."""
+    lsock = socket.socket()
+    lsock.bind(('127.0.0.1', 0))
+    lsock.listen(8)
+    port = lsock.getsockname()[1]
+    stop = threading.Event()
+
+    def bad_server():
+        while not stop.is_set():
+            try:
+                conn, _ = lsock.accept()
+            except OSError:
+                return
+            try:
+                while True:
+                    t, meta, _ = wire.read_msg(conn)
+                    wire.write_msg(conn, wire.REPLY_OK,
+                                   {'seq': meta.get('seq', 0) + 977})
+            except (ConnectionError, OSError):
+                pass
+            finally:
+                conn.close()
+
+    th = threading.Thread(target=bad_server, daemon=True)
+    th.start()
+    try:
+        cli = PSClient('127.0.0.1:%d' % port, trainer_id=0,
+                       retry_policy=RetryPolicy(
+                           max_attempts=3, backoff=0.01,
+                           max_backoff=0.02, reconnect_secs=2.0))
+        with pytest.raises(RetryableRPCError) as exc:
+            cli.send_var('g', np.ones(4, 'f4'))
+        assert isinstance(exc.value.__cause__, wire.FrameCorruptError)
+        assert 'desynced' in str(exc.value.__cause__)
+    finally:
+        stop.set()
+        lsock.close()
+        th.join(timeout=5.0)
+
+
+# ---------------------------------------------------------------------------
+# parallel fan-out on a real 2x2 subprocess cluster
+# ---------------------------------------------------------------------------
+
+def _free_ports(n):
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(('127.0.0.1', 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+@pytest.mark.timeout(600)
+def test_parallel_barrier_cluster_parity():
+    """2 trainers x 2 pservers with a small in-flight window and
+    batching ON: parallel send fan-out + concurrent barriers across
+    pservers still close exactly one sync round per step, and the
+    trained weights match local single-process SGD."""
+    import ps_worker
+    local_losses, local_w = ps_worker.local_train('mlp', 4, 'sgd', 2)
+    eps = ','.join('127.0.0.1:%d' % p for p in _free_ports(2))
+    base_env = dict(os.environ)
+    base_env.pop('JAX_PLATFORMS', None)
+    base_env.pop('XLA_FLAGS', None)
+    base_env.update({'PS_MODEL': 'mlp', 'PS_ENDPOINTS': eps,
+                     'PS_TRAINERS': '2', 'PS_STEPS': '4',
+                     'PS_SYNC': '1', 'PS_OPTIMIZER': 'sgd',
+                     'FLAGS_rpc_inflight_window': '4',
+                     'FLAGS_rpc_batch_bytes': '65536'})
+    procs = []
+    for i in range(2):
+        env = dict(base_env, PS_ROLE='pserver', PS_PSERVER_ID=str(i))
+        procs.append(subprocess.Popen(
+            [sys.executable, _WORKER], env=env, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True))
+    tprocs = []
+    for i in range(2):
+        env = dict(base_env, PS_ROLE='trainer', PS_TRAINER_ID=str(i))
+        tprocs.append(subprocess.Popen(
+            [sys.executable, _WORKER], env=env, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True))
+    outs = []
+    for p in tprocs:
+        out, _ = p.communicate(timeout=420)
+        outs.append(out)
+    for p in procs:
+        out, _ = p.communicate(timeout=120)
+        outs.append(out)
+    for p, out in zip(tprocs + procs, outs):
+        assert p.returncode == 0, out[-4000:]
+    results = []
+    for out in outs[:2]:
+        line = [ln for ln in out.splitlines() if ln.startswith('RESULT ')]
+        assert line, out[-4000:]
+        results.append(json.loads(line[-1][len('RESULT '):]))
+    for p, lw in local_w.items():
+        np.testing.assert_allclose(
+            np.asarray(results[0]['weights'][p]), np.asarray(lw),
+            rtol=1e-4, atol=1e-5, err_msg='param %s diverged' % p)
+    for p in local_w:
+        np.testing.assert_allclose(
+            np.asarray(results[0]['weights'][p]),
+            np.asarray(results[1]['weights'][p]), rtol=1e-6)

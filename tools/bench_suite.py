@@ -324,6 +324,29 @@ def run_dist(model, n, steps, full):
     return row
 
 
+_TRANSPORT_QUICK = [None]   # dist_bench --quick, measured at most once
+
+
+def _transport_quick():
+    """Headline serial-vs-pipelined RPC speedup (tools/dist_bench.py
+    --quick: 160 vars x 1KiB across 2 pservers) stamped onto every
+    pserver-mode row; one subprocess, cached across models."""
+    if _TRANSPORT_QUICK[0] is None:
+        try:
+            env = dict(os.environ, JAX_PLATFORMS='cpu')
+            out = subprocess.run(
+                [sys.executable,
+                 os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                              'dist_bench.py'), '--quick'],
+                capture_output=True, text=True, timeout=300, env=env)
+            line = [ln for ln in out.stdout.splitlines()
+                    if ln.startswith('{') and '"summary"' in ln][-1]
+            _TRANSPORT_QUICK[0] = json.loads(line)['speedup']
+        except Exception:   # noqa: BLE001 — a bench extra, never fatal
+            _TRANSPORT_QUICK[0] = 0.0
+    return _TRANSPORT_QUICK[0]
+
+
 def run_pserver(model, n_trainers, steps, full):
     """N trainers + 2 pservers via the DistributeTranspiler (the
     reference fluid_benchmark.py's --update_method pserver)."""
@@ -377,6 +400,9 @@ def run_pserver(model, n_trainers, steps, full):
     row['samples_per_sec'] = round(
         row['samples_per_sec'] * n_trainers, 2)
     row['mode'] = 'pserver%d' % n_trainers
+    spd = _transport_quick()
+    if spd:
+        row['transport_speedup'] = spd
     return row
 
 

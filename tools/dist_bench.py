@@ -1,0 +1,230 @@
+"""Transport microbench: serial vs pipelined PSClient on a local
+2-pserver cluster.
+
+Measures what PR 5's async engine buys on the wire itself, isolated
+from model compute: each "step" pushes `num_vars` dense gradients split
+across 2 pserver PROCESSES (sync mode, 1 trainer), closes the round
+with BATCH_BARRIERs, and fetches a parameter back — the exact RPC
+shape of one sync training round in ops/dist_ops.py. The pservers are
+subprocesses, not threads: serial mode pays the real
+client-work + server-work + round-trip sum per tensor, and pipelining
+gets to overlap them, exactly as on a real cluster.
+
+  serial     the pre-PR5 path: blocking send_var per tensor, one
+             endpoint at a time, sequential barriers (stop-and-wait —
+             every frame pays a full round trip)
+  pipelined  send_vars_async fan-out (in-flight window + SEND_VARS
+             coalescing), concurrent barriers, async fetch
+
+Sweeps num_vars x tensor_size x window x batching; prints one JSON row
+per configuration and a speedup summary (serial ms / pipelined ms per
+shape). The many-small-tensors shapes are the ResNet/BN regime the
+batching flag exists for.
+
+Usage:
+  python tools/dist_bench.py             # full sweep (~4 min, CPU only)
+  python tools/dist_bench.py --quick     # one acceptance shape
+                                         # (160 vars x 1KiB, w=32, batch)
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as np
+
+N_PSERVERS = 2
+
+
+def _pserver_worker():
+    """One pserver process: near-no-op round work so the wire dominates
+    the measurement. Exits when the (single) trainer sends COMPLETE."""
+    from paddle_tpu.distributed.param_service import ParameterService
+    from paddle_tpu.distributed.rpc import PSServer
+    param = np.zeros(256, 'f4')
+    state = {'rounds': 0}
+
+    def run_round(merged):
+        state['rounds'] += 1
+
+    svc = ParameterService(
+        num_trainers=1, sync_mode=True,
+        get_param=lambda name: param, run_round=run_round,
+        rpc_deadline=60.0)
+    srv = PSServer(os.environ['DIST_BENCH_EP'], svc)
+    print('READY', flush=True)
+    srv.serve_forever()
+
+
+def _free_ports(n):
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(('127.0.0.1', 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+def _mk_cluster():
+    eps = ['127.0.0.1:%d' % p for p in _free_ports(N_PSERVERS)]
+    procs = []
+    for ep in eps:
+        env = dict(os.environ, DIST_BENCH_ROLE='pserver',
+                   DIST_BENCH_EP=ep, JAX_PLATFORMS='cpu')
+        procs.append(subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__)], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
+    for p in procs:        # block until each shard is accepting
+        line = p.stdout.readline()
+        if 'READY' not in line:
+            rest = p.stdout.read() or ''
+            raise RuntimeError('pserver failed to start:\n'
+                               + (line + rest)[-2000:])
+    return eps, procs
+
+
+def _grads(num_vars, nbytes):
+    """num_vars dense gradients of nbytes each, round-robined across
+    the pservers (the transpiler's split placement)."""
+    n = max(1, nbytes // 4)
+    per_ep = [[] for _ in range(N_PSERVERS)]
+    for i in range(num_vars):
+        per_ep[i % N_PSERVERS].append(
+            ('g%d' % i, np.full(n, float(i + 1), 'f4')))
+    return per_ep
+
+
+def _clients(eps):
+    from paddle_tpu.distributed.resilience import RetryPolicy
+    from paddle_tpu.distributed.rpc import PSClient
+    retry = RetryPolicy(max_attempts=3, backoff=0.05, max_backoff=0.5,
+                        reconnect_secs=10.0)
+    return [PSClient(ep, trainer_id=0, retry_policy=retry) for ep in eps]
+
+
+def _step_serial(clis, per_ep):
+    for cli, pairs in zip(clis, per_ep):
+        for name, v in pairs:
+            cli.send_var(name, v)
+    for cli in clis:
+        cli.batch_barrier()
+    for cli in clis:
+        cli.get_var('w')
+
+
+def _step_pipelined(clis, per_ep):
+    futs = []
+    for cli, pairs in zip(clis, per_ep):
+        futs.extend(cli.send_vars_async(pairs))
+    for f in futs:
+        f.result()
+    for f in [cli.batch_barrier_async() for cli in clis]:
+        f.result()
+    for f in [cli.get_var_async('w') for cli in clis]:
+        f.result()
+
+
+def _run(mode, num_vars, nbytes, steps, warmup, window=32, batch=True):
+    """Fresh cluster + clients per run: no dedup/round state bleeds
+    between configurations. Returns ms per step."""
+    from paddle_tpu import flags
+    flags.set_flags({'FLAGS_rpc_inflight_window': window,
+                     'FLAGS_rpc_batch_bytes': 65536 if batch else 0})
+    eps, procs = _mk_cluster()
+    clis = _clients(eps)
+    per_ep = _grads(num_vars, nbytes)
+    step = _step_serial if mode == 'serial' else _step_pipelined
+    try:
+        for _ in range(warmup):
+            step(clis, per_ep)
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            step(clis, per_ep)
+        dt = time.perf_counter() - t0
+    finally:
+        for cli in clis:
+            try:
+                cli.complete()
+            except Exception:
+                pass
+            cli.close()
+        for p in procs:
+            try:
+                p.communicate(timeout=30)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                p.communicate()
+    return dt * 1000.0 / steps
+
+
+def main():
+    if os.environ.get('DIST_BENCH_ROLE') == 'pserver':
+        _pserver_worker()
+        return
+    ap = argparse.ArgumentParser()
+    ap.add_argument('--quick', action='store_true',
+                    help='one acceptance shape: 160 vars x 1KiB, '
+                         'window 32, batching on')
+    ap.add_argument('--steps', type=int, default=10)
+    ap.add_argument('--warmup', type=int, default=2)
+    args = ap.parse_args()
+    os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+
+    if args.quick:
+        shapes = [(160, 1024)]
+        pipelined_cfgs = [(32, True)]
+    else:
+        shapes = [(40, 1024), (160, 1024), (160, 16384), (320, 256)]
+        pipelined_cfgs = [(1, False), (8, False), (32, False),
+                          (32, True)]
+
+    rows = []
+    for num_vars, nbytes in shapes:
+        serial_ms = _run('serial', num_vars, nbytes,
+                         args.steps, args.warmup)
+        row = {'mode': 'serial', 'num_vars': num_vars,
+               'tensor_bytes': nbytes, 'pservers': N_PSERVERS,
+               'ms_per_step': round(serial_ms, 2)}
+        rows.append(row)
+        print(json.dumps(row), flush=True)
+        best = None
+        for window, batch in pipelined_cfgs:
+            ms = _run('pipelined', num_vars, nbytes,
+                      args.steps, args.warmup, window=window,
+                      batch=batch)
+            row = {'mode': 'pipelined', 'num_vars': num_vars,
+                   'tensor_bytes': nbytes, 'pservers': N_PSERVERS,
+                   'window': window, 'batch': batch,
+                   'ms_per_step': round(ms, 2),
+                   'speedup': round(serial_ms / ms, 2)}
+            rows.append(row)
+            print(json.dumps(row), flush=True)
+            if best is None or ms < best:
+                best = ms
+        print('# %d vars x %dB: serial %.1f ms -> pipelined %.1f ms '
+              '= %.1fx' % (num_vars, nbytes, serial_ms, best,
+                           serial_ms / best), flush=True)
+        if num_vars >= 150:
+            print(json.dumps({'summary': 'acceptance',
+                              'num_vars': num_vars,
+                              'tensor_bytes': nbytes,
+                              'serial_ms': round(serial_ms, 2),
+                              'pipelined_ms': round(best, 2),
+                              'speedup': round(serial_ms / best, 2)}),
+                  flush=True)
+    return rows
+
+
+if __name__ == '__main__':
+    main()
