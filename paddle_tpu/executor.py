@@ -490,7 +490,18 @@ class Executor(object):
         self._prepared_cache = {}
         self._step = 0
         self._base_key = None
+        # device segments jit-compiled by this executor (monotonic):
+        # serving asserts the decode program compiles exactly once
+        # across a generation loop (jit_cache_stats)
+        self._compile_count = 0
         _LIVE_EXECUTORS.add(self)
+
+    def jit_cache_stats(self):
+        """{'prepared_programs', 'compiled_segments'} — compiled_segments
+        is monotonic, so a steady-state serving loop proves jit-cache
+        hits by observing it stay constant across N decode steps."""
+        return {'prepared_programs': len(self._prepared_cache),
+                'compiled_segments': self._compile_count}
 
     def compiled_hlo_texts(self):
         """Optimized-HLO text of each compiled device segment (re-lowered
@@ -818,6 +829,7 @@ class Executor(object):
                     raise _wrap_op_error(e, op, block, pos=off) from e
             return tuple(env[n] for n in out_names)
 
+        self._compile_count += 1
         return jax.jit(seg_fn, donate_argnums=(0,) if donate else (),
                        **self._jit_options(segment, feed_names))
 

@@ -93,6 +93,17 @@ Known flags:
   obs_flush_secs         seconds between periodic metric-snapshot
                          export lines (a final line is flushed at
                          clean exit regardless)
+  serving_slots          KV-cache slot-pool size per DecodePredictor
+                         (paddle_tpu/serving/): decode runs one
+                         compiled step over this many lanes
+  serving_prefill_batch  prompts per compiled prefill call (admissions
+                         are grouped up to this; 1 = one prefill per
+                         request)
+  serving_max_queue      ServingEngine admission queue bound — submit()
+                         past this raises instead of buffering
+                         unboundedly
+  serving_idle_wait      seconds an idle serving worker sleeps between
+                         queue polls
 """
 from __future__ import annotations
 
@@ -200,6 +211,13 @@ _DEFAULTS = {
     # optimizer's dominant HBM stream; one rounding per step; master
     # params stay fp32). Off by default for exact-fp32 parity.
     'bf16_momentum': False,
+    # serving engine (paddle_tpu/serving/): decode slot-pool size,
+    # prompts per compiled prefill, admission queue bound, idle worker
+    # poll interval
+    'serving_slots': 8,
+    'serving_prefill_batch': 1,
+    'serving_max_queue': 256,
+    'serving_idle_wait': 0.05,
     # observability (paddle_tpu/obs/): JSONL export root ('' = off),
     # per-process lane label, and metric export cadence
     'obs_dir': '',

@@ -99,6 +99,11 @@ class Variable(object):
         self.stop_gradient = stop_gradient
         self.type = type
         self.is_data = is_data
+        # runtime-state var (serving KV cache): persistable so the
+        # executor writes it back to the Scope across run() calls, but
+        # excluded from save/load_persistables — the values are
+        # per-process serving state, not model weights (io.py predicate)
+        self.is_cache = kwargs.get('is_cache', False)
         self.error_clip = kwargs.get('error_clip', None)
         # padded-sequence companion: the Variable holding this var's [B]
         # int32 sequence lengths (set for lod_level>0 vars; layers
@@ -506,6 +511,7 @@ class Program(object):
                 'dtype': v.dtype, 'lod_level': v.lod_level,
                 'persistable': v.persistable, 'stop_gradient': v.stop_gradient,
                 'type': v.type, 'is_data': v.is_data,
+                'is_cache': v.is_cache,
                 'is_parameter': isinstance(v, Parameter),
                 'trainable': getattr(v, 'trainable', None),
             }
@@ -536,7 +542,8 @@ class Program(object):
                               dtype=vd['dtype'], lod_level=vd['lod_level'],
                               persistable=vd['persistable'],
                               stop_gradient=vd['stop_gradient'],
-                              type=vd['type'], is_data=vd['is_data'])
+                              type=vd['type'], is_data=vd['is_data'],
+                              is_cache=vd.get('is_cache', False))
                 if vd.get('is_parameter'):
                     kwargs['trainable'] = vd.get('trainable', True)
                 v = cls(b, **kwargs)

@@ -82,6 +82,21 @@ class Predictor(object):
                     % (len(self._feed_names), self._feed_names,
                        len(inputs)))
             inputs = dict(zip(self._feed_names, inputs))
+        else:
+            # validate the dict against the model ABI up front: the
+            # executor would only notice a missing feed deep inside
+            # compilation, and would silently ignore an unknown one
+            unknown = sorted(set(inputs) - set(self._feed_names))
+            missing = sorted(set(self._feed_names) - set(inputs))
+            if unknown or missing:
+                parts = []
+                if unknown:
+                    parts.append('unknown input name(s) %s' % unknown)
+                if missing:
+                    parts.append('missing input name(s) %s' % missing)
+                raise ValueError(
+                    '%s — this model\'s inputs are get_input_names() '
+                    '= %s' % ('; '.join(parts), self._feed_names))
         # scope= kwarg, NOT scope_guard: run() must be safe from serving
         # threads, and the guard swaps a process-global
         outs = self._exe.run(self._program, feed=inputs,
@@ -141,6 +156,16 @@ class AnalysisPredictor(Predictor):
 
     def clone(self):
         return AnalysisPredictor(self._config, _clone_of=self)
+
+    def prepare_decoding(self, slots=None, prefill_batch=None):
+        """Transpile the loaded LM into the KV-cached prefill + decode
+        pair and return a serving.DecodePredictor over this predictor's
+        weight scope (see paddle_tpu/serving/decode.py). Raises
+        transpiler.DecodeTranspileError if the program is not a
+        recognizable decoder-only LM."""
+        from .serving import DecodePredictor
+        return DecodePredictor(self, slots=slots,
+                               prefill_batch=prefill_batch)
 
 
 def create_analysis_predictor(config):

@@ -21,7 +21,10 @@ _MODEL_FILENAME = '__model__'
 
 
 def is_persistable(var):
-    return var.persistable
+    # cache vars (serving KV rings) are persistable for the executor's
+    # scope write-back but are runtime state, not weights: a saved
+    # decode program must not try to serialize (or later load) them
+    return var.persistable and not getattr(var, 'is_cache', False)
 
 
 def is_parameter(var):

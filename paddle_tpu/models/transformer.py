@@ -186,3 +186,282 @@ def train_network(tokens, labels, cfg):
     cost = L.cross_entropy(input=probs, label=labels)
     avg_cost = L.mean(cost)
     return probs, avg_cost
+
+
+# ---------------------------------------------------------------------------
+# Cached-attention mode (paddle_tpu/serving/): prefill + decode builders
+# ---------------------------------------------------------------------------
+#
+# A loaded language-model program is transpiled
+# (transpiler/decode_transpiler.py) into a DecodeSpec — the discovered
+# dims plus the exact parameter NAMES of the source program — and these
+# builders emit two fresh programs that bind those names, so both run
+# against the Predictor's existing weight Scope without copying a byte:
+#
+#   prefill: [pb, T, 1] prompt tokens (+ per-prompt last position and
+#            target slot) -> full causal attention, K/V written into the
+#            [slots, T, H, dk] ring caches, last-real-position logits
+#   decode:  [slots, 1, 1] one token per slot + per-slot step_idx ->
+#            ring append at step_idx % T, attention over the cache,
+#            next-token logits. O(1) per token instead of O(T).
+#
+# Everything is static-shape (slot count, T, heads fixed at build time)
+# so each program compiles exactly once through the executor's
+# whole-block jit cache; slot liveness is a masking question
+# (decode_mask), never a shape question. The decode attention reuses the
+# SAME ops as the full path (mul, matmul+alpha, set-to--1e9 mask, fp32
+# softmax) over same-length reduction axes, which is what makes greedy
+# decode bit-exact against full-prefix recompute (tests/test_serving.py).
+
+class DecodeSpec(object):
+    """Dims + parameter names extracted from a loaded LM program.
+
+    blocks[i] is a dict with keys ln1/ln2 -> (scale_name, bias_name),
+    qkv/proj/up/down -> (w_name, b_name); final_ln is (scale, bias);
+    head is (w_name, b_name_or_None). pos_len is the positional TABLE
+    length (>= max_len, the sequence length programs are built for).
+    """
+
+    def __init__(self, vocab, dim, heads, layers, ffn, max_len, pos_len,
+                 emb_w, pos_w, blocks, final_ln, head, use_flash=False):
+        self.vocab, self.dim, self.heads = vocab, dim, heads
+        self.layers, self.ffn = layers, ffn
+        self.max_len, self.pos_len = max_len, pos_len
+        self.dh = dim // heads
+        self.emb_w, self.pos_w = emb_w, pos_w
+        self.blocks = blocks
+        self.final_ln = final_ln
+        self.head = head
+        self.use_flash = use_flash
+
+    def cache_names(self, layer=None):
+        """Ring-cache var names; shared by the prefill/decode pair."""
+        if layer is not None:
+            return ('kv_cache.layer%d.k' % layer,
+                    'kv_cache.layer%d.v' % layer)
+        out = []
+        for i in range(self.layers):
+            out.extend(self.cache_names(i))
+        return out
+
+    def cache_shape(self, slots):
+        return (slots, self.max_len, self.heads, self.dh)
+
+    def param_names(self):
+        names = [self.emb_w, self.pos_w,
+                 self.final_ln[0], self.final_ln[1], self.head[0]]
+        if self.head[1]:
+            names.append(self.head[1])
+        for blk in self.blocks:
+            for key in ('ln1', 'ln2', 'qkv', 'proj', 'up', 'down'):
+                names.extend(n for n in blk[key] if n)
+        return names
+
+
+def _named_attr(name):
+    from ..param_attr import ParamAttr
+    return ParamAttr(name=name) if name else False
+
+
+def _named_fc(x, size, pair, act=None, num_flatten_dims=2):
+    return L.fc(input=x, size=size, num_flatten_dims=num_flatten_dims,
+                param_attr=_named_attr(pair[0]),
+                bias_attr=_named_attr(pair[1]), act=act)
+
+
+def _named_ln(x, pair):
+    return L.layer_norm(x, begin_norm_axis=2,
+                        param_attr=_named_attr(pair[0]),
+                        bias_attr=_named_attr(pair[1]))
+
+
+def _block_op(op_type, inputs, outputs, attrs=None):
+    from ..framework import default_main_program
+    default_main_program().current_block().append_op(
+        type=op_type, inputs=inputs, outputs=outputs, attrs=attrs or {})
+
+
+def _tmp_var(dtype='float32'):
+    from ..framework import default_main_program
+    from .. import unique_name
+    return default_main_program().current_block().create_var(
+        name=unique_name.generate('kv_decode.tmp'), dtype=dtype)
+
+
+def _create_cache_vars(spec, slots):
+    """Per-layer K/V ring vars: persistable (the executor writes them
+    back to the Scope each run — and donates them, so the update is
+    in-place on device) but is_cache (io.py save/load skip them)."""
+    from ..framework import default_main_program
+    block = default_main_program().global_block()
+    caches = []
+    for i in range(spec.layers):
+        kn, vn = spec.cache_names(i)
+        caches.append(tuple(
+            block.create_var(name=n, shape=spec.cache_shape(slots),
+                             dtype='float32', persistable=True,
+                             stop_gradient=True, is_cache=True)
+            for n in (kn, vn)))
+    return caches
+
+
+def _qkv_parts(x, spec, blk, t):
+    """qkv fc + per-part slice/reshape to [-1, t, H, dh] — the full
+    path's heads() up to (not including) the transpose, which is the
+    cache's storage layout."""
+    qkv = _named_fc(x, 3 * spec.dim, blk['qkv'])
+    D = spec.dim
+
+    def part(s, e):
+        p = L.slice(qkv, axes=[2], starts=[s], ends=[e])
+        return L.reshape(p, shape=[-1, t, spec.heads, spec.dh])
+
+    return part(0, D), part(D, 2 * D), part(2 * D, 3 * D)
+
+
+def _prefill_attention(x, spec, blk, cache, slot_idx):
+    q4, k4, v4 = _qkv_parts(x, spec, blk, spec.max_len)
+    for cache_var, new in ((cache[0], k4), (cache[1], v4)):
+        _block_op('kv_cache_write',
+                  inputs={'Cache': [cache_var], 'X': [new],
+                          'Slots': [slot_idx]},
+                  outputs={'Out': [cache_var]})
+    q = L.transpose(q4, perm=[0, 2, 1, 3])             # [pb, H, T, dh]
+    k = L.transpose(k4, perm=[0, 2, 1, 3])
+    v = L.transpose(v4, perm=[0, 2, 1, 3])
+    if spec.use_flash:
+        ctx = L.flash_attention(q, k, v, causal=True)
+    else:
+        scores = L.matmul(q, k, transpose_y=True,
+                          alpha=1.0 / np.sqrt(spec.dh))
+        probs = L.softmax(L.causal_mask_bias(scores))
+        ctx = L.matmul(probs, v)
+    ctx = L.transpose(ctx, perm=[0, 2, 1, 3])
+    ctx = L.reshape(ctx, shape=[-1, spec.max_len, spec.dim])
+    return _named_fc(ctx, spec.dim, blk['proj'])
+
+
+def _decode_attention(x, spec, blk, cache, step_idx):
+    q1, k1, v1 = _qkv_parts(x, spec, blk, 1)           # [S, 1, H, dh]
+    for cache_var, new in ((cache[0], k1), (cache[1], v1)):
+        _block_op('kv_cache_append',
+                  inputs={'Cache': [cache_var], 'X': [new],
+                          'StepIdx': [step_idx]},
+                  outputs={'Out': [cache_var]})
+    q = L.transpose(q1, perm=[0, 2, 1, 3])             # [S, H, 1, dh]
+    kt = L.transpose(cache[0], perm=[0, 2, 1, 3])      # [S, H, T, dh]
+    vt = L.transpose(cache[1], perm=[0, 2, 1, 3])
+    scores = L.matmul(q, kt, transpose_y=True,
+                      alpha=1.0 / np.sqrt(spec.dh))    # [S, H, 1, T]
+    masked = _tmp_var()
+    _block_op('decode_mask',
+              inputs={'X': [scores], 'StepIdx': [step_idx]},
+              outputs={'Out': [masked]})
+    probs = L.softmax(masked)
+    ctx = L.matmul(probs, vt)                          # [S, H, 1, dh]
+    ctx = L.transpose(ctx, perm=[0, 2, 1, 3])
+    ctx = L.reshape(ctx, shape=[-1, 1, spec.dim])
+    return _named_fc(ctx, spec.dim, blk['proj'])
+
+
+def _cached_block(x, spec, i, attention):
+    blk = spec.blocks[i]
+    attn = attention(_named_ln(x, blk['ln1']), spec, blk)
+    x = L.elementwise_add(x, attn)
+    ffn = _named_fc(_named_ln(x, blk['ln2']), spec.ffn, blk['up'],
+                    act='gelu')
+    ffn = _named_fc(ffn, spec.dim, blk['down'])
+    return L.elementwise_add(x, ffn)
+
+
+def build_prefill_program(spec, slots, batch=1):
+    """Prefill program over `batch` prompt rows (padded to max_len).
+
+    Feeds:  prefill_tokens [batch, T, 1] int64, prefill_pos [batch]
+            int32 (index of each prompt's LAST real token, i.e.
+            len - 1), prefill_slots [batch] int32 (target cache slots).
+    Writes every layer's K/V rows for the fed slots (whole-row
+    overwrite), then gathers each prompt's last real position before
+    the lm_head — logits [batch, vocab] + greedy ids [batch].
+    Returns (program, feed_names, fetch_vars[logits, ids]).
+    """
+    from ..framework import Program, program_guard
+    prog, startup = Program(), Program()
+    prog._is_test = True
+    with program_guard(prog, startup):
+        tokens = L.data('prefill_tokens', [batch, spec.max_len, 1],
+                        append_batch_size=False, dtype='int64')
+        pos_idx = L.data('prefill_pos', [batch],
+                         append_batch_size=False, dtype='int32')
+        slot_idx = L.data('prefill_slots', [batch],
+                          append_batch_size=False, dtype='int32')
+        caches = _create_cache_vars(spec, slots)
+        emb = L.embedding(tokens, size=[spec.vocab, spec.dim],
+                          param_attr=_named_attr(spec.emb_w))
+        pos = L.position_embedding(emb, spec.pos_len,
+                                   param_attr=_named_attr(spec.pos_w))
+        x = L.elementwise_add(emb, pos)
+        for i in range(spec.layers):
+            x = _cached_block(
+                x, spec, i,
+                lambda ln, sp, blk, _i=i: _prefill_attention(
+                    ln, sp, blk, caches[_i], slot_idx))
+        x = _named_ln(x, spec.final_ln)
+        last = _tmp_var()
+        _block_op('gather_time',
+                  inputs={'X': [x], 'Index': [pos_idx]},
+                  outputs={'Out': [last]})               # [batch, D]
+        logits = _named_fc(last, spec.vocab, spec.head,
+                           num_flatten_dims=1)           # [batch, V]
+        ids = L.argmax(logits, axis=-1)
+    return prog, ['prefill_tokens', 'prefill_pos', 'prefill_slots'], \
+        [logits, ids]
+
+
+def build_decode_program(spec, slots):
+    """One-token decode step over the whole slot pool.
+
+    Feeds:  decode_tokens [slots, 1, 1] int64 (the token each slot
+            generated last), decode_step_idx [slots] int32 (its
+            absolute position; the ring write lands at step_idx % T).
+    Appends one K/V row per layer per slot, attends over the ring with
+    decode_mask validity, and returns next-token logits [slots, vocab]
+    + greedy ids [slots]. Idle slots compute garbage that the caller
+    ignores — their cache rows are rewritten wholesale at admission.
+    Returns (program, feed_names, fetch_vars[logits, ids]).
+    """
+    from ..framework import Program, program_guard
+    prog, startup = Program(), Program()
+    prog._is_test = True
+    with program_guard(prog, startup):
+        tokens = L.data('decode_tokens', [slots, 1, 1],
+                        append_batch_size=False, dtype='int64')
+        step_idx = L.data('decode_step_idx', [slots],
+                          append_batch_size=False, dtype='int32')
+        caches = _create_cache_vars(spec, slots)
+        emb = L.embedding(tokens, size=[spec.vocab, spec.dim],
+                          param_attr=_named_attr(spec.emb_w))      # [S,1,D]
+        # per-slot gather of the positional TABLE row for this step —
+        # the prefill path's pos[:T] broadcast slice has no analog when
+        # every slot sits at a different position
+        from ..layer_helper import LayerHelper
+        helper = LayerHelper('position_embedding',
+                             param_attr=_named_attr(spec.pos_w))
+        pos_var = helper.create_parameter(
+            attr=helper.param_attr, shape=[spec.pos_len, spec.dim],
+            dtype='float32')
+        pos = _tmp_var()
+        _block_op('position_embedding_at',
+                  inputs={'Pos': [pos_var], 'Index': [step_idx]},
+                  outputs={'Out': [pos]})                # [S, 1, D]
+        x = L.elementwise_add(emb, pos)
+        for i in range(spec.layers):
+            x = _cached_block(
+                x, spec, i,
+                lambda ln, sp, blk, _i=i: _decode_attention(
+                    ln, sp, blk, caches[_i], step_idx))
+        x = _named_ln(x, spec.final_ln)
+        logits3 = _named_fc(x, spec.vocab, spec.head)    # [S, 1, V]
+        logits = L.reshape(logits3, shape=[-1, spec.vocab])
+        ids = L.argmax(logits, axis=-1)
+    return prog, ['decode_tokens', 'decode_step_idx'], [logits, ids]

@@ -1,8 +1,17 @@
 """Attention ops. ring_attention: context-parallel attention over the
 'sp' mesh axis (parallel/ring_attention.py design notes). Under a plain
 single-device Executor (no mesh) it lowers to ordinary fused attention,
-so programs are portable between local debugging and sp meshes."""
+so programs are portable between local debugging and sp meshes.
+
+KV-cache ops (serving/): static-shape ring-buffer cache primitives for
+the prefill/decode program pair (models/transformer.py builders). Every
+shape is fixed at build time — slots, max_len, heads — so the decode
+step compiles once for the life of the server; per-slot positions are
+feeds, and validity is expressed as masking (the beam-search lattice
+idiom), never as a dynamic shape."""
 from __future__ import annotations
+
+import jax.numpy as jnp
 
 from ..registry import register_op, op_emitter, register_vjp_grad, \
     amp_cast
@@ -66,3 +75,119 @@ def _flash_attention_emit(ctx, op):
 
 register_op('flash_attention', infer_shape=_ring_infer)
 register_vjp_grad('flash_attention', in_slots=('Q', 'K', 'V'))
+
+
+# ---------------------------------------------------------------------------
+# KV-cache primitives (paddle_tpu/serving/)
+# ---------------------------------------------------------------------------
+
+@op_emitter('kv_cache_write')
+def _kv_cache_write_emit(ctx, op):
+    """Prefill: scatter a whole prompt's K or V rows into their slots.
+    Cache [slots, T, H, dk], X [pb, T, H, dk], Slots [pb] int32 — the
+    entire [T] row is overwritten, so stale ring contents from a slot's
+    previous occupant can never leak into a new request."""
+    cache = ctx.get(op.single_input('Cache'))
+    x = ctx.get(op.single_input('X'))
+    slots = ctx.get(op.single_input('Slots')).astype(jnp.int32)
+    ctx.set(op.single_output('Out'), cache.at[slots].set(x.astype(cache.dtype)))
+
+
+@op_emitter('kv_cache_append')
+def _kv_cache_append_emit(ctx, op):
+    """Decode: per-slot ring write of one new K or V row.
+    Cache [slots, T, H, dk], X [slots, 1, H, dk], StepIdx [slots] int32
+    (absolute position of the incoming token; the write lands at
+    StepIdx % T). Every slot writes every step — an idle slot writes at
+    its own ring position 0, which is dead weight masked by decode_mask
+    and fully overwritten by the prefill that next admits the slot."""
+    cache = ctx.get(op.single_input('Cache'))
+    x = ctx.get(op.single_input('X'))
+    step = ctx.get(op.single_input('StepIdx')).astype(jnp.int32)
+    T = cache.shape[1]
+    rows = jnp.arange(cache.shape[0], dtype=jnp.int32)
+    ctx.set(op.single_output('Out'),
+            cache.at[rows, step % T].set(x[:, 0].astype(cache.dtype)))
+
+
+@op_emitter('decode_mask')
+def _decode_mask_emit(ctx, op):
+    """Ring-aware validity mask for decode attention scores.
+    X [slots, H, 1, T] (scores against the full cache), StepIdx [slots].
+    Cache index j holds the token at absolute position
+    t_j = step - ((step - j) mod T); it is a real, in-window token iff
+    t_j >= 0. For step < T this reduces to j <= step (plain causal);
+    for step >= T the whole ring is valid. Same set-to--1e9 semantics
+    as the causal_mask op so masked lanes underflow to exactly 0.0
+    after the softmax's exp — the bit-exactness contract with the
+    full-recompute path."""
+    x = ctx.get(op.single_input('X'))
+    step = ctx.get(op.single_input('StepIdx')).astype(jnp.int32)
+    T = x.shape[-1]
+    j = jnp.arange(T, dtype=jnp.int32)
+    s = step[:, None]                                  # [slots, 1]
+    valid = (s - ((s - j[None, :]) % T)) >= 0          # [slots, T]
+    valid = valid[:, None, None, :]                    # [slots, 1, 1, T]
+    ctx.set(op.single_output('Out'), jnp.where(valid, x, -1e9))
+
+
+@op_emitter('position_embedding_at')
+def _position_embedding_at_emit(ctx, op):
+    """Gather one positional-embedding row per slot: Pos [max_len, D],
+    Index [slots] int32 -> [slots, 1, D] (ring position Index % T_pos,
+    matching the prefill path's pos[:T] table slice)."""
+    pos = ctx.get(op.single_input('Pos'))
+    idx = ctx.get(op.single_input('Index')).astype(jnp.int32)
+    out = jnp.take(pos, idx % pos.shape[0], axis=0)[:, None, :]
+    ctx.set(op.single_output('Out'), out)
+
+
+@op_emitter('gather_time')
+def _gather_time_emit(ctx, op):
+    """Per-row gather along the time axis: X [B, T, ...], Index [B]
+    int32 -> [B, ...] (row b keeps X[b, Index[b]]). Prefill uses this to
+    pick each prompt's last real position before the lm_head, so padded
+    tail positions never reach the logits."""
+    x = ctx.get(op.single_input('X'))
+    idx = ctx.get(op.single_input('Index')).astype(jnp.int32)
+    rows = jnp.arange(x.shape[0], dtype=jnp.int32)
+    ctx.set(op.single_output('Out'), x[rows, jnp.clip(idx, 0, x.shape[1] - 1)])
+
+
+def _kv_cache_update_infer(op, block):
+    cache = block.var_recursive(op.single_input('Cache'))
+    out = block.var_recursive(op.single_output('Out'))
+    out.shape = cache.shape
+    out.dtype = cache.dtype
+
+
+def _decode_mask_infer(op, block):
+    x = block.var_recursive(op.single_input('X'))
+    out = block.var_recursive(op.single_output('Out'))
+    out.shape = x.shape
+    out.dtype = x.dtype
+
+
+def _position_embedding_at_infer(op, block):
+    pos = block.var_recursive(op.single_input('Pos'))
+    idx = block.var_recursive(op.single_input('Index'))
+    out = block.var_recursive(op.single_output('Out'))
+    out.shape = (idx.shape[0], 1, pos.shape[-1])
+    out.dtype = pos.dtype
+
+
+def _gather_time_infer(op, block):
+    x = block.var_recursive(op.single_input('X'))
+    out = block.var_recursive(op.single_output('Out'))
+    out.shape = (x.shape[0],) + tuple(x.shape[2:])
+    out.dtype = x.dtype
+
+
+register_op('kv_cache_write', infer_shape=_kv_cache_update_infer,
+            no_grad=True)
+register_op('kv_cache_append', infer_shape=_kv_cache_update_infer,
+            no_grad=True)
+register_op('decode_mask', infer_shape=_decode_mask_infer, no_grad=True)
+register_op('position_embedding_at', infer_shape=_position_embedding_at_infer,
+            no_grad=True)
+register_op('gather_time', infer_shape=_gather_time_infer, no_grad=True)

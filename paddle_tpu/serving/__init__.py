@@ -1,0 +1,30 @@
+"""KV-cache incremental decoding + continuous-batching serving.
+
+The "millions of users" half of the north star (ROADMAP item 1),
+layered on the inference Predictor ABI:
+
+- decode.py   DecodePredictor: a loaded LM transpiled into a prefill +
+              decode program pair (transpiler/decode_transpiler.py)
+              with per-layer [slots, T, H, dk] K/V ring caches living
+              in a child Scope — weights shared with the base
+              Predictor (and every clone) through the parent Scope,
+              cache state private per worker.
+- engine.py   ServingEngine: continuous batching over a fixed slot
+              pool — requests are admitted into the running batch
+              between decode steps, finished/cancelled slots are
+              evicted and masked, worker threads share weights via
+              clone(). serving.* telemetry flows into paddle_tpu/obs/.
+- api.py      LMServer: the user-facing blocking generate() + async
+              submit/poll surface (reference
+              inference/api/paddle_inference_api.h PaddlePredictor
+              serving contract, re-shaped for token streams).
+
+Decode cost per token is O(1) against the cache instead of O(T) prefix
+recompute, and greedy decode is bit-exact against the full-recompute
+path (tests/test_serving.py).
+"""
+from .decode import DecodePredictor
+from .engine import ServingEngine, Request
+from .api import LMServer
+
+__all__ = ['DecodePredictor', 'ServingEngine', 'Request', 'LMServer']

@@ -1,0 +1,195 @@
+"""DecodePredictor: cached prefill/decode execution over a slot pool.
+
+Scope layout is the whole trick:
+
+    base Predictor Scope (weights, device-resident, shared)
+        └── this DecodePredictor's child Scope (K/V ring caches)
+
+Weights are pinned to device ONCE in the parent scope at construction;
+every clone() gets a fresh child scope (private cache state, zeroed)
+over the same parent, so N serving workers share one copy of the
+weights in HBM — the reference PaddlePredictor::Clone contract extended
+to runtime state. Both programs are static-shape, so each compiles
+exactly once through the executor's whole-block jit cache and the cache
+buffers ride the executor's donation path (in-place update on device).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..executor import Executor, Scope
+from ..flags import get_flag
+
+__all__ = ['DecodePredictor']
+
+
+class DecodePredictor(object):
+    def __init__(self, predictor, slots=None, prefill_batch=None,
+                 _clone_of=None):
+        """predictor: a (loaded) Predictor/AnalysisPredictor whose
+        program is a decoder-only LM; prefer
+        AnalysisPredictor.prepare_decoding() over calling this
+        directly. slots / prefill_batch default to FLAGS_serving_slots
+        / FLAGS_serving_prefill_batch."""
+        self._base = predictor
+        if _clone_of is not None:
+            self._pair = _clone_of._pair
+            self._weight_scope = _clone_of._weight_scope
+        else:
+            from ..transpiler.decode_transpiler import DecodeTranspiler
+            slots = int(slots or get_flag('serving_slots'))
+            prefill_batch = int(prefill_batch
+                                or get_flag('serving_prefill_batch'))
+            self._pair = DecodeTranspiler().transpile(
+                predictor._program, slots=slots,
+                prefill_batch=prefill_batch)
+            self._weight_scope = predictor._scope
+        self._exe = Executor(predictor._place)
+        if _clone_of is None:
+            self._pin_weights()
+        self._scope = Scope(parent=self._weight_scope)
+        self.reset()
+
+    # -- introspection -----------------------------------------------------
+    @property
+    def slots(self):
+        return self._pair.slots
+
+    @property
+    def prefill_batch(self):
+        return self._pair.prefill_batch
+
+    @property
+    def max_len(self):
+        return self._pair.spec.max_len
+
+    @property
+    def vocab(self):
+        return self._pair.spec.vocab
+
+    def jit_cache_stats(self):
+        return self._exe.jit_cache_stats()
+
+    # -- lifecycle ---------------------------------------------------------
+    def _pin_weights(self):
+        """Pin every referenced parameter to device in the PARENT scope
+        before any child scope exists — otherwise the executor's lazy
+        pin would write per-worker device copies into each child,
+        duplicating the model in HBM once per clone."""
+        import jax
+        block = self._pair.decode_program.global_block()
+        for name in self._pair.spec.param_names():
+            val = self._weight_scope.find_var(name)
+            if val is None:
+                raise RuntimeError(
+                    'decode transpile references param %r that is not '
+                    'in the predictor scope — was the model loaded with '
+                    'load_params=True?' % name)
+            if isinstance(val, np.ndarray) and \
+                    val.dtype not in (np.int64, np.uint64, np.float64):
+                var = block.vars.get(name)
+                if var is not None and var.persistable:
+                    self._weight_scope.set_var(
+                        name, jax.device_put(val, self._exe.device))
+
+    def reset(self):
+        """Zero every ring cache (all slots forget everything)."""
+        shape = self._pair.spec.cache_shape(self.slots)
+        for name in self._pair.cache_names:
+            self._scope.set_var(name, np.zeros(shape, np.float32))
+
+    def clone(self):
+        """A worker sharing this one's weights and compiled-program
+        identity (same Program objects -> same jit cache keys) with a
+        PRIVATE cache scope + executor — concurrent decode streams
+        can't cross-talk."""
+        return DecodePredictor(self._base, _clone_of=self)
+
+    # -- execution ---------------------------------------------------------
+    def _pad_prompts(self, prompts, slot_ids):
+        pb, T = self.prefill_batch, self.max_len
+        if not prompts or len(prompts) > pb:
+            raise ValueError('prefill takes 1..%d prompts, got %d'
+                             % (pb, len(prompts)))
+        if len(prompts) != len(slot_ids):
+            raise ValueError('%d prompts for %d slots'
+                             % (len(prompts), len(slot_ids)))
+        tokens = np.zeros((pb, T, 1), np.int64)
+        pos = np.zeros((pb,), np.int32)
+        slots = np.zeros((pb,), np.int32)
+        for i, (p, s) in enumerate(zip(prompts, slot_ids)):
+            p = np.asarray(p).reshape(-1)
+            if not 1 <= p.size <= T:
+                raise ValueError(
+                    'prompt length %d outside [1, %d] (max_len)'
+                    % (p.size, T))
+            if not 0 <= int(s) < self.slots:
+                raise ValueError('slot %r outside [0, %d)'
+                                 % (s, self.slots))
+            tokens[i, :p.size, 0] = p
+            pos[i] = p.size - 1
+            slots[i] = int(s)
+        # a short batch re-writes the LAST real (prompt, slot) pair into
+        # the same slot: identical values, so the duplicate scatter is
+        # deterministic and no idle slot is touched
+        for i in range(len(prompts), pb):
+            tokens[i] = tokens[len(prompts) - 1]
+            pos[i] = pos[len(prompts) - 1]
+            slots[i] = slots[len(prompts) - 1]
+        return tokens, pos, slots
+
+    def prefill(self, prompts, slot_ids, return_logits=False):
+        """Write the prompts' K/V into their slots and return the first
+        greedy token per prompt: ids [len(prompts)] int64 (and, with
+        return_logits, last-position logits [len(prompts), vocab])."""
+        tokens, pos, slots = self._pad_prompts(prompts, slot_ids)
+        logits, ids = self._exe.run(
+            self._pair.prefill_program,
+            feed={'prefill_tokens': tokens, 'prefill_pos': pos,
+                  'prefill_slots': slots},
+            fetch_list=self._pair.prefill_fetches,
+            scope=self._scope, return_numpy=False)
+        n = len(prompts)
+        out_ids = np.asarray(ids)[:n]
+        if return_logits:
+            return out_ids, np.asarray(logits)[:n]
+        return out_ids
+
+    def decode_step(self, tokens, positions, return_logits=False):
+        """One step for the WHOLE pool: tokens [slots] (last generated
+        token per slot), positions [slots] (its absolute position; the
+        ring write lands at position % max_len). Returns next greedy
+        ids [slots] int64 (and logits [slots, vocab] if asked). Idle
+        slots may carry any values — their rows are garbage by
+        contract and rewritten at admission."""
+        tokens = np.asarray(tokens, np.int64).reshape(self.slots, 1, 1)
+        positions = np.asarray(positions, np.int32).reshape(self.slots)
+        logits, ids = self._exe.run(
+            self._pair.decode_program,
+            feed={'decode_tokens': tokens,
+                  'decode_step_idx': positions},
+            fetch_list=self._pair.decode_fetches,
+            scope=self._scope, return_numpy=False)
+        if return_logits:
+            return np.asarray(ids), np.asarray(logits)
+        # ids only: the [slots, vocab] logits stay on device — at
+        # production vocab sizes the per-step host transfer would
+        # otherwise dominate the decode step itself
+        return np.asarray(ids)
+
+    def generate(self, prompt, max_new_tokens, eos_id=None, slot=0):
+        """Solo greedy generation on one slot (the benchmark / parity
+        path; real traffic goes through ServingEngine)."""
+        ids = self.prefill([prompt], [slot])
+        tok = int(ids[0])
+        out = [tok]
+        pos = len(np.asarray(prompt).reshape(-1))
+        toks = np.zeros((self.slots,), np.int64)
+        poss = np.zeros((self.slots,), np.int32)
+        while len(out) < max_new_tokens and tok != eos_id:
+            toks[slot] = tok
+            poss[slot] = pos
+            tok = int(self.decode_step(toks, poss)[slot])
+            out.append(tok)
+            pos += 1
+        return out

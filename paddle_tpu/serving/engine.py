@@ -1,0 +1,310 @@
+"""ServingEngine: continuous batching over a fixed slot pool.
+
+Orca-style iteration-level scheduling, TPU-flavored: the decode step is
+ONE compiled program over all `slots` lanes, so admission/eviction
+never changes a shape — a request joining the running batch is a
+prefill (whole-row cache overwrite for its slot) between two decode
+steps, a finished/cancelled request is simply a lane the scheduler
+stops reading (decode_mask already hides whatever the dead lane
+writes). Worker threads each own a DecodePredictor clone — private
+cache scope + executor, weights shared through the parent Scope — and
+pull from one shared queue.
+
+Telemetry (paddle_tpu/obs/, exported when FLAGS_obs_dir is set):
+  serving.requests.{submitted,admitted,completed,cancelled,rejected,
+  failed}  counters; serving.tokens_generated / serving.decode_steps /
+  serving.prefills  counters; serving.queue_depth /
+  serving.slot_occupancy  gauges; serving.ttft /
+  serving.token_latency / serving.decode_batch  histograms (seconds /
+  seconds / active lanes per step).
+"""
+from __future__ import annotations
+
+import collections
+import itertools
+import threading
+import time
+
+import numpy as np
+
+from ..flags import get_flag
+from ..obs import telemetry
+
+__all__ = ['Request', 'ServingEngine']
+
+QUEUED, RUNNING, DONE, CANCELLED, FAILED = \
+    'QUEUED', 'RUNNING', 'DONE', 'CANCELLED', 'FAILED'
+
+_submitted = telemetry.counter('serving.requests.submitted')
+_admitted = telemetry.counter('serving.requests.admitted')
+_completed = telemetry.counter('serving.requests.completed')
+_cancelled = telemetry.counter('serving.requests.cancelled')
+_rejected = telemetry.counter('serving.requests.rejected')
+_failed = telemetry.counter('serving.requests.failed')
+_tokens_out = telemetry.counter('serving.tokens_generated')
+_decode_steps = telemetry.counter('serving.decode_steps')
+_prefills = telemetry.counter('serving.prefills')
+_queue_depth = telemetry.gauge('serving.queue_depth')
+_occupancy = telemetry.gauge('serving.slot_occupancy')
+_ttft = telemetry.histogram('serving.ttft')
+_token_latency = telemetry.histogram('serving.token_latency')
+_decode_batch = telemetry.histogram('serving.decode_batch')
+
+
+class Request(object):
+    """One generation request. tokens grows as the stream decodes;
+    wait() blocks until a terminal state (DONE/CANCELLED/FAILED)."""
+
+    _ids = itertools.count()
+
+    def __init__(self, prompt, max_new_tokens, eos_id):
+        self.id = next(Request._ids)
+        self.prompt = [int(t) for t in np.asarray(prompt).reshape(-1)]
+        self.max_new_tokens = int(max_new_tokens)
+        self.eos_id = eos_id
+        self.state = QUEUED
+        self.tokens = []
+        self.error = None
+        self.submitted_at = time.perf_counter()
+        self.first_token_at = None
+        self.done_at = None
+        self._done = threading.Event()
+
+    def _finish(self, state, error=None):
+        self.state = state
+        self.error = error
+        self.done_at = time.perf_counter()
+        self._done.set()
+
+    def wait(self, timeout=None):
+        return self._done.wait(timeout)
+
+    def result(self, timeout=None):
+        """Block for the generated tokens; raises on FAILED, returns
+        the partial stream on CANCELLED."""
+        if not self.wait(timeout):
+            raise TimeoutError('request %d still %s after %rs'
+                               % (self.id, self.state, timeout))
+        if self.state == FAILED:
+            raise RuntimeError('request %d failed: %s'
+                               % (self.id, self.error))
+        return list(self.tokens)
+
+
+class _Lane(object):
+    """One occupied slot: the request plus the position its NEXT token
+    will be appended at (== absolute position of the token being fed)."""
+    __slots__ = ('req', 'pos', 'tok')
+
+    def __init__(self, req, pos, tok):
+        self.req, self.pos, self.tok = req, pos, tok
+
+
+class ServingEngine(object):
+    def __init__(self, predictor, workers=1, max_queue=None,
+                 idle_wait=None):
+        """predictor: a DecodePredictor (AnalysisPredictor
+        .prepare_decoding()); workers > 1 adds clone()-shared-weight
+        worker threads, each with its own slot pool."""
+        self._predictors = [predictor]
+        for _ in range(1, int(workers)):
+            self._predictors.append(predictor.clone())
+        self._max_queue = int(max_queue
+                              or get_flag('serving_max_queue'))
+        self._idle_wait = float(idle_wait
+                                if idle_wait is not None
+                                else get_flag('serving_idle_wait'))
+        self._queue = collections.deque()
+        self._cond = threading.Condition()
+        self._running = False
+        self._threads = []
+        self._active_total = 0
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self):
+        if self._running:
+            return self
+        self._running = True
+        self._threads = [
+            threading.Thread(target=self._worker_loop, args=(p,),
+                             name='serving-worker-%d' % i, daemon=True)
+            for i, p in enumerate(self._predictors)]
+        for t in self._threads:
+            t.start()
+        return self
+
+    def stop(self, drain=True):
+        """drain=True finishes queued + running requests first;
+        drain=False cancels everything still queued."""
+        with self._cond:
+            if not drain:
+                while self._queue:
+                    req = self._queue.popleft()
+                    req._finish(CANCELLED)
+                    _cancelled.inc()
+            self._running = False
+            self._cond.notify_all()
+        for t in self._threads:
+            t.join()
+        self._threads = []
+
+    close = stop
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop(drain=not any(exc))
+
+    # -- client surface ----------------------------------------------------
+    def submit(self, prompt, max_new_tokens=16, eos_id=None):
+        prompt = np.asarray(prompt).reshape(-1)
+        max_len = self._predictors[0].max_len
+        if not 1 <= prompt.size <= max_len:
+            _rejected.inc()
+            raise ValueError('prompt length %d outside [1, %d] '
+                             '(max_len)' % (prompt.size, max_len))
+        if max_new_tokens < 1:
+            _rejected.inc()
+            raise ValueError('max_new_tokens must be >= 1')
+        req = Request(prompt, max_new_tokens, eos_id)
+        with self._cond:
+            if len(self._queue) >= self._max_queue:
+                _rejected.inc()
+                raise RuntimeError('serving queue full (%d)'
+                                   % self._max_queue)
+            self._queue.append(req)
+            _queue_depth.set(len(self._queue))
+            self._cond.notify_all()
+        _submitted.inc()
+        return req
+
+    def generate(self, prompt, max_new_tokens=16, eos_id=None,
+                 timeout=None):
+        return self.submit(prompt, max_new_tokens,
+                           eos_id=eos_id).result(timeout)
+
+    def cancel(self, req):
+        """Mark a request cancelled; a queued one never runs, a running
+        one is evicted at the next step boundary (its partial tokens
+        remain readable)."""
+        if req.state in (QUEUED, RUNNING):
+            req.state = CANCELLED
+        return req
+
+    def stats(self):
+        with self._cond:
+            depth = len(self._queue)
+        return {'queue_depth': depth, 'active': self._active_total,
+                'workers': len(self._predictors),
+                'slots_per_worker': self._predictors[0].slots,
+                'jit': self._predictors[0].jit_cache_stats()}
+
+    # -- scheduler ---------------------------------------------------------
+    def _pop_next(self):
+        with self._cond:
+            while self._queue:
+                req = self._queue.popleft()
+                _queue_depth.set(len(self._queue))
+                if req.state == CANCELLED:
+                    req._finish(CANCELLED)
+                    _cancelled.inc()
+                    continue
+                return req
+        return None
+
+    def _finish_lane(self, lanes, slot, state, error=None):
+        lane = lanes.pop(slot)
+        lane.req._finish(state, error)
+        self._active_total -= 1
+        if state == DONE:
+            _completed.inc()
+        elif state == CANCELLED:
+            _cancelled.inc()
+        else:
+            _failed.inc()
+
+    def _lane_accept(self, lanes, slot, tok):
+        """Record one generated token; returns False if the lane is
+        done (eos / budget / cancelled) and was evicted."""
+        lane = lanes[slot]
+        req = lane.req
+        if req.state == CANCELLED:
+            self._finish_lane(lanes, slot, CANCELLED)
+            return False
+        req.tokens.append(int(tok))
+        _tokens_out.inc()
+        if req.first_token_at is None:
+            req.first_token_at = time.perf_counter()
+            _ttft.observe(req.first_token_at - req.submitted_at)
+        if len(req.tokens) >= req.max_new_tokens or \
+                (req.eos_id is not None and int(tok) == req.eos_id):
+            self._finish_lane(lanes, slot, DONE)
+            return False
+        lane.tok = int(tok)
+        return True
+
+    def _admit(self, pred, lanes):
+        """Fill free slots from the queue; one prefill per admitted
+        request (prefill_batch > 1 batches them)."""
+        free = [s for s in range(pred.slots) if s not in lanes]
+        batch = []
+        while free:
+            req = self._pop_next()
+            if req is None:
+                break
+            req.state = RUNNING
+            slot = free.pop(0)
+            batch.append((req, slot))
+            self._active_total += 1
+            _admitted.inc()
+        for i in range(0, len(batch), pred.prefill_batch):
+            chunk = batch[i:i + pred.prefill_batch]
+            try:
+                ids = pred.prefill([r.prompt for r, _ in chunk],
+                                   [s for _, s in chunk])
+            except Exception as e:     # noqa: BLE001 — lane-fatal only
+                for req, _slot in chunk:
+                    req._finish(FAILED, error=repr(e))
+                    self._active_total -= 1
+                    _failed.inc()
+                continue
+            _prefills.inc(len(chunk))
+            for (req, slot), tok in zip(chunk, ids):
+                lanes[slot] = _Lane(req, pos=len(req.prompt),
+                                    tok=int(tok))
+                self._lane_accept(lanes, slot, int(tok))
+
+    def _worker_loop(self, pred):
+        lanes = {}                       # slot -> _Lane
+        tokens = np.zeros((pred.slots,), np.int64)
+        positions = np.zeros((pred.slots,), np.int32)
+        while True:
+            with self._cond:
+                while self._running and not self._queue and not lanes:
+                    self._cond.wait(self._idle_wait)
+                if not self._running and not self._queue and not lanes:
+                    return
+            self._admit(pred, lanes)
+            _occupancy.set(self._active_total)
+            if not lanes:
+                continue
+            for slot, lane in lanes.items():
+                tokens[slot] = lane.tok
+                positions[slot] = lane.pos
+            t0 = time.perf_counter()
+            try:
+                ids = pred.decode_step(tokens, positions)
+            except Exception as e:       # noqa: BLE001 — engine survives
+                for slot in list(lanes):
+                    self._finish_lane(lanes, slot, FAILED,
+                                      error=repr(e))
+                continue
+            dt = time.perf_counter() - t0
+            _decode_steps.inc()
+            _token_latency.observe(dt)
+            _decode_batch.observe(len(lanes))
+            for slot in list(lanes):
+                lanes[slot].pos += 1
+                self._lane_accept(lanes, slot, int(ids[slot]))
+            _occupancy.set(self._active_total)

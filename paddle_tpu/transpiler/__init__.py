@@ -2,7 +2,9 @@
 
 DistributeTranspiler rewrites a local program into trainer + pserver
 programs for parameter-server mode. InferenceTranspiler folds
-batch-norm into convs for deployment. The memory-optimize transpiler
+batch-norm into convs for deployment. DecodeTranspiler turns a loaded
+decoder-only LM into a KV-cached prefill + decode program pair for the
+serving engine (paddle_tpu/serving/). The memory-optimize transpiler
 computes the reference's liveness/reuse plan while delegating actual
 buffer sharing to XLA buffer assignment (see its module docstring).
 """
@@ -10,9 +12,13 @@ from .distribute_transpiler import (DistributeTranspiler,
                                     DistributeTranspilerConfig)
 from .ps_dispatcher import PSDispatcher, RoundRobin, HashName
 from .inference_transpiler import InferenceTranspiler
+from .decode_transpiler import (DecodeTranspiler, DecodeTranspileError,
+                                DecodePair, extract_decode_spec)
 from .memory_optimization_transpiler import (memory_optimize,
                                              release_memory)
 
 __all__ = ['DistributeTranspiler', 'DistributeTranspilerConfig',
            'PSDispatcher', 'RoundRobin', 'HashName',
-           'InferenceTranspiler', 'memory_optimize', 'release_memory']
+           'InferenceTranspiler', 'DecodeTranspiler',
+           'DecodeTranspileError', 'DecodePair', 'extract_decode_spec',
+           'memory_optimize', 'release_memory']

@@ -71,3 +71,58 @@ def test_concurrent_cloned_predictors_agree_with_serial(tmp_path):
     # weights are genuinely shared, not copied: the clones' scope IS
     # the base predictor's scope object
     assert all(c._scope is base._scope for c in clones)
+
+
+def test_concurrent_cloned_decode_predictors_agree_with_serial(tmp_path):
+    """The serving extension of the clone contract: DecodePredictor
+    clones share the weight scope but carry PRIVATE K/V cache scopes,
+    so concurrent generation streams must equal their serial runs
+    (deeper checks live in tests/test_serving.py)."""
+    from paddle_tpu.models.transformer import (TransformerConfig,
+                                               language_model_logits)
+    cfg = TransformerConfig(vocab=32, dim=16, heads=2, layers=1,
+                            ffn=32, max_len=8, use_tp=False,
+                            use_sp=False)
+    prog, startup = Program(), Program()
+    prog.random_seed = startup.random_seed = 5
+    with unique_name.guard(), program_guard(prog, startup):
+        toks = fluid.layers.data(name='tokens',
+                                 shape=[1, cfg.max_len, 1],
+                                 dtype='int64', append_batch_size=False)
+        logits = language_model_logits(toks, cfg)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        fluid.io.save_inference_model(str(tmp_path), ['tokens'],
+                                      [logits], exe, main_program=prog)
+    from paddle_tpu.inference import AnalysisConfig, AnalysisPredictor
+    pred = AnalysisPredictor(AnalysisConfig(str(tmp_path),
+                                            place=fluid.CPUPlace()))
+    base = pred.prepare_decoding(slots=1, prefill_batch=1)
+    workers = [base] + [base.clone() for _ in range(2)]
+    prompts = [[3, 1, 4], [7, 7], [2, 9, 6, 1]]
+    serial = [w.generate(p, 5) for w, p in zip(workers, prompts)]
+    for w in workers:
+        w.reset()
+
+    results, errors = [None] * 3, []
+    start = threading.Barrier(3)
+
+    def worker(i):
+        try:
+            start.wait(timeout=30)
+            results[i] = workers[i].generate(prompts[i], 5)
+        except Exception as e:                   # surface, don't hang
+            errors.append((i, repr(e)))
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(3)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join(timeout=120)
+        assert not th.is_alive(), 'decode thread hung (deadlock?)'
+    assert not errors, errors
+    assert results == serial
+    assert all(w._weight_scope is base._weight_scope for w in workers)

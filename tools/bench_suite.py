@@ -151,9 +151,14 @@ def run_one(model, mode, steps, full):
     for _ in range(steps):
         lv = run(feed_fn(rng, bs))
     dt = time.perf_counter() - t0
-    return {'model': model, 'mode': mode,
-            'samples_per_sec': round(bs * steps / dt, 2),
-            'loss': round(float(np.asarray(lv[0]).mean()), 4)}
+    row = {'model': model, 'mode': mode,
+           'samples_per_sec': round(bs * steps / dt, 2),
+           'loss': round(float(np.asarray(lv[0]).mean()), 4)}
+    if model == 'transformer' and mode == 'local':
+        spd = _serving_quick()
+        if spd:
+            row['decode_speedup'] = spd
+    return row
 
 
 def run_scaling(model, steps, full, bn_local_stats=False,
@@ -345,6 +350,29 @@ def _transport_quick():
         except Exception:   # noqa: BLE001 — a bench extra, never fatal
             _TRANSPORT_QUICK[0] = 0.0
     return _TRANSPORT_QUICK[0]
+
+
+_SERVING_QUICK = [None]     # serve_bench --quick, measured at most once
+
+
+def _serving_quick():
+    """Headline cached-vs-recompute decode speedup
+    (tools/serve_bench.py --quick) stamped onto the transformer
+    local-mode row; one subprocess, cached across invocations."""
+    if _SERVING_QUICK[0] is None:
+        try:
+            env = dict(os.environ, JAX_PLATFORMS='cpu')
+            out = subprocess.run(
+                [sys.executable,
+                 os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                              'serve_bench.py'), '--quick'],
+                capture_output=True, text=True, timeout=300, env=env)
+            line = [ln for ln in out.stdout.splitlines()
+                    if ln.startswith('{') and '"summary"' in ln][-1]
+            _SERVING_QUICK[0] = json.loads(line)['infer_decode_speedup']
+        except Exception:   # noqa: BLE001 — a bench extra, never fatal
+            _SERVING_QUICK[0] = 0.0
+    return _SERVING_QUICK[0]
 
 
 def run_pserver(model, n_trainers, steps, full):

@@ -11,7 +11,12 @@ per-process clocks from client/server RPC span midpoints, and writes:
   arrows linking each client RPC span to its server handler span,
   instant markers for injected faults and trainer FaultEvents), and
 - a metrics rollup (per-role counters/gauges/histograms plus cluster
-  totals summed across roles and incarnations).
+  totals summed across roles and incarnations). The rollup is
+  name-agnostic, so the serving engine's serving.* series (TTFT /
+  per-token latency histograms, queue-depth / slot-occupancy gauges,
+  request counters — paddle_tpu/serving/engine.py) appear alongside
+  the rpc.* / trainer.* training metrics when a serving process runs
+  under FLAGS_obs_dir.
 
     python tools/obs_report.py --obs_dir /tmp/run_obs \
         --timeline tl.json --rollup rollup.json

@@ -1,0 +1,194 @@
+"""Serving benchmark: KV-cached decode vs full-prefix recompute.
+
+Measures what paddle_tpu/serving/ buys on a decoder-only LM:
+
+  recompute   the pre-serving decode loop — one full T-prefix forward
+              per generated token through the plain AnalysisPredictor
+              (O(T) work per token)
+  cached      DecodePredictor decode_step over the K/V ring caches
+              (O(1) per token), swept across slot-pool sizes: each
+              batch size is its own transpiled decode program, so the
+              row reflects a pool actually compiled at that width
+  engine      ServingEngine end-to-end at the widest pool: continuous
+              batching with per-request TTFT, driven by a burst of
+              concurrent submissions
+
+Prints one JSON row per configuration (infer_decode_* keys, the
+bench.py naming) and an acceptance summary row with the cached vs
+recompute speedup at full context. serving.* telemetry flows into the
+obs registry; run under FLAGS_obs_dir to export it for
+tools/obs_report.py.
+
+Usage:
+  python tools/serve_bench.py               # CPU-sized sweep, bs 1..64
+  python tools/serve_bench.py --quick       # one tiny shape (CI smoke)
+  python tools/serve_bench.py --full        # L4/D1024/T512 (accelerator)
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def _build_predictor(cfg):
+    """Train-free LM -> save_inference_model -> AnalysisPredictor."""
+    import paddle_tpu as fluid
+    from paddle_tpu.inference import AnalysisConfig, AnalysisPredictor
+    from paddle_tpu.models import transformer as tfm
+    main_prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main_prog, startup):
+        tokens = fluid.layers.data(
+            'tokens', shape=[1, cfg.max_len, 1], dtype='int64',
+            append_batch_size=False)
+        logits = tfm.language_model_logits(tokens, cfg)
+    exe = fluid.Executor(fluid.TPUPlace())
+    scope = fluid.Scope()
+    with tempfile.TemporaryDirectory() as tmp:
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            fluid.io.save_inference_model(tmp, ['tokens'], [logits],
+                                          exe, main_program=main_prog)
+        return AnalysisPredictor(AnalysisConfig(tmp))
+
+
+def _recompute_tokens_per_sec(pred, cfg, iters):
+    """One next-token per full-prefix forward (the baseline a user
+    without serving/ would run): tokens/s at context T."""
+    rng = np.random.RandomState(0)
+    toks = rng.randint(0, cfg.vocab, (1, cfg.max_len, 1)).astype('int64')
+    pred.run([toks])
+    pred.run([toks])
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        pred.run([toks])
+    dt = (time.perf_counter() - t0) / iters
+    return 1.0 / dt, dt
+
+
+def _cached_tokens_per_sec(pred, cfg, slots, iters):
+    """Steady-state decode over a full pool of `slots` lanes, caches
+    warmed to full context. Returns (tokens/s, step_ms, prefill_ms)."""
+    rng = np.random.RandomState(0)
+    dec = pred.prepare_decoding(slots=slots, prefill_batch=1)
+    t0 = time.perf_counter()
+    for s in range(slots):
+        dec.prefill([rng.randint(0, cfg.vocab, cfg.max_len)], [s])
+    prefill_ms = (time.perf_counter() - t0) * 1e3 / slots
+    toks = rng.randint(0, cfg.vocab, slots).astype('int64')
+    pos = np.full((slots,), cfg.max_len - 1, 'int32')
+    dec.decode_step(toks, pos)      # compile
+    dec.decode_step(toks, pos)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        dec.decode_step(toks, pos)
+    dt = (time.perf_counter() - t0) / iters
+    stats = dec.jit_cache_stats()
+    assert stats['compiled_segments'] == 2, stats   # prefill + decode
+    return slots / dt, dt * 1e3, prefill_ms
+
+
+def _engine_leg(pred, cfg, slots, n_requests, new_tokens):
+    """End-to-end ServingEngine burst: n_requests submitted at once,
+    TTFT and completion tokens/s measured from the request records."""
+    from paddle_tpu.serving import ServingEngine
+    rng = np.random.RandomState(1)
+    dec = pred.prepare_decoding(slots=slots, prefill_batch=1)
+    prompts = [rng.randint(0, cfg.vocab, max(1, cfg.max_len // 2))
+               for _ in range(n_requests)]
+    # compile both programs outside the measured window, then drop the
+    # warmup state — TTFT should price admission + prefill, not XLA
+    dec.prefill([prompts[0]], [0])
+    dec.decode_step(np.zeros(slots, 'int64'), np.zeros(slots, 'int32'))
+    dec.reset()
+    t0 = time.perf_counter()
+    with ServingEngine(dec) as eng:
+        reqs = [eng.submit(p, max_new_tokens=new_tokens)
+                for p in prompts]
+        for r in reqs:
+            r.result(600)
+    wall = time.perf_counter() - t0
+    ttfts = [r.first_token_at - r.submitted_at for r in reqs]
+    total = sum(len(r.tokens) for r in reqs)
+    return {'requests': n_requests, 'slots': slots,
+            'engine_tokens_per_sec': round(total / wall, 2),
+            'ttft_p50_ms': round(sorted(ttfts)[len(ttfts) // 2] * 1e3, 1),
+            'ttft_max_ms': round(max(ttfts) * 1e3, 1)}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument('--quick', action='store_true',
+                    help='one tiny shape, bs 1 + 4 (CI smoke)')
+    ap.add_argument('--full', action='store_true',
+                    help='L4/D1024/T512 benchmark shape (accelerator)')
+    ap.add_argument('--iters', type=int, default=20)
+    args = ap.parse_args()
+    if not args.full:
+        os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+
+    from paddle_tpu.models import transformer as tfm
+    if args.full:
+        cfg = tfm.TransformerConfig(vocab=32768, dim=1024, heads=16,
+                                    layers=4, ffn=4096, max_len=512,
+                                    use_tp=False, use_sp=False,
+                                    flash_attention=True)
+        batch_sizes = [1, 4, 16, 64]
+    elif args.quick:
+        cfg = tfm.TransformerConfig(vocab=128, dim=32, heads=2,
+                                    layers=1, ffn=64, max_len=16,
+                                    use_tp=False, use_sp=False)
+        batch_sizes = [1, 4]
+    else:
+        cfg = tfm.TransformerConfig(vocab=512, dim=128, heads=4,
+                                    layers=2, ffn=256, max_len=128,
+                                    use_tp=False, use_sp=False)
+        batch_sizes = [1, 4, 16, 64]
+
+    label = 'L%d_D%d_T%d' % (cfg.layers, cfg.dim, cfg.max_len)
+    pred = _build_predictor(cfg)
+
+    rec_tps, rec_dt = _recompute_tokens_per_sec(pred, cfg, args.iters)
+    print(json.dumps({'mode': 'recompute', 'config': label,
+                      'infer_decode_recompute_tokens_per_sec':
+                          round(rec_tps, 2),
+                      'step_ms': round(rec_dt * 1e3, 2)}), flush=True)
+
+    best = None
+    for bs in batch_sizes:
+        tps, step_ms, prefill_ms = _cached_tokens_per_sec(
+            pred, cfg, bs, args.iters)
+        row = {'mode': 'cached', 'config': label, 'slots': bs,
+               'infer_decode_cached_tokens_per_sec': round(tps, 2),
+               'step_ms': round(step_ms, 2),
+               'infer_decode_prefill_ms': round(prefill_ms, 1)}
+        print(json.dumps(row), flush=True)
+        if best is None or tps > best['tps']:
+            best = {'bs': bs, 'tps': tps}
+
+    eng_row = _engine_leg(pred, cfg, slots=batch_sizes[-1],
+                          n_requests=2 * batch_sizes[-1],
+                          new_tokens=4 if args.quick else 16)
+    eng_row.update({'mode': 'engine', 'config': label})
+    print(json.dumps(eng_row), flush=True)
+
+    summary = {'summary': 'acceptance', 'infer_decode_config': label,
+               'infer_decode_recompute_tokens_per_sec':
+                   round(rec_tps, 2),
+               'infer_decode_cached_tokens_per_sec':
+                   round(best['tps'], 2), 'best_slots': best['bs'],
+               'infer_decode_speedup': round(best['tps'] / rec_tps, 2)}
+    print(json.dumps(summary), flush=True)
+    return summary
+
+
+if __name__ == '__main__':
+    main()
