@@ -58,6 +58,7 @@ from . import contrib
 from . import transpiler
 from .transpiler import DistributeTranspiler, DistributeTranspilerConfig
 from . import distributed
+from . import checkpoint
 from . import flags
 from .flags import set_flags, get_flags
 from . import recordio

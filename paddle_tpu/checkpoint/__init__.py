@@ -1,0 +1,22 @@
+"""Mesh-native sharded checkpointing (ROADMAP item 3).
+
+Layering, bottom up:
+
+  manifest.py   the one CHECKPOINT_DIGESTS digest-manifest story shared
+                with the legacy host path (io.py / trainer.py)
+  sharded.py    AsyncShardedSaver — per-shard files, no host gather,
+                async commit, two-generation rotation, OWNER fencing
+  restore.py    topology-change restore — reassemble any region of a
+                var from shard files, reshard onto a new mesh
+  elastic.py    MeshCheckpointer — the Trainer/Supervisor wiring
+
+See README "Sharded checkpointing" for the on-disk layout.
+"""
+from .manifest import CheckpointCorruptError, verify_digests, write_digests
+from .sharded import AsyncShardedSaver, save_sharded
+from .restore import ShardedCheckpoint, load_checkpoint, restore_sharded
+from .elastic import MeshCheckpointer
+
+__all__ = ['CheckpointCorruptError', 'verify_digests', 'write_digests',
+           'AsyncShardedSaver', 'save_sharded', 'ShardedCheckpoint',
+           'load_checkpoint', 'restore_sharded', 'MeshCheckpointer']

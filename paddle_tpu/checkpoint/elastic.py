@@ -1,0 +1,99 @@
+"""Elastic mesh recovery: the Trainer-facing face of the subsystem.
+
+`MeshCheckpointer` binds a checkpoint root to a training scope: it
+knows which scope vars are checkpointable (persistable, not `is_cache`
+— serving KV rings are runtime state, not weights), snapshots them
+per-shard through `AsyncShardedSaver`, and on restart pours the last
+committed generation back into the scope. The Supervisor contract is
+the one the pserver mode proved out in tests/test_chaos.py: the
+restarted worker comes up with a bumped FLAGS_trainer_incarnation, the
+saver's OWNER claim fences any zombie of the old incarnation
+(StaleIncarnationError instead of clobbered generations), the trainer
+fast-forwards its reader to extras['step_id'] + 1, and the run is
+bit-exact against a fault-free one.
+
+Restored values land in the scope as host arrays; the
+ParallelExecutor's `_bcast_params` places them into each var's mesh
+sharding on the first run — device_put resharding is numerically
+exact, so bit-exactness survives the round trip even when the NEW
+mesh has a different topology than the one that saved.
+"""
+from __future__ import annotations
+
+from .. import io as io_mod
+from . import restore as restore_mod
+from .sharded import AsyncShardedSaver
+
+__all__ = ['MeshCheckpointer']
+
+
+class MeshCheckpointer(object):
+
+    def __init__(self, root, incarnation=None, workers=None):
+        self.root = root
+        self._incarnation = incarnation
+        self._workers = workers
+        self._saver = None
+
+    def _get_saver(self):
+        # lazy: the OWNER claim happens on the first SAVE, not at
+        # construction — restore-only users (a predictor loading
+        # weights) must not fence out the trainer that owns the root
+        if self._saver is None:
+            self._saver = AsyncShardedSaver(
+                self.root, incarnation=self._incarnation,
+                workers=self._workers)
+        return self._saver
+
+    @staticmethod
+    def checkpoint_vars(scope, program):
+        """{name: value} of every persistable non-cache var the scope
+        actually holds."""
+        out = {}
+        for var in program.list_vars():
+            if not io_mod.is_persistable(var):
+                continue
+            val = scope.find_var(var.name)
+            if val is not None:
+                out[var.name] = val
+        return out
+
+    def save_scope(self, scope, program, extras=None, block=False):
+        """Snapshot the scope's checkpointable vars as the next
+        generation; returns the generation number."""
+        return self._get_saver().save(
+            self.checkpoint_vars(scope, program), extras=extras,
+            block=block)
+
+    def restore_scope(self, scope, program, mesh=None):
+        """Pour the newest good generation into the scope (only vars
+        the program declares persistable — a stale manifest var that no
+        longer exists in the program is ignored). Returns the
+        checkpoint's extras dict, or None when there is nothing to
+        restore."""
+        ckpt = restore_mod.load_checkpoint(self.root)
+        if ckpt is None:
+            return None
+        wanted = {v.name for v in program.list_vars()
+                  if io_mod.is_persistable(v)}
+        for name in ckpt.var_names():
+            if name not in wanted:
+                continue
+            if mesh is not None:
+                scope.set_var(name, ckpt.as_jax(name, mesh))
+            else:
+                scope.set_var(name, ckpt.read(name))
+        return dict(ckpt.extras or {})
+
+    def wait(self):
+        if self._saver is not None:
+            self._saver.wait()
+
+    def close(self):
+        if self._saver is not None:
+            self._saver.close()
+            self._saver = None
+
+    @property
+    def last_stats(self):
+        return self._saver.last_stats if self._saver is not None else None

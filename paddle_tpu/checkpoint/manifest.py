@@ -1,0 +1,136 @@
+"""The ONE digest-manifest story shared by every checkpoint flavor.
+
+Both checkpoint paths — the legacy host path (`io.save_persistables`
+into a flat dir, `Trainer`'s `checkpoint_<n>` dirs) and the mesh path
+(`checkpoint/sharded.py` per-shard generation dirs) — record the same
+`CHECKPOINT_DIGESTS` manifest: a flat JSON map
+
+    {"<relpath>": [crc32, size], ...}
+
+over every payload file in the directory, written AFTER the payloads
+land and BEFORE the commit marker (`_SUCCESS` / `COMMIT`). The marker
+alone only proves a save COMPLETED; the manifest is how a later load
+tells silent corruption (bad disk, truncating copy, stray write) from
+a clean save and falls back to an older generation instead of loading
+garbage.
+
+Verification failures raise (or return a reason naming) the offending
+var AND file — one error message format for the host path, the Trainer
+resume path and the mesh restore path.
+"""
+from __future__ import annotations
+
+import json
+import os
+
+from ..integrity import crc32_file
+
+__all__ = ['DIGESTS_FILE', 'CheckpointCorruptError', 'write_digests',
+           'read_digests', 'verify_digests', 'verify_or_raise']
+
+DIGESTS_FILE = 'CHECKPOINT_DIGESTS'
+
+# never digested: commit markers and the manifest itself
+_MARKERS = (DIGESTS_FILE, '_SUCCESS', 'COMMIT', 'OWNER')
+
+
+class CheckpointCorruptError(RuntimeError):
+    """A checkpoint payload does not match its recorded digest (or is
+    missing). Carries the checkpoint dir, the offending relpath, and —
+    when the caller can name it — the var the file holds."""
+
+    def __init__(self, reason, path=None, file=None, var=None):
+        super(CheckpointCorruptError, self).__init__(reason)
+        self.path = path
+        self.file = file
+        self.var = var
+
+
+def _walk_payload_files(dirname):
+    out = []
+    for root, _dirs, files in os.walk(dirname):
+        for fn in files:
+            if fn in _MARKERS or fn.endswith('.crc'):
+                continue
+            out.append(os.path.relpath(os.path.join(root, fn), dirname))
+    return out
+
+
+def write_digests(dirname, files=None, merge=False):
+    """Write (or, with merge=True, update) `<dirname>/CHECKPOINT_DIGESTS`
+    covering `files` (relpaths; default: every payload file under the
+    dir). merge keeps existing entries for files NOT in this batch —
+    the io.save_vars path uses it so `save_inference_model`'s
+    `__model__` and a later `save_persistables` into the same dir share
+    one manifest."""
+    if files is None:
+        files = _walk_payload_files(dirname)
+    digests = {}
+    if merge:
+        digests = read_digests(dirname) or {}
+    for rel in files:
+        crc, size = crc32_file(os.path.join(dirname, rel))
+        digests[rel] = [crc, size]
+    with open(os.path.join(dirname, DIGESTS_FILE), 'w') as f:
+        json.dump(digests, f)
+    return digests
+
+
+def read_digests(dirname):
+    """The manifest dict, or None when the dir predates digests."""
+    path = os.path.join(dirname, DIGESTS_FILE)
+    if not os.path.exists(path):
+        return None
+    with open(path) as f:
+        return json.load(f)
+
+
+def verify_digests(dirname, files=None, var_of=None):
+    """None if every covered file matches its digest, else a reason
+    string naming the file (and its var, when `var_of(relpath)` can).
+    `files` restricts the check to a subset (a load that only reads
+    some vars need not pay for the rest). A dir with NO manifest
+    verifies clean — pre-digest checkpoints stay loadable."""
+    try:
+        digests = read_digests(dirname)
+    except (OSError, ValueError) as e:
+        return 'unreadable digest manifest: %r' % e
+    if digests is None:
+        return None
+
+    def _name(rel):
+        var = var_of(rel) if var_of is not None else None
+        return '%s (var %s)' % (rel, var) if var else rel
+
+    if files is None:
+        files = sorted(digests)
+    for rel in files:
+        if rel not in digests:
+            # a file the manifest never covered (written by an older
+            # save, or outside this path's responsibility): skip — the
+            # manifest can only vouch for what it recorded
+            continue
+        crc, size = digests[rel]
+        fp = os.path.join(dirname, rel)
+        if not os.path.exists(fp):
+            return 'missing payload file %s' % _name(rel)
+        got_crc, got_size = crc32_file(fp)
+        if got_crc != int(crc) or got_size != int(size):
+            return 'digest mismatch on %s' % _name(rel)
+    return None
+
+
+def verify_or_raise(dirname, files=None, var_of=None):
+    """verify_digests, raising CheckpointCorruptError on failure."""
+    reason = verify_digests(dirname, files=files, var_of=var_of)
+    if reason is not None:
+        file = var = None
+        for rel in (files if files is not None
+                    else sorted(read_digests(dirname) or {})):
+            if rel in reason:
+                file = rel
+                var = var_of(rel) if var_of is not None else None
+                break
+        raise CheckpointCorruptError(
+            'corrupt checkpoint %s: %s' % (dirname, reason),
+            path=dirname, file=file, var=var)

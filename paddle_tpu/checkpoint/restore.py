@@ -1,0 +1,225 @@
+"""Topology-change restore for sharded checkpoints.
+
+A generation written by `checkpoint/sharded.py` is self-describing:
+MANIFEST.json records, per var, the global shape/dtype/PartitionSpec
+and the index box each shard file covers. Restore therefore never
+needs the saving mesh to exist again — it assembles whatever REGION of
+the global value a reader asks for from the shard files that overlap
+it, which is how an n=8-mesh checkpoint loads onto an n=4 (or n=16, or
+single-device) mesh: `as_jax` hands `jax.make_array_from_callback` a
+per-device-slice reader, so each device of the NEW mesh reads only its
+own slice and the full value is never materialized on the host either.
+The recorded spec is adapted to the new mesh by `parallel.mesh.fit_spec`
+(axes the new mesh lacks, or that no longer divide the dim, fall away).
+
+Trust order mirrors the pserver snapshot fallback: `current/` is only
+eligible if its `COMMIT` marker exists AND every file matches the
+`CHECKPOINT_DIGESTS` manifest; a failed generation is quarantined
+aside (`statefile.quarantine_dir`) and `current.prev/` is tried next.
+Both bad -> None, and the caller cold-starts.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import numpy as np
+
+import jax
+
+from ..distributed import statefile
+from ..obs import telemetry, trace
+from ..parallel import mesh as mesh_mod
+from . import manifest
+from .sharded import COMMIT_FILE, CURRENT_DIR, MANIFEST_FILE, PREV_DIR
+
+__all__ = ['ShardedCheckpoint', 'load_checkpoint', 'restore_sharded']
+
+_RESTORE_LATENCY = telemetry.histogram('ckpt.restore_latency')
+
+
+class ShardedCheckpoint(object):
+    """One committed, digest-verified generation, opened for reading."""
+
+    def __init__(self, dirname, man):
+        self.dirname = dirname
+        self.manifest = man
+        self.generation = int(man.get('generation', 0))
+        self.extras = man.get('extras', {})
+        self._vars = man['vars']
+
+    def var_names(self):
+        return sorted(self._vars)
+
+    def __contains__(self, name):
+        return name in self._vars
+
+    def spec_of(self, name):
+        spec = self._vars[name]['spec']
+        if spec is None:
+            return None
+        return tuple(tuple(e) if isinstance(e, list) else e for e in spec)
+
+    def shape_of(self, name):
+        return tuple(self._vars[name]['shape'])
+
+    def dtype_of(self, name):
+        return np.dtype(self._vars[name]['dtype'])
+
+    def _read_shard(self, rec, name):
+        entry = self._vars[name]
+        dtype = np.dtype(entry['dtype'])
+        box = rec['index']
+        shard_shape = tuple(int(b[1]) - int(b[0]) for b in box)
+        path = os.path.join(self.dirname, rec['file'])
+        with open(path, 'rb') as f:
+            data = f.read()
+        want = int(np.prod(shard_shape, dtype=np.int64)) * dtype.itemsize \
+            if shard_shape else dtype.itemsize
+        if len(data) != want:
+            raise manifest.CheckpointCorruptError(
+                'shard file %s for var %s holds %d bytes, expected %d'
+                % (rec['file'], name, len(data), want),
+                path=self.dirname, file=rec['file'], var=name)
+        return np.frombuffer(data, dtype=dtype).reshape(shard_shape)
+
+    def read_slice(self, name, index):
+        """Assemble the region `index` (tuple of slices over the global
+        shape) of var `name` from the shard files that overlap it. Host
+        memory cost = the requested region, never the global value
+        (unless the region IS the global value)."""
+        entry = self._vars[name]
+        shape = tuple(entry['shape'])
+        dtype = np.dtype(entry['dtype'])
+        req = []
+        for sl, dim in zip(index, shape):
+            start, stop, _ = sl.indices(dim)
+            req.append((int(start), int(stop)))
+        out_shape = tuple(b - a for a, b in req)
+        out = np.empty(out_shape, dtype=dtype)
+        covered = 0
+        for rec in entry['shards']:
+            box = [(int(b[0]), int(b[1])) for b in rec['index']]
+            inter = [(max(a0, b0), min(a1, b1))
+                     for (a0, a1), (b0, b1) in zip(req, box)]
+            if any(a >= b for a, b in inter):
+                continue
+            shard = self._read_shard(rec, name)
+            src = tuple(slice(a - b0, b - b0)
+                        for (a, b), (b0, _b1) in zip(inter, box))
+            dst = tuple(slice(a - r0, b - r0)
+                        for (a, b), (r0, _r1) in zip(inter, req))
+            out[dst] = shard[src]
+            covered += int(np.prod([b - a for a, b in inter],
+                                   dtype=np.int64)) if inter else 1
+        want = int(np.prod(out_shape, dtype=np.int64)) if out_shape else 1
+        if not out_shape and entry['shards']:
+            # rank-0: a single shard file holds the scalar
+            out = self._read_shard(entry['shards'][0], name).reshape(())
+            covered = 1
+        if covered < want:
+            raise manifest.CheckpointCorruptError(
+                'shard files for var %s cover only %d of %d elements of '
+                'region %r' % (name, covered, want, req),
+                path=self.dirname, var=name)
+        return out
+
+    def read(self, name):
+        """The full global value of `name` as one host array (reference
+        comparisons, host-path interop). For device loading prefer
+        `as_jax`, which keeps host traffic per-device-slice."""
+        shape = self.shape_of(name)
+        return self.read_slice(name, tuple(slice(0, d) for d in shape))
+
+    def as_jax(self, name, mesh, spec=None):
+        """The var resharded onto `mesh`: spec defaults to the one
+        recorded at save, adapted by fit_spec to the new topology; each
+        device's slice is read straight from the overlapping shard
+        files (no global host value)."""
+        shape = self.shape_of(name)
+        if spec is None:
+            spec = self.spec_of(name)
+        spec = mesh_mod.fit_spec(spec, shape, mesh)
+        sharding = mesh_mod.named_sharding(mesh, spec)
+        dtype = self.dtype_of(name)
+
+        def cb(index):
+            # np.asarray(order='C'), not ascontiguousarray: the latter
+            # promotes 0-d (scalar vars) to 1-d
+            return np.asarray(
+                self.read_slice(name, index).astype(dtype, copy=False),
+                order='C')
+
+        return jax.make_array_from_callback(shape, sharding, cb)
+
+
+def _try_open(dirname):
+    """-> ShardedCheckpoint | None (missing) | str reason (corrupt)."""
+    if not os.path.isdir(dirname):
+        return None
+    if not os.path.exists(os.path.join(dirname, COMMIT_FILE)):
+        return 'no COMMIT marker (save never finished)'
+    man = None
+
+    def _var_of(rel):
+        if not man:
+            return None
+        for vname, entry in man.get('vars', {}).items():
+            if any(rec['file'] == rel for rec in entry['shards']):
+                return vname
+        return None
+
+    try:
+        with open(os.path.join(dirname, MANIFEST_FILE)) as f:
+            man = json.load(f)
+    except (OSError, ValueError) as e:
+        return 'unreadable MANIFEST.json: %r' % e
+    reason = manifest.verify_digests(dirname, var_of=_var_of)
+    if reason is not None:
+        return reason
+    return ShardedCheckpoint(dirname, man)
+
+
+def load_checkpoint(root, quarantine=True):
+    """Open the newest trustworthy generation under `root`: `current/`,
+    else (after quarantining the corrupt dir aside) `current.prev/`,
+    else None. A generation with no COMMIT marker is skipped silently —
+    an unfinished save is expected after a crash, not corruption."""
+    t0 = time.time()
+    ckpt = None
+    with trace.span('ckpt.restore.open', root=root):
+        for sub in (CURRENT_DIR, PREV_DIR):
+            dirname = os.path.join(root, sub)
+            got = _try_open(dirname)
+            if isinstance(got, ShardedCheckpoint):
+                ckpt = got
+                break
+            if isinstance(got, str):
+                if 'COMMIT' in got:
+                    continue
+                if quarantine:
+                    statefile.quarantine_dir(dirname, got)
+    if ckpt is not None:
+        _RESTORE_LATENCY.observe(time.time() - t0)
+    return ckpt
+
+
+def restore_sharded(root, mesh=None, specs=None, names=None):
+    """Convenience: open the newest good generation and return
+    ({name: value}, extras, generation) — values are resharded
+    jax.Arrays when `mesh` is given, host np arrays otherwise. `specs`
+    overrides the recorded PartitionSpec per var; `names` restricts the
+    load. Returns (None, None, 0) when no generation is loadable."""
+    ckpt = load_checkpoint(root)
+    if ckpt is None:
+        return None, None, 0
+    out = {}
+    with trace.span('ckpt.restore.read', gen=ckpt.generation):
+        for name in (names if names is not None else ckpt.var_names()):
+            if mesh is not None:
+                spec = (specs or {}).get(name)
+                out[name] = ckpt.as_jax(name, mesh, spec=spec)
+            else:
+                out[name] = ckpt.read(name)
+    return out, ckpt.extras, ckpt.generation

@@ -1,0 +1,339 @@
+"""Per-shard async checkpointing of sharded jax.Arrays — no host gather.
+
+The pserver snapshot path (distributed/statefile.py) assumes the full
+value of every var fits, gathered, in one host buffer. Under a GSPMD
+mesh that gather is exactly the thing a sharded model exists to avoid:
+an 8-way-sharded param would materialize 8x its shard footprint on one
+host just to hit disk. Here each process instead writes ONLY its
+addressable shards — one flat `.bin` file per param-shard, raw
+row-major bytes — and a JSON `MANIFEST.json` records, per var, the
+global shape, dtype, `PartitionSpec` and the index box each shard file
+covers, which is everything restore needs to reassemble the global
+value on ANY later mesh (checkpoint/restore.py).
+
+Durability reuses the story the host path already proved out:
+
+  * every payload + the manifest is covered by a flat
+    `CHECKPOINT_DIGESTS` crc manifest (checkpoint/manifest.py);
+  * a `COMMIT` marker is written LAST inside the staging dir, so a
+    half-written generation is never eligible for restore;
+  * two generations are kept (`current/`, `current.prev/`) and rotated
+    by directory rename — a crash between renames loses at most the
+    newest generation, and restore falls back to `.prev` on corruption
+    exactly as the pserver falls back to its previous snapshot;
+  * an `OWNER` file fences stale incarnations: a zombie trainer whose
+    replacement (higher FLAGS_trainer_incarnation) has already claimed
+    the root gets StaleIncarnationError instead of clobbering the
+    successor's generations.
+
+The training step is blocked only for the device->host shard copies
+(`snapshot`): shard buffers must be copied BEFORE the step returns
+because the executor donates scope arrays into the next step, so a
+deferred device read would touch deleted buffers. Everything after the
+copy — file writes, digests, commit, rotation — runs on a background
+pool (FLAGS_ckpt_async_workers) and overlaps the next steps; `wait()`
+drains and re-raises any async failure.
+"""
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+
+from .. import flags
+from ..distributed import statefile
+from ..distributed.resilience import StaleIncarnationError
+from ..obs import telemetry, trace
+from . import manifest
+
+__all__ = ['AsyncShardedSaver', 'save_sharded', 'MANIFEST_FILE',
+           'COMMIT_FILE', 'OWNER_FILE', 'CURRENT_DIR', 'PREV_DIR']
+
+MANIFEST_FILE = 'MANIFEST.json'
+COMMIT_FILE = 'COMMIT'
+OWNER_FILE = 'OWNER'
+CURRENT_DIR = 'current'
+PREV_DIR = 'current.prev'
+MANIFEST_FORMAT = 1
+
+_SAVE_LATENCY = telemetry.histogram('ckpt.save_latency')
+_BYTES_WRITTEN = telemetry.histogram('ckpt.bytes_written')
+_GENERATIONS = telemetry.counter('ckpt.generations')
+
+
+def _spec_to_json(sharding):
+    """PartitionSpec -> JSON list (entries: axis name, list of names for
+    a multi-axis dim, or None). None for non-Named shardings."""
+    spec = getattr(sharding, 'spec', None)
+    if spec is None:
+        return None
+    out = []
+    for entry in spec:
+        if entry is None:
+            out.append(None)
+        elif isinstance(entry, (tuple, list)):
+            out.append([str(e) for e in entry])
+        else:
+            out.append(str(entry))
+    return out
+
+
+def _normalize_index(index, shape):
+    """Shard index (tuple of slices; replicated dims carry
+    slice(None)) -> [[start, stop], ...] over the global shape."""
+    out = []
+    for sl, dim in zip(index, shape):
+        start, stop, _step = sl.indices(dim)
+        out.append([int(start), int(stop)])
+    return out
+
+
+def _shard_filename(name, box):
+    safe = name.replace('/', '__')
+    starts = '_'.join(str(b[0]) for b in box)
+    return '%s.s%s.bin' % (safe, starts)
+
+
+class AsyncShardedSaver(object):
+    """Owns one checkpoint root; save() snapshots shards to host
+    synchronously and commits the generation asynchronously."""
+
+    def __init__(self, root, incarnation=None, workers=None):
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+        self.incarnation = int(
+            flags.get_flag('trainer_incarnation', 0)
+            if incarnation is None else incarnation)
+        self._claim_owner()
+        self._pool = ThreadPoolExecutor(
+            max_workers=int(flags.get_flag('ckpt_async_workers', 2)
+                            if workers is None else workers),
+            thread_name_prefix='ckpt-save')
+        self._lock = threading.Lock()   # serializes commit rotations
+        self._pending = []
+        self._error = None
+        self.generation = self._last_committed_generation() + 1
+        self.last_stats = None
+
+    # -- fencing ----------------------------------------------------------
+
+    def _owner_path(self):
+        return os.path.join(self.root, OWNER_FILE)
+
+    def _claim_owner(self):
+        owner = statefile.read_json(self._owner_path())
+        if owner and int(owner.get('incarnation', -1)) > self.incarnation:
+            raise StaleIncarnationError(
+                'checkpoint root %s is owned by incarnation %s; this '
+                'process is stale incarnation %d'
+                % (self.root, owner['incarnation'], self.incarnation))
+        statefile.atomic_write_json(
+            self._owner_path(),
+            {'incarnation': self.incarnation, 'pid': os.getpid()})
+
+    def _check_fence(self):
+        """Re-read OWNER right before a commit rotation: a successor
+        incarnation may have claimed the root while this save's write
+        was in flight — its generations must win."""
+        owner = statefile.read_json(self._owner_path())
+        if owner and int(owner.get('incarnation', -1)) > self.incarnation:
+            raise StaleIncarnationError(
+                'fenced: checkpoint root %s now owned by incarnation %s '
+                '(this process is %d)'
+                % (self.root, owner['incarnation'], self.incarnation))
+
+    # -- generation bookkeeping -------------------------------------------
+
+    def _last_committed_generation(self):
+        cur = os.path.join(self.root, CURRENT_DIR)
+        if os.path.exists(os.path.join(cur, COMMIT_FILE)):
+            m = statefile.read_json(os.path.join(cur, MANIFEST_FILE))
+            if m:
+                return int(m.get('generation', 0))
+        return 0
+
+    # -- save -------------------------------------------------------------
+
+    def snapshot(self, arrays):
+        """Synchronous device->host copy of the addressable, replica-0
+        shards of each array. This is the ONLY part that blocks the
+        training step, and the largest single host allocation it makes
+        is one shard — never the global value (the no-host-gather
+        contract; `stats['max_host_bytes']` proves it)."""
+        snap = {}
+        max_host = 0
+        for name, arr in arrays.items():
+            shape = tuple(int(d) for d in np.shape(arr))
+            shards = []
+            seen = set()
+            if not hasattr(arr, 'addressable_shards'):
+                # host value (startup-initialized, before the first mesh
+                # run): one shard covering the whole box
+                host = np.asarray(arr)
+                max_host = max(max_host, host.nbytes)
+                snap[name] = {
+                    'shape': shape,
+                    'dtype': str(host.dtype),
+                    'spec': None,
+                    'shards': [([[0, d] for d in shape], host)],
+                }
+                continue
+            for s in arr.addressable_shards:
+                if s.replica_id != 0:
+                    continue
+                box = _normalize_index(s.index, shape)
+                key = tuple(tuple(b) for b in box)
+                if key in seen:
+                    continue
+                seen.add(key)
+                host = np.asarray(s.data)
+                max_host = max(max_host, host.nbytes)
+                shards.append((box, host))
+            snap[name] = {
+                'shape': shape,
+                'dtype': str(np.dtype(arr.dtype)),
+                'spec': _spec_to_json(getattr(arr, 'sharding', None)),
+                'shards': shards,
+            }
+        return snap, max_host
+
+    def save(self, arrays, extras=None, block=False):
+        """Checkpoint `arrays` ({name: jax.Array}) as the next
+        generation. `extras` is an opaque JSON dict carried in the
+        manifest (step counters, rng state, ...). Returns the
+        generation number. With block=True the commit completes before
+        returning; otherwise it rides the background pool."""
+        self._raise_pending_error()
+        t0 = time.time()
+        gen = self.generation
+        self.generation += 1
+        with trace.span('ckpt.snapshot', gen=gen):
+            snap, max_host = self.snapshot(arrays)
+        fut = self._pool.submit(self._write_and_commit, gen, snap,
+                                dict(extras or {}), max_host, t0)
+        self._pending.append(fut)
+        self._pending = [f for f in self._pending if not f.done()]
+        if block:
+            fut.result()
+            self._raise_pending_error()
+        return gen
+
+    def _write_and_commit(self, gen, snap, extras, max_host, t0):
+        try:
+            with trace.span('ckpt.write', gen=gen):
+                self._do_write_and_commit(gen, snap, extras, max_host, t0)
+        except BaseException as e:
+            self._error = e
+            raise
+
+    def _do_write_and_commit(self, gen, snap, extras, max_host, t0):
+        staging = os.path.join(self.root,
+                               '.staging-%d-%d' % (os.getpid(), gen))
+        os.makedirs(staging, exist_ok=True)
+        total_bytes = 0
+        man_vars = {}
+        for name, entry in snap.items():
+            shard_recs = []
+            for box, host in entry['shards']:
+                fname = _shard_filename(name, box)
+                data = np.ascontiguousarray(host).tobytes()
+                with statefile.atomic_replace(
+                        os.path.join(staging, fname)) as f:
+                    f.write(data)
+                total_bytes += len(data)
+                shard_recs.append({'file': fname, 'index': box})
+            man_vars[name] = {
+                'shape': list(entry['shape']),
+                'dtype': entry['dtype'],
+                'spec': entry['spec'],
+                'shards': shard_recs,
+            }
+        statefile.atomic_write_json(os.path.join(staging, MANIFEST_FILE), {
+            'format': MANIFEST_FORMAT,
+            'generation': gen,
+            'incarnation': self.incarnation,
+            'time': time.time(),
+            'extras': extras,
+            'vars': man_vars,
+        })
+        # digests cover every payload INCLUDING the manifest payload
+        # files; COMMIT lands strictly last
+        manifest.write_digests(staging)
+        with open(os.path.join(staging, COMMIT_FILE), 'w') as f:
+            f.write('%d\n' % gen)
+            f.flush()
+            os.fsync(f.fileno())
+        superseded = False
+        with self._lock:
+            self._check_fence()
+            if self._last_committed_generation() >= gen:
+                # out-of-order pool scheduling: a NEWER generation
+                # already committed while this one was writing —
+                # installing this one would roll current/ BACKWARDS.
+                # Newest-wins; this generation is dropped whole.
+                shutil.rmtree(staging, ignore_errors=True)
+                superseded = True
+            else:
+                self._rotate(staging)
+        self.last_stats = {
+            'generation': gen,
+            'bytes': total_bytes,
+            'files': sum(len(v['shards']) for v in man_vars.values()),
+            'max_host_bytes': max_host,
+            'latency': time.time() - t0,
+            'superseded': superseded,
+        }
+        _SAVE_LATENCY.observe(self.last_stats['latency'])
+        _BYTES_WRITTEN.observe(total_bytes)
+        if not superseded:
+            _GENERATIONS.inc()
+
+    def _rotate(self, staging):
+        """staging -> current, demoting current -> current.prev. A crash
+        between the two renames leaves prev missing or current missing
+        for a moment — restore tolerates both (it tries current, then
+        prev, and a missing dir just means that generation is gone)."""
+        cur = os.path.join(self.root, CURRENT_DIR)
+        prev = os.path.join(self.root, PREV_DIR)
+        if os.path.exists(cur):
+            if os.path.exists(prev):
+                shutil.rmtree(prev)
+            os.replace(cur, prev)
+        os.replace(staging, cur)
+
+    # -- completion -------------------------------------------------------
+
+    def _raise_pending_error(self):
+        if self._error is not None:
+            err, self._error = self._error, None
+            raise err
+
+    def wait(self):
+        """Drain in-flight saves; re-raise the first async failure."""
+        pending, self._pending = self._pending, []
+        for fut in pending:
+            try:
+                fut.result()
+            except BaseException:
+                pass   # surfaced via _raise_pending_error below
+        self._raise_pending_error()
+
+    def close(self):
+        self.wait()
+        self._pool.shutdown(wait=True)
+
+
+def save_sharded(root, arrays, extras=None, incarnation=None):
+    """One-shot blocking save (tools/tests); Trainer holds a long-lived
+    AsyncShardedSaver instead."""
+    saver = AsyncShardedSaver(root, incarnation=incarnation)
+    try:
+        gen = saver.save(arrays, extras=extras, block=True)
+    finally:
+        saver.close()
+    return gen

@@ -110,6 +110,31 @@ def move_with_digest(src, dst):
             pass
 
 
+def quarantine_dir(path, reason):
+    """Directory flavor of `quarantine`: rename a corrupt checkpoint
+    directory aside to `<path>.corrupt` (suffixed `-N` if that name is
+    taken) so recovery can fall back to an older generation while the
+    bytes stay on disk for post-mortem. Returns the quarantine path, or
+    None if the dir vanished underneath us."""
+    qpath = path + '.corrupt'
+    n = 0
+    while os.path.exists(qpath):
+        n += 1
+        qpath = '%s.corrupt-%d' % (path, n)
+    try:
+        os.replace(path, qpath)
+    except OSError as e:
+        sys.stderr.write('WARNING: could not quarantine dir %s (%s): %s\n'
+                         % (path, reason, e))
+        return None
+    from ..obs import telemetry
+    telemetry.counter('ps.snapshot.quarantines').inc()
+    sys.stderr.write('WARNING: quarantined corrupt checkpoint dir %s -> %s '
+                     '(%s); kept for post-mortem\n' % (path, qpath, reason))
+    sys.stderr.flush()
+    return qpath
+
+
 def quarantine(path, reason):
     """Rename a corrupt file (and its sidecar) aside to `<path>.corrupt`
     — loudly. The bytes stay on disk for post-mortem; the original name

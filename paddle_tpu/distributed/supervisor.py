@@ -75,13 +75,19 @@ class Supervisor(object):
 
     def __init__(self, max_restarts=3, backoff=0.5,
                  backoff_multiplier=2.0, max_backoff=10.0, log_dir=None,
-                 clear_fault_plan_on_restart=True, obs_dir=None):
+                 clear_fault_plan_on_restart=True, obs_dir=None,
+                 clear_env_on_restart=()):
         self.max_restarts = int(max_restarts)
         self.backoff = float(backoff)
         self.backoff_multiplier = float(backoff_multiplier)
         self.max_backoff = float(max_backoff)
         self.log_dir = log_dir
         self.clear_fault_plan_on_restart = clear_fault_plan_on_restart
+        # extra env vars dropped from every RESTART environment (the
+        # FLAGS_fault_plan strip, generalized): anything that must only
+        # apply to the FIRST incarnation — a one-shot kill trigger, a
+        # cold-start-only knob — goes here
+        self.clear_env_on_restart = tuple(clear_env_on_restart)
         self.obs_dir = obs_dir
         self._roles = []
         self._lock = threading.Lock()
@@ -122,6 +128,8 @@ class Supervisor(object):
             env['FLAGS_trainer_incarnation'] = str(role.restarts)
             if self.clear_fault_plan_on_restart:
                 env.pop('FLAGS_fault_plan', None)
+            for key in self.clear_env_on_restart:
+                env.pop(key, None)
         if self.obs_dir:
             # one obs subdir per role: each incarnation appends its own
             # metrics-/events- files there (filenames carry the pid),

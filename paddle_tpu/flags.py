@@ -104,6 +104,18 @@ Known flags:
                          unboundedly
   serving_idle_wait      seconds an idle serving worker sleeps between
                          queue polls
+  ckpt_verify            legacy host checkpoint path (io.py): write a
+                         CHECKPOINT_DIGESTS manifest on save_vars and
+                         verify it before load_vars, sharing the mesh
+                         path's verification story (CheckpointCorrupt-
+                         Error naming the offending var + file)
+  ckpt_async_workers     background writer threads per AsyncSharded-
+                         Saver (checkpoint/sharded.py): file I/O,
+                         digests and generation rotation overlap the
+                         next training steps
+  mesh_shape             MeshConfig.from_flags axis spec, e.g.
+                         'dp=2,tp=2' ('' = pure data parallelism over
+                         every local device)
 """
 from __future__ import annotations
 
@@ -218,6 +230,12 @@ _DEFAULTS = {
     'serving_prefill_batch': 1,
     'serving_max_queue': 256,
     'serving_idle_wait': 0.05,
+    # sharded checkpointing (paddle_tpu/checkpoint/): digest-verify the
+    # legacy host save/load path, async writer pool size, and the
+    # MeshConfig.from_flags axis spec ('dp=2,tp=2'; '' = pure dp)
+    'ckpt_verify': False,
+    'ckpt_async_workers': 2,
+    'mesh_shape': '',
     # observability (paddle_tpu/obs/): JSONL export root ('' = off),
     # per-process lane label, and metric export cadence
     'obs_dir': '',

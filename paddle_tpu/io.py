@@ -9,9 +9,11 @@ them; the tensor file format lives in ops/io_ops.py.
 from __future__ import annotations
 
 import os
+import sys
 
 from .framework import (Program, Parameter, Variable, default_main_program,
                         program_guard)
+from .flags import get_flag
 
 __all__ = ['save_vars', 'save_params', 'save_persistables', 'load_vars',
            'load_params', 'load_persistables', 'save_inference_model',
@@ -54,50 +56,86 @@ def _build_io_program(main_program, vars, dirname, filename, op_type):
     return prog
 
 
-def save_vars(executor, dirname, main_program=None, vars=None,
-              predicate=None, filename=None):
-    main_program = main_program or default_main_program()
+def _select_vars(main_program, vars, predicate, filter_fn):
+    """predicate picks the base var set (persistables, params, ...);
+    filter_fn composes on top — the caller's hook to exclude (or keep
+    only) some of them without re-stating the base rule."""
     if vars is None:
         vars = [v for v in main_program.list_vars()
                 if predicate is None or predicate(v)]
     else:
         vars = [main_program.global_block().var(v) if isinstance(v, str)
                 else v for v in vars]
+    if filter_fn is not None:
+        vars = [v for v in vars if filter_fn(v)]
+    return vars
+
+
+def _io_files(vars, filename):
+    return [filename] if filename is not None else [v.name for v in vars]
+
+
+def save_vars(executor, dirname, main_program=None, vars=None,
+              predicate=None, filename=None, filter_fn=None):
+    main_program = main_program or default_main_program()
+    vars = _select_vars(main_program, vars, predicate, filter_fn)
     prog = _build_io_program(main_program, vars, dirname, filename, 'save')
     executor.run(prog)
+    if get_flag('ckpt_verify', False):
+        # record the just-written files in the dir's CHECKPOINT_DIGESTS
+        # (merging: __model__ from save_inference_model and a later
+        # save_persistables into the same dir share one manifest) —
+        # the same verification story as the mesh path
+        from .checkpoint import manifest
+        manifest.write_digests(dirname, files=_io_files(vars, filename),
+                               merge=True)
 
 
-def save_params(executor, dirname, main_program=None, filename=None):
+def save_params(executor, dirname, main_program=None, filename=None,
+                filter_fn=None):
     save_vars(executor, dirname, main_program, predicate=is_parameter,
-              filename=filename)
+              filename=filename, filter_fn=filter_fn)
 
 
-def save_persistables(executor, dirname, main_program=None, filename=None):
+def save_persistables(executor, dirname, main_program=None, filename=None,
+                      filter_fn=None):
     save_vars(executor, dirname, main_program, predicate=is_persistable,
-              filename=filename)
+              filename=filename, filter_fn=filter_fn)
 
 
 def load_vars(executor, dirname, main_program=None, vars=None,
-              predicate=None, filename=None):
+              predicate=None, filename=None, filter_fn=None):
     main_program = main_program or default_main_program()
-    if vars is None:
-        vars = [v for v in main_program.list_vars()
-                if predicate is None or predicate(v)]
-    else:
-        vars = [main_program.global_block().var(v) if isinstance(v, str)
-                else v for v in vars]
+    vars = _select_vars(main_program, vars, predicate, filter_fn)
+    if get_flag('ckpt_verify', False):
+        # verify exactly the files this load is about to read BEFORE
+        # any of them reaches the scope; a mismatch raises
+        # CheckpointCorruptError naming the var + file
+        from .checkpoint import manifest
+        names = {v.name for v in vars}
+        if manifest.read_digests(dirname) is None:
+            sys.stderr.write(
+                'WARNING: FLAGS_ckpt_verify set but %s has no %s '
+                'manifest (pre-digest save?); loading unverified\n'
+                % (dirname, manifest.DIGESTS_FILE))
+        else:
+            manifest.verify_or_raise(
+                dirname, files=_io_files(vars, filename),
+                var_of=lambda rel: rel if rel in names else None)
     prog = _build_io_program(main_program, vars, dirname, filename, 'load')
     executor.run(prog)
 
 
-def load_params(executor, dirname, main_program=None, filename=None):
+def load_params(executor, dirname, main_program=None, filename=None,
+                filter_fn=None):
     load_vars(executor, dirname, main_program, predicate=is_parameter,
-              filename=filename)
+              filename=filename, filter_fn=filter_fn)
 
 
-def load_persistables(executor, dirname, main_program=None, filename=None):
+def load_persistables(executor, dirname, main_program=None, filename=None,
+                      filter_fn=None):
     load_vars(executor, dirname, main_program, predicate=is_persistable,
-              filename=filename)
+              filename=filename, filter_fn=filter_fn)
 
 
 def save_inference_model(dirname, feeded_var_names, target_vars, executor,

@@ -18,7 +18,10 @@ into the cluster rollup.
 
 Naming convention: dotted series names, subsystem first —
 `wire.frames_out`, `rpc.client.retries`, `ps.journal.appends`,
-`trainer.step_latency` (see README "Observability" for the catalog).
+`trainer.step_latency`, and the sharded-checkpoint family
+`ckpt.save_latency` / `ckpt.bytes_written` / `ckpt.restore_latency`
+(histograms) + `ckpt.generations` (counter) from paddle_tpu/checkpoint/
+(see README "Observability" for the catalog).
 """
 from __future__ import annotations
 

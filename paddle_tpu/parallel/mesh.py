@@ -15,7 +15,7 @@ import numpy as np
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
-__all__ = ['MeshConfig', 'get_mesh', 'set_mesh', 'mesh_scope']
+__all__ = ['MeshConfig', 'get_mesh', 'set_mesh', 'mesh_scope', 'fit_spec']
 
 # canonical axis order, outermost first
 AXIS_ORDER = ('pp', 'dp', 'ep', 'sp', 'tp')
@@ -32,6 +32,28 @@ class MeshConfig(object):
         self.axis_sizes = {ax: int(axis_sizes.get(ax, 1))
                            for ax in AXIS_ORDER}
         self.devices = devices
+
+    @classmethod
+    def from_flags(cls, devices=None):
+        """Build from FLAGS_mesh_shape ('dp=2,tp=4'; '' = pure data
+        parallelism over every local device) so tools/tests construct
+        meshes without hand-wiring axis sizes."""
+        from .. import flags
+        shape = str(flags.get_flag('mesh_shape', '') or '').strip()
+        if not shape:
+            n = len(devices) if devices is not None else len(jax.devices())
+            return cls(devices=devices, dp=n)
+        sizes = {}
+        for part in shape.split(','):
+            part = part.strip()
+            if not part:
+                continue
+            if '=' not in part:
+                raise ValueError(
+                    'FLAGS_mesh_shape entry %r is not axis=size' % part)
+            ax, n = part.split('=', 1)
+            sizes[ax.strip()] = int(n)
+        return cls(devices=devices, **sizes)
 
     @property
     def size(self):
@@ -66,7 +88,12 @@ def get_mesh():
 
 @contextlib.contextmanager
 def mesh_scope(mesh):
+    """Install `mesh` (a jax Mesh, or a MeshConfig to build) as the
+    current mesh for the scope; the previous mesh is restored even when
+    the body raises."""
     global _current_mesh
+    if isinstance(mesh, MeshConfig):
+        mesh = mesh.build()
     prev, _current_mesh = _current_mesh, mesh
     try:
         yield mesh
@@ -74,11 +101,54 @@ def mesh_scope(mesh):
         _current_mesh = prev
 
 
+def fit_spec(spec, shape, mesh):
+    """Adapt a PartitionSpec-in-tuple-form to a (possibly different)
+    mesh: drop axis names the mesh does not have, and drop axes whose
+    size does not divide the dim they shard — the reshard-on-restore
+    rule (checkpoint/restore.py) that lets a spec recorded on a
+    dp=2,tp=2 save apply on a tp=4 (or dp=4, or single-device) mesh.
+    Entries may be an axis name, a tuple/list of names, or None."""
+    if spec is None:
+        return None
+    axis_size = dict(zip(mesh.axis_names, mesh.devices.shape))
+    out = []
+    for dim, entry in zip(shape, tuple(spec) + (None,) * len(shape)):
+        if entry is None:
+            out.append(None)
+            continue
+        names = list(entry) if isinstance(entry, (tuple, list)) else [entry]
+        kept, factor = [], 1
+        for ax in names:
+            n = axis_size.get(ax)
+            if n is None:
+                continue
+            if int(dim) % (factor * n) != 0:
+                continue
+            kept.append(ax)
+            factor *= n
+        if not kept:
+            out.append(None)
+        elif len(kept) == 1:
+            out.append(kept[0])
+        else:
+            out.append(tuple(kept))
+    return tuple(out[:len(shape)])
+
+
 def named_sharding(mesh, spec):
     """spec: tuple of axis-name/None per dim (a PartitionSpec in tuple
-    form, e.g. ('dp', None) or (None, 'tp'))."""
+    form, e.g. ('dp', None) or (None, 'tp')); an entry may also be a
+    tuple of names for a multi-axis dim."""
     if spec is None:
         return NamedSharding(mesh, PartitionSpec())
     names = set(mesh.axis_names)
-    cleaned = tuple(s if (s in names or s is None) else None for s in spec)
-    return NamedSharding(mesh, PartitionSpec(*cleaned))
+
+    def _clean(s):
+        if s is None:
+            return None
+        if isinstance(s, (tuple, list)):
+            kept = tuple(a for a in s if a in names)
+            return kept if kept else None
+        return s if s in names else None
+
+    return NamedSharding(mesh, PartitionSpec(*(_clean(s) for s in spec)))

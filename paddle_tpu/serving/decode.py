@@ -92,6 +92,35 @@ class DecodePredictor(object):
                     self._weight_scope.set_var(
                         name, jax.device_put(val, self._exe.device))
 
+    def load_sharded(self, ckpt_dir, mesh=None):
+        """Replace the weights from a sharded checkpoint root
+        (checkpoint/sharded.py two-generation layout): each referenced
+        param is assembled from the shard files of the last committed,
+        digest-verified generation and resharded onto `mesh` (default:
+        pinned whole to this predictor's device) — serving can roll to
+        a checkpoint saved on ANY training topology. Cache vars are
+        runtime state, never checkpointed, never touched here. Raises
+        if no generation is loadable or a referenced param is absent."""
+        import jax
+        from ..checkpoint import restore as restore_mod
+        ckpt = restore_mod.load_checkpoint(ckpt_dir)
+        if ckpt is None:
+            raise RuntimeError(
+                'no committed checkpoint generation under %r' % ckpt_dir)
+        cache_names = set(self._pair.cache_names)
+        for name in self._pair.spec.param_names():
+            if name in cache_names:
+                continue
+            if name not in ckpt:
+                raise RuntimeError(
+                    'sharded checkpoint %s (generation %d) is missing '
+                    'param %r' % (ckpt.dirname, ckpt.generation, name))
+            if mesh is not None:
+                val = ckpt.as_jax(name, mesh)
+            else:
+                val = jax.device_put(ckpt.read(name), self._exe.device)
+            self._weight_scope.set_var(name, val)
+
     def reset(self):
         """Zero every ring cache (all slots forget everything)."""
         shape = self._pair.spec.cache_shape(self.slots)

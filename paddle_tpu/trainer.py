@@ -99,11 +99,17 @@ class FaultEvent(object):
 
 class CheckpointConfig(object):
     """(reference trainer.py:100) checkpoint_dir=None disables
-    checkpointing; step_interval counts steps within an epoch."""
+    checkpointing; step_interval counts steps within an epoch.
+
+    sharded=True switches to the mesh-native path
+    (paddle_tpu/checkpoint/): checkpoint_dir becomes a two-generation
+    sharded root (current/ + current.prev/) written per-shard with no
+    host gather, and resume reshards onto whatever mesh the restarted
+    process builds."""
 
     def __init__(self, checkpoint_dir=None, max_num_checkpoints=3,
                  epoch_interval=1, step_interval=10,
-                 pserver_endpoints=None, trainer_id=0):
+                 pserver_endpoints=None, trainer_id=0, sharded=False):
         self.checkpoint_dir = checkpoint_dir
         self.max_num_checkpoints = max(1, int(max_num_checkpoints))
         self.epoch_interval = max(1, int(epoch_interval))
@@ -114,6 +120,7 @@ class CheckpointConfig(object):
         # the same op for manual loops)
         self.pserver_endpoints = list(pserver_endpoints or [])
         self.trainer_id = int(trainer_id)
+        self.sharded = bool(sharded)
 
 
 def _poison_feed(feed):
@@ -154,10 +161,15 @@ class Trainer(object):
     """
 
     def __init__(self, train_func, optimizer_func, place=None,
-                 param_path=None, parallel=False, checkpoint_config=None):
+                 param_path=None, parallel=False, checkpoint_config=None,
+                 strategy=None):
         self.place = place if place is not None else TPUPlace()
         self.parallel = parallel
         self.checkpoint_cfg = checkpoint_config
+        # DistributedStrategy for the ParallelExecutor (multi-axis
+        # mesh / ZeRO sharding); None = plain dp over all devices
+        self._strategy = strategy
+        self._mesh_checkpointer = None
         self.scope = Scope()
         self.train_program = Program()
         self.startup_program = Program()
@@ -222,8 +234,34 @@ class Trainer(object):
         return os.path.join(self.checkpoint_cfg.checkpoint_dir,
                             '%s_%d' % (_CHECKPOINT_PREFIX, ckpt_id))
 
+    def _mesh_ckpt(self):
+        if self._mesh_checkpointer is None:
+            from .checkpoint import MeshCheckpointer
+            self._mesh_checkpointer = MeshCheckpointer(
+                self.checkpoint_cfg.checkpoint_dir)
+        return self._mesh_checkpointer
+
+    def _train_state_extras(self, epoch_id, step_id):
+        active = self._pe if self._pe is not None else self.exe
+        return {'epoch_id': epoch_id, 'step_id': step_id,
+                'exe_step': active._step,
+                # the REALIZED rng seed (random_seed=0 draws one at first
+                # use): without it, a restarted process draws a fresh base
+                # key and dropout streams diverge despite _step matching
+                'rng_seed': getattr(active, '_realized_seed', None),
+                'rng_seed_used': getattr(active, '_seed_used', None)}
+
     def _save_checkpoint(self, epoch_id, step_id):
         cfg = self.checkpoint_cfg
+        if cfg.sharded:
+            # mesh path: per-shard async save straight from the scope's
+            # device arrays (checkpoint/sharded.py) — the step blocks
+            # only for the device->host shard copies; file I/O, digests
+            # and the generation rotation overlap the next steps
+            self._mesh_ckpt().save_scope(
+                self.scope, self.train_program,
+                extras=self._train_state_extras(epoch_id, step_id))
+            return
         ids = _checkpoint_ids(cfg.checkpoint_dir)
         new_id = (ids[-1] + 1) if ids else 0
         path = self._ckpt_path(new_id)
@@ -231,16 +269,8 @@ class Trainer(object):
         with scope_guard(self.scope):
             io_mod.save_persistables(self.exe, path,
                                      main_program=self.train_program)
-        active = self._pe if self._pe is not None else self.exe
-        meta = {'epoch_id': epoch_id, 'step_id': step_id,
-                'exe_step': active._step,
-                # the REALIZED rng seed (random_seed=0 draws one at first
-                # use): without it, a restarted process draws a fresh base
-                # key and dropout streams diverge despite _step matching
-                'rng_seed': getattr(active, '_realized_seed', None),
-                'rng_seed_used': getattr(active, '_seed_used', None)}
         with open(os.path.join(path, _METADATA_FILE), 'w') as f:
-            json.dump(meta, f)
+            json.dump(self._train_state_extras(epoch_id, step_id), f)
         if cfg.pserver_endpoints and cfg.trainer_id == 0:
             # pserver mode: have each parameter server save its shard
             # (params + server-side optimizer state) under this
@@ -270,37 +300,17 @@ class Trainer(object):
     @staticmethod
     def _write_digests(path):
         """CHECKPOINT_DIGESTS: {relpath: [crc32, size]} over every file
-        in the checkpoint dir (except the marker and the manifest)."""
-        from .integrity import crc32_file
-        digests = {}
-        for root, _dirs, files in os.walk(path):
-            for fn in files:
-                if fn in (_SUCCESS_FILE, _DIGESTS_FILE):
-                    continue
-                fp = os.path.join(root, fn)
-                crc, size = crc32_file(fp)
-                digests[os.path.relpath(fp, path)] = [crc, size]
-        with open(os.path.join(path, _DIGESTS_FILE), 'w') as f:
-            json.dump(digests, f)
+        in the checkpoint dir (except the marker and the manifest) —
+        the shared manifest story of checkpoint/manifest.py."""
+        from .checkpoint import manifest as ckpt_manifest
+        ckpt_manifest.write_digests(path)
 
     @staticmethod
     def _verify_checkpoint(path):
         """None if every digest matches (or the checkpoint predates
         digests — accepted for back-compat), else a reason string."""
-        from .integrity import crc32_file
-        manifest = os.path.join(path, _DIGESTS_FILE)
-        if not os.path.exists(manifest):
-            return None
-        with open(manifest) as f:
-            digests = json.load(f)
-        for rel, (crc, size) in digests.items():
-            fp = os.path.join(path, rel)
-            if not os.path.exists(fp):
-                return 'missing payload file %s' % rel
-            got_crc, got_size = crc32_file(fp)
-            if got_crc != int(crc) or got_size != int(size):
-                return 'digest mismatch on %s' % rel
-        return None
+        from .checkpoint import manifest as ckpt_manifest
+        return ckpt_manifest.verify_digests(path)
 
     def _maybe_resume(self):
         """Restore from the newest VALID checkpoint. A dir with no
@@ -312,6 +322,8 @@ class Trainer(object):
         cfg = self.checkpoint_cfg
         if cfg is None or not cfg.checkpoint_dir:
             return False
+        if cfg.sharded:
+            return self._maybe_resume_sharded()
         for ckpt_id in reversed(_checkpoint_ids(cfg.checkpoint_dir)):
             path = self._ckpt_path(ckpt_id)
             try:
@@ -322,15 +334,8 @@ class Trainer(object):
                 # corrupt payload: quarantine the WHOLE checkpoint dir
                 # (renamed aside, kept for post-mortem — and no longer
                 # SUCCESS-listed, so it is never retried) and fall back
-                import sys
-                qpath = path + '.corrupt'
-                try:
-                    os.replace(path, qpath)
-                except OSError:
-                    qpath = '<unmovable>'
-                print('WARNING: quarantined corrupt checkpoint %s -> %s '
-                      '(%s); falling back to an older checkpoint'
-                      % (path, qpath, reason), file=sys.stderr)
+                from .distributed.statefile import quarantine_dir
+                quarantine_dir(path, reason)
                 continue
             try:
                 with open(os.path.join(path, _METADATA_FILE)) as f:
@@ -359,6 +364,31 @@ class Trainer(object):
             return True
         return False
 
+    def _maybe_resume_sharded(self):
+        """Mesh-path resume: pour the last committed generation
+        (digest-verified; .prev fallback and quarantine handled inside
+        checkpoint/restore.py) back into the scope and restore the
+        train-state extras. Values land as host arrays; the
+        ParallelExecutor re-places them into each var's mesh sharding
+        on its next run — exact, even onto a different topology than
+        the one that saved."""
+        extras = self._mesh_ckpt().restore_scope(self.scope,
+                                                 self.train_program)
+        if extras is None:
+            return False
+        self.epoch_id = int(extras.get('epoch_id', 0))
+        self.step_id = int(extras.get('step_id', -1)) + 1
+        self._restored_step = int(extras.get('exe_step', 0))
+        self._restored_rng = (extras.get('rng_seed'),
+                              extras.get('rng_seed_used'))
+        self._apply_rng_state(self.exe)
+        if self._pe is not None:
+            self._apply_rng_state(self._pe)
+            # force _bcast_params on the next run so the restored host
+            # values return to their mesh shardings
+            self._pe._params_placed = False
+        return True
+
     def _apply_rng_state(self, executor):
         executor._step = getattr(self, '_restored_step', 0)
         seed, seed_used = getattr(self, '_restored_rng', (None, None))
@@ -376,7 +406,8 @@ class Trainer(object):
             from .parallel_executor import ParallelExecutor
             self._pe = ParallelExecutor(
                 use_cuda=True, loss_name=self.loss.name,
-                main_program=self.train_program, scope=self.scope)
+                main_program=self.train_program, scope=self.scope,
+                strategy=self._strategy)
             self._apply_rng_state(self._pe)
         return self._pe
 
@@ -397,8 +428,14 @@ class Trainer(object):
         rollbacks = 0
         while True:
             try:
-                return self._train_loop(num_epochs, event_handler,
-                                        reader, feed_order)
+                result = self._train_loop(num_epochs, event_handler,
+                                          reader, feed_order)
+                if self._mesh_checkpointer is not None:
+                    # drain in-flight async generation commits (and
+                    # surface any async save failure) before the caller
+                    # believes training — and its checkpoints — are done
+                    self._mesh_checkpointer.wait()
+                return result
             except FatalRPCError as e:
                 cfg = self.checkpoint_cfg
                 if cfg is None or not cfg.checkpoint_dir or \

@@ -1,0 +1,111 @@
+"""Subprocess worker for sharded-mesh checkpoint chaos tests.
+
+A Supervisor-run mesh training job: Trainer(parallel=True) over a
+virtual 8-CPU-device mesh with ZeRO-3 parameter sharding, saving
+sharded generations (CheckpointConfig(sharded=True)) every
+MESH_CKPT_EVERY steps. A FLAGS_fault_plan 'exit' rule kill-9s it
+mid-step; the Supervisor restarts it with a bumped incarnation and the
+run must resume from the last committed generation to bit-exact
+weights (tests/test_sharded_ckpt.py / tools/chaos_sweep.py
+--mesh-kill). Env:
+
+  MESH_STEPS       total steps of the one training epoch
+  MESH_CKPT        checkpoint root dir
+  MESH_CKPT_EVERY  step_interval of the sharded CheckpointConfig
+  MESH_DP/MESH_TP  mesh axis sizes (default dp=4, tp=1)
+"""
+import json
+import os
+import sys
+
+# the virtual device count must be pinned BEFORE jax initializes
+_flags = os.environ.get('XLA_FLAGS', '')
+if 'host_platform_device_count' not in _flags:
+    os.environ['XLA_FLAGS'] = (
+        _flags + ' --xla_force_host_platform_device_count=8').strip()
+
+import jax                              # noqa: E402
+
+jax.config.update('jax_platforms', 'cpu')
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np                      # noqa: E402
+import paddle_tpu as fluid              # noqa: E402
+from paddle_tpu.parallel import DistributedStrategy   # noqa: E402
+
+BATCH = 16
+DIM = 8
+HIDDEN = 16
+
+
+def train_func():
+    fluid.default_main_program().random_seed = 17
+    fluid.default_startup_program().random_seed = 17
+    x = fluid.layers.data(name='x', shape=[DIM], dtype='float32')
+    y = fluid.layers.data(name='y', shape=[1], dtype='float32')
+    h = fluid.layers.fc(input=x, size=HIDDEN, act='relu',
+                        param_attr=fluid.ParamAttr(
+                            name='mw1',
+                            initializer=fluid.initializer.Normal(
+                                scale=0.1, seed=7)),
+                        bias_attr=fluid.ParamAttr(
+                            name='mb1',
+                            initializer=fluid.initializer.Constant(0.1)))
+    pred = fluid.layers.fc(input=h, size=1,
+                           param_attr=fluid.ParamAttr(
+                               name='mw2',
+                               initializer=fluid.initializer.Normal(
+                                   scale=0.1, seed=11)))
+    return fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+
+
+def reader(steps):
+    def _r():
+        rng = np.random.RandomState(0)
+        w = np.linspace(-1, 1, DIM).astype('float32')[:, None]
+        for _ in range(steps):
+            x = rng.randn(BATCH, DIM).astype('float32')
+            yield [x, (x @ w + 0.1).astype('float32')]
+    return _r
+
+
+def main():
+    steps = int(os.environ.get('MESH_STEPS', 8))
+    ckpt_root = os.environ.get('MESH_CKPT', '')
+    every = int(os.environ.get('MESH_CKPT_EVERY', 2))
+    dp = int(os.environ.get('MESH_DP', 4))
+    tp = int(os.environ.get('MESH_TP', 1))
+
+    strategy = DistributedStrategy(dp=dp, tp=tp, sharded_params=True)
+    cfg = fluid.CheckpointConfig(checkpoint_dir=ckpt_root,
+                                 step_interval=every,
+                                 sharded=True) if ckpt_root else None
+    trainer = fluid.Trainer(train_func,
+                            lambda: fluid.optimizer.Adam(0.02),
+                            place=fluid.CPUPlace(), parallel=True,
+                            checkpoint_config=cfg, strategy=strategy)
+    losses = []
+
+    def handler(event):
+        if isinstance(event, fluid.EndStepEvent):
+            losses.append(float(np.asarray(event.metrics[0])))
+
+    trainer.train(num_epochs=1, event_handler=handler,
+                  reader=reader(steps), feed_order=['x', 'y'])
+    weights = {}
+    for var in trainer.train_program.list_vars():
+        if not var.persistable:
+            continue
+        val = trainer.scope.find_var(var.name)
+        if val is None:
+            continue
+        arr = np.asarray(val)
+        if arr.dtype.kind == 'f':
+            weights[var.name] = arr.tolist()
+    print('RESULT ' + json.dumps({'losses': losses, 'weights': weights}),
+          flush=True)
+
+
+if __name__ == '__main__':
+    main()

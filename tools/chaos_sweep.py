@@ -32,6 +32,14 @@ snapshot + journal. Verdicts:
   fatal      a role exhausted its restart budget
   hung       the supervised cluster blew the time budget
 
+`--mesh-kill` is the sharded-mesh flavor of `--kill`: one
+Supervisor-run mesh trainer (tests/mesh_worker.py — 8 virtual CPU
+devices, ZeRO-3 parameter sharding, CheckpointConfig(sharded=True)
+generations under paddle_tpu/checkpoint/) is kill-9'd at a seeded step
+and restarted; the resumed run must match a fault-free mesh baseline
+**bit-exactly** (np.array_equal, not allclose — the checkpoint path
+replays the identical arithmetic). Same verdicts as --kill.
+
 `--corrupt` switches the generator to `FaultPlan.from_corrupt_seed`:
 plans of bit-flip (`corrupt`) and poisoned-gradient (`nan`) rules on
 trainer 0's sends. Unlike the drop/close/error sweep, every corrupt
@@ -50,6 +58,7 @@ Usage:
     python tools/chaos_sweep.py --seed-start 7 --seeds 1 --verbose
     python tools/chaos_sweep.py --kill --seeds 10   # process-kill mode
     python tools/chaos_sweep.py --corrupt --quick   # integrity smoke
+    python tools/chaos_sweep.py --mesh-kill --quick # sharded-mesh kill
 
 Exit status is non-zero iff any seed DIVERGED (or, under --quick, any
 seed was fatal/hung): fatal/hung seeds of the full sweep are
@@ -71,6 +80,7 @@ sys.path.insert(0, _ROOT)
 sys.path.insert(0, os.path.join(_ROOT, 'tests'))
 
 _WORKER = os.path.join(_ROOT, 'tests', 'ps_worker.py')
+_MESH_WORKER = os.path.join(_ROOT, 'tests', 'mesh_worker.py')
 
 
 def _free_ports(n):
@@ -218,6 +228,52 @@ def _run_kill_seed(seed, model, steps, trainers, pservers, budget,
         sup.stop()
 
 
+def _run_mesh_seed(kill_nth, steps, budget, workdir, obs_dir=None,
+                   dp=4, tp=1):
+    """One supervised mesh-trainer run; kill_nth=None is the fault-free
+    baseline. Returns (verdict, weights, plan_json, outs) — verdict
+    'ok' means the run finished; recovered/nokill are decided by the
+    caller from the restart count."""
+    from paddle_tpu.distributed.supervisor import Supervisor
+
+    env = dict(os.environ)
+    env.pop('JAX_PLATFORMS', None)
+    env.pop('XLA_FLAGS', None)
+    env.update({'MESH_STEPS': str(steps), 'MESH_CKPT':
+                os.path.join(workdir, 'ckpt'), 'MESH_CKPT_EVERY': '2',
+                'MESH_DP': str(dp), 'MESH_TP': str(tp)})
+    plan_json = ''
+    if kill_nth is not None:
+        plan_json = json.dumps({'rules': [{
+            'when': 'step', 'type': '*', 'nth': int(kill_nth),
+            'action': 'exit'}]})
+        env['FLAGS_fault_plan'] = plan_json
+    if obs_dir:
+        env['FLAGS_obs_flush_secs'] = '0.5'
+    sup = Supervisor(max_restarts=2, backoff=0.5, log_dir=workdir,
+                     obs_dir=obs_dir)
+    sup.add_role('mesh', [sys.executable, _MESH_WORKER], env=env)
+    sup.start()
+    states = sup.wait(timeout=budget)
+    out = sup.output('mesh')
+    restarts = sup.restarts['mesh']
+    sup.stop()
+    if any(s in ('running', 'backoff') for s in states.values()):
+        return 'hung', None, plan_json, [out]
+    if any(s == 'failed' for s in states.values()):
+        return 'fatal', None, plan_json, [out]
+    weights = None
+    for ln in out.splitlines():
+        if ln.startswith('RESULT '):
+            weights = json.loads(ln[len('RESULT '):])['weights']
+    if weights is None:
+        return 'fatal', None, plan_json, [out]
+    if kill_nth is None:
+        return 'ok', weights, plan_json, [out]
+    return (('recovered' if restarts else 'nokill'),
+            weights, plan_json, [out])
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument('--seeds', type=int, default=None,
@@ -238,6 +294,10 @@ def main(argv=None):
     ap.add_argument('--corrupt', action='store_true',
                     help='integrity mode: seeded bit-flip (corrupt) and '
                          'poisoned-gradient (nan) plans on trainer 0')
+    ap.add_argument('--mesh-kill', action='store_true',
+                    help='sharded-mesh elastic recovery: kill-9 a '
+                         'supervised mesh trainer (sharded checkpoints) '
+                         'at a seeded step; bit-exact resume required')
     ap.add_argument('--quick', action='store_true',
                     help='CI smoke: 3 seeds unless --seeds given, and '
                          'fatal/hung seeds fail the sweep too')
@@ -250,21 +310,40 @@ def main(argv=None):
                     help='where --report keeps per-seed obs output '
                          '(default: a ./chaos_report.<pid> dir)')
     args = ap.parse_args(argv)
-    if args.kill and args.corrupt:
-        ap.error('--kill and --corrupt are mutually exclusive')
+    if sum((args.kill, args.corrupt, args.mesh_kill)) > 1:
+        ap.error('--kill, --corrupt and --mesh-kill are mutually '
+                 'exclusive')
     if args.seeds is None:
         args.seeds = 3 if args.quick else 20
 
+    import random
     import tempfile
 
     import numpy as np
 
-    import ps_worker
     from paddle_tpu.distributed.resilience import FaultPlan
 
-    print('baseline: local %s x %d steps ...' % (args.model, args.steps))
-    _, local_w = ps_worker.local_train(args.model, args.steps, 'sgd',
-                                       args.trainers)
+    if args.mesh_kill:
+        # the mesh sweep's baseline is the same worker, fault-free —
+        # acceptance is BIT-exact, so it must be the identical program,
+        # not ps_worker's local_train
+        mesh_steps = max(args.steps, 6)
+        print('baseline: supervised mesh x %d steps ...' % mesh_steps)
+        with tempfile.TemporaryDirectory() as workdir:
+            verdict, local_w, _, outs = _run_mesh_seed(
+                None, mesh_steps, args.budget, workdir)
+        if verdict != 'ok':
+            print('mesh baseline failed (%s)' % verdict)
+            if args.verbose:
+                for out in outs:
+                    print('  | ' + '\n  | '.join(out.splitlines()[-15:]))
+            return 1
+    else:
+        import ps_worker
+        print('baseline: local %s x %d steps ...'
+              % (args.model, args.steps))
+        _, local_w = ps_worker.local_train(args.model, args.steps, 'sgd',
+                                           args.trainers)
 
     report_root = None
     if args.report:
@@ -272,7 +351,8 @@ def main(argv=None):
         report_root = args.report_dir or ('chaos_report.%d' % os.getpid())
         os.makedirs(report_root, exist_ok=True)
 
-    ok_verdicts = ('recovered', 'nokill') if args.kill else ('ok',)
+    ok_verdicts = (('recovered', 'nokill')
+                   if (args.kill or args.mesh_kill) else ('ok',))
     tally = {'ok': 0, 'recovered': 0, 'nokill': 0, 'diverged': 0,
              'fatal': 0, 'hung': 0}
     bad_seeds, rows = [], []
@@ -282,7 +362,15 @@ def main(argv=None):
         if report_root:
             obs_dir = os.path.join(report_root, 'seed%04d' % seed)
             os.makedirs(obs_dir, exist_ok=True)
-        if args.kill:
+        if args.mesh_kill:
+            # kill inside the live step range; nth counts on_step calls
+            kill_nth = random.Random(('mesh', seed).__repr__()).randint(
+                2, mesh_steps)
+            with tempfile.TemporaryDirectory() as workdir:
+                verdict, weights, plan_json, outs = _run_mesh_seed(
+                    kill_nth, mesh_steps, args.budget, workdir, obs_dir)
+            label = 'mesh %s' % plan_json
+        elif args.kill:
             with tempfile.TemporaryDirectory() as workdir:
                 verdict, weights, victim, plan_json, outs = \
                     _run_kill_seed(seed, args.model, args.steps,
@@ -298,9 +386,15 @@ def main(argv=None):
                 args.pservers, args.budget, obs_dir)
         if verdict in ok_verdicts:
             for p, lw in local_w.items():
-                if not np.allclose(np.asarray(weights[p]),
-                                   np.asarray(lw),
-                                   rtol=1e-4, atol=1e-5):
+                got = np.asarray(weights.get(p))
+                if args.mesh_kill:
+                    # sharded-checkpoint resume replays identical
+                    # arithmetic: BIT-exact or it is a recovery bug
+                    if not np.array_equal(got, np.asarray(lw)):
+                        verdict = 'diverged'
+                        break
+                elif not np.allclose(got, np.asarray(lw),
+                                     rtol=1e-4, atol=1e-5):
                     verdict = 'diverged'
                     break
         tally[verdict] += 1
@@ -333,7 +427,8 @@ def main(argv=None):
           % (total, tally['ok'], tally['recovered'], tally['nokill'],
              tally['diverged'], tally['fatal'], tally['hung']))
     if report_root:
-        mode = ('kill' if args.kill
+        mode = ('mesh-kill' if args.mesh_kill
+                else 'kill' if args.kill
                 else 'corrupt' if args.corrupt else 'fault')
         report_path = os.path.join(report_root, 'sweep_report.json')
         with open(report_path, 'w') as f:
