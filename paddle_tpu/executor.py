@@ -19,6 +19,8 @@ Executor's program cache (executor.py:374) plus XLA's own executable cache.
 from __future__ import annotations
 
 import contextlib
+import hashlib
+import time
 
 import numpy as np
 
@@ -384,6 +386,13 @@ class PreparedProgram(object):
         # serve a parameter concurrently with the next async update, so
         # buffers must not be invalidated in place
         self.donate = donate
+        # perf observatory (obs/perf.py): fingerprint tags this
+        # prepared program's xla.compile spans; cost_* accumulate the
+        # XLA cost analysis of each compiled segment (complete once
+        # every segment has run) — the work model behind perf.mfu
+        self.fingerprint = None
+        self.cost_flops = 0.0
+        self.cost_bytes = 0.0
         self.steps = []          # list of _DeviceSegment | _HostStep
         self._build_segments()
         self._analyze_dataflow()
@@ -494,14 +503,24 @@ class Executor(object):
         # serving asserts the decode program compiles exactly once
         # across a generation loop (jit_cache_stats)
         self._compile_count = 0
+        # per-executor mirror of the xla.jit_cache.{hit,miss} telemetry
+        # counters: one hit/miss per device-segment dispatch (misses ==
+        # compiled_segments outside check_nan_inf mode)
+        self._segment_hits = 0
+        self._segment_misses = 0
         _LIVE_EXECUTORS.add(self)
 
     def jit_cache_stats(self):
-        """{'prepared_programs', 'compiled_segments'} — compiled_segments
-        is monotonic, so a steady-state serving loop proves jit-cache
-        hits by observing it stay constant across N decode steps."""
+        """{'prepared_programs', 'compiled_segments', 'segment_hits',
+        'segment_misses'} — compiled_segments is monotonic, so a
+        steady-state serving loop proves jit-cache hits by observing it
+        stay constant across N decode steps; hits/misses count every
+        device-segment dispatch (ParallelExecutor inherits all four —
+        SPMD and pipeline paths feed the same counters)."""
         return {'prepared_programs': len(self._prepared_cache),
-                'compiled_segments': self._compile_count}
+                'compiled_segments': self._compile_count,
+                'segment_hits': self._segment_hits,
+                'segment_misses': self._segment_misses}
 
     def compiled_hlo_texts(self):
         """Optimized-HLO text of each compiled device segment (re-lowered
@@ -540,6 +559,8 @@ class Executor(object):
     def run(self, program=None, feed=None, fetch_list=None,
             feed_var_name='feed', fetch_var_name='fetch', scope=None,
             return_numpy=True, use_program_cache=True):
+        from .obs import perf as _perf
+        t0_perf = _perf.step_begin()
         program = program or default_main_program()
         if not isinstance(program, Program):
             raise TypeError('Executor.run expects a Program')
@@ -588,12 +609,25 @@ class Executor(object):
                                        fetch_names)
             if use_program_cache:
                 self._prepared_cache[cache_key] = prepared
+        if prepared.fingerprint is None:
+            prepared.fingerprint = hashlib.md5(
+                repr(cache_key).encode()).hexdigest()[:12]
 
         result = self._run_prepared(prepared, feed_arrays, fetch_names,
                                     scope, program)
         self._step += 1
         if return_numpy:
-            return [self._to_numpy(r) for r in result]
+            # the host fetch below IS the device sync (PERF.md: the one
+            # reliable barrier on the remoted transport) — stamp the
+            # step after it so perf.step_latency covers real work
+            result = [self._to_numpy(r) for r in result]
+            if t0_perf is not None:
+                _perf.step_end(t0_perf, prepared, device=self.device,
+                               scope=scope)
+            return result
+        if t0_perf is not None:
+            _perf.step_end(t0_perf, prepared, device=self.device,
+                           scope=scope, sync=result)
         return result
 
     def _to_numpy(self, value):
@@ -639,6 +673,7 @@ class Executor(object):
 
         from . import flags as flags_mod
         from . import profiler as _prof
+        from .obs import trace as _trace
         check_nan_inf = flags_mod.get_flag('check_nan_inf')
 
         for step_idx, step in enumerate(prepared.steps):
@@ -677,11 +712,18 @@ class Executor(object):
                 outs = self._run_segment_checked(step, block, program,
                                                  const, key_arg)
             else:
-                if step.jitted is None:
+                from .obs import perf as _perf
+                fresh_compile = step.jitted is None
+                if fresh_compile:
+                    self._segment_misses += 1
+                    _perf.jit_cache_miss()
                     step.jitted = self._compile_segment(
                         step, block, program,
                         feed_names=tuple(feed_arrays.keys()),
                         donate=prepared.donate)
+                else:
+                    self._segment_hits += 1
+                    _perf.jit_cache_hit()
                 if getattr(step, '_arg_struct', None) is None:
                     # abstract arg signature kept so the profiler can
                     # re-lower this segment and read the compiled HLO
@@ -691,10 +733,30 @@ class Executor(object):
                             np.shape(a), getattr(a, 'dtype', None)
                             or np.asarray(a).dtype),
                         (donated, const, key_arg))
-                with _prof.RecordEvent(
-                        'device_segment:%d(%d ops)'
-                        % (step_idx, len(step.ops))):
-                    outs = step.jitted(donated, const, key_arg)
+                if fresh_compile and (_perf.enabled()
+                                      or _trace.enabled()):
+                    # time the FIRST call: trace+lower+XLA-compile all
+                    # happen inside it (an explicit lower().compile()
+                    # does NOT warm jax's jit call cache), so this span
+                    # is the user-visible compile stall
+                    t0c = time.perf_counter()
+                    with _perf.compile_span(prepared.fingerprint,
+                                            step_idx, len(step.ops)):
+                        with _prof.RecordEvent(
+                                'device_segment:%d(%d ops)'
+                                % (step_idx, len(step.ops))):
+                            outs = step.jitted(donated, const, key_arg)
+                    flops, nbytes = _perf.segment_cost(
+                        step.jitted, step._arg_struct)
+                    prepared.cost_flops += flops
+                    prepared.cost_bytes += nbytes
+                    _perf.record_compile(time.perf_counter() - t0c,
+                                         flops, nbytes)
+                else:
+                    with _prof.RecordEvent(
+                            'device_segment:%d(%d ops)'
+                            % (step_idx, len(step.ops))):
+                        outs = step.jitted(donated, const, key_arg)
             for name, val in zip(step.out_names, outs):
                 local[name] = val
                 var = block.vars.get(name)
@@ -784,6 +846,8 @@ class Executor(object):
         if prepared is None:
             prepared = PreparedProgram(program, block_id, (),
                                        list(fetch_names), donate=False)
+            prepared.fingerprint = hashlib.md5(
+                repr(cache_key).encode()).hexdigest()[:12]
             self._prepared_cache[cache_key] = prepared
         return self._run_prepared(prepared, {}, list(fetch_names), scope,
                                   program)

@@ -116,6 +116,22 @@ Known flags:
   mesh_shape             MeshConfig.from_flags axis spec, e.g.
                          'dp=2,tp=2' ('' = pure data parallelism over
                          every local device)
+  perf_sync_steps        block_until_ready un-fetched Executor.run
+                         results before stamping perf.step_latency
+                         (obs/perf.py). Default on; disable on the
+                         remoted transport where block_until_ready is
+                         unreliable (PERF.md) and a return_numpy fetch
+                         or async window should time steps instead
+  perf_peak_tflops       peak dense bf16 TFLOP/s used as the perf.mfu
+                         denominator (0 = auto from the TPU device-kind
+                         table; must be set explicitly for nonzero MFU
+                         on CPU/GPU backends)
+  slo_rules              declarative SLO rule list for obs/slo.py —
+                         inline JSON (list of {name, metric, kind,
+                         threshold[, min_count]}) or @/path/rules.json
+                         ('' = no watchdog). Breaches emit slo.breach
+                         trace events + the slo.breaches counter
+  slo_check_secs         SLOWatchdog evaluation period in seconds
 """
 from __future__ import annotations
 
@@ -241,6 +257,21 @@ _DEFAULTS = {
     'obs_dir': '',
     'obs_role': '',
     'obs_flush_secs': 2.0,
+    # perf observatory (obs/perf.py): block_until_ready un-fetched run
+    # results before stamping perf.step_latency (disable on the remoted
+    # transport, where block_until_ready is documented-unreliable —
+    # PERF.md — and throughput should be measured over an async
+    # window); peak dense bf16 TFLOP/s override for the perf.mfu
+    # denominator (0 = look up the TPU device-kind table; set
+    # explicitly on CPU/GPU backends)
+    'perf_sync_steps': True,
+    'perf_peak_tflops': 0.0,
+    # SLO watchdog (obs/slo.py): declarative rule list — inline JSON or
+    # @/path/rules.json ('' = off); evaluation cadence in seconds.
+    # Armed by serving.Engine.start() and lazily by the first
+    # instrumented training step.
+    'slo_rules': '',
+    'slo_check_secs': 5.0,
     # batch_norm under data parallelism: compute statistics per device
     # (the reference's semantics — multi_devices_graph_pass.cc replicates
     # batch_norm per device, so stats are local and un-synced) instead of

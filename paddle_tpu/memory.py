@@ -17,8 +17,8 @@ from __future__ import annotations
 import numpy as np
 
 __all__ = ['memory_stats', 'memory_allocated', 'max_memory_allocated',
-           'memory_limit', 'scope_footprint', 'estimate_program_memory',
-           'estimate_peak_memory']
+           'memory_limit', 'scope_footprint', 'hbm_snapshot',
+           'estimate_program_memory', 'estimate_peak_memory']
 
 _DTYPE_BYTES = {
     'float64': 8, 'int64': 8, 'uint64': 8,
@@ -82,6 +82,23 @@ def scope_footprint(scope=None):
         elif isinstance(val, np.ndarray):
             total += val.nbytes
     return total
+
+
+def hbm_snapshot(device=None, scope=None):
+    """One consistent dict of the live HBM numbers for the obs layer's
+    hbm.* gauges: bytes_in_use / peak_bytes from the PJRT allocator
+    (scope footprint fallback where the backend exposes no stats —
+    CPU), bytes_limit (0 if unknown), and the framework-tracked
+    scope_bytes alongside either way."""
+    stats = memory_stats(device) or {}
+    scope_bytes = scope_footprint(scope)
+    in_use = int(stats.get('bytes_in_use', scope_bytes))
+    peak = int(stats.get('peak_bytes_in_use', in_use))
+    limit = stats.get('bytes_limit')
+    return {'bytes_in_use': in_use,
+            'peak_bytes': max(peak, in_use),
+            'bytes_limit': int(limit) if limit is not None else 0,
+            'scope_bytes': scope_bytes}
 
 
 def _var_bytes(var):

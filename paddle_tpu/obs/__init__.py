@@ -9,12 +9,23 @@ Three pillars (see README "Observability"):
   optional `trace` field; spans, FaultEvents, and RecordEvent scopes
   share one per-process JSONL event log.
 - `obs.report` — merges per-role logs into one chrome://tracing
-  timeline (clock offsets estimated from RPC midpoints) plus a
-  metrics rollup. CLI: `python tools/obs_report.py --obs_dir ...`.
+  timeline (clock offsets estimated from RPC midpoints, device-op
+  lanes from profiler xplane captures) plus a metrics rollup.
+  CLI: `python tools/obs_report.py --obs_dir ...`.
+
+Plus the device-side performance observatory on top of them:
+
+- `obs.perf` — compile/JIT telemetry (xla.compile spans,
+  xla.compile_latency, xla.jit_cache.{hit,miss}), per-step
+  perf.step_latency / perf.mfu / perf.achieved_tflops, and hbm.*
+  gauges/watermarks. Wired into Executor/ParallelExecutor.
+- `obs.slo` — declarative threshold rules over the registry
+  (MFU floor, latency percentiles, serving rates) evaluated by a
+  watchdog that emits slo.breach events.
 
 Everything is off unless `FLAGS_obs_dir` is set (the Supervisor plants
 a per-role subdir in each child's environment).
 """
-from . import telemetry, trace, report
+from . import telemetry, trace, report, perf, slo
 
-__all__ = ['telemetry', 'trace', 'report']
+__all__ = ['telemetry', 'trace', 'report', 'perf', 'slo']

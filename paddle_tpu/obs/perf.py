@@ -1,0 +1,228 @@
+"""Device-side performance observatory: compile/JIT telemetry, live
+MFU, and HBM watermarks.
+
+The cluster half of obs/ (PR 4) watches the wire; this module watches
+the device. The Executor's JIT pipeline reports into it at two points:
+
+- compile time (`record_compile` / `jit_cache_miss` / `jit_cache_hit`)
+  — every lazily-compiled device segment stamps an
+  `xla.compile_latency` observation and bumps the
+  `xla.jit_cache.{hit,miss}` counters, and its analytical cost
+  (FLOPs / bytes accessed, from jax's compiled cost analysis) is
+  accumulated onto the owning PreparedProgram so step attribution
+  below has a work model to divide by.
+- step time (`step_begin` / `step_end`) — wall latency of each
+  `Executor.run` call lands in the `perf.step_latency` histogram, and
+  combined with the compile-time FLOP count yields
+  `perf.achieved_tflops` and `perf.mfu` gauges. Timing follows the
+  PERF.md discipline: a `return_numpy=True` fetch has already
+  synchronized through the host transfer, otherwise we
+  `block_until_ready` the fetched arrays first (disable with
+  `FLAGS_perf_sync_steps=0` on the remoted transport, where
+  block_until_ready is documented-unreliable and throughput should be
+  measured over an async window instead).
+
+Every hook is a no-op while telemetry is disabled — same
+one-global-bool fast path as the rest of the registry — so the
+executor hot loop pays nothing by default. The one deliberate
+exception: capturing a segment's cost analysis requires a second
+lower+compile of the already-jitted function (an explicit
+lower().compile() does not warm jax's call cache), which doubles a
+once-per-program cost. That is why it is gated on telemetry being
+enabled rather than free-running.
+
+MFU needs a peak-FLOPs denominator: on TPU it is looked up from the
+device kind (same table as bench.py); elsewhere — and in CPU tests —
+set `FLAGS_perf_peak_tflops` to pin it explicitly.
+
+HBM gauges (`hbm.bytes_in_use`, `hbm.peak_bytes`, `hbm.bytes_limit`,
+`hbm.scope_bytes`, `hbm.watermark_bytes`) are refreshed on every
+step_end from memory.hbm_snapshot(); on backends without PJRT memory
+stats (CPU) bytes_in_use falls back to the scope footprint so the
+series stay live in tests. `hbm.watermark_bytes` is a process-local
+high-water mark that survives allocator-level peak resets.
+"""
+from __future__ import annotations
+
+import time
+
+from . import telemetry, trace
+from .. import flags
+
+__all__ = ['enabled', 'step_begin', 'step_end', 'jit_cache_hit',
+           'jit_cache_miss', 'record_compile', 'segment_cost',
+           'device_peak_flops', 'update_hbm', 'compile_span']
+
+# --- instruments (registered at import; zero until enabled) ---------
+_compile_latency = telemetry.histogram('xla.compile_latency')
+_jit_hits = telemetry.counter('xla.jit_cache.hit')
+_jit_misses = telemetry.counter('xla.jit_cache.miss')
+_step_latency = telemetry.histogram('perf.step_latency')
+_steps = telemetry.counter('perf.steps')
+_mfu = telemetry.gauge('perf.mfu')
+_achieved_tflops = telemetry.gauge('perf.achieved_tflops')
+_hbm_in_use = telemetry.gauge('hbm.bytes_in_use')
+_hbm_peak = telemetry.gauge('hbm.peak_bytes')
+_hbm_limit = telemetry.gauge('hbm.bytes_limit')
+_hbm_scope = telemetry.gauge('hbm.scope_bytes')
+_hbm_watermark = telemetry.gauge('hbm.watermark_bytes')
+
+_watermark = 0          # process-local high-water of bytes_in_use
+_slo_started = False    # lazy FLAGS_slo_rules watchdog, armed once
+
+# Dense peak bf16 FLOP/s by device kind prefix (same table bench.py
+# uses for its MFU math; longest-prefix match on device.device_kind).
+_PEAK_BF16 = {
+    'TPU v4': 275e12,
+    'TPU v5 lite': 197e12,
+    'TPU v5': 459e12,
+    'TPU v6 lite': 918e12,
+}
+
+
+def enabled():
+    return telemetry._enabled
+
+
+def device_peak_flops(device=None):
+    """Peak dense bf16 FLOP/s for MFU attribution: the
+    FLAGS_perf_peak_tflops override if set (TFLOP/s; the only way to
+    get a nonzero MFU on CPU), else the device-kind table, else 0.0
+    (MFU gauge stays unset)."""
+    override = float(flags.get_flag('perf_peak_tflops', 0.0))
+    if override > 0.0:
+        return override * 1e12
+    if device is None:
+        return 0.0
+    kind = getattr(device, 'device_kind', '') or ''
+    best, best_len = 0.0, -1
+    for prefix, peak in _PEAK_BF16.items():
+        if kind.startswith(prefix) and len(prefix) > best_len:
+            best, best_len = peak, len(prefix)
+    return best
+
+
+# --- compile-time hooks ---------------------------------------------
+
+def jit_cache_hit():
+    _jit_hits.inc()
+
+
+def jit_cache_miss():
+    _jit_misses.inc()
+
+
+def compile_span(fingerprint, segment, n_ops):
+    """Trace span wrapping a device segment's first (compiling) call.
+    The program fingerprint tag lets a timeline reader join the span
+    to the jit_cache series and to rerun-vs-rerun comparisons."""
+    return trace.span('xla.compile', fingerprint=fingerprint,
+                      segment=segment, n_ops=n_ops)
+
+
+def record_compile(latency_s, flops=0.0, bytes_accessed=0.0):
+    _compile_latency.observe(latency_s)
+
+
+def segment_cost(jitted, arg_struct):
+    """Analytical (flops, bytes_accessed) for a jitted segment via the
+    XLA cost model. Requires a fresh lower+compile (jax's jit call
+    cache is not warmed by an explicit .lower().compile(), so this is
+    a duplicated compile — acceptable once per segment when telemetry
+    is on). Returns (0.0, 0.0) on any backend that can't answer."""
+    try:
+        cost = jitted.lower(*arg_struct).compile().cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0] if cost else {}
+        flops = float(cost.get('flops', 0.0) or 0.0)
+        nbytes = float(cost.get('bytes accessed', 0.0) or 0.0)
+        return (max(flops, 0.0), max(nbytes, 0.0))
+    except Exception:
+        return (0.0, 0.0)
+
+
+# --- step-time hooks ------------------------------------------------
+
+def step_begin():
+    """Start-of-run timestamp, or None when telemetry is off (the
+    executor passes the None straight back to step_end's guard)."""
+    if not telemetry._enabled:
+        return None
+    return time.perf_counter()
+
+
+def step_end(t0, prepared=None, device=None, scope=None, sync=None):
+    """Close out one Executor.run: observe step latency, derive
+    achieved TFLOP/s + MFU from the prepared program's compile-time
+    cost, refresh the hbm.* gauges, and (once) arm the FLAGS_slo_rules
+    watchdog.
+
+    `sync` is the fetched result list when the caller did NOT request
+    numpy (so the timer must block on device completion first); None
+    means the host fetch already synchronized."""
+    if t0 is None or not telemetry._enabled:
+        return
+    if sync is not None and flags.get_flag('perf_sync_steps', True):
+        try:
+            import jax
+            jax.block_until_ready(
+                [r for r in sync if r is not None
+                 and hasattr(r, 'block_until_ready')])
+        except Exception:
+            pass
+    dt = time.perf_counter() - t0
+    _step_latency.observe(dt)
+    _steps.inc()
+    flops = float(getattr(prepared, 'cost_flops', 0.0) or 0.0)
+    if dt > 0.0 and flops > 0.0:
+        achieved = flops / dt
+        _achieved_tflops.set(achieved / 1e12)
+        peak = device_peak_flops(device)
+        if peak > 0.0:
+            _mfu.set(achieved / peak)
+    update_hbm(device=device, scope=scope)
+    _maybe_start_slo()
+
+
+def update_hbm(device=None, scope=None):
+    """Export memory.hbm_snapshot() as gauges + the process-local
+    watermark. Callable standalone (bench_suite stamps it between
+    steps of hand-rolled loops)."""
+    global _watermark
+    if not telemetry._enabled:
+        return
+    from .. import memory
+    try:
+        snap = memory.hbm_snapshot(device=device, scope=scope)
+    except Exception:
+        return
+    _hbm_in_use.set(snap['bytes_in_use'])
+    _hbm_peak.set(snap['peak_bytes'])
+    _hbm_limit.set(snap['bytes_limit'])
+    _hbm_scope.set(snap['scope_bytes'])
+    if snap['bytes_in_use'] > _watermark:
+        _watermark = snap['bytes_in_use']
+    if snap['peak_bytes'] > _watermark:
+        _watermark = snap['peak_bytes']
+    _hbm_watermark.set(_watermark)
+
+
+def _maybe_start_slo():
+    """First instrumented step arms the declarative SLO watchdog when
+    FLAGS_slo_rules is set — training runs get breach events without
+    touching the serving engine's explicit start()/stop() wiring."""
+    global _slo_started
+    if _slo_started:
+        return
+    _slo_started = True
+    if not flags.get_flag('slo_rules', ''):
+        return
+    from . import slo
+    slo.maybe_start_global()
+
+
+def _reset_for_tests():
+    """Zero the module-local state telemetry.reset() can't see."""
+    global _watermark, _slo_started
+    _watermark = 0
+    _slo_started = False

@@ -22,20 +22,29 @@ role, accumulating shifts, so even roles that never talk directly
 
 Timeline output is chrome://tracing JSON: one pid lane per role,
 spans as 'X' duration events, client->server RPC links as 's'/'f'
-flow events (same `id` = span id), faults as instant events.
+flow events (same `id` = span id), faults as instant events. Device
+kernels from a profiler xplane capture join as their own lanes
+(device_events_to_records / write_report(xplane_dir=...)): xplane
+device timestamps are unix-epoch ns — the same clock family the host
+spans use — so they align without an offset estimate.
 """
 from __future__ import annotations
 
 import collections
 import json
 import os
+import warnings
+
+from . import telemetry
 
 __all__ = ['collect', 'estimate_offsets', 'build_timeline', 'rollup',
-           'write_report', 'format_rollup_text']
+           'write_report', 'format_rollup_text',
+           'device_events_to_records']
 
 
 def _read_jsonl(path):
     out = []
+    bad = 0
     try:
         with open(path) as f:
             for line in f:
@@ -45,9 +54,14 @@ def _read_jsonl(path):
                 try:
                     out.append(json.loads(line))
                 except ValueError:
-                    pass   # torn tail from a kill -9 mid-write
+                    bad += 1   # torn tail from a kill -9 mid-write
     except OSError:
         pass
+    if bad:
+        warnings.warn(
+            'obs merge: skipped %d unparseable line(s) in %s '
+            '(torn tail from an unclean shutdown?)' % (bad, path),
+            stacklevel=2)
     return out
 
 
@@ -201,6 +215,31 @@ def build_timeline(events, offsets=None):
             'metadata': {'clock_shifts': offsets}}
 
 
+def device_events_to_records(device_events, role='device',
+                             clock_offset=0.0):
+    """profiler.device_op_events output -> span records that merge
+    straight into the host event stream. Accepts (label, start_ns,
+    dur_ns) 3-tuples (one shared lane) or (label, start_ns, dur_ns,
+    plane) 4-tuples (one timeline lane PER PLANE — per device chip).
+
+    xplane device timestamps are unix-epoch nanoseconds (the same
+    clock host spans stamp with time.time() — see tools/timeline.py),
+    so t0 = start_ns/1e9 lands directly on the merged clock;
+    `clock_offset` is there for captures known to be shifted."""
+    recs = []
+    for i, ev in enumerate(device_events):
+        label, start_ns, dur_ns = ev[0], ev[1], ev[2]
+        plane = ev[3] if len(ev) > 3 else ''
+        # '/device:TPU:0' -> lane 'device:TPU:0' (already self-naming)
+        lane = plane.rsplit('/', 1)[-1] if plane else role
+        t0 = start_ns / 1e9 + clock_offset
+        recs.append({'type': 'span', 'kind': 'device', 'name': label,
+                     'sid': 'dev-%d' % i, 't0': t0,
+                     't1': t0 + dur_ns / 1e9, 'tid': 0, 'role': lane,
+                     'pid': 0})
+    return recs
+
+
 def _merge_hist(into, h):
     if h.get('count', 0) == 0:
         return
@@ -222,7 +261,10 @@ def _merge_hist(into, h):
 def rollup(metric_lasts):
     """-> {'roles': {role: {counters, gauges, hists}}, 'totals':
     {counter: sum}}. Counters sum across incarnations AND roles;
-    gauges keep the latest-ts value per role; histograms merge."""
+    gauges keep the latest-ts value per role; histograms merge, then
+    report p50/p95/p99 recomputed over the MERGED buckets (the raw
+    bucket arrays are dropped from the output — percentiles are the
+    consumable form)."""
     roles = {}
     for rec in sorted(metric_lasts, key=lambda r: r.get('ts', 0)):
         role = rec.get('role', '?')
@@ -238,6 +280,12 @@ def rollup(metric_lasts):
     for agg in roles.values():
         for n, v in agg['counters'].items():
             totals[n] = totals.get(n, 0) + v
+        for h in agg['hists'].values():
+            if h.get('buckets') is not None:
+                for key, q in (('p50', 0.50), ('p95', 0.95),
+                               ('p99', 0.99)):
+                    h[key] = telemetry.hist_quantile(h, q)
+                del h['buckets']
     return {'roles': roles, 'totals': totals}
 
 
@@ -262,17 +310,37 @@ def format_rollup_text(ru, nonzero_only=True):
         for n, v in shown:
             lines.append('  %-40s %d' % (n, v))
         for n, h in hists:
-            lines.append('  %-40s n=%d mean=%.6fs max=%.6fs'
+            pcts = ''
+            if h.get('p50') is not None:
+                pcts = ' p50=%.6fs p95=%.6fs p99=%.6fs' % (
+                    h['p50'], h.get('p95') or 0.0, h.get('p99') or 0.0)
+            lines.append('  %-40s n=%d mean=%.6fs%s max=%.6fs'
                          % (n, h['count'], h['sum'] / h['count'],
-                            h['max']))
+                            pcts, h['max']))
     return '\n'.join(lines)
 
 
 def write_report(obs_root, timeline_path=None, rollup_path=None,
-                 pretty=False):
+                 pretty=False, xplane_dir=None, hlo_dir=None):
     """Merge everything under obs_root; optionally write the timeline
-    and rollup JSON files. -> (timeline dict, rollup dict)."""
+    and rollup JSON files. -> (timeline dict, rollup dict).
+
+    With xplane_dir (a jax.profiler trace capture taken during the
+    run), the device-op events join the timeline as device lanes;
+    hlo_dir (compiled-HLO .txt dumps, e.g. from compiled_hlo_texts())
+    maps fused-instruction names back to framework op names first."""
     events, metric_lasts = collect(obs_root)
+    if xplane_dir:
+        from .. import profiler
+        op_map = {}
+        if hlo_dir and os.path.isdir(hlo_dir):
+            for fn in sorted(os.listdir(hlo_dir)):
+                if fn.endswith('.txt'):
+                    with open(os.path.join(hlo_dir, fn)) as f:
+                        op_map.update(profiler.hlo_op_map(f.read()))
+        events = events + device_events_to_records(
+            profiler.device_op_events(xplane_dir, op_map,
+                                      with_plane=True))
     tl = build_timeline(events)
     ru = rollup(metric_lasts)
     indent = 2 if pretty else None

@@ -32,8 +32,8 @@ import threading
 import time
 
 __all__ = ['Counter', 'Gauge', 'Histogram', 'counter', 'gauge',
-           'histogram', 'snapshot', 'flush', 'enabled', 'enable',
-           'disable', 'reset']
+           'histogram', 'hist_quantile', 'snapshot', 'flush',
+           'enabled', 'enable', 'disable', 'reset']
 
 _lock = threading.Lock()
 _enabled = False
@@ -113,6 +113,43 @@ class Histogram(object):
             self.buckets[i] += 1
 
 
+def hist_quantile(hist, q):
+    """Estimate the q-quantile (0 < q <= 1) of a histogram given in
+    snapshot-dict form ({'count','min','max','buckets'}). Linear
+    interpolation inside the owning exponential bucket, clamped to the
+    observed min/max so a single-sample histogram reports that sample
+    exactly. Returns None for an empty histogram.
+
+    Works on live snapshots and on report.py's cross-role merges alike
+    (both carry the same bucket layout)."""
+    count = hist.get('count', 0)
+    if not count:
+        return None
+    buckets = hist['buckets']
+    mn = hist.get('min') or 0.0
+    mx = hist.get('max', 0.0)
+    rank = q * count
+    cum = 0
+    for i, n in enumerate(buckets):
+        if n and cum + n >= rank:
+            lo = _BOUNDS[i - 1] if i > 0 else 0.0
+            hi = _BOUNDS[i] if i < len(_BOUNDS) else mx
+            frac = (rank - cum) / n
+            v = lo + frac * max(hi - lo, 0.0)
+            return min(max(v, mn), mx)
+        cum += n
+    return mx
+
+
+def _hist_dict(h):
+    d = {'count': h.count, 'sum': h.sum,
+         'min': (None if h.count == 0 else h.min),
+         'max': h.max, 'buckets': list(h.buckets)}
+    for key, q in (('p50', 0.50), ('p95', 0.95), ('p99', 0.99)):
+        d[key] = hist_quantile(d, q)
+    return d
+
+
 def _get(table, cls, name):
     with _lock:
         inst = table.get(name)
@@ -145,10 +182,7 @@ def snapshot():
         return {
             'counters': {n: c.value for n, c in _counters.items()},
             'gauges': {n: g.value for n, g in _gauges.items()},
-            'hists': {n: {'count': h.count, 'sum': h.sum,
-                          'min': (None if h.count == 0 else h.min),
-                          'max': h.max, 'buckets': list(h.buckets)}
-                      for n, h in _hists.items()},
+            'hists': {n: _hist_dict(h) for n, h in _hists.items()},
         }
 
 

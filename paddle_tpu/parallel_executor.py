@@ -222,12 +222,15 @@ class ParallelExecutor(Executor):
                          donate=True):
         """pp-annotated segments lower through the pipeline engine
         (parallel/pp_lowering.py); everything else takes the standard
-        whole-block emission path."""
+        whole-block emission path. Both paths count into
+        jit_cache_stats()['compiled_segments'] — the SPMD/pipeline
+        executor keeps full stats parity with the base Executor."""
         if self._strategy is not None and self._strategy.pp > 1:
             from .parallel.pp_lowering import (segment_has_pp,
                                                build_pp_segment_fn)
             if segment_has_pp(segment):
                 seg_fn = build_pp_segment_fn(self, segment, block, program)
+                self._compile_count += 1
                 return jax.jit(seg_fn,
                                donate_argnums=(0,) if donate else (),
                                **self._jit_options(segment, feed_names))

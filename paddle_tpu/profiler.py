@@ -177,10 +177,13 @@ def hlo_op_map(hlo_texts):
     return out
 
 
-def device_op_events(xplane_dir, op_map=None):
+def device_op_events(xplane_dir, op_map=None, with_plane=False):
     """[(label, start_ns, dur_ns)] for every device-side XLA op event in
     an xplane capture, labeled through op_map when the instruction's
-    metadata resolves to an IR op."""
+    metadata resolves to an IR op. with_plane=True appends the owning
+    plane name as a 4th element — one lane per device chip for the
+    merged obs timeline (obs/report.py device_events_to_records);
+    default stays the 3-tuple shape tools/timeline.py unpacks."""
     import glob
     from jax.profiler import ProfileData
     files = sorted(glob.glob(
@@ -197,7 +200,12 @@ def device_op_events(xplane_dir, op_map=None):
                 for e in line.events:
                     instr = e.name.split(' = ')[0].lstrip('%')
                     label = (op_map or {}).get(instr, instr)
-                    events.append((label, e.start_ns, e.duration_ns))
+                    if with_plane:
+                        events.append((label, e.start_ns,
+                                       e.duration_ns, plane.name))
+                    else:
+                        events.append((label, e.start_ns,
+                                       e.duration_ns))
     return events
 
 

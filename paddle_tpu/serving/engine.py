@@ -119,12 +119,21 @@ class ServingEngine(object):
         self._running = False
         self._threads = []
         self._active_total = 0
+        self._slo = None
 
     # -- lifecycle ---------------------------------------------------------
     def start(self):
         if self._running:
             return self
         self._running = True
+        # serving SLOs (obs/slo.py): when FLAGS_slo_rules is set, a
+        # watchdog re-checks TTFT/token-latency percentiles and token
+        # rates against the declared thresholds for the engine's
+        # lifetime, emitting slo.breach events
+        from ..obs import slo as _slo
+        self._slo = _slo.watchdog_from_flags()
+        if self._slo is not None:
+            self._slo.start()
         self._threads = [
             threading.Thread(target=self._worker_loop, args=(p,),
                              name='serving-worker-%d' % i, daemon=True)
@@ -147,6 +156,11 @@ class ServingEngine(object):
         for t in self._threads:
             t.join()
         self._threads = []
+        if self._slo is not None:
+            # final check covers the tail between the last periodic
+            # evaluation and drain
+            self._slo.stop(final_check=True)
+            self._slo = None
 
     close = stop
 

@@ -192,9 +192,13 @@ def test_greedy_parity_bit_exact_and_compiles_once(lm_predictor):
         pos += 1
     assert stream == _ref_generate(lm_predictor, prompt,
                                    CFG.max_len - len(prompt) + 1)
-    # the whole loop compiled exactly two programs: prefill + decode
-    assert dec.jit_cache_stats() == {'prepared_programs': 2,
-                                     'compiled_segments': 2}
+    # the whole loop compiled exactly two programs: prefill + decode;
+    # every further dispatch was a jit-cache hit
+    stats = dec.jit_cache_stats()
+    assert stats['prepared_programs'] == 2
+    assert stats['compiled_segments'] == 2
+    assert stats['segment_misses'] == 2
+    assert stats['segment_hits'] >= 1
 
 
 def test_generate_past_max_len_slides_window(lm_predictor):

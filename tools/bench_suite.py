@@ -132,9 +132,16 @@ def _fresh_build(model, full):
     return loss, feed_fn, bs, scope, exe
 
 
-def run_one(model, mode, steps, full):
+def run_one(model, mode, steps, full, quick=False):
     import paddle_tpu as fluid
     import jax
+    if quick:
+        # perf-gate feed: record through the obs perf observatory so
+        # the row carries compile/MFU/HBM columns alongside throughput
+        from paddle_tpu.obs import telemetry, perf
+        telemetry.reset()
+        telemetry.enable()
+        perf._reset_for_tests()
     loss, feed_fn, bs, scope, exe = _fresh_build(model, full)
     rng = np.random.RandomState(0)
     if mode == 'parallel':
@@ -154,7 +161,17 @@ def run_one(model, mode, steps, full):
     row = {'model': model, 'mode': mode,
            'samples_per_sec': round(bs * steps / dt, 2),
            'loss': round(float(np.asarray(lv[0]).mean()), 4)}
-    if model == 'transformer' and mode == 'local':
+    if quick:
+        snap = telemetry.snapshot()
+        row['mfu'] = round(snap['gauges']['perf.mfu'], 4)
+        row['compile_ms'] = round(
+            snap['hists']['xla.compile_latency']['sum'] * 1e3, 1)
+        row['hbm_peak'] = int(snap['gauges']['hbm.watermark_bytes'])
+        telemetry.disable(final_flush=False)
+        telemetry.reset()
+    elif model == 'transformer' and mode == 'local':
+        # subprocess extra — skipped under --quick to keep the gate
+        # feed fast
         spd = _serving_quick()
         if spd:
             row['decode_speedup'] = spd
@@ -537,6 +554,15 @@ def main():
     ap.add_argument('--sp-ring', action='store_true',
                     help='scaling mode: sequence-parallel ring '
                          'attention over the mesh (longcontext model)')
+    ap.add_argument('--quick', action='store_true',
+                    help='fast perf-gate feed: local mode on a small '
+                         'model set, obs-gauge mfu/compile_ms/hbm_peak '
+                         'stamped into each row, slow subprocess '
+                         'extras skipped (tools/perf_gate.py '
+                         '--run-suite consumes this)')
+    ap.add_argument('--json', action='store_true',
+                    help='print the full row list as one JSON array '
+                         'on the last stdout line')
     args = ap.parse_args()
     if not args.full:
         os.environ.setdefault(
@@ -546,6 +572,11 @@ def main():
     models = MODELS if args.model == 'all' else [args.model]
     modes = (['local', 'parallel', 'dist', 'pserver']
              if args.mode == 'all' else [args.mode])
+    if args.quick:
+        if args.model == 'all':
+            models = ['mnist', 'transformer']
+        if args.mode == 'all':
+            modes = ['local']
     rows = []
     for model in models:
         for mode in modes:
@@ -562,7 +593,8 @@ def main():
                     row = run_dist(model, args.dist_trainers, args.steps,
                                    args.full)
                 else:
-                    row = run_one(model, mode, args.steps, args.full)
+                    row = run_one(model, mode, args.steps, args.full,
+                                  quick=args.quick)
             except Exception as e:   # noqa: BLE001 — suite keeps going
                 row = {'model': model, 'mode': mode,
                        'error': str(e)[:120]}
@@ -570,6 +602,8 @@ def main():
             print(json.dumps(row), flush=True)
     ok = sum('error' not in r for r in rows)
     print('# %d/%d configurations ran' % (ok, len(rows)))
+    if args.json:
+        print(json.dumps(rows), flush=True)
 
 
 if __name__ == '__main__':

@@ -52,6 +52,14 @@ def main(argv=None):
     ap.add_argument('--all', action='store_true',
                     help='show zero-valued series in the text rollup '
                          'too')
+    ap.add_argument('--xplane_dir', default=None,
+                    help='jax.profiler trace dir captured during the '
+                         'run: its device-op events join the timeline '
+                         'as per-chip device lanes')
+    ap.add_argument('--hlo_dir', default=None,
+                    help='dir of compiled-HLO .txt dumps used to map '
+                         'fused instruction names back to framework '
+                         'op names on the device lanes')
     args = ap.parse_args(argv)
     if not os.path.isdir(args.obs_dir):
         ap.error('--obs_dir %s is not a directory' % args.obs_dir)
@@ -59,7 +67,9 @@ def main(argv=None):
     tl, ru = report.write_report(args.obs_dir,
                                  timeline_path=args.timeline,
                                  rollup_path=args.rollup,
-                                 pretty=args.pretty)
+                                 pretty=args.pretty,
+                                 xplane_dir=args.xplane_dir,
+                                 hlo_dir=args.hlo_dir)
     n_span = sum(1 for e in tl['traceEvents'] if e.get('ph') == 'X')
     n_flow = sum(1 for e in tl['traceEvents'] if e.get('ph') == 's')
     shifts = tl.get('metadata', {}).get('clock_shifts', {})
