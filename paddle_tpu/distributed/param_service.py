@@ -133,6 +133,10 @@ _JOURNAL_APPENDS = _tm.counter('ps.journal.appends')
 _JOURNAL_REPLAYED = _tm.counter('ps.journal.replayed_frames')
 _SNAP_WRITES = _tm.counter('ps.snapshot.writes')
 _SNAP_RESTORES = _tm.counter('ps.snapshot.restores')
+# online refresh (paddle_tpu/online/): the version this shard currently
+# publishes, and how many GET_VARS shard pulls it served
+_PARAM_VERSION = _tm.gauge('ps.param_version')
+_VERSION_PULLS = _tm.counter('ps.version_pulls')
 
 
 class ParameterService(object):
@@ -140,7 +144,7 @@ class ParameterService(object):
                  run_one_grad=None, prefetch=None, save_params=None,
                  rpc_deadline=None, snapshot_path=None,
                  snapshot_every=None, dump_state=None, load_state=None,
-                 average_live=None):
+                 average_live=None, param_names=None):
         """get_param(name) -> value; run_round(merged: {grad: value});
         run_one_grad(grad_name, value) for async; prefetch(table, ids);
         save_params(dirname) checkpoints this server's shard (the
@@ -154,7 +158,10 @@ class ParameterService(object):
         persistable scope; snapshot_every (None ->
         FLAGS_ps_snapshot_every) is the round period. average_live
         (None -> FLAGS_ps_average_live) switches _merge to the live-set
-        denominator."""
+        denominator. param_names: the parameter block names this shard
+        hosts — enables online-refresh version publication (GET_VERSION
+        manifests + GET_VARS multi-pulls); None leaves those handlers
+        serving an empty manifest."""
         import time
         from ..flags import get_flag
         self.num_trainers = num_trainers
@@ -196,6 +203,16 @@ class ParameterService(object):
         self._dedup_window = int(get_flag('rpc_dedup_window', 512))
         self._seq_seen = {}           # tid -> set of tokens
         self._seq_order = {}          # tid -> deque (eviction order)
+        # -- online refresh ------------------------------------------------
+        # monotonically increasing param version this shard publishes:
+        # bumped at every sync round close (and per applied async grad),
+        # so version == completed optimizer rounds on a fresh server.
+        # The digest manifest (per-param crc32 over the exact bytes a
+        # GET_VARS pull ships) is computed lazily per version and cached
+        # — pollers pay the hash at most once per round, not per poll.
+        self.param_names = list(param_names or ())
+        self._param_version = 0
+        self._manifest_cache = None   # (version, {name: crc32}) or None
         # -- durability ----------------------------------------------------
         if snapshot_path is None:
             snapshot_path = get_flag('ps_state_path', '') or None
@@ -377,6 +394,10 @@ class ParameterService(object):
             self._barrier_tids.clear()
             self._completed_rounds += 1
             _ROUNDS.inc()
+            # the round's weights are final RIGHT HERE: publish them as
+            # a new param version (online subscribers poll GET_VERSION
+            # and pull the freshly-closed round's params)
+            self._bump_version_locked()
             # pending is empty RIGHT NOW — the cheapest instant for a
             # consistent snapshot; the barrier that closed this round
             # is acked only after the snapshot is durable
@@ -401,6 +422,60 @@ class ParameterService(object):
             if self._completed_rounds >= self._trainer_rounds.get(tid, 0):
                 break
             self._cond.wait(timeout=1.0)
+
+    # -- online refresh ----------------------------------------------------
+    def _bump_version_locked(self):
+        self._param_version += 1
+        self._manifest_cache = None
+        _PARAM_VERSION.set(self._param_version)
+
+    def _manifest_locked(self):
+        """{param block name: crc32 of its wire payload bytes} for the
+        CURRENT version, cached until the next bump. The digest covers
+        the exact canonical bytes a GET_VARS pull ships (_payload_of),
+        so subscriber-side verification is a byte-identity check, not a
+        float comparison."""
+        if self._manifest_cache is not None \
+                and self._manifest_cache[0] == self._param_version:
+            return self._manifest_cache[1]
+        from . import wire
+        from ..integrity import crc32
+        digests = {}
+        for name in self.param_names:
+            _, payload = wire._payload_of(self._get_param(name))
+            digests[name] = crc32(payload)
+        self._manifest_cache = (self._param_version, digests)
+        return digests
+
+    def on_get_version(self, tid, inc=None, with_manifest=False):
+        """Current published param version (reply meta). With
+        with_manifest, the per-param digest manifest rides along — the
+        subscriber learns WHAT this shard hosts and what bytes version
+        N's params must hash to."""
+        with self._lock:
+            self._enter_locked(tid, inc)
+            out = {'version': self._param_version}
+            if with_manifest:
+                out['manifest'] = self._manifest_locked()
+            return out
+
+    def on_get_vars(self, names, tid, inc=None):
+        """Atomic multi-param read for an online subscriber: every
+        requested param plus its digest, all read under ONE lock hold —
+        a version-consistent shard image even while trainers are
+        pushing the next round. Returns (version, [(entry_meta, value),
+        ...]) for the server to pack into one REPLY_VAR frame."""
+        with self._lock:
+            self._enter_locked(tid, inc)
+            manifest = self._manifest_locked()
+            items = []
+            for name in names:
+                e = {'name': name}
+                if name in manifest:
+                    e['digest'] = manifest[name]
+                items.append((e, self._get_param(name)))
+            _VERSION_PULLS.inc()
+            return self._param_version, items
 
     def _enter_locked(self, tid, inc=None):
         """Fence + touch + liveness check under the CALLER's lock:
@@ -507,6 +582,7 @@ class ParameterService(object):
                              for k, v in self._incarnations.items()},
             'seq_order': {str(k): [list(t) for t in v]
                           for k, v in self._seq_order.items()},
+            'param_version': self._param_version,
         }
         arrays = {'p:' + name: np.asarray(val)
                   for name, val in self._dump_state().items()}
@@ -607,6 +683,11 @@ class ParameterService(object):
                 tid = int(k)
                 self._seq_order[tid] = deque(tuple(t) for t in toks)
                 self._seq_seen[tid] = set(self._seq_order[tid])
+            # pre-online snapshots carry no version: resume publication
+            # at the restored round count (the fresh-server identity)
+            self._param_version = int(
+                state.get('param_version', self._completed_rounds))
+            self._manifest_cache = None
             loaded = cand
             _SNAP_RESTORES.inc()
             if cand != snap:
@@ -736,6 +817,9 @@ class ParameterService(object):
                 self._run_one_grad(name, value)
                 self._record_seq_locked(tid, seq)
                 self._async_applied += 1
+                # async has no rounds: every applied grad IS a publish
+                # point (the reference's async-SGD staleness model)
+                self._bump_version_locked()
                 # async has no round boundary; snapshot on a send count
                 if (self.snapshot_path and not self._replaying
                         and self._async_applied % 256 == 0):
@@ -857,6 +941,12 @@ class ParameterService(object):
     def on_complete(self, tid, inc=None):
         from . import wire
         with self._lock:
+            if tid >= self.num_trainers:
+                # a serving-side client (rpc.SERVING_TID_BASE range)
+                # closing its connection: it was never part of the
+                # training contract, so its COMPLETE must not count
+                # toward (or trip) the all-trainers-done shutdown
+                return False
             # same zombie rejection as every other handler: a
             # deadline-retired trainer's COMPLETE must fail loudly, not
             # silently shrink the expected-completions set

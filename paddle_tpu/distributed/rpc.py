@@ -57,6 +57,7 @@ from ..obs import telemetry as _tm
 from ..obs import trace as _trace
 
 __all__ = ['PSClient', 'PSServer', 'get_client', 'close_all_clients',
+           'get_serving_client', 'SERVING_TID_BASE',
            'RetryableRPCError', 'FatalRPCError']
 
 # client-side RPC health: every logical call, every replay of one
@@ -75,11 +76,20 @@ _BATCH_VARS = _tm.histogram('rpc.client.batch_vars')
 
 _MSG_NAMES = {
     wire.SEND_VAR: 'SEND_VAR', wire.GET_VAR: 'GET_VAR',
-    wire.SEND_VARS: 'SEND_VARS',
+    wire.SEND_VARS: 'SEND_VARS', wire.GET_VARS: 'GET_VARS',
+    wire.GET_VERSION: 'GET_VERSION',
     wire.PREFETCH: 'PREFETCH', wire.BATCH_BARRIER: 'BATCH_BARRIER',
     wire.FETCH_BARRIER: 'FETCH_BARRIER', wire.COMPLETE: 'COMPLETE',
     wire.CHECKPOINT: 'CHECKPOINT', wire.REGISTER: 'REGISTER',
 }
+
+# serving-side trainer-id range: a ParamSubscriber co-located with a
+# trainer process must never share the server's per-tid (cli, seq)
+# dedup/replay windows, liveness clocks, or round state with the real
+# trainer 0..num_trainers-1 — tids at or above this base are READ-ONLY
+# peers the ParameterService treats as inert (no liveness retirement,
+# no round waits, COMPLETE ignored).
+SERVING_TID_BASE = 1 << 16
 
 
 def _msg_name(msg_type):
@@ -682,6 +692,47 @@ class PSClient(object):
         return _chain(self._submit(wire.GET_VAR, {'name': name}),
                       lambda r: r[1])
 
+    def get_vars_async(self, names):
+        """Pipelined multi-param pull (online refresh): ONE GET_VARS
+        frame for all of `names`, read atomically on the server. Future
+        resolves to (version, entries, values) — entries carry the
+        per-param digest stamped under the same lock hold as the read,
+        values decode in entry order."""
+        return _chain(self._submit(wire.GET_VARS,
+                                   {'names': [str(n) for n in names]}),
+                      lambda r: (int(r[0].get('version', 0)),
+                                 r[0].get('vars', []), r[1]))
+
+    def get_version_async(self, with_manifest=False):
+        """Pipelined version poll: future resolving to {'version': int
+        [, 'manifest': {name: crc32}]} for this shard."""
+        def _strip(r):
+            out = dict(r[0])
+            out.pop('seq', None)
+            return out
+        meta = {'manifest': True} if with_manifest else {}
+        return _chain(self._submit(wire.GET_VERSION, meta), _strip)
+
+    def get_version(self, with_manifest=False):
+        """This shard's current published param version (optionally
+        with the per-param digest manifest)."""
+        if self._reader is not None:
+            return self.get_version_async(with_manifest).result()
+        meta = {'manifest': True} if with_manifest else {}
+        rmeta, _ = self._call(wire.GET_VERSION, meta)
+        out = dict(rmeta)
+        out.pop('seq', None)
+        return out
+
+    def get_vars(self, names):
+        """Blocking multi-param pull — see get_vars_async."""
+        if self._reader is not None:
+            return self.get_vars_async(names).result()
+        rmeta, values = self._call(
+            wire.GET_VARS, {'names': [str(n) for n in names]})
+        return (int(rmeta.get('version', 0)),
+                rmeta.get('vars', []), values)
+
     def prefetch_async(self, table_name, ids):
         """Pipelined prefetch: future resolving to the embedding rows."""
         import numpy as np
@@ -789,6 +840,15 @@ def get_client(endpoint, trainer_id=0):
         return c
 
 
+def get_serving_client(endpoint, subscriber_id=0):
+    """A pooled PSClient in the serving tid range (SERVING_TID_BASE +
+    subscriber_id): its (cli, seq) tokens, liveness clock and dedup
+    window on the server are disjoint from every co-located trainer's
+    client pool — a subscriber pull can never be mistaken for (or
+    replay-collide with) trainer traffic."""
+    return get_client(endpoint, SERVING_TID_BASE + int(subscriber_id))
+
+
 def _evict_client(client):
     """Drop a poisoned client from the pool (called by the client itself
     while holding its own lock — take only the pool lock here)."""
@@ -831,6 +891,8 @@ class PSServer(object):
       on_checkpoint(dirname, trainer_id, seq=None, inc=None)
       on_register(trainer_id, inc=None, seq=None) -> reply meta dict
       on_complete(trainer_id, inc=None) -> True when ALL completed
+      on_get_vars(names, trainer_id, inc=None) -> (version, items)
+      on_get_version(trainer_id, inc=None, with_manifest=False) -> meta
 
     A restarted pserver re-binding its endpoint may race the dying
     process's listener (or its TIME_WAIT): bind retries for
@@ -987,6 +1049,24 @@ class PSServer(object):
             wire.write_msg(conn, wire.REPLY_OK, ack)
         elif msg_type == wire.REGISTER:
             out = svc.on_register(tid, inc=inc, seq=key)
+            reply = dict(out or {})
+            reply.update(ack)
+            wire.write_msg(conn, wire.REPLY_OK, reply)
+        elif msg_type == wire.GET_VARS:
+            version, items = svc.on_get_vars(meta.get('names', ()),
+                                             tid, inc=inc)
+            entries, payload = wire.pack_vars_body(items)
+            reply = dict(ack)
+            reply['version'] = int(version)
+            reply['vars'] = entries
+            # one REPLY_VAR frame for the whole shard pull: the 'vars'
+            # meta makes the client decode it as a value list, and a
+            # chaos-plan 'corrupt' rule on REPLY_VAR hits exactly this
+            # reply (the refresh-path fault surface)
+            wire.write_msg(conn, wire.REPLY_VAR, reply, payload=payload)
+        elif msg_type == wire.GET_VERSION:
+            out = svc.on_get_version(
+                tid, inc=inc, with_manifest=bool(meta.get('manifest')))
             reply = dict(out or {})
             reply.update(ack)
             wire.write_msg(conn, wire.REPLY_OK, reply)

@@ -65,6 +65,17 @@ REGISTER = 11       # trainer -> pserver: (re)join handshake — carries the
                     # trainer's incarnation; reply meta reports the
                     # server's round state so a restarted trainer knows
                     # where to resume (elastic recovery)
+GET_VARS = 13       # serving -> pserver: pull MANY params in one frame
+                    # (meta['names']); the REPLY_VAR carries meta['vars']
+                    # entries (name/dtype/shape/len/digest) + the params'
+                    # concatenated bytes, all read atomically under the
+                    # service lock and stamped with the param version
+                    # they belong to (online refresh pulls one
+                    # version-consistent shard per round trip)
+GET_VERSION = 14    # serving -> pserver: current param version; with
+                    # meta['manifest'] the REPLY_OK also carries the
+                    # per-param crc32 digest manifest the subscriber
+                    # verifies pulled bytes against
 REPLY_VAR = 7       # pserver -> trainer: a variable value
 REPLY_OK = 8        # pserver -> trainer: ack
 REPLY_ERR = 9       # pserver -> trainer: error (meta['error'])
@@ -191,6 +202,25 @@ def _values_of_batch(meta, payload):
         values.append(_value_of(e, payload[off:off + n]))
         off += n
     return values
+
+
+def pack_vars_body(items):
+    """(entries, payload) for a multi-var frame body: items is
+    [(entry_meta, value), ...]; each entry gets the value's dtype/shape
+    plus 'len' filled in, the payload is the values' bytes back to back
+    — the exact body _values_of_batch decodes. The inverse pairing lets
+    a server build a multi-var REPLY_VAR through the ordinary write_msg
+    path (fault hooks see ONE reply frame, matching the one logical
+    GET_VARS request)."""
+    entries, chunks = [], []
+    for emeta, value in items:
+        vmeta, payload = _payload_of(value)
+        e = dict(emeta)
+        e.update(vmeta)
+        e['len'] = len(payload)
+        entries.append(e)
+        chunks.append(payload)
+    return entries, b''.join(chunks)
 
 
 def _parse_body(body, meta_len):

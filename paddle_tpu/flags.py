@@ -132,6 +132,14 @@ Known flags:
                          ('' = no watchdog). Breaches emit slo.breach
                          trace events + the slo.breaches counter
   slo_check_secs         SLOWatchdog evaluation period in seconds
+  online_poll_secs       ParamSubscriber (paddle_tpu/online/) version-
+                         poll period in seconds — how often serving
+                         asks its pservers for the published param
+                         version between refreshes
+  online_pull_timeout    seconds one refresh (version poll + shard
+                         pulls + verify + stage) may take before it is
+                         abandoned; the previously installed verified
+                         version keeps serving
 """
 from __future__ import annotations
 
@@ -272,6 +280,12 @@ _DEFAULTS = {
     # instrumented training step.
     'slo_rules': '',
     'slo_check_secs': 5.0,
+    # online refresh (paddle_tpu/online/): subscriber version-poll
+    # cadence, and the wall budget one refresh (poll + pull + verify +
+    # stage) gets before it is abandoned in favor of the installed
+    # version
+    'online_poll_secs': 0.5,
+    'online_pull_timeout': 30.0,
     # batch_norm under data parallelism: compute statistics per device
     # (the reference's semantics — multi_devices_graph_pass.cc replicates
     # batch_norm per device, so stats are local and un-synced) instead of

@@ -345,6 +345,17 @@ def _listen_and_serv_emit(ctx, op):
         for name, val in params.items():
             scope.set_var(name, val)
 
+    # the param blocks this shard hosts = the Param input of each
+    # optimize sub-block (online refresh publishes versions + digest
+    # manifests over exactly these; accumulators/LR vars stay private)
+    param_names = []
+    for g in sorted(grad_to_block):
+        for blk_op in program.blocks[grad_to_block[g]].ops:
+            if blk_op.input('Param'):
+                p = blk_op.single_input('Param')
+                if p not in param_names:
+                    param_names.append(p)
+
     ckpt_dir = op.attr('checkpoint_dir', '')
     if ckpt_dir:
         # restore this shard from a checkpoint_notify save (the reload
@@ -365,7 +376,8 @@ def _listen_and_serv_emit(ctx, op):
         run_one_grad=run_one_grad,
         prefetch=prefetch if op.attr('prefetch_table', '') else None,
         save_params=save_params,
-        dump_state=dump_state, load_state=load_state)
+        dump_state=dump_state, load_state=load_state,
+        param_names=param_names)
     server = PSServer(op.attr('endpoint'), service)
     server.serve_forever()
 

@@ -38,7 +38,26 @@ class LMServer(object):
         self._engine = ServingEngine(dec, workers=workers,
                                      max_queue=max_queue)
         self._requests = {}
+        self._subscriber = None
         self._engine.start()
+
+    # -- online refresh ----------------------------------------------------
+    def enable_refresh(self, endpoints, subscriber_id=0, poll_secs=None,
+                       pull_timeout=None, start=True):
+        """Attach a ParamSubscriber (paddle_tpu/online/): serving
+        tracks the pserver fleet's published param versions and
+        installs fresh weights at decode step boundaries. Returns the
+        subscriber (started unless start=False)."""
+        if self._subscriber is not None:
+            return self._subscriber
+        from ..online import ParamSubscriber
+        self._subscriber = ParamSubscriber(
+            endpoints, self._decode, engine=self._engine,
+            subscriber_id=subscriber_id, poll_secs=poll_secs,
+            pull_timeout=pull_timeout)
+        if start:
+            self._subscriber.start()
+        return self._subscriber
 
     # -- blocking ----------------------------------------------------------
     def generate(self, prompt, max_new_tokens=16, eos_id=None,
@@ -75,9 +94,25 @@ class LMServer(object):
 
     # -- ops ---------------------------------------------------------------
     def stats(self):
-        return self._engine.stats()
+        """Engine stats plus the online-refresh position: param_version
+        (installed; None before any refresh machinery is attached) and
+        staleness_rounds (rounds behind the newest published version)."""
+        out = self._engine.stats()
+        if self._subscriber is not None:
+            sub = self._subscriber.stats()
+            out['param_version'] = sub['installed_version']
+            out['staleness_rounds'] = sub['staleness_rounds']
+            out['refreshes'] = sub['refreshes']
+            out['refresh_failures'] = sub['failures']
+        else:
+            out['param_version'] = None
+            out['staleness_rounds'] = None
+        return out
 
     def close(self, drain=True):
+        if self._subscriber is not None:
+            self._subscriber.stop()
+            self._subscriber = None
         self._engine.stop(drain=drain)
 
     def __enter__(self):

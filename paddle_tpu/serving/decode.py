@@ -121,6 +121,49 @@ class DecodePredictor(object):
                 val = jax.device_put(ckpt.read(name), self._exe.device)
             self._weight_scope.set_var(name, val)
 
+    def param_names(self):
+        """The refreshable weight names: every transpile-referenced
+        param minus the runtime cache vars (which are per-worker state,
+        never shipped by a parameter server)."""
+        cache_names = set(self._pair.cache_names)
+        return [n for n in self._pair.spec.param_names()
+                if n not in cache_names]
+
+    def stage_weights(self, params):
+        """Stage a {name: host array} weight update for install: names
+        are validated against the decode programs' param set, shapes
+        against the currently pinned values, and every array is
+        device_put OFF the decode path — the expensive half of a
+        refresh. Returns an opaque staged dict for install_weights.
+        Raises (installing nothing) on an unknown name or a shape
+        mismatch."""
+        import jax
+        known = set(self.param_names())
+        staged = {}
+        for name, val in params.items():
+            if name not in known:
+                raise KeyError(
+                    'refresh carries unknown param %r (this predictor '
+                    'serves %d params)' % (name, len(known)))
+            arr = np.ascontiguousarray(val)
+            cur = self._weight_scope.find_var(name)
+            cur_shape = getattr(cur, 'shape', None)
+            if cur_shape is not None and tuple(cur_shape) != arr.shape:
+                raise ValueError(
+                    'refresh shape mismatch for %r: got %r, serving %r'
+                    % (name, arr.shape, tuple(cur_shape)))
+            staged[name] = jax.device_put(arr, self._exe.device)
+        return staged
+
+    def install_weights(self, staged):
+        """Swap staged device arrays into the PARENT weight scope — a
+        few dict-pointer writes, cheap enough to run under the serving
+        engine's step-boundary swap gate. Every clone sees the new
+        weights on its next step (shared parent scope); in-flight steps
+        already read the old arrays."""
+        for name, val in staged.items():
+            self._weight_scope.set_var(name, val)
+
     def reset(self):
         """Zero every ring cache (all slots forget everything)."""
         shape = self._pair.spec.cache_shape(self.slots)

@@ -21,6 +21,7 @@ Telemetry (paddle_tpu/obs/, exported when FLAGS_obs_dir is set):
 from __future__ import annotations
 
 import collections
+import contextlib
 import itertools
 import threading
 import time
@@ -49,6 +50,56 @@ _occupancy = telemetry.gauge('serving.slot_occupancy')
 _ttft = telemetry.histogram('serving.ttft')
 _token_latency = telemetry.histogram('serving.token_latency')
 _decode_batch = telemetry.histogram('serving.decode_batch')
+_weight_swaps = telemetry.counter('serving.weight_swaps')
+_swap_wait = telemetry.histogram('serving.swap_wait')
+
+
+class _StepGate(object):
+    """Writer-preferring read/write gate around engine steps.
+
+    Every worker iteration (admission prefills + the decode step) runs
+    as a READER; a weight install (ParamSubscriber via request_swap)
+    runs as the SOLE WRITER. A waiting writer blocks new iterations
+    from starting, drains the in-flight ones, runs between two steps,
+    and releases — the ISSUE's step-boundary swap contract: in-flight
+    decode steps finish on the old weights, the next step reads the
+    new ones, and the writer's critical section is only the staged
+    pointer swap (never a network pull)."""
+
+    def __init__(self):
+        self._mu = threading.Condition()
+        self._readers = 0
+        self._writers_waiting = 0
+        self._writing = False
+
+    @contextlib.contextmanager
+    def read(self):
+        with self._mu:
+            while self._writing or self._writers_waiting:
+                self._mu.wait()
+            self._readers += 1
+        try:
+            yield
+        finally:
+            with self._mu:
+                self._readers -= 1
+                if not self._readers:
+                    self._mu.notify_all()
+
+    @contextlib.contextmanager
+    def exclusive(self):
+        with self._mu:
+            self._writers_waiting += 1
+            while self._writing or self._readers:
+                self._mu.wait()
+            self._writers_waiting -= 1
+            self._writing = True
+        try:
+            yield
+        finally:
+            with self._mu:
+                self._writing = False
+                self._mu.notify_all()
 
 
 class Request(object):
@@ -120,6 +171,8 @@ class ServingEngine(object):
         self._threads = []
         self._active_total = 0
         self._slo = None
+        self._gate = _StepGate()
+        self._swaps = 0
 
     # -- lifecycle ---------------------------------------------------------
     def start(self):
@@ -206,12 +259,33 @@ class ServingEngine(object):
             req.state = CANCELLED
         return req
 
+    def request_swap(self, fn, label='weights'):
+        """Run fn() with every worker quiesced at a step boundary and
+        return its result. fn must be CHEAP (staged-pointer installs,
+        not pulls): it holds up every decode lane while it runs. With
+        the engine stopped there are no steps in flight and fn runs
+        inline. The wait-for-boundary time lands in serving.swap_wait;
+        serving.weight_swaps counts completed swaps."""
+        t0 = time.perf_counter()
+        if not self._threads:
+            out = fn()
+            self._swaps += 1
+            _weight_swaps.inc()
+            return out
+        with self._gate.exclusive():
+            _swap_wait.observe(time.perf_counter() - t0)
+            out = fn()
+            self._swaps += 1
+            _weight_swaps.inc()
+            return out
+
     def stats(self):
         with self._cond:
             depth = len(self._queue)
         return {'queue_depth': depth, 'active': self._active_total,
                 'workers': len(self._predictors),
                 'slots_per_worker': self._predictors[0].slots,
+                'weight_swaps': self._swaps,
                 'jit': self._predictors[0].jit_cache_stats()}
 
     # -- scheduler ---------------------------------------------------------
@@ -299,26 +373,30 @@ class ServingEngine(object):
                     self._cond.wait(self._idle_wait)
                 if not self._running and not self._queue and not lanes:
                     return
-            self._admit(pred, lanes)
-            _occupancy.set(self._active_total)
-            if not lanes:
-                continue
-            for slot, lane in lanes.items():
-                tokens[slot] = lane.tok
-                positions[slot] = lane.pos
-            t0 = time.perf_counter()
-            try:
-                ids = pred.decode_step(tokens, positions)
-            except Exception as e:       # noqa: BLE001 — engine survives
+            # one gate-read section per iteration: a waiting weight
+            # swap (request_swap) runs between iterations — i.e. at a
+            # step boundary — never under a prefill or decode step
+            with self._gate.read():
+                self._admit(pred, lanes)
+                _occupancy.set(self._active_total)
+                if not lanes:
+                    continue
+                for slot, lane in lanes.items():
+                    tokens[slot] = lane.tok
+                    positions[slot] = lane.pos
+                t0 = time.perf_counter()
+                try:
+                    ids = pred.decode_step(tokens, positions)
+                except Exception as e:   # noqa: BLE001 — engine survives
+                    for slot in list(lanes):
+                        self._finish_lane(lanes, slot, FAILED,
+                                          error=repr(e))
+                    continue
+                dt = time.perf_counter() - t0
+                _decode_steps.inc()
+                _token_latency.observe(dt)
+                _decode_batch.observe(len(lanes))
                 for slot in list(lanes):
-                    self._finish_lane(lanes, slot, FAILED,
-                                      error=repr(e))
-                continue
-            dt = time.perf_counter() - t0
-            _decode_steps.inc()
-            _token_latency.observe(dt)
-            _decode_batch.observe(len(lanes))
-            for slot in list(lanes):
-                lanes[slot].pos += 1
-                self._lane_accept(lanes, slot, int(ids[slot]))
-            _occupancy.set(self._active_total)
+                    lanes[slot].pos += 1
+                    self._lane_accept(lanes, slot, int(ids[slot]))
+                _occupancy.set(self._active_total)

@@ -172,9 +172,11 @@ def run_one(model, mode, steps, full, quick=False):
     elif model == 'transformer' and mode == 'local':
         # subprocess extra — skipped under --quick to keep the gate
         # feed fast
-        spd = _serving_quick()
-        if spd:
-            row['decode_speedup'] = spd
+        serving = _serving_quick()
+        if serving.get('infer_decode_speedup'):
+            row['decode_speedup'] = serving['infer_decode_speedup']
+        if serving.get('refresh_p99_ratio'):
+            row['refresh_p99_ratio'] = serving['refresh_p99_ratio']
     return row
 
 
@@ -373,22 +375,25 @@ _SERVING_QUICK = [None]     # serve_bench --quick, measured at most once
 
 
 def _serving_quick():
-    """Headline cached-vs-recompute decode speedup
-    (tools/serve_bench.py --quick) stamped onto the transformer
-    local-mode row; one subprocess, cached across invocations."""
+    """Headline serving numbers (tools/serve_bench.py --quick
+    --refresh) stamped onto the transformer local-mode row: the
+    cached-vs-recompute decode speedup plus the online-refresh tail
+    cost (refresh_p99_ratio — token p99 with a live ParamSubscriber
+    install loop over the undisturbed p99). One subprocess, cached
+    across invocations; {} on any failure."""
     if _SERVING_QUICK[0] is None:
         try:
             env = dict(os.environ, JAX_PLATFORMS='cpu')
             out = subprocess.run(
                 [sys.executable,
                  os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                              'serve_bench.py'), '--quick'],
+                              'serve_bench.py'), '--quick', '--refresh'],
                 capture_output=True, text=True, timeout=300, env=env)
             line = [ln for ln in out.stdout.splitlines()
                     if ln.startswith('{') and '"summary"' in ln][-1]
-            _SERVING_QUICK[0] = json.loads(line)['infer_decode_speedup']
+            _SERVING_QUICK[0] = json.loads(line)
         except Exception:   # noqa: BLE001 — a bench extra, never fatal
-            _SERVING_QUICK[0] = 0.0
+            _SERVING_QUICK[0] = {}
     return _SERVING_QUICK[0]
 
 

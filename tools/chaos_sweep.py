@@ -48,6 +48,20 @@ and the pserver finite guard rejects NaN payloads retryably, so the
 retry resends the clean value in both cases; `fatal`/`hung` here means
 an integrity hole, not a plan-dependent outcome.
 
+`--refresh` chaoses the online-learning loop (paddle_tpu/online/): a
+1-trainer cluster trains through its sync rounds while a SEPARATE
+serving process tracks the pserver fleet's published param versions
+via ParamSubscriber (tests/online_worker.py roles). Each seed faults
+pserver 0 — bit-flipped outbound replies, or a kill mid-traffic under
+the restarting Supervisor — and the serving process (never restarted)
+must end installed at version == steps with param digests matching the
+trainer's final pull: corrupt pulls keep the old version serving until
+a clean retry, a shard outage just stalls staleness. Verdicts: `ok`
+(corrupt plan survived), `recovered`/`nokill` (kill plan, shard
+restarted / kill point never fired), `diverged` (serving's installed
+bytes differ from the trainer's — a refresh-integrity bug, report the
+seed), plus the usual `fatal`/`hung`.
+
 `--quick` is the CI smoke shape: 3 seeds by default, and the exit
 status is ALSO non-zero on any fatal/hung seed (a quick sweep exists
 to gate regressions, so every non-ok outcome fails it).
@@ -59,6 +73,7 @@ Usage:
     python tools/chaos_sweep.py --kill --seeds 10   # process-kill mode
     python tools/chaos_sweep.py --corrupt --quick   # integrity smoke
     python tools/chaos_sweep.py --mesh-kill --quick # sharded-mesh kill
+    python tools/chaos_sweep.py --refresh --quick   # online-refresh chaos
 
 Exit status is non-zero iff any seed DIVERGED (or, under --quick, any
 seed was fatal/hung): fatal/hung seeds of the full sweep are
@@ -81,6 +96,7 @@ sys.path.insert(0, os.path.join(_ROOT, 'tests'))
 
 _WORKER = os.path.join(_ROOT, 'tests', 'ps_worker.py')
 _MESH_WORKER = os.path.join(_ROOT, 'tests', 'mesh_worker.py')
+_ONLINE_WORKER = os.path.join(_ROOT, 'tests', 'online_worker.py')
 
 
 def _free_ports(n):
@@ -274,6 +290,97 @@ def _run_mesh_seed(kill_nth, steps, budget, workdir, obs_dir=None,
             weights, plan_json, [out])
 
 
+def _run_refresh_seed(seed, steps, pservers, budget, workdir,
+                      obs_dir=None):
+    """One --refresh seed: trainer x pservers x ONE serving process
+    (tests/online_worker.py roles) under the Supervisor, with a seeded
+    fault on pserver 0 — either bit-flipped outbound replies (the
+    subscriber's pull path must reject the corrupt frame and keep the
+    old version serving until a clean retry) or a kill mid-traffic (the
+    Supervisor restarts the shard from its snapshot and the refresh
+    loop rides out the outage). The serving process is NEVER
+    restarted; acceptance is that it ends installed at version ==
+    steps with param digests matching the trainer's final pull.
+    Returns (verdict, fault_mode, plan_json, outs)."""
+    import random
+
+    from paddle_tpu.distributed.supervisor import Supervisor
+
+    rng = random.Random(('refresh', seed).__repr__())
+    mode = rng.choice(['corrupt', 'kill'])
+    if mode == 'corrupt':
+        rules = [{'when': 'send', 'type': 'REPLY_VAR',
+                  'nth': rng.randint(1, 6), 'action': 'corrupt',
+                  'bits': rng.randint(1, 8)}
+                 for _ in range(rng.randint(1, 2))]
+    else:
+        rules = [{'when': 'recv',
+                  'type': rng.choice(['GET_VERSION', 'GET_VARS',
+                                      'SEND_VAR']),
+                  'nth': rng.randint(2, 8), 'action': 'exit'}]
+    plan_json = json.dumps({'rules': rules})
+
+    eps = ','.join('127.0.0.1:%d' % p for p in _free_ports(pservers))
+    base_env = dict(os.environ)
+    base_env.pop('XLA_FLAGS', None)
+    base_env.update({'PS_ENDPOINTS': eps, 'PS_STEPS': str(steps),
+                     'ON_DIR': workdir,
+                     'FLAGS_online_poll_secs': '0.1',
+                     'FLAGS_rpc_deadline': '120',
+                     'FLAGS_rpc_max_retries': '12',
+                     'FLAGS_rpc_reconnect_secs': '10'})
+    if obs_dir:
+        base_env['FLAGS_obs_flush_secs'] = '0.5'
+    sup = Supervisor(max_restarts=2, backoff=0.5, log_dir=workdir,
+                     obs_dir=obs_dir)
+    for i in range(pservers):
+        env = dict(base_env, ON_ROLE='pserver', PS_PSERVER_ID=str(i),
+                   FLAGS_ps_state_path=os.path.join(
+                       workdir, 'ps%d_s%d.state' % (i, seed)))
+        if i == 0:
+            env['FLAGS_fault_plan'] = plan_json
+        sup.add_role('pserver%d' % i,
+                     [sys.executable, _ONLINE_WORKER], env=env)
+    sup.add_role('trainer0', [sys.executable, _ONLINE_WORKER],
+                 env=dict(base_env, ON_ROLE='trainer'))
+    # serving must survive the whole seed on its own refresh machinery:
+    # a serving crash (or restart) is a finding, not a recovery
+    sup.add_role('serving0', [sys.executable, _ONLINE_WORKER],
+                 env=dict(base_env, ON_ROLE='serving'),
+                 restartable=False)
+    sup.start()
+    states = sup.wait(timeout=budget)
+    outs = [sup.output(n) for n in sorted(states)]
+    try:
+        if any(s in ('running', 'backoff') for s in states.values()):
+            return 'hung', mode, plan_json, outs
+        if any(s == 'failed' for s in states.values()):
+            return 'fatal', mode, plan_json, outs
+
+        def result_of(name):
+            for ln in sup.output(name).splitlines():
+                if ln.startswith('RESULT '):
+                    return json.loads(ln[len('RESULT '):])
+            return None
+        trainer, serving = result_of('trainer0'), result_of('serving0')
+        if trainer is None or serving is None:
+            return 'fatal', mode, plan_json, outs
+        if serving['installed_version'] != steps:
+            return 'diverged', mode, plan_json, outs
+        for name, digest in serving['digests'].items():
+            # the bytes serving installed must be the bytes the
+            # trainer's final fetch_barrier pulled — end-to-end, per
+            # param, regardless of what the fault did in between
+            if trainer['digests'].get(name) != digest:
+                return 'diverged', mode, plan_json, outs
+        if mode == 'kill':
+            return (('recovered' if sup.restarts['pserver0'] else
+                     'nokill'), mode, plan_json, outs)
+        return 'ok', mode, plan_json, outs
+    finally:
+        sup.stop()
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument('--seeds', type=int, default=None,
@@ -298,6 +405,11 @@ def main(argv=None):
                     help='sharded-mesh elastic recovery: kill-9 a '
                          'supervised mesh trainer (sharded checkpoints) '
                          'at a seeded step; bit-exact resume required')
+    ap.add_argument('--refresh', action='store_true',
+                    help='online-refresh chaos: corrupt/kill pserver 0 '
+                         'while a serving process tracks its published '
+                         'param versions; serving must converge to the '
+                         "trainer's final digests without restarting")
     ap.add_argument('--quick', action='store_true',
                     help='CI smoke: 3 seeds unless --seeds given, and '
                          'fatal/hung seeds fail the sweep too')
@@ -310,9 +422,10 @@ def main(argv=None):
                     help='where --report keeps per-seed obs output '
                          '(default: a ./chaos_report.<pid> dir)')
     args = ap.parse_args(argv)
-    if sum((args.kill, args.corrupt, args.mesh_kill)) > 1:
-        ap.error('--kill, --corrupt and --mesh-kill are mutually '
-                 'exclusive')
+    if sum((args.kill, args.corrupt, args.mesh_kill,
+            args.refresh)) > 1:
+        ap.error('--kill, --corrupt, --mesh-kill and --refresh are '
+                 'mutually exclusive')
     if args.seeds is None:
         args.seeds = 3 if args.quick else 20
 
@@ -323,7 +436,12 @@ def main(argv=None):
 
     from paddle_tpu.distributed.resilience import FaultPlan
 
-    if args.mesh_kill:
+    if args.refresh:
+        # no external baseline: the trainer's OWN final-pull digests
+        # (printed by online_worker) are the acceptance reference, so
+        # the comparison lives inside _run_refresh_seed
+        local_w = {}
+    elif args.mesh_kill:
         # the mesh sweep's baseline is the same worker, fault-free —
         # acceptance is BIT-exact, so it must be the identical program,
         # not ps_worker's local_train
@@ -351,7 +469,8 @@ def main(argv=None):
         report_root = args.report_dir or ('chaos_report.%d' % os.getpid())
         os.makedirs(report_root, exist_ok=True)
 
-    ok_verdicts = (('recovered', 'nokill')
+    ok_verdicts = (('ok', 'recovered', 'nokill') if args.refresh
+                   else ('recovered', 'nokill')
                    if (args.kill or args.mesh_kill) else ('ok',))
     tally = {'ok': 0, 'recovered': 0, 'nokill': 0, 'diverged': 0,
              'fatal': 0, 'hung': 0}
@@ -362,7 +481,14 @@ def main(argv=None):
         if report_root:
             obs_dir = os.path.join(report_root, 'seed%04d' % seed)
             os.makedirs(obs_dir, exist_ok=True)
-        if args.mesh_kill:
+        if args.refresh:
+            with tempfile.TemporaryDirectory() as workdir:
+                verdict, fmode, plan_json, outs = _run_refresh_seed(
+                    seed, args.steps, args.pservers, args.budget,
+                    workdir, obs_dir)
+            weights = {}
+            label = 'refresh/%s %s' % (fmode, plan_json)
+        elif args.mesh_kill:
             # kill inside the live step range; nth counts on_step calls
             kill_nth = random.Random(('mesh', seed).__repr__()).randint(
                 2, mesh_steps)
@@ -427,7 +553,8 @@ def main(argv=None):
           % (total, tally['ok'], tally['recovered'], tally['nokill'],
              tally['diverged'], tally['fatal'], tally['hung']))
     if report_root:
-        mode = ('mesh-kill' if args.mesh_kill
+        mode = ('refresh' if args.refresh
+                else 'mesh-kill' if args.mesh_kill
                 else 'kill' if args.kill
                 else 'corrupt' if args.corrupt else 'fault')
         report_path = os.path.join(report_root, 'sweep_report.json')

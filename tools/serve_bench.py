@@ -124,12 +124,128 @@ def _engine_leg(pred, cfg, slots, n_requests, new_tokens):
             'ttft_max_ms': round(max(ttfts) * 1e3, 1)}
 
 
+def _refresh_leg(pred, cfg, slots, n_requests, new_tokens):
+    """Online-refresh cost leg: the SAME engine burst twice — once
+    undisturbed, once with a live ParamSubscriber installing a new
+    param version every ~50 ms (in-process pserver publishing rounds)
+    — and the per-token latency p50/p99 + tokens/s for both.
+    refresh_p99_ratio (refresh p99 / baseline p99) is the headline:
+    how much tail a concurrent refresh loop costs a decode stream."""
+    import threading
+
+    from paddle_tpu.distributed.param_service import ParameterService
+    from paddle_tpu.distributed.rpc import PSClient, PSServer
+    from paddle_tpu.obs import telemetry
+    from paddle_tpu.online import ParamSubscriber
+    from paddle_tpu.serving import ServingEngine
+
+    rng = np.random.RandomState(3)
+    dec = pred.prepare_decoding(slots=slots, prefill_batch=1)
+    prompts = [rng.randint(0, cfg.vocab, max(1, cfg.max_len // 2))
+               for _ in range(n_requests)]
+    dec.prefill([prompts[0]], [0])      # compile outside the window
+    dec.decode_step(np.zeros(slots, 'int64'), np.zeros(slots, 'int32'))
+
+    # in-process pserver shard hosting the predictor's own params: a
+    # refresh pulls + installs the full model, decode output unchanged
+    params = {n: np.asarray(dec._weight_scope.find_var(n))
+              for n in dec.param_names()}
+    svc = ParameterService(
+        num_trainers=1, sync_mode=True,
+        get_param=lambda n: params[n], run_round=lambda merged: None,
+        rpc_deadline=60.0, param_names=sorted(params))
+    srv = PSServer('127.0.0.1:0', svc)
+    sthread = threading.Thread(target=srv.serve_forever, daemon=True)
+    sthread.start()
+
+    def burst(eng, min_wall=0.35):
+        # loop the burst until min_wall so the refresh loop gets to
+        # land several installs INSIDE the measured window — a single
+        # quick-shape burst finishes in ~10 ms, under one poll period
+        t0 = time.perf_counter()
+        total = 0
+        while True:
+            reqs = [eng.submit(p, max_new_tokens=new_tokens)
+                    for p in prompts]
+            for r in reqs:
+                r.result(600)
+            total += sum(len(r.tokens) for r in reqs)
+            if time.perf_counter() - t0 >= min_wall:
+                break
+        wall = time.perf_counter() - t0
+        return total / wall
+
+    out = {}
+    telemetry.enable()
+    try:
+        for tag in ('baseline', 'refresh'):
+            telemetry.reset()
+            dec.reset()
+            eng = ServingEngine(dec)
+            eng.start()
+            sub, stop_bump, bump = None, None, None
+            if tag == 'refresh':
+                sub = ParamSubscriber(['127.0.0.1:%d' % srv.port], dec,
+                                      engine=eng, poll_secs=0.02)
+                sub.start()
+                stop_bump = threading.Event()
+                seq = [0]
+
+                def bump_loop():
+                    while not stop_bump.wait(0.03):
+                        seq[0] += 1
+                        svc.on_send_var('r@GRAD', 0, np.zeros(1, 'f4'),
+                                        seq=('bench', seq[0]))
+                        seq[0] += 1
+                        svc.on_batch_barrier(0, seq=('bench', seq[0]))
+                bump = threading.Thread(target=bump_loop, daemon=True)
+                bump.start()
+            try:
+                tps = burst(eng)
+            finally:
+                if stop_bump is not None:
+                    stop_bump.set()
+                    bump.join(timeout=10)
+                if sub is not None:
+                    sub.stop()
+                eng.stop()
+            h = telemetry.snapshot()['hists'].get('serving.token_latency')
+            p50 = telemetry.hist_quantile(h, 0.50) if h else None
+            p99 = telemetry.hist_quantile(h, 0.99) if h else None
+            out[tag] = {'tokens_per_sec': round(tps, 2),
+                        'token_p50_ms':
+                            round(p50 * 1e3, 3) if p50 else 0.0,
+                        'token_p99_ms':
+                            round(p99 * 1e3, 3) if p99 else 0.0,
+                        'refreshes': sub.refreshes if sub else 0,
+                        'refresh_failures': sub.failures if sub else 0}
+    finally:
+        telemetry.disable()
+        telemetry.reset()
+        tcli = PSClient('127.0.0.1:%d' % srv.port, trainer_id=0)
+        tcli.complete()
+        tcli.close()
+        sthread.join(timeout=10)
+    base_p99 = out['baseline']['token_p99_ms']
+    ratio = (out['refresh']['token_p99_ms'] / base_p99
+             if base_p99 else 0.0)
+    return {'mode': 'refresh', 'slots': slots,
+            'requests': n_requests,
+            'baseline': out['baseline'], 'refresh': out['refresh'],
+            'refresh_p99_ratio': round(ratio, 3)}
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument('--quick', action='store_true',
                     help='one tiny shape, bs 1 + 4 (CI smoke)')
     ap.add_argument('--full', action='store_true',
                     help='L4/D1024/T512 benchmark shape (accelerator)')
+    ap.add_argument('--refresh', action='store_true',
+                    help='add the online-refresh cost leg: the engine '
+                         'burst with vs without a concurrent '
+                         'ParamSubscriber install loop '
+                         '(refresh_p99_ratio in the summary)')
     ap.add_argument('--iters', type=int, default=20)
     args = ap.parse_args()
     if not args.full:
@@ -186,6 +302,16 @@ def main():
                'infer_decode_cached_tokens_per_sec':
                    round(best['tps'], 2), 'best_slots': best['bs'],
                'infer_decode_speedup': round(best['tps'] / rec_tps, 2)}
+
+    if args.refresh:
+        ref_row = _refresh_leg(pred, cfg, slots=batch_sizes[-1],
+                               n_requests=2 * batch_sizes[-1],
+                               new_tokens=4 if args.quick else 16)
+        ref_row['config'] = label
+        print(json.dumps(ref_row), flush=True)
+        summary['refresh_p99_ratio'] = ref_row['refresh_p99_ratio']
+        summary['refresh_installs'] = ref_row['refresh']['refreshes']
+
     print(json.dumps(summary), flush=True)
     return summary
 
