@@ -740,14 +740,24 @@ class Executor(object):
                     # does NOT warm jax's jit call cache), so this span
                     # is the user-visible compile stall
                     t0c = time.perf_counter()
+                    # discard any extra-flops notes left over from
+                    # traces outside this segment (direct tool calls
+                    # into the pallas kernels) so they aren't billed
+                    # to us
+                    _perf.pallas_extra_flops()
                     with _perf.compile_span(prepared.fingerprint,
                                             step_idx, len(step.ops)):
                         with _prof.RecordEvent(
                                 'device_segment:%d(%d ops)'
                                 % (step_idx, len(step.ops))):
                             outs = step.jitted(donated, const, key_arg)
+                    # the compiling call above is what traces the inner
+                    # pallas jits — drain the work this segment's arms
+                    # reported beyond the analytical cost model
+                    extra = _perf.pallas_extra_flops()
                     flops, nbytes = _perf.segment_cost(
                         step.jitted, step._arg_struct)
+                    flops += extra
                     prepared.cost_flops += flops
                     prepared.cost_bytes += nbytes
                     _perf.record_compile(time.perf_counter() - t0c,

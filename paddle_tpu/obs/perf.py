@@ -141,6 +141,27 @@ def segment_cost(jitted, arg_struct):
         return (0.0, 0.0)
 
 
+def pallas_extra_flops():
+    """Drain trace-time extra-work notes from the Pallas kernels.
+
+    XLA's cost model cannot see inside a Pallas custom call, so the
+    flash segment is priced by the analytical 2-matmul attention model.
+    Arms that execute MORE than that model (the twopass forward's
+    second QK sweep) note the surplus at trace time; the executor
+    drains it here right after the compiling call and folds it into
+    the segment's cost_flops so live MFU divides by work that actually
+    ran. Granularity is once-per-trace: a second program hitting the
+    same inner-jit cache contributes nothing new (and needs nothing
+    new — cost_flops is per-prepared-program, priced at its own
+    compile). Draining is destructive; callers that only want to
+    discard stale notes call this and ignore the return."""
+    try:
+        from paddle_tpu.pallas import flash_attention as _fa
+        return float(_fa.take_extra_flops())
+    except Exception:
+        return 0.0
+
+
 # --- step-time hooks ------------------------------------------------
 
 def step_begin():

@@ -21,7 +21,11 @@ guards and falls back to XLA otherwise); d should be a lane multiple
 Forward grid (bh, qi, ki), ki innermost: the (m, l, o) accumulators for
 one q block live in VMEM scratch across the ki sweep; causal q-blocks
 stop their sweep at the diagonal (pl.when skips both compute and the
-write until the final valid ki).
+write until the final valid ki). That is the `online` arm; a second
+`twopass` arm (PADDLE_FLASH_FWD, round 6) splits the sweep into a
+stats pass (row max + lse only, no V traffic) and a 1-exp rescale-free
+accumulation pass — the stored-lse trick the backward already uses —
+see the forward-arm comment block below.
 
 Backward: delta = rowsum(dO·O) in plain JAX, then the KV-MAJOR
 single-pass kernel (grid (bh, ki, qi), both inner dims sequential;
@@ -47,6 +51,11 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
+
+# jax renamed TPUCompilerParams -> CompilerParams; support both so the
+# kernels (and their interpret-mode CI) run on either side of the rename
+_CompilerParams = getattr(pltpu, 'CompilerParams', None) \
+    or getattr(pltpu, 'TPUCompilerParams')
 
 __all__ = ['flash_attention']
 
@@ -82,14 +91,61 @@ if not _FORCE_ARM and _os.environ.get('PADDLE_FLASH_ONEPASS', '') in (
 # guards may silently swap a forced arm for 'split', so measurement
 # tools must check this rather than trust the arm they requested
 _RESOLVED_ARM = ''
-# There is deliberately NO forward-arm choice: a 'boundmax' fwd
-# (precomputed Cauchy-Schwarz row bound M ≥ max(s_row) replacing the
-# online max/corr/rescale chain — softmax is shift-invariant, so o and
-# lse = M + log Σ exp(s−M) stay exact in exact arithmetic) was built
-# and measured in round 5: ≲10% faster, UNRESOLVED inside the chip's
-# noise band, while dq parity degraded 4x (2.2e-2 → 9e-2 vs naive —
-# the bound-shifted accumulation loses mantissa). Dropped; the online
-# kernel stands (PERF.md round-5 boundmax note).
+
+# Forward-arm selection (round 6). Two arms, both parity-tested on
+# (o, lse, grads):
+#   online   — the classic one-sweep kernel above: running max +
+#              correction + acc rescale per K block (1 QK matmul,
+#              1 exp stream, the max/corr/rescale VPU chain that
+#              round-5 attribution names as ~70% of the roofline gap)
+#   twopass  — the backward's stored-lse trick ported forward: pass 1
+#              sweeps K computing only row max and lse (no V traffic,
+#              no output accumulator, [bq]-sized corr only); pass 2
+#              recomputes S and accumulates exp(s − lse) @ v with ONE
+#              exp per element, rescale-free and division-free. Trades
+#              one extra QK matmul/read (the kernel is VPU-bound, and
+#              the kvmajor clamp A/B proved skipped-block DMAs hide
+#              under compute) for the whole [bq, d] corr/rescale chain.
+# PADDLE_FLASH_FWD=online|twopass forces an arm; default stays online
+# until a chip A/B ranks them (PERF.md round 6 — the earlier round-5
+# 'boundmax' fwd attempt was dropped for a 4x dq-parity loss; the
+# stored-lse schedule has no such mantissa hazard because lse is exact,
+# not a slack bound).
+_FWD_ARMS = ('', 'online', 'twopass')
+_FORCE_FWD_ARM = _os.environ.get('PADDLE_FLASH_FWD', '').strip().lower()
+if _FORCE_FWD_ARM not in _FWD_ARMS:
+    # same loud-config contract as PADDLE_FLASH_BWD: a typo silently
+    # benchmarking the default arm would corrupt an A/B sweep
+    raise ValueError('PADDLE_FLASH_FWD=%r: expected one of %s'
+                     % (_FORCE_FWD_ARM, _FWD_ARMS[1:]))
+# the arm _fwd actually dispatched at its last trace — the twopass
+# residency guard may silently swap a forced arm for 'online', so
+# measurement tools must cross-check this before ranking
+_RESOLVED_FWD_ARM = ''
+
+# Trace-time note of pallas work that XLA's cost analysis cannot see
+# inside the custom call: the twopass forward executes a second QK
+# matmul per visited block that the 2-matmul attention work model does
+# not include. obs/perf drains this into the owning PreparedProgram's
+# cost_flops so live MFU divides by what actually ran.
+_PENDING_EXTRA_FLOPS = 0.0
+
+
+def _note_extra_flops(flops):
+    global _PENDING_EXTRA_FLOPS
+    _PENDING_EXTRA_FLOPS += float(flops)
+
+
+def take_extra_flops():
+    """Drain the extra-work notes accumulated since the last drain
+    (trace-time; one note per fresh _fwd trace, so a segment compile
+    that re-uses an already-traced _fwd shape contributes nothing —
+    the same once-per-trace granularity as the jit cache itself)."""
+    global _PENDING_EXTRA_FLOPS
+    flops, _PENDING_EXTRA_FLOPS = _PENDING_EXTRA_FLOPS, 0.0
+    return flops
+
+
 # clamp block index maps during causally-skipped grid steps so the
 # dead prefetch DMAs are elided (trace-time; off only for A/B)
 _CLAMP_SKIPPED_DMA = True
@@ -168,6 +224,116 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         lse = jnp.where(m <= _NEG_INF / 2, _NEG_INF,
                         m + jnp.log(safe_l))
         lse_ref[0] = lse[:, None]
+
+
+def _fwd_stats_kernel(q_ref, k_ref, lse_ref, m_scr, l_scr, *, sm_scale,
+                      causal, block_q, block_k, nk):
+    """Two-pass forward, pass 1: sweep K at streaming rate computing
+    only the row max and lse. No V traffic, no [bq, d] output
+    accumulator — residency is two [bq] vectors — so the only
+    per-element VPU work is the exp feeding the l sum; the running
+    max/corr chain survives here but operates on [bq] vectors, not the
+    [bq, d] accumulator the online kernel rescales every block."""
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    last_ki = nk - 1
+    if causal:
+        last_ki = ((qi + 1) * block_q - 1) // block_k
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+
+    @pl.when(ki <= last_ki)
+    def _step():
+        q = q_ref[0] * sm_scale          # [bq, d] (input dtype)
+        k = k_ref[0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)       # [bq, bk]
+        if causal:
+            s = _mask_if_straddling(s, qi, ki, block_q, block_k)
+        m_prev = m_scr[:]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1))
+        safe_m = jnp.where(m_new <= _NEG_INF / 2, 0.0, m_new)
+        corr = jnp.exp(jnp.where(m_prev <= _NEG_INF / 2, safe_m, m_prev)
+                       - safe_m)
+        # masked s = -1e30 underflows to exactly 0 against any finite
+        # (or zeroed) safe_m — same no-second-mask argument as online
+        l_scr[:] = l_scr[:] * corr + jnp.sum(
+            jnp.exp(s - safe_m[:, None]), axis=1)
+        m_scr[:] = m_new
+
+    @pl.when(ki == last_ki)
+    def _finalize():
+        m = m_scr[:]
+        lse = jnp.where(m <= _NEG_INF / 2, _NEG_INF,
+                        m + jnp.log(jnp.maximum(l_scr[:], 1e-30)))
+        lse_ref[0] = lse[:, None]
+
+
+def _fwd_acc_kernel(q_ref, k_ref, v_ref, lse_ref, o_ref, acc_scr, *,
+                    sm_scale, causal, block_q, block_k, nk):
+    """Two-pass forward, pass 2: recompute S and accumulate
+    exp(s − lse) @ v. With lse = m + log l stored from pass 1,
+    p = exp(s − lse) IS the softmax row exactly — one exp per element,
+    no running max, no correction, no accumulator rescale, and no final
+    division (the backward's stored-lse identity, applied forward)."""
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    last_ki = nk - 1
+    if causal:
+        last_ki = ((qi + 1) * block_q - 1) // block_k
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    @pl.when(ki <= last_ki)
+    def _step():
+        q = q_ref[0] * sm_scale          # [bq, d] (input dtype)
+        k = k_ref[0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)       # [bq, bk]
+        if causal:
+            s = _mask_if_straddling(s, qi, ki, block_q, block_k)
+        lse = lse_ref[0]                              # [bq, 1] fp32
+        # lse = -inf marks an all-masked row (cannot occur causally —
+        # every row sees the diagonal — but the online kernel emits 0
+        # there, so match it): zero the shift and rely on the masked
+        # s = -1e30 to underflow p to exactly 0
+        p = jnp.exp(s - jnp.where(lse <= _NEG_INF / 2, 0.0, lse))
+        acc_scr[:] += jax.lax.dot_general(
+            p.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(ki == last_ki)
+    def _finalize():
+        o_ref[0] = acc_scr[:].astype(o_ref.dtype)
+
+
+# Measured-safe scoped-VMEM ceiling shared with the kv-major backward
+# guard; module-level so the guard unit test can pin it down without
+# fabricating a shape that actually overflows VMEM.
+_TWOPASS_VMEM_CEILING = 64 * 1024 * 1024
+
+
+def _twopass_vmem_bytes(T, d, bq, bk, io_itemsize):
+    """Scoped-VMEM request for the LARGER (second) pass of the twopass
+    forward: fp32 acc scratch + streamed q/k/v/o blocks at the I/O
+    dtype + fp32 lse blocks, triple-buffered as the worst case Mosaic
+    schedules. Neither pass holds a full-sequence accumulator — that is
+    the point of the arm — so this sits far below the ceiling for every
+    tiled shape; the guard exists for forced-block extremes and keeps
+    the forced-arm-can-be-swapped contract identical to the backward.
+    The 6 MB margin absorbs Mosaic's stack accounting (the round-5 OOM
+    lesson: measured stack runs MB above the component sum and drifts
+    with libtpu)."""
+    acc = bq * d * 4
+    stream = (2 * bq * d + 2 * bk * d) * io_itemsize + bq * 4
+    return int(acc + 3 * stream) + 6 * 1024 * 1024
 
 
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
@@ -419,8 +585,16 @@ _BLOCK_TABLE_FWD = {
     (8192, 128): (1024, 1024),
 }
 
+# The twopass arm shifts the balance again: it has no per-block
+# corr/rescale to amortize, and bk=1024 keeps the pass-2 exp stream on
+# full 1024-lane rows (lane-parallel exp scheduling). Populated by
+# `tools/flash_autotune.py --fwd-only --fwd-arm twopass` so the tuned
+# table stays per-arm honest; falls back to _BLOCK_TABLE_FWD until a
+# chip sweep lands a twopass-specific winner.
+_BLOCK_TABLE_FWD_TWOPASS = {}
 
-def _block_sizes(T, d, fwd=False):
+
+def _block_sizes(T, d, fwd=False, arm=''):
     from ..flags import get_flag
     fq = int(get_flag('flash_block_q', 0) or 0)
     fk = int(get_flag('flash_block_k', 0) or 0)
@@ -436,6 +610,8 @@ def _block_sizes(T, d, fwd=False):
             raise ValueError('flash block override (%d, %d) does not '
                              'divide T=%d' % (fq, fk, T))
         return fq, fk
+    if fwd and arm == 'twopass' and (T, d) in _BLOCK_TABLE_FWD_TWOPASS:
+        return _BLOCK_TABLE_FWD_TWOPASS[(T, d)]
     if fwd and (T, d) in _BLOCK_TABLE_FWD:
         return _BLOCK_TABLE_FWD[(T, d)]
     if (T, d) in _BLOCK_TABLE:
@@ -449,25 +625,62 @@ def _block_sizes(T, d, fwd=False):
     return max(bq, 8), max(bk, 128 if T % 128 == 0 else bk)
 
 
+def _fwd_kvmap(causal, bq, bk):
+    """K/V-side block index map for the forward grids. During causally-
+    skipped steps (j > last_ki(i)) clamp the fetch to the last visited
+    block: the block index is then unchanged step-to-step, so Mosaic
+    elides the dead DMA. (_CLAMP_SKIPPED_DMA is the trace-time A/B
+    hook.)"""
+    def kvmap(b, i, j):
+        if causal and _CLAMP_SKIPPED_DMA:
+            j = jnp.minimum(j, ((i + 1) * bq - 1) // bk)
+        return (b, j, 0)
+    return kvmap
+
+
 @functools.partial(jax.jit, static_argnames=('causal', 'sm_scale',
                                              'interpret'))
 def _fwd(q, k, v, causal, sm_scale, interpret=False):
     BH, T, d = q.shape
-    bq, bk = _block_sizes(T, d, fwd=True)
+    # Arm selection mirrors _bwd: forced via PADDLE_FLASH_FWD, else
+    # online (the incumbent; twopass is the round-6 challenger — see
+    # the arm comment block at the top). Block sizes resolve per-arm
+    # first because the twopass table may differ; the residency guard
+    # can then swap a forced twopass back to online, in which case the
+    # blocks re-resolve under the online table.
+    arm = _FORCE_FWD_ARM or 'online'
+    bq, bk = _block_sizes(T, d, fwd=True, arm=arm)
+    if arm == 'twopass' and _twopass_vmem_bytes(
+            T, d, bq, bk, q.dtype.itemsize) > _TWOPASS_VMEM_CEILING:
+        arm = 'online'
+        bq, bk = _block_sizes(T, d, fwd=True, arm=arm)
+    global _RESOLVED_FWD_ARM
+    _RESOLVED_FWD_ARM = arm
     nq, nk = T // bq, T // bk
+    if arm == 'twopass':
+        # the second QK sweep is real executed work the 2-matmul
+        # attention model (and XLA's cost analysis, blind inside the
+        # custom call) does not count — note it for obs/perf so live
+        # MFU divides by what actually ran. Visited blocks only: the
+        # causal sweep stops at the diagonal.
+        if causal:
+            visited = sum(((i + 1) * bq - 1) // bk + 1
+                          for i in range(nq))
+        else:
+            visited = nq * nk
+        _note_extra_flops(2.0 * BH * visited * bq * bk * d)
+        return _fwd_twopass(q, k, v, causal, sm_scale, interpret,
+                            bq, bk, nq, nk)
+    return _fwd_online(q, k, v, causal, sm_scale, interpret,
+                       bq, bk, nq, nk)
+
+
+def _fwd_online(q, k, v, causal, sm_scale, interpret, bq, bk, nq, nk):
+    BH, T, d = q.shape
     kern = functools.partial(_fwd_kernel, sm_scale=sm_scale,
                              causal=causal, block_q=bq, block_k=bk,
                              nk=nk)
-
-    def kvmap(b, i, j):
-        # During causally-skipped steps (j > last_ki(i)) clamp the k/v
-        # fetch to the last visited block: the block index is then
-        # unchanged step-to-step, so Mosaic elides the dead DMA.
-        # (_CLAMP_SKIPPED_DMA is the trace-time A/B hook.)
-        if causal and _CLAMP_SKIPPED_DMA:
-            j = jnp.minimum(j, ((i + 1) * bq - 1) // bk)
-        return (b, j, 0)
-
+    kvmap = _fwd_kvmap(causal, bq, bk)
     o, lse = pl.pallas_call(
         kern,
         grid=(BH, nq, nk),
@@ -492,10 +705,74 @@ def _fwd(q, k, v, causal, sm_scale, interpret=False):
             pltpu.VMEM((bq,), jnp.float32),
             pltpu.VMEM((bq, d), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=('parallel', 'parallel', 'arbitrary')),
         interpret=interpret,
     )(q, k, v)
+    return o, lse
+
+
+def _fwd_twopass(q, k, v, causal, sm_scale, interpret, bq, bk, nq, nk):
+    """Stored-lse two-pass forward (see _fwd_stats_kernel /
+    _fwd_acc_kernel). Returns the same exact (o, lse) contract as the
+    online kernel, so the backward arms and ring_attention's global-lse
+    merge consume either forward unchanged. Neither pass holds a
+    full-sequence accumulator, so no raised scoped-vmem request is
+    needed for tiled shapes; forced-block extremes raise it via the
+    _twopass_vmem_bytes estimate (the guard in _fwd already capped it
+    at the 64 MB measured-safe ceiling)."""
+    BH, T, d = q.shape
+    kvmap = _fwd_kvmap(causal, bq, bk)
+    qmap = lambda b, i, j: (b, i, 0)  # noqa: E731 — mirrors kvmap
+
+    lse = pl.pallas_call(
+        functools.partial(_fwd_stats_kernel, sm_scale=sm_scale,
+                          causal=causal, block_q=bq, block_k=bk,
+                          nk=nk),
+        grid=(BH, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), qmap, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bk, d), kvmap, memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, bq, 1), qmap,
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((BH, T, 1), jnp.float32),
+        scratch_shapes=[
+            pltpu.VMEM((bq,), jnp.float32),
+            pltpu.VMEM((bq,), jnp.float32),
+        ],
+        compiler_params=_CompilerParams(
+            dimension_semantics=('parallel', 'parallel', 'arbitrary')),
+        interpret=interpret,
+    )(q, k)
+
+    est = _twopass_vmem_bytes(T, d, bq, bk, q.dtype.itemsize)
+    params = dict(
+        dimension_semantics=('parallel', 'parallel', 'arbitrary'))
+    if est > 16 * 1024 * 1024:
+        # only raise the scoped-vmem request past the compiler default
+        # when the estimate says we must (forced-block extremes);
+        # shrinking Mosaic's budget below the default would be a
+        # self-inflicted double-buffering starve
+        params['vmem_limit_bytes'] = est
+    o = pl.pallas_call(
+        functools.partial(_fwd_acc_kernel, sm_scale=sm_scale,
+                          causal=causal, block_q=bq, block_k=bk,
+                          nk=nk),
+        grid=(BH, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), qmap, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bk, d), kvmap, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bk, d), kvmap, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bq, 1), qmap, memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, bq, d), qmap,
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((BH, T, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+        compiler_params=_CompilerParams(**params),
+        interpret=interpret,
+    )(q, k, v, lse)
     return o, lse
 
 
@@ -570,7 +847,7 @@ def _bwd(q, k, v, o, lse, do, causal, sm_scale, interpret=False):
         scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32),
                         pltpu.VMEM((nk, bk, d), jnp.float32),
                         pltpu.VMEM((nk, bk, d), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=('parallel', 'arbitrary', 'arbitrary'),
             # T=8192/d=128 needs ~18 MB (8 MB fp32 accumulators + 4 MB
             # resident outputs + double-buffered blocks) — above the
@@ -613,7 +890,7 @@ def _bwd_split(q, k, v, do, lse, delta, causal, sm_scale, interpret,
                                memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((BH, T, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=('parallel', 'parallel', 'arbitrary')),
         interpret=interpret,
     )(q, k, v, do, lse, delta)
@@ -648,7 +925,7 @@ def _bwd_split(q, k, v, do, lse, delta, causal, sm_scale, interpret,
         ],
         scratch_shapes=[pltpu.VMEM((bk, d), jnp.float32),
                         pltpu.VMEM((bk, d), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=('parallel', 'parallel', 'arbitrary')),
         interpret=interpret,
     )(q, k, v, do, lse, delta)
@@ -705,7 +982,7 @@ def _bwd_kvmajor(q, k, v, do, lse, delta, causal, sm_scale, interpret,
         scratch_shapes=[pltpu.VMEM((nq, bq, d), jnp.float32),
                         pltpu.VMEM((bk, d), jnp.float32),
                         pltpu.VMEM((bk, d), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=('parallel', 'arbitrary', 'arbitrary'),
             vmem_limit_bytes=_kvmajor_vmem_bytes(
                 T, d, bq, bk, q.dtype.itemsize)),

@@ -227,6 +227,265 @@ def test_alt_backward_arms_grads_match_naive(causal, arm, T, bq, bk):
         assert rel < 5e-2, 'd%s rel err %.3e' % (name, rel)
 
 
+# --- forward arms (online vs stored-lse twopass) --------------------
+
+def _force_fwd_arm(fa, arm):
+    """Force a forward arm AND drop stale traces: the arm binds at
+    trace time, and _fwd's jit cache keys on shapes+static args, not
+    on the hook state."""
+    fa._FORCE_FWD_ARM = arm
+    fa._fwd.clear_cache()
+
+
+@pytest.mark.parametrize('arm', ['online', 'twopass'])
+@pytest.mark.parametrize('causal', [False, True])
+def test_fwd_arms_output_and_lse_match_naive(causal, arm):
+    """Both forward arms must honor the exact (o, lse) contract: o vs
+    the naive contraction, lse vs a directly-computed logsumexp of the
+    masked scores (the backward arms and ring attention's global-lse
+    merge both consume lse, so output parity alone is not enough)."""
+    from paddle_tpu.pallas import flash_attention as fa
+    rng = np.random.RandomState(4)
+    BH, T, d = 2, 256, 128
+    q = jnp.asarray(rng.randn(BH, T, d).astype('float32')) * 0.3
+    k = jnp.asarray(rng.randn(BH, T, d).astype('float32')) * 0.3
+    v = jnp.asarray(rng.randn(BH, T, d).astype('float32'))
+    scale = d ** -0.5
+    _force_fwd_arm(fa, arm)
+    try:
+        o, lse = fa._fwd(q, k, v, causal, scale, INTERPRET)
+        assert fa._RESOLVED_FWD_ARM == arm
+    finally:
+        _force_fwd_arm(fa, '')
+    np.testing.assert_allclose(
+        np.asarray(o), np.asarray(_naive(q, k, v, causal, scale)),
+        rtol=2e-2, atol=2e-2)
+    s = jnp.einsum('bqd,bkd->bqk', q, k) * scale
+    if causal:
+        mask = jnp.tril(jnp.ones((T, T), bool))
+        s = jnp.where(mask[None], s, -jnp.inf)
+    want_lse = jax.scipy.special.logsumexp(s, axis=-1)
+    np.testing.assert_allclose(np.asarray(lse[..., 0]),
+                               np.asarray(want_lse),
+                               rtol=1e-4, atol=1e-4)
+
+
+@pytest.mark.parametrize('causal', [False, True])
+def test_fwd_arms_agree_bitwise_on_lse(causal):
+    """lse is a pure function of (q, k, mask); both arms compute it
+    with the same running-max recurrence, so it must agree to fp32
+    rounding — an lse drift here would silently skew every backward."""
+    from paddle_tpu.pallas import flash_attention as fa
+    rng = np.random.RandomState(5)
+    BH, T, d = 2, 256, 128
+    q = jnp.asarray(rng.randn(BH, T, d).astype('float32')) * 0.3
+    k = jnp.asarray(rng.randn(BH, T, d).astype('float32')) * 0.3
+    v = jnp.asarray(rng.randn(BH, T, d).astype('float32'))
+    out = {}
+    try:
+        for arm in ('online', 'twopass'):
+            _force_fwd_arm(fa, arm)
+            out[arm] = fa._fwd(q, k, v, causal, d ** -0.5, INTERPRET)
+    finally:
+        _force_fwd_arm(fa, '')
+    np.testing.assert_allclose(np.asarray(out['online'][1]),
+                               np.asarray(out['twopass'][1]),
+                               rtol=1e-6, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(out['online'][0]),
+                               np.asarray(out['twopass'][0]),
+                               rtol=1e-4, atol=1e-4)
+
+
+@pytest.mark.parametrize('bwd_arm', ['split', 'onepass', 'kvmajor'])
+@pytest.mark.parametrize('fwd_arm', ['online', 'twopass'])
+@pytest.mark.parametrize('causal', [False, True])
+def test_fwd_bwd_arm_matrix_grads_match_naive(causal, fwd_arm,
+                                              bwd_arm):
+    """Full 2 fwd x 3 bwd arm matrix: every backward consumes (o, lse)
+    from either forward unchanged. Blocks forced to (64, 128) so the
+    bk > bq tuned-table shape class (kvmajor lesson) and causal
+    diagonal-straddling q-blocks are both in play at CI size."""
+    import paddle_tpu as fluid
+    from paddle_tpu.pallas import flash_attention as fa
+    rng = np.random.RandomState(6)
+    BH, T, d = 2, 256, 128
+    q = jnp.asarray(rng.randn(BH, T, d).astype('float32')) * 0.3
+    k = jnp.asarray(rng.randn(BH, T, d).astype('float32')) * 0.3
+    v = jnp.asarray(rng.randn(BH, T, d).astype('float32'))
+    scale = d ** -0.5
+    fluid.set_flags({'flash_block_q': 64, 'flash_block_k': 128})
+    fa._FORCE_ARM = bwd_arm
+    _force_fwd_arm(fa, fwd_arm)
+    fa._bwd.clear_cache()
+    try:
+        def loss_k(q, k, v):
+            return jnp.sum(_flash(q, k, v, causal, scale,
+                                  INTERPRET) ** 2)
+
+        def loss_n(q, k, v):
+            return jnp.sum(_naive(q, k, v, causal, scale) ** 2)
+
+        gk = jax.grad(loss_k, argnums=(0, 1, 2))(q, k, v)
+        assert fa._RESOLVED_FWD_ARM == fwd_arm
+        assert fa._RESOLVED_ARM == bwd_arm
+        gn = jax.grad(loss_n, argnums=(0, 1, 2))(q, k, v)
+    finally:
+        fa._FORCE_ARM = ''
+        _force_fwd_arm(fa, '')
+        fa._bwd.clear_cache()
+        fluid.set_flags({'flash_block_q': 0, 'flash_block_k': 0})
+    for name, a, b in zip('qkv', gk, gn):
+        scale_ref = float(jnp.abs(b).max()) + 1e-9
+        rel = float(jnp.abs(a - b).max()) / scale_ref
+        assert rel < 5e-2, 'd%s rel err %.3e' % (name, rel)
+
+
+def test_twopass_vmem_guard_falls_back_to_online():
+    """A forced twopass whose residency estimate exceeds the ceiling
+    must silently dispatch online — introspectable via
+    _RESOLVED_FWD_ARM (the A/B tools cross-check exactly this), with
+    the numbers still correct."""
+    from paddle_tpu.pallas import flash_attention as fa
+    rng = np.random.RandomState(7)
+    BH, T, d = 2, 256, 128
+    q = jnp.asarray(rng.randn(BH, T, d).astype('float32')) * 0.3
+    k = jnp.asarray(rng.randn(BH, T, d).astype('float32')) * 0.3
+    v = jnp.asarray(rng.randn(BH, T, d).astype('float32'))
+    saved = fa._TWOPASS_VMEM_CEILING
+    fa._TWOPASS_VMEM_CEILING = 1   # every estimate exceeds this
+    _force_fwd_arm(fa, 'twopass')
+    try:
+        o, lse = fa._fwd(q, k, v, True, d ** -0.5, INTERPRET)
+        assert fa._RESOLVED_FWD_ARM == 'online'
+    finally:
+        fa._TWOPASS_VMEM_CEILING = saved
+        _force_fwd_arm(fa, '')
+    np.testing.assert_allclose(
+        np.asarray(o), np.asarray(_naive(q, k, v, True, d ** -0.5)),
+        rtol=2e-2, atol=2e-2)
+
+
+def test_twopass_vmem_estimate_sane():
+    """The residency estimate must include the 6 MB Mosaic stack
+    margin (the round-5 OOM lesson) and grow with the block sizes."""
+    from paddle_tpu.pallas import flash_attention as fa
+    small = fa._twopass_vmem_bytes(8192, 128, 256, 256, 2)
+    big = fa._twopass_vmem_bytes(8192, 128, 1024, 1024, 2)
+    assert small > 6 * 1024 * 1024
+    assert big > small
+    assert big <= fa._TWOPASS_VMEM_CEILING   # tuned sizes stay legal
+
+
+def test_unknown_fwd_arm_env_raises_at_import():
+    """Loud-config hygiene: a typo'd PADDLE_FLASH_FWD must fail the
+    import, not silently benchmark the default arm (mirrors
+    PADDLE_FLASH_BWD). A valid value must bind the forcing hook."""
+    import os
+    import subprocess
+    import sys
+    env = dict(os.environ, JAX_PLATFORMS='cpu',
+               PADDLE_FLASH_FWD='twopas')
+    r = subprocess.run(
+        [sys.executable, '-c',
+         'import paddle_tpu.pallas.flash_attention'],
+        capture_output=True, text=True, env=env)
+    assert r.returncode != 0
+    assert 'PADDLE_FLASH_FWD' in (r.stderr or '')
+    env['PADDLE_FLASH_FWD'] = 'twopass'
+    r = subprocess.run(
+        [sys.executable, '-c',
+         'from paddle_tpu.pallas import flash_attention as fa; '
+         'assert fa._FORCE_FWD_ARM == "twopass"'],
+        capture_output=True, text=True, env=env)
+    assert r.returncode == 0, r.stderr
+
+
+@pytest.mark.parametrize('causal', [False, True])
+def test_twopass_extra_flops_noted_for_work_model(causal):
+    """The twopass forward executes a second QK sweep that the
+    2-matmul cost model (and XLA's cost analysis, blind inside the
+    custom call) cannot see; the arm notes it at trace time and
+    obs/perf drains it so live MFU divides by work that actually ran.
+    Exact bookkeeping: 2*BH*visited_blocks*bq*bk*d, visited stopping
+    at the diagonal under causal."""
+    from paddle_tpu.obs import perf as obsperf
+    from paddle_tpu.pallas import flash_attention as fa
+    rng = np.random.RandomState(8)
+    BH, T, d = 2, 256, 128
+    q = jnp.asarray(rng.randn(BH, T, d).astype('float32')) * 0.3
+    k = jnp.asarray(rng.randn(BH, T, d).astype('float32')) * 0.3
+    v = jnp.asarray(rng.randn(BH, T, d).astype('float32'))
+    try:
+        _force_fwd_arm(fa, 'online')
+        fa.take_extra_flops()   # discard notes from earlier tests
+        fa._fwd(q, k, v, causal, d ** -0.5, INTERPRET)
+        assert fa.take_extra_flops() == 0.0   # online = the model
+        _force_fwd_arm(fa, 'twopass')
+        fa._fwd(q, k, v, causal, d ** -0.5, INTERPRET)
+        bq, bk = fa._block_sizes(T, d, fwd=True, arm='twopass')
+        nq, nk = T // bq, T // bk
+        if causal:
+            visited = sum(((i + 1) * bq - 1) // bk + 1
+                          for i in range(nq))
+        else:
+            visited = nq * nk
+        want = 2.0 * BH * visited * bq * bk * d
+        # drained through the obs/perf hook the executor uses
+        assert obsperf.pallas_extra_flops() == want
+        assert obsperf.pallas_extra_flops() == 0.0   # destructive
+        # a second call with the same shapes hits the jit cache: no
+        # re-trace, no double-count
+        fa._fwd(q, k, v, causal, d ** -0.5, INTERPRET)
+        assert fa.take_extra_flops() == 0.0
+    finally:
+        _force_fwd_arm(fa, '')
+
+
+@pytest.mark.parametrize('causal', [False, True])
+def test_twopass_block_table_is_per_arm(causal):
+    """The lane-parallel bk sweep tunes the twopass arm separately:
+    an entry in _BLOCK_TABLE_FWD_TWOPASS must bind ONLY the twopass
+    dispatch (online keeps _BLOCK_TABLE_FWD), and the twopass kernels
+    must stay correct under the re-tabled (bk > bq) blocks."""
+    from paddle_tpu.pallas import flash_attention as fa
+    rng = np.random.RandomState(9)
+    BH, T, d = 2, 256, 128
+    q = jnp.asarray(rng.randn(BH, T, d).astype('float32')) * 0.3
+    k = jnp.asarray(rng.randn(BH, T, d).astype('float32')) * 0.3
+    v = jnp.asarray(rng.randn(BH, T, d).astype('float32'))
+    fa._BLOCK_TABLE_FWD_TWOPASS[(T, d)] = (64, 256)
+    try:
+        assert fa._block_sizes(T, d, fwd=True, arm='twopass') \
+            == (64, 256)
+        assert fa._block_sizes(T, d, fwd=True, arm='online') \
+            != (64, 256)
+        _force_fwd_arm(fa, 'twopass')
+        o, _lse = fa._fwd(q, k, v, causal, d ** -0.5, INTERPRET)
+        assert fa._RESOLVED_FWD_ARM == 'twopass'
+    finally:
+        del fa._BLOCK_TABLE_FWD_TWOPASS[(T, d)]
+        _force_fwd_arm(fa, '')
+    np.testing.assert_allclose(
+        np.asarray(o), np.asarray(_naive(q, k, v, causal, d ** -0.5)),
+        rtol=2e-2, atol=2e-2)
+
+
+def test_flash_fwd_arms_quick_smoke():
+    """tools/flash_fwd_arms.py --quick is the tier-1 wiring for the
+    A/B harness: forcing, cache-clearing, resolved-arm cross-check and
+    ranking all run end to end on the interpret backend."""
+    import os
+    import sys
+    tools = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), 'tools')
+    sys.path.insert(0, tools)
+    try:
+        import flash_fwd_arms
+        flash_fwd_arms.main(['--quick'])
+    finally:
+        sys.path.remove(tools)
+
+
 @pytest.mark.parametrize('causal', [False, True])
 def test_per_direction_block_tables_independent(causal):
     """The fwd and bwd kernels share only (o, lse), which are

@@ -56,6 +56,49 @@ def test_ring_flash_parity_kernel_blocks(causal):
         fluid.set_flags({'pallas_interpret': False})
 
 
+@pytest.mark.parametrize('causal', [False, True])
+def test_ring_flash_parity_twopass_forward(causal):
+    """ring_flash_attention's global-lse merge and ring-level backward
+    consume the per-block (o, lse) straight from _fwd — the exact
+    contract both forward arms preserve. Force the twopass arm
+    underneath and re-run the single-chip parity + grad check."""
+    from paddle_tpu.pallas import flash_attention as fa
+    fluid.set_flags({'pallas_interpret': True})
+    fa._FORCE_FWD_ARM = 'twopass'
+    fa._fwd.clear_cache()
+    try:
+        rng = np.random.RandomState(3)
+        B, H, T, d = 2, 2, 512, 128
+        mesh = _mesh_sp(4)
+        q = jnp.asarray(rng.randn(B, H, T, d).astype('float32') * 0.3)
+        k = jnp.asarray(rng.randn(B, H, T, d).astype('float32') * 0.3)
+        v = jnp.asarray(rng.randn(B, H, T, d).astype('float32'))
+        got = ring_flash_attention_global(q, k, v, mesh, causal=causal)
+        assert fa._RESOLVED_FWD_ARM == 'twopass'
+        want = ring_attention_global(q, k, v, None, causal=causal)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=3e-2, atol=3e-2)
+
+        def loss_rf(q, k, v):
+            return jnp.sum(ring_flash_attention_global(
+                q, k, v, mesh, causal=causal).astype(jnp.float32) ** 2)
+
+        def loss_n(q, k, v):
+            return jnp.sum(ring_attention_global(
+                q, k, v, None, causal=causal).astype(jnp.float32) ** 2)
+
+        gr = jax.grad(loss_rf, argnums=(0, 1, 2))(q, k, v)
+        gn = jax.grad(loss_n, argnums=(0, 1, 2))(q, k, v)
+        for name, a, b in zip('qkv', gr, gn):
+            rel = float(jnp.abs(a - b).max()) / \
+                (float(jnp.abs(b).max()) + 1e-9)
+            assert rel < 5e-2, 'd%s rel err %.3e' % (name, rel)
+    finally:
+        fa._FORCE_FWD_ARM = ''
+        fa._fwd.clear_cache()
+        fluid.set_flags({'pallas_interpret': False})
+
+
 def test_ring_flash_fallback_blocks():
     # Tl = 64: below lane alignment -> per-block XLA fallback path,
     # same parity contract

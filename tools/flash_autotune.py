@@ -7,11 +7,19 @@ ONE process, alternate arms across rounds, and difference in-jit N/2N
 loops. This tool re-runs the (block_q, block_k) sweep that way.
 
     python tools/flash_autotune.py [--T 8192] [--bh 16] [--rounds 3]
+        [--fwd-only] [--fwd-arm online|twopass]
 
 Prints per-config fwd+bwd ms (median over rounds) so a real >5%
 winner, if one exists, survives the noise floor. Populate
 pallas/flash_attention._BLOCK_TABLE with any config that wins
 consistently.
+
+--fwd-only times the forward alone (the round-5 fwd-table sweep mode,
+now also the round-6 twopass mode); --fwd-arm forces a forward arm for
+the whole sweep so the per-arm tables (_BLOCK_TABLE_FWD vs
+_BLOCK_TABLE_FWD_TWOPASS, incl. the bk=1024 lane-parallel candidates)
+stay honest — a config whose residency guard swaps the forced arm is
+dropped from the ranking, same as a VMEM OOM.
 """
 from __future__ import annotations
 
@@ -54,14 +62,43 @@ def timed_step(flash, q, k, v, iters):
     return loop
 
 
-def measure(flash, q, k, v, iters=6):
-    l1 = timed_step(flash, q, k, v, iters)
-    l2 = timed_step(flash, q, k, v, 2 * iters)
+def timed_fwd(flash, q, k, v, iters, interpret=False):
+    def step(q, k, v):
+        o, lse = flash._fwd(q, k, v, True, 0.0884, interpret)
+        # fold BOTH outputs into the carry so neither the o nor the
+        # lse side of the kernel can be DCE'd out of the loop; the
+        # float32 lse is cast back down so the carry dtype is stable
+        # across scan iterations
+        eps = jnp.asarray(1e-12, q.dtype)
+        return (q + (o.astype(jnp.float32) + lse)
+                .astype(q.dtype) * eps, k, v)
+
+    @jax.jit
+    def loop(q, k, v):
+        def body(c, _):
+            return step(*c), None
+        (q, k, v), _ = jax.lax.scan(body, (q, k, v), None,
+                                    length=iters)
+        return q[0, 0, 0]
+    return loop
+
+
+def measure(flash, q, k, v, iters=6, fwd_only=False, interpret=False):
+    if fwd_only:
+        def timed(flash, q, k, v, iters):
+            return timed_fwd(flash, q, k, v, iters,
+                             interpret=interpret)
+    else:
+        # the fwd+bwd loop goes through _flash, which has no interpret
+        # plumbing here — it is the chip-sweep path
+        timed = timed_step
+    l1 = timed(flash, q, k, v, iters)
+    l2 = timed(flash, q, k, v, 2 * iters)
     np.asarray(l1(q, k, v)); np.asarray(l2(q, k, v))   # compile both
     t0 = time.perf_counter(); np.asarray(l1(q, k, v))
     t1 = time.perf_counter(); np.asarray(l2(q, k, v))
     t2 = time.perf_counter()
-    return ((t2 - t1) - (t1 - t0)) / iters * 1e3  # ms per fwd+bwd
+    return ((t2 - t1) - (t1 - t0)) / iters * 1e3  # ms per step
 
 
 def main():
@@ -72,10 +109,16 @@ def main():
     ap.add_argument('--rounds', type=int, default=3)
     ap.add_argument('--blocks', type=int, nargs='+',
                     default=[256, 512, 1024])
+    ap.add_argument('--fwd-only', action='store_true')
+    ap.add_argument('--fwd-arm', default='',
+                    choices=['', 'online', 'twopass'])
     args = ap.parse_args()
 
     import paddle_tpu as fluid
     from paddle_tpu.pallas import flash_attention as flash
+
+    if args.fwd_arm:
+        flash._FORCE_FWD_ARM = args.fwd_arm
 
     rng = np.random.RandomState(0)
     q = jnp.asarray(rng.randn(args.bh, args.T, args.d), jnp.bfloat16)
@@ -97,15 +140,26 @@ def main():
             flash._fwd.clear_cache()
             flash._bwd.clear_cache()
             try:
-                ms = measure(flash, q, k, v)
+                ms = measure(flash, q, k, v, fwd_only=args.fwd_only)
             except Exception as e:   # noqa: BLE001 — e.g. VMEM OOM
                 failed.add(cfg)
                 print('round %d  bq=%-5d bk=%-5d  FAILED (%.80s)'
                       % (rnd, cfg[0], cfg[1], str(e)), flush=True)
                 continue
+            if args.fwd_arm and flash._RESOLVED_FWD_ARM != args.fwd_arm:
+                # the residency guard swapped the forced arm for this
+                # block config — ranking the substitute would put an
+                # online number in the twopass table
+                failed.add(cfg)
+                print('round %d  bq=%-5d bk=%-5d  SKIPPED (guard '
+                      'dispatched %r)' % (rnd, cfg[0], cfg[1],
+                                          flash._RESOLVED_FWD_ARM),
+                      flush=True)
+                continue
             results[cfg].append(ms)
             print('round %d  bq=%-5d bk=%-5d  %.2f ms'
                   % (rnd, cfg[0], cfg[1], ms), flush=True)
+    flash._FORCE_FWD_ARM = ''
     fluid.flags.set_flags({'FLAGS_flash_block_q': 0,
                            'FLAGS_flash_block_k': 0})
     # drop configs with ANY failure: a transiently-failed arm would

@@ -65,6 +65,22 @@ TOL_OVERRIDES = {
     'compile_ms': 0.50,   # host-load sensitive
 }
 
+# The headline bars (ROADMAP: transformer >= 0.70, longcontext 0.52 ->
+# 0.60 is the round-6 win condition). A new BENCH round that silently
+# DROPS these rows would pass the per-metric gate vacuously — the
+# newest committed round must therefore both carry them and gate them
+# against the prior trajectory, or the gate fails loudly.
+REQUIRED_GATED = ('longcontext_mfu', 'transformer_mfu')
+
+
+def missing_required(checked, required=REQUIRED_GATED):
+    """Required metric names that did NOT get gated (absent from the
+    candidate or from every reference round). Suffix match, same as
+    direction/tolerance inference, so bench-row prefixes don't break
+    the contract."""
+    return [req for req in required
+            if not any(name.endswith(req) for name in checked)]
+
 
 def metric_direction(name):
     """+1 (higher better), -1 (lower better), or None (ungated)."""
@@ -205,6 +221,19 @@ def smoke():
     traj2 = [{'longcontext_mfu': 0.46}]
     fails, _, _ = gate(traj2, {'longcontext_mfu': 0.41})
     expect(not fails, 'longcontext tolerance override lost')
+    # required-row enforcement: a candidate that drops the headline
+    # MFU rows must be caught even when nothing it DOES carry regresses
+    traj3 = [{'longcontext_mfu': 0.52, 'transformer_mfu': 0.72,
+              'resnet_images_per_sec': 100.0}]
+    _, checked3, _ = gate(traj3, {'resnet_images_per_sec': 101.0})
+    expect(sorted(missing_required(checked3)) ==
+           ['longcontext_mfu', 'transformer_mfu'],
+           'dropped headline rows not reported missing')
+    _, checked3, _ = gate(traj3, {'longcontext_mfu': 0.53,
+                                  'transformer_mfu': 0.72,
+                                  'resnet_images_per_sec': 101.0})
+    expect(missing_required(checked3) == [],
+           'present headline rows reported missing')
     # the real committed trajectory must gate clean (newest vs prior)
     files = bench_files()
     if len(files) >= 2:
@@ -213,6 +242,9 @@ def smoke():
         expect(not fails,
                'committed trajectory regresses?! %r' % fails)
         expect(len(checked) > 0, 'committed trajectory: nothing gated')
+        expect(missing_required(checked) == [],
+               'newest committed round is missing required rows: %r'
+               % missing_required(checked))
     print('smoke: %s (%d mechanics checks)'
           % ('ok' if bad == 0 else '%d FAILURES' % bad, total))
     return bad
@@ -295,7 +327,20 @@ def main(argv=None):
     failures, checked, skipped = gate(refs, candidate,
                                       default_tol=args.tolerance)
     report(failures, checked, skipped, label)
-    return 1 if failures else 0
+    rc = 1 if failures else 0
+    if not args.run_suite and not args.candidate \
+            and not args.bench_glob:
+        # newest-committed-round mode over the REAL trajectory: the
+        # headline MFU rows must actually have been gated — a round
+        # that drops them would otherwise pass vacuously. Fixture
+        # globs (--bench-glob) and ad-hoc candidates are exempt; the
+        # smoke covers the mechanics.
+        missing = missing_required(checked)
+        for req in missing:
+            print('  MISSING required gated metric: %s '
+                  '(newest round must carry and gate it)' % req)
+            rc = 1
+    return rc
 
 
 if __name__ == '__main__':
