@@ -12,6 +12,11 @@ Restart semantics:
 - nonzero exit is a FAILURE: the role is restarted after a backoff
   (exponential per role, capped), until its restart budget
   (`max_restarts`) is spent — then the role is FAILED and stays down.
+- a role that stayed up for ``healthy_secs`` (FLAGS_sup_healthy_secs)
+  before dying gets its restart BUDGET and backoff exponent reset
+  first: a replica that crashes once a day is a healthy role having a
+  bad moment, not a crash loop. The LIFETIME restart count (and the
+  incarnation fence it feeds) keeps growing monotonically.
 - every restart sets ``FLAGS_trainer_incarnation`` to the role's
   restart count in the child's environment, so a restarted trainer
   re-registers with a higher incarnation and the pserver's fence
@@ -54,8 +59,10 @@ class _Role(object):
         self.restartable = restartable
         self.max_restarts = max_restarts
         self.proc = None
-        self.restarts = 0
-        self.state = 'pending'        # pending|running|done|failed
+        self.restarts = 0             # LIFETIME — feeds the incarnation
+        self.budget_used = 0          # restarts since last healthy run
+        self.spawned_at = None        # monotonic; healthy-secs clock
+        self.state = 'pending'        # pending|running|done|failed|removed
         self.next_restart_at = None   # monotonic; backoff gate
         self.log_path = None
 
@@ -76,8 +83,12 @@ class Supervisor(object):
     def __init__(self, max_restarts=3, backoff=0.5,
                  backoff_multiplier=2.0, max_backoff=10.0, log_dir=None,
                  clear_fault_plan_on_restart=True, obs_dir=None,
-                 clear_env_on_restart=()):
+                 clear_env_on_restart=(), healthy_secs=None):
+        from ..flags import get_flag
         self.max_restarts = int(max_restarts)
+        self.healthy_secs = float(healthy_secs
+                                  if healthy_secs is not None
+                                  else get_flag('sup_healthy_secs'))
         self.backoff = float(backoff)
         self.backoff_multiplier = float(backoff_multiplier)
         self.max_backoff = float(max_backoff)
@@ -93,23 +104,57 @@ class Supervisor(object):
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._monitor = None
+        self._started = False
         self.events = []   # [(monotonic, role, event_str), ...]
 
     # -- configuration -----------------------------------------------------
     def add_role(self, name, argv, env=None, restartable=True,
                  max_restarts=None):
-        """Register a role before start(). `env` replaces os.environ for
-        the child when given; restartable=False makes any nonzero exit
-        terminal (a role whose failure the test wants to SEE)."""
+        """Register a role; `env` replaces os.environ for the child
+        when given; restartable=False makes any nonzero exit terminal
+        (a role whose failure the test wants to SEE). After start()
+        this is the fleet scale-OUT primitive: the role is spawned
+        immediately and the monitor picks it up. Returns the role
+        name."""
         if max_restarts is None:
             max_restarts = self.max_restarts
-        self._roles.append(_Role(name, argv, env, restartable,
-                                 int(max_restarts)))
+        role = _Role(name, argv, env, restartable, int(max_restarts))
+        with self._lock:
+            self._roles.append(role)
+        if self._started:
+            self._spawn(role)
+            self._ensure_monitor()
+        return name
+
+    def remove_role(self, name, kill=True):
+        """Retire a role at runtime (fleet scale-IN): the monitor stops
+        watching it and — with kill=True — its process is killed. A
+        removed role counts as settled for wait()."""
+        with self._lock:
+            role = next((r for r in self._roles if r.name == name), None)
+        if role is None:
+            raise ValueError('unknown role %r' % name)
+        role.state = 'removed'
+        self._event(role, 'removed')
+        if kill and role.proc is not None and role.proc.poll() is None:
+            role.proc.kill()
+            try:
+                role.proc.wait(timeout=10.0)
+            except subprocess.TimeoutExpired:
+                pass
 
     # -- lifecycle ---------------------------------------------------------
     def start(self):
-        for role in self._roles:
+        self._started = True
+        for role in list(self._roles):
             self._spawn(role)
+        self._ensure_monitor()
+
+    def _ensure_monitor(self):
+        if self._monitor is not None and self._monitor.is_alive():
+            return
+        if self._stop.is_set():
+            return
         self._monitor = threading.Thread(target=self._monitor_loop,
                                          daemon=True)
         self._monitor.start()
@@ -145,6 +190,7 @@ class Supervisor(object):
         finally:
             if logf is not subprocess.DEVNULL:
                 logf.close()   # the child holds its own fd now
+        role.spawned_at = time.monotonic()
         role.state = 'running'
         self._event(role, 'spawned' if not role.restarts
                     else 'restarted #%d' % role.restarts)
@@ -186,37 +232,46 @@ class Supervisor(object):
             pass   # observability must never take the supervisor down
 
     def _monitor_loop(self):
+        # runs until stop(): roles can be added at runtime (fleet
+        # scale-out), so "everything settled" is never final
         while not self._stop.is_set():
-            all_settled = True
             now = time.monotonic()
-            for role in self._roles:
+            with self._lock:
+                roles = list(self._roles)
+            for role in roles:
                 if role.state == 'running':
                     rc = role.proc.poll()
                     if rc is None:
-                        all_settled = False
                         continue
                     if rc == 0:
                         role.state = 'done'
                         self._event(role, 'exit 0')
                         continue
                     self._event(role, 'exit %d' % rc)
+                    if (role.spawned_at is not None and self.healthy_secs
+                            and now - role.spawned_at
+                            >= self.healthy_secs
+                            and role.budget_used):
+                        # healthy long enough: this crash starts a
+                        # fresh budget + backoff ladder; the lifetime
+                        # count (incarnation fence) keeps climbing
+                        role.budget_used = 0
+                        self._event(role, 'budget reset (healthy %.1fs)'
+                                    % (now - role.spawned_at))
                     if (not role.restartable
-                            or role.restarts >= role.max_restarts):
+                            or role.budget_used >= role.max_restarts):
                         role.state = 'failed'
                         continue
+                    role.budget_used += 1
                     role.restarts += 1
                     delay = min(
                         self.backoff * self.backoff_multiplier
-                        ** (role.restarts - 1), self.max_backoff)
+                        ** (role.budget_used - 1), self.max_backoff)
                     role.state = 'backoff'
                     role.next_restart_at = now + delay
-                    all_settled = False
                 elif role.state == 'backoff':
-                    all_settled = False
                     if now >= role.next_restart_at:
                         self._spawn(role)
-            if all_settled:
-                return
             self._stop.wait(timeout=0.05)
 
     def wait(self, timeout=None):
@@ -227,7 +282,8 @@ class Supervisor(object):
             else time.monotonic() + timeout
         while True:
             states = self.states()
-            if all(s in ('done', 'failed') for s in states.values()):
+            if all(s in ('done', 'failed', 'removed')
+                   for s in states.values()):
                 return states
             if deadline is not None and time.monotonic() >= deadline:
                 return states

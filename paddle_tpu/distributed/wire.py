@@ -76,6 +76,28 @@ GET_VERSION = 14    # serving -> pserver: current param version; with
                     # meta['manifest'] the REPLY_OK also carries the
                     # per-param crc32 digest manifest the subscriber
                     # verifies pulled bytes against
+SRV_SUBMIT = 20     # router -> replica: open a generation stream
+                    # (meta rid/max_new_tokens/eos_id, value = prompt
+                    # token ids). A failover re-submit carries the
+                    # original prompt PLUS the tokens already decoded —
+                    # greedy determinism makes the re-prefilled stream
+                    # bit-exact with the unkilled one
+SRV_POLL = 21       # router -> replica: progress of meta['rids'];
+                    # reply meta['streams'] maps rid -> {state, tokens}
+                    # (UNKNOWN for a rid the replica never saw — a
+                    # restarted replica's answer for pre-kill streams)
+SRV_CANCEL = 22     # router -> replica: cancel stream meta['rid']
+SRV_HEALTH = 23     # router -> replica: liveness + load probe; reply
+                    # carries queue_depth/active/capacity/max_len/
+                    # param_version/draining (and with meta['digests']
+                    # the per-param crc32s a deploy convergence check
+                    # compares against the pserver manifest)
+SRV_DRAIN = 24      # router -> replica: drain fence — meta['on'] stops
+                    # (or resumes) THIS replica admitting new streams;
+                    # in-flight streams keep decoding to completion
+SRV_REFRESH = 25    # router -> replica: pull + install the pservers'
+                    # newest params NOW (ParamSubscriber.refresh_once);
+                    # the rolling-deploy step after the drain completes
 REPLY_VAR = 7       # pserver -> trainer: a variable value
 REPLY_OK = 8        # pserver -> trainer: ack
 REPLY_ERR = 9       # pserver -> trainer: error (meta['error'])

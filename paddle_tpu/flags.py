@@ -140,6 +140,36 @@ Known flags:
                          pulls + verify + stage) may take before it is
                          abandoned; the previously installed verified
                          version keeps serving
+  sup_healthy_secs       Supervisor (distributed/supervisor.py): a role
+                         that stayed up this long before dying gets its
+                         restart BUDGET (and backoff exponent) reset —
+                         a replica that crashes once a day is not a
+                         crash loop. Lifetime restart counts (and the
+                         incarnation fence they feed) are unaffected
+  fleet_poll_secs        FleetRouter (serving/fleet.py) stream-pump
+                         period: dispatch held requests + SRV_POLL
+                         progress of every in-flight stream
+  fleet_probe_secs       FleetRouter control period: SRV_HEALTH probe
+                         of every replica + admission-rule evaluation +
+                         autoscaler tick
+  fleet_probe_fails      consecutive failed probes before a quiet
+                         replica (no in-flight streams to trip the
+                         pump) is declared dead; a failed poll/submit
+                         kills it immediately
+  fleet_max_hold         FleetRouter hold-queue bound — submissions
+                         past this raise OverloadError regardless of
+                         the admission rules
+  fleet_shed_consecutive control periods a breached admission rule must
+                         persist before the router starts shedding
+                         (typed OverloadError on submit)
+  fleet_admission_rules  obs/slo.py rule list (same format as
+                         slo_rules) evaluated against the router's OWN
+                         fleet.* snapshot as the admission-control
+                         trigger; '' = the built-in fleet.queue_depth
+                         gauge_max rule at fleet_max_hold / 2
+  fleet_deploy_timeout   seconds rolling_deploy() may spend per replica
+                         on drain + refresh + health-check before the
+                         deploy aborts (the replica is un-drained)
 """
 from __future__ import annotations
 
@@ -286,6 +316,14 @@ _DEFAULTS = {
     # version
     'online_poll_secs': 0.5,
     'online_pull_timeout': 30.0,
+    'sup_healthy_secs': 300.0,
+    'fleet_poll_secs': 0.01,
+    'fleet_probe_secs': 0.25,
+    'fleet_probe_fails': 2,
+    'fleet_max_hold': 512,
+    'fleet_shed_consecutive': 2,
+    'fleet_admission_rules': '',
+    'fleet_deploy_timeout': 120.0,
     # batch_norm under data parallelism: compute statistics per device
     # (the reference's semantics — multi_devices_graph_pass.cc replicates
     # batch_norm per device, so stats are local and un-synced) instead of

@@ -18,13 +18,27 @@ layered on the inference Predictor ABI:
               submit/poll surface (reference
               inference/api/paddle_inference_api.h PaddlePredictor
               serving contract, re-shaped for token streams).
+- replica.py  ReplicaServer: one LMServer exposed on the wire (SRV_*
+              message types) so a fleet router can address it.
+- fleet.py    FleetRouter: health-checked dispatch over N replicas
+              with session affinity, transparent mid-stream failover
+              (greedy re-prefill from the accumulated prefix),
+              SLO-rule admission control (typed OverloadError), and
+              zero-drop rolling weight deploys; FleetAutoscaler drives
+              replica count from the same signals.
 
 Decode cost per token is O(1) against the cache instead of O(T) prefix
 recompute, and greedy decode is bit-exact against the full-recompute
-path (tests/test_serving.py).
+path (tests/test_serving.py); the same determinism makes fleet
+failover bit-exact (tests/test_fleet.py).
 """
 from .decode import DecodePredictor
 from .engine import ServingEngine, Request
 from .api import LMServer
+from .replica import ReplicaServer
+from .fleet import (FleetRouter, FleetAutoscaler, FleetRequest,
+                    OverloadError, FleetDeployError)
 
-__all__ = ['DecodePredictor', 'ServingEngine', 'Request', 'LMServer']
+__all__ = ['DecodePredictor', 'ServingEngine', 'Request', 'LMServer',
+           'ReplicaServer', 'FleetRouter', 'FleetAutoscaler',
+           'FleetRequest', 'OverloadError', 'FleetDeployError']

@@ -43,11 +43,15 @@ class LMServer(object):
 
     # -- online refresh ----------------------------------------------------
     def enable_refresh(self, endpoints, subscriber_id=0, poll_secs=None,
-                       pull_timeout=None, start=True):
+                       pull_timeout=None, start=True, paused=False):
         """Attach a ParamSubscriber (paddle_tpu/online/): serving
         tracks the pserver fleet's published param versions and
         installs fresh weights at decode step boundaries. Returns the
-        subscriber (started unless start=False)."""
+        subscriber (started unless start=False). paused=True starts the
+        poll loop but freezes automatic installs — the fleet-replica
+        posture, where only an orchestrator-driven refresh_once() (a
+        rolling deploy's SRV_REFRESH) installs, while staleness keeps
+        being measured."""
         if self._subscriber is not None:
             return self._subscriber
         from ..online import ParamSubscriber
@@ -57,7 +61,24 @@ class LMServer(object):
             pull_timeout=pull_timeout)
         if start:
             self._subscriber.start()
+        if paused:
+            self._subscriber.pause()
         return self._subscriber
+
+    @property
+    def subscriber(self):
+        """The attached ParamSubscriber, or None."""
+        return self._subscriber
+
+    def refresh_once(self):
+        """One orchestrator-driven refresh (pull + verify + install at
+        a step boundary); returns the installed version. Raises
+        RuntimeError when no refresh machinery is attached, RefreshError
+        (old weights untouched) on a failed pull."""
+        if self._subscriber is None:
+            raise RuntimeError('no refresh attached — call '
+                               'enable_refresh(endpoints) first')
+        return self._subscriber.refresh_once()
 
     # -- blocking ----------------------------------------------------------
     def generate(self, prompt, max_new_tokens=16, eos_id=None,
@@ -93,6 +114,22 @@ class LMServer(object):
         self._engine.cancel(self._req(handle))
 
     # -- ops ---------------------------------------------------------------
+    @property
+    def max_len(self):
+        """Context-window bound: prompt + generated tokens per stream."""
+        return self._decode.max_len
+
+    def param_digests(self):
+        """{param name: crc32 of its wire payload} for every served
+        weight — what a rolling deploy's convergence check compares
+        against the pserver manifest."""
+        return self._decode.param_digests()
+
+    def drain(self, timeout=None):
+        """Wait for queued + running streams to finish WITHOUT closing;
+        True once idle, False when `timeout` expired first."""
+        return self._engine.drain(timeout)
+
     def stats(self):
         """Engine stats plus the online-refresh position: param_version
         (installed; None before any refresh machinery is attached) and
@@ -109,11 +146,15 @@ class LMServer(object):
             out['staleness_rounds'] = None
         return out
 
-    def close(self, drain=True):
+    def close(self, drain=True, timeout=None):
+        """drain=True waits for in-flight streams; a `timeout` bounds
+        the wait and then escalates to cancel-and-close instead of
+        hanging forever on a stuck stream (ServingEngine.stop). Returns
+        True for a clean drain, False when the escalation fired."""
         if self._subscriber is not None:
             self._subscriber.stop()
             self._subscriber = None
-        self._engine.stop(drain=drain)
+        return self._engine.stop(drain=drain, timeout=timeout)
 
     def __enter__(self):
         return self

@@ -129,6 +129,19 @@ class DecodePredictor(object):
         return [n for n in self._pair.spec.param_names()
                 if n not in cache_names]
 
+    def param_digests(self):
+        """{name: crc32 of the param's wire payload} over the served
+        weights — the same digest a pserver stamps into its manifest,
+        so a fleet deploy can prove a replica converged to a published
+        version without shipping the bytes again."""
+        from ..distributed import wire
+        from ..integrity import crc32
+        out = {}
+        for name in self.param_names():
+            val = np.asarray(self._weight_scope.find_var(name))
+            out[name] = crc32(wire._payload_of(val)[1])
+        return out
+
     def stage_weights(self, params):
         """Stage a {name: host array} weight update for install: names
         are validated against the decode programs' param set, shapes

@@ -170,6 +170,8 @@ class ServingEngine(object):
         self._running = False
         self._threads = []
         self._active_total = 0
+        self._inflight = {}           # req.id -> RUNNING Request
+        self._accepting = True        # False once a drain/stop began
         self._slo = None
         self._gate = _StepGate()
         self._swaps = 0
@@ -179,6 +181,7 @@ class ServingEngine(object):
         if self._running:
             return self
         self._running = True
+        self._accepting = True
         # serving SLOs (obs/slo.py): when FLAGS_slo_rules is set, a
         # watchdog re-checks TTFT/token-latency percentiles and token
         # rates against the declared thresholds for the engine's
@@ -195,25 +198,66 @@ class ServingEngine(object):
             t.start()
         return self
 
-    def stop(self, drain=True):
+    def drain(self, timeout=None):
+        """Block until no queued or running work remains, leaving the
+        engine serving. Returns True once idle, False if `timeout`
+        expired first (nothing is cancelled — the caller decides
+        whether to escalate). On a never-started engine the queue has
+        no one to drain it: returns immediately."""
+        if not self._threads:
+            return not self._queue and not self._inflight
+        deadline = None if timeout is None \
+            else time.monotonic() + timeout
+        while True:
+            with self._cond:
+                if not self._queue and not self._inflight:
+                    return True
+            if deadline is not None and time.monotonic() >= deadline:
+                return False
+            time.sleep(0.005)
+
+    def stop(self, drain=True, timeout=None):
         """drain=True finishes queued + running requests first;
-        drain=False cancels everything still queued."""
+        drain=False cancels everything still queued. A `timeout` bounds
+        the drain: past it the stop ESCALATES — every still-queued and
+        still-running request is cancelled (partial tokens stay
+        readable) and the workers are joined with a bound instead of
+        hanging forever on a stuck stream. Returns True for a clean
+        drain, False when the escalation fired."""
+        self._accepting = False
+        clean = True
+        if drain and timeout is not None:
+            clean = self.drain(timeout)
         with self._cond:
-            if not drain:
+            if not drain or not clean:
                 while self._queue:
                     req = self._queue.popleft()
                     req._finish(CANCELLED)
                     _cancelled.inc()
+                if not clean:
+                    # running lanes notice the CANCELLED state at the
+                    # next step boundary and evict (cancel() semantics)
+                    for req in list(self._inflight.values()):
+                        if req.state == RUNNING:
+                            req.state = CANCELLED
             self._running = False
             self._cond.notify_all()
+        join_deadline = None if timeout is None \
+            else time.monotonic() + max(5.0, timeout)
         for t in self._threads:
-            t.join()
+            t.join(None if join_deadline is None
+                   else max(0.1, join_deadline - time.monotonic()))
+            if t.is_alive():
+                # a wedged decode step: the daemon thread dies with the
+                # process — surfacing a False beats hanging the caller
+                clean = False
         self._threads = []
         if self._slo is not None:
             # final check covers the tail between the last periodic
             # evaluation and drain
             self._slo.stop(final_check=True)
             self._slo = None
+        return clean
 
     close = stop
 
@@ -236,6 +280,10 @@ class ServingEngine(object):
             raise ValueError('max_new_tokens must be >= 1')
         req = Request(prompt, max_new_tokens, eos_id)
         with self._cond:
+            if self._running and not self._accepting:
+                _rejected.inc()
+                raise RuntimeError(
+                    'serving engine is draining — submission rejected')
             if len(self._queue) >= self._max_queue:
                 _rejected.inc()
                 raise RuntimeError('serving queue full (%d)'
@@ -303,6 +351,7 @@ class ServingEngine(object):
 
     def _finish_lane(self, lanes, slot, state, error=None):
         lane = lanes.pop(slot)
+        self._inflight.pop(lane.req.id, None)
         lane.req._finish(state, error)
         self._active_total -= 1
         if state == DONE:
@@ -342,6 +391,7 @@ class ServingEngine(object):
             if req is None:
                 break
             req.state = RUNNING
+            self._inflight[req.id] = req
             slot = free.pop(0)
             batch.append((req, slot))
             self._active_total += 1
@@ -353,6 +403,7 @@ class ServingEngine(object):
                                    [s for _, s in chunk])
             except Exception as e:     # noqa: BLE001 — lane-fatal only
                 for req, _slot in chunk:
+                    self._inflight.pop(req.id, None)
                     req._finish(FAILED, error=repr(e))
                     self._active_total -= 1
                     _failed.inc()

@@ -1,0 +1,1002 @@
+"""FleetRouter: health-checked serving fleet with admission control,
+transparent mid-stream failover, and zero-drop rolling weight deploys.
+
+The "millions of users" topology (ROADMAP item 1): one router in front
+of N ReplicaServer processes (tools/serve_replica.py, each wrapping an
+LMServer), speaking the SRV_* wire types. Four responsibilities:
+
+dispatch    queue-depth/occupancy-aware: a held request goes to the
+            least-loaded healthy, non-draining replica (router-side
+            in-flight count + the replica's own serving.queue_depth
+            from the SRV_HEALTH probe, normalized by capacity), with
+            session affinity — a multi-turn session sticks to its
+            replica while that replica stays eligible.
+
+failover    greedy decode is deterministic, so a stream is fully
+            described by (original prompt + tokens so far, remaining
+            budget). When a replica dies (failed poll/submit, or
+            fleet_probe_fails consecutive failed probes), every live
+            stream it held is re-submitted to a healthy replica with
+            its accumulated prefix as the prompt — the continuation is
+            bit-exact with the unkilled run (tests/test_fleet.py), and
+            the client's FleetRequest never notices beyond latency.
+
+admission   obs/slo.py rules evaluated every control tick against the
+            router's OWN fleet.* snapshot (local accounting, so the
+            trigger works whether or not telemetry export is enabled).
+            A rule breached fleet_shed_consecutive ticks flips the
+            router into shedding: submit() raises a typed
+            OverloadError (counted in fleet.shed) instead of letting
+            queue depth grow until the TTFT SLO breaks. The hold-queue
+            bound (fleet_max_hold) is a hard backstop.
+
+deploys     rolling_deploy(): one replica at a time — stop dispatching
+            to it (+ SRV_DRAIN fence), wait for its in-flight streams,
+            SRV_REFRESH (the PR-9 ParamSubscriber pull/verify/install
+            path, orchestrator-driven on paused subscribers),
+            health-check the installed version, rejoin. A param
+            version bump drops zero streams. enable_rolling_deploys()
+            watches the pservers' published version and rolls
+            automatically.
+
+FleetAutoscaler drives replica count from the same snapshot: sustained
+up-rule breach -> scale_up() (spawn a replica — Supervisor.add_role —
+and router.add_replica), sustained idle -> drain + remove + scale_down.
+
+Telemetry (exported when FLAGS_obs_dir is set; the router ALSO keeps
+local counts for stats() and the admission snapshot):
+  fleet.requests.{submitted,completed,failed,cancelled} / fleet.shed /
+  fleet.failovers / fleet.replica_deaths / fleet.dispatches /
+  fleet.deploys / fleet.tokens_generated   counters;
+  fleet.queue_depth / fleet.active_streams / fleet.replicas_healthy /
+  fleet.replicas_total / fleet.shedding    gauges;
+  fleet.ttft / fleet.dispatch_wait         histograms;
+  fleet.deploy / fleet.drain               spans.
+"""
+from __future__ import annotations
+
+import collections
+import itertools
+import os
+import socket
+import threading
+import time
+
+import numpy as np
+
+from ..distributed import wire
+from ..flags import get_flag
+from ..obs import telemetry
+from ..obs import trace as _trace
+from .engine import QUEUED, RUNNING, DONE, CANCELLED, FAILED
+
+__all__ = ['FleetRouter', 'FleetAutoscaler', 'FleetRequest',
+           'OverloadError', 'FleetDeployError']
+
+_submitted = telemetry.counter('fleet.requests.submitted')
+_completed = telemetry.counter('fleet.requests.completed')
+_failed = telemetry.counter('fleet.requests.failed')
+_cancelled = telemetry.counter('fleet.requests.cancelled')
+_shed = telemetry.counter('fleet.shed')
+_failovers = telemetry.counter('fleet.failovers')
+_deaths = telemetry.counter('fleet.replica_deaths')
+_dispatches = telemetry.counter('fleet.dispatches')
+_deploys = telemetry.counter('fleet.deploys')
+_tokens_out = telemetry.counter('fleet.tokens_generated')
+_queue_depth = telemetry.gauge('fleet.queue_depth')
+_active_streams = telemetry.gauge('fleet.active_streams')
+_replicas_healthy = telemetry.gauge('fleet.replicas_healthy')
+_replicas_total = telemetry.gauge('fleet.replicas_total')
+_shedding_g = telemetry.gauge('fleet.shedding')
+_ttft = telemetry.histogram('fleet.ttft')
+_dispatch_wait = telemetry.histogram('fleet.dispatch_wait')
+
+
+class OverloadError(RuntimeError):
+    """Typed admission-control rejection: the fleet is shedding load
+    (an obs/slo.py admission rule breached for a sustained window) or
+    the hold queue hit its hard bound. Back off and retry — accepted
+    streams are being protected, not dropped."""
+
+
+class FleetDeployError(RuntimeError):
+    """A rolling deploy step could not complete inside its deadline
+    (drain stuck, refresh failing, or the post-refresh health check
+    disagreeing about the installed version). The replica is
+    un-drained and keeps serving its OLD verified weights."""
+
+
+class _ReplicaError(RuntimeError):
+    """REPLY_ERR from a replica; retryable mirrors the wire field."""
+
+    def __init__(self, msg, retryable=False):
+        super(_ReplicaError, self).__init__(msg)
+        self.retryable = retryable
+
+
+class _LocalHist(object):
+    """telemetry.Histogram's bucket layout, always-on: the admission
+    rules must see fleet.ttft whether or not export is enabled, so the
+    router keeps its own accounting and feeds SLORule.evaluate
+    snapshot-shaped dicts."""
+
+    def __init__(self):
+        self.count = 0
+        self.sum = 0.0
+        self.min = float('inf')
+        self.max = float('-inf')
+        self.buckets = [0] * (len(telemetry._BOUNDS) + 1)
+
+    def observe(self, v):
+        v = float(v)
+        self.count += 1
+        self.sum += v
+        self.min = min(self.min, v)
+        self.max = max(self.max, v)
+        i = 0
+        for bound in telemetry._BOUNDS:
+            if v <= bound:
+                break
+            i += 1
+        self.buckets[i] += 1
+
+    def snapshot(self):
+        return {'count': self.count, 'sum': self.sum, 'min': self.min,
+                'max': self.max, 'buckets': list(self.buckets)}
+
+
+class FleetRequest(object):
+    """One fleet-level generation stream. `tokens` accumulates ACROSS
+    failover segments; wait()/result() match engine.Request."""
+
+    _ids = itertools.count()
+
+    def __init__(self, prompt, max_new_tokens, eos_id, session):
+        self.id = next(FleetRequest._ids)
+        self.prompt = [int(t) for t in np.asarray(prompt).reshape(-1)]
+        self.max_new_tokens = int(max_new_tokens)
+        self.eos_id = eos_id
+        self.session = session
+        self.state = QUEUED
+        self.tokens = []
+        self.error = None
+        self.replica = None           # endpoint currently serving it
+        self.segment = 0              # bumps on every failover
+        self.base = 0                 # len(tokens) at segment dispatch
+        self.rid = None
+        self.submitted_at = time.perf_counter()
+        self.dispatched_at = None
+        self.first_token_at = None
+        self.done_at = None
+        self._done = threading.Event()
+
+    def _finish(self, state, error=None):
+        self.state = state
+        self.error = error
+        self.done_at = time.perf_counter()
+        self._done.set()
+
+    def wait(self, timeout=None):
+        return self._done.wait(timeout)
+
+    def result(self, timeout=None):
+        if not self.wait(timeout):
+            raise TimeoutError('fleet request %d still %s after %rs'
+                               % (self.id, self.state, timeout))
+        if self.state == FAILED:
+            raise RuntimeError('fleet request %d failed: %s'
+                               % (self.id, self.error))
+        return list(self.tokens)
+
+
+class _ReplicaClient(object):
+    """One blocking wire connection to a replica (reconnect on demand,
+    serialized calls, seq-echo desync check). NO retry layer: a failed
+    call IS the router's death signal for that replica."""
+
+    def __init__(self, endpoint, timeout=10.0):
+        self.endpoint = endpoint
+        self._timeout = float(timeout)
+        self._sock = None
+        self._mu = threading.Lock()
+        self._seq = itertools.count()
+
+    def call(self, msg_type, meta=None, value=None, timeout=None):
+        with self._mu:
+            seq = next(self._seq)
+            m = dict(meta or {})
+            m['seq'] = seq
+            try:
+                if self._sock is None:
+                    host, port = self.endpoint.rsplit(':', 1)
+                    self._sock = socket.create_connection(
+                        (host, int(port)), timeout=2.0)
+                    self._sock.setsockopt(socket.IPPROTO_TCP,
+                                          socket.TCP_NODELAY, 1)
+                self._sock.settimeout(timeout or self._timeout)
+                wire.write_msg(self._sock, msg_type, m, value)
+                rt, rmeta, _rv = wire.read_msg(self._sock)
+            except (ConnectionError, OSError):
+                self._reset_locked()
+                raise
+            if rmeta.get('seq') != seq:
+                self._reset_locked()
+                raise ConnectionError(
+                    'replica %s reply seq %r != %d — desynced'
+                    % (self.endpoint, rmeta.get('seq'), seq))
+            if rt == wire.REPLY_ERR:
+                raise _ReplicaError(
+                    '%s: %s' % (self.endpoint, rmeta.get('error')),
+                    retryable=bool(rmeta.get('retryable')))
+            return rmeta
+
+    def _reset_locked(self):
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+
+    def close(self):
+        with self._mu:
+            self._reset_locked()
+
+
+class _Replica(object):
+    __slots__ = ('endpoint', 'client', 'order', 'healthy', 'draining',
+                 'fails', 'active', 'capacity', 'queue_depth',
+                 'max_len', 'param_version', 'hold_until')
+
+    def __init__(self, endpoint, order, timeout):
+        self.endpoint = endpoint
+        self.client = _ReplicaClient(endpoint, timeout=timeout)
+        self.order = order
+        self.healthy = False          # flips on the first good probe
+        self.draining = False
+        self.fails = 0
+        self.active = {}              # req.id -> FleetRequest
+        self.capacity = 1
+        self.queue_depth = 0
+        self.max_len = None
+        self.param_version = None
+        self.hold_until = 0.0         # brief dispatch backoff (full)
+
+
+class FleetAutoscaler(object):
+    """Replica-count policy over the router's admission snapshot.
+
+    scale_up() -> endpoint of a freshly launched replica (the caller
+    owns process lifecycle — Supervisor.add_role in production); the
+    router add_replica()s it. scale_down(endpoint) is called AFTER the
+    router drained and removed the replica. Sustained up-rule breach
+    (default: fleet.queue_depth > 0) scales out; a fully idle fleet
+    (no held or active streams) for `sustain` ticks scales in, down to
+    min_replicas. cooldown_secs separates consecutive actions."""
+
+    def __init__(self, scale_up=None, scale_down=None, min_replicas=1,
+                 max_replicas=8, up_rules=None, sustain=3,
+                 cooldown_secs=5.0):
+        from ..obs import slo as _slo
+        self.scale_up = scale_up
+        self.scale_down = scale_down
+        self.min_replicas = int(min_replicas)
+        self.max_replicas = int(max_replicas)
+        self.sustain = int(sustain)
+        self.cooldown_secs = float(cooldown_secs)
+        if up_rules is None:
+            up_rules = [{'name': 'fleet_backlog',
+                         'metric': 'fleet.queue_depth',
+                         'kind': 'gauge_max', 'threshold': 0}]
+        self.up_rules = _slo.parse_rules(up_rules)
+        self._up_streak = 0
+        self._idle_streak = 0
+        self._cool_until = 0.0
+        self.events = []              # [(monotonic, action, endpoint)]
+
+    def tick(self, router, snap, prev, dt):
+        gauges = snap.get('gauges', {})
+        breach = False
+        for rule in self.up_rules:
+            out = rule.evaluate(snap, prev=prev, dt=dt)
+            if out is not None and out[1]:
+                breach = True
+        idle = (not gauges.get('fleet.queue_depth')
+                and not gauges.get('fleet.active_streams'))
+        if breach:
+            self._up_streak += 1
+            self._idle_streak = 0
+        elif idle:
+            self._idle_streak += 1
+            self._up_streak = 0
+        else:
+            self._up_streak = self._idle_streak = 0
+        now = time.monotonic()
+        if now < self._cool_until:
+            return
+        n = len(router.replicas())
+        if (self._up_streak >= self.sustain and n < self.max_replicas
+                and self.scale_up is not None):
+            ep = self.scale_up()
+            if ep:
+                router.add_replica(ep)
+                self.events.append((now, 'scale_up', ep))
+                _trace.event('fleet.scale_up', endpoint=ep, replicas=n + 1)
+            self._up_streak = 0
+            self._cool_until = now + self.cooldown_secs
+        elif self._idle_streak >= self.sustain and n > self.min_replicas:
+            victim = router.idle_replica()
+            if victim is None:
+                return
+            try:
+                router.remove_replica(victim, drain=True, timeout=10.0)
+            except FleetDeployError:
+                return                # streams arrived mid-drain: keep it
+            if self.scale_down is not None:
+                self.scale_down(victim)
+            self.events.append((now, 'scale_down', victim))
+            _trace.event('fleet.scale_down', endpoint=victim,
+                         replicas=n - 1)
+            self._idle_streak = 0
+            self._cool_until = now + self.cooldown_secs
+
+
+class FleetRouter(object):
+    def __init__(self, replicas, pservers=None, poll_secs=None,
+                 probe_secs=None, max_hold=None, admission_rules=None,
+                 shed_consecutive=None, probe_fail_threshold=None,
+                 call_timeout=10.0, subscriber_id=900):
+        """replicas: ReplicaServer endpoints ('host:port'). pservers:
+        the parameter-server fleet (only needed for published-version
+        watching / enable_rolling_deploys). admission_rules: obs/slo.py
+        rule list (objects, dicts, JSON, or @path — parse_rules) over
+        the fleet.* snapshot; default is a fleet.queue_depth gauge_max
+        rule at max_hold/2."""
+        from ..obs import slo as _slo
+        self._poll_secs = float(poll_secs if poll_secs is not None
+                                else get_flag('fleet_poll_secs'))
+        self._probe_secs = float(probe_secs if probe_secs is not None
+                                 else get_flag('fleet_probe_secs'))
+        self._max_hold = int(max_hold or get_flag('fleet_max_hold'))
+        self._shed_consecutive = int(
+            shed_consecutive if shed_consecutive is not None
+            else get_flag('fleet_shed_consecutive'))
+        self._probe_fail_threshold = int(
+            probe_fail_threshold if probe_fail_threshold is not None
+            else get_flag('fleet_probe_fails'))
+        self._call_timeout = float(call_timeout)
+        if admission_rules is None:
+            admission_rules = get_flag('fleet_admission_rules') or [
+                {'name': 'fleet_queue_depth',
+                 'metric': 'fleet.queue_depth', 'kind': 'gauge_max',
+                 'threshold': max(1, self._max_hold // 2)}]
+        self._admission_rules = _slo.parse_rules(admission_rules)
+        self._pservers = list(pservers or [])
+        self._subscriber_id = int(subscriber_id)
+        self._mu = threading.Condition()
+        self._hold = collections.deque()
+        self._reps = {}
+        self._order = itertools.count()
+        self._sessions = {}           # session -> endpoint
+        self._nonce = os.urandom(4).hex()
+        self._ttft_local = _LocalHist()
+        self._submitted_n = 0
+        self._completed_n = 0
+        self._failed_n = 0
+        self._cancelled_n = 0
+        self._shed_n = 0
+        self._failovers_n = 0
+        self._deploys_n = 0
+        self._tokens_n = 0
+        self._dispatches_n = 0
+        self._shedding = False
+        self._breach_streak = 0
+        self._breach_rule = None
+        self._prev_snap = None
+        self._prev_snap_t = None
+        self._deployed_version = 0
+        self._autoscaler = None
+        self._stop_evt = threading.Event()
+        self._threads = []
+        for ep in replicas:
+            self.add_replica(ep)
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self):
+        if self._threads:
+            return self
+        self._stop_evt.clear()
+        self._threads = [
+            threading.Thread(target=self._pump_loop,
+                             name='fleet-pump', daemon=True),
+            threading.Thread(target=self._control_loop,
+                             name='fleet-control', daemon=True)]
+        for t in self._threads:
+            t.start()
+        return self
+
+    def stop(self):
+        self._stop_evt.set()
+        for t in self._threads:
+            t.join(timeout=10.0)
+        self._threads = []
+        with self._mu:
+            victims = list(self._hold)
+            self._hold.clear()
+            for rep in self._reps.values():
+                victims.extend(rep.active.values())
+                rep.active.clear()
+        for req in victims:
+            if req.state in (QUEUED, RUNNING):
+                req._finish(CANCELLED)
+                self._cancelled_n += 1
+                _cancelled.inc()
+        for rep in self._reps.values():
+            rep.client.close()
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    # -- fleet membership --------------------------------------------------
+    def add_replica(self, endpoint):
+        with self._mu:
+            if endpoint in self._reps:
+                return
+            self._reps[endpoint] = _Replica(endpoint,
+                                            next(self._order),
+                                            self._call_timeout)
+        _replicas_total.set(len(self._reps))
+
+    def remove_replica(self, endpoint, drain=True, timeout=30.0):
+        """Scale-in: stop dispatching to the replica, optionally wait
+        for its in-flight streams, drop it. The process itself belongs
+        to the caller (Supervisor.remove_role)."""
+        with self._mu:
+            rep = self._reps.get(endpoint)
+            if rep is None:
+                return
+            rep.draining = True
+        if drain:
+            deadline = time.monotonic() + timeout
+            while True:
+                with self._mu:
+                    n = len(rep.active)
+                if not n:
+                    break
+                if time.monotonic() >= deadline:
+                    with self._mu:
+                        rep.draining = False
+                    raise FleetDeployError(
+                        'replica %s still has %d in-flight streams '
+                        'after %.1fs drain' % (endpoint, n, timeout))
+                time.sleep(0.01)
+        with self._mu:
+            # no drain (or a dead replica): surviving streams fail over
+            for req in list(rep.active.values()):
+                rep.active.pop(req.id, None)
+                self._requeue_locked(req)
+            self._reps.pop(endpoint, None)
+            for s, ep in list(self._sessions.items()):
+                if ep == endpoint:
+                    del self._sessions[s]
+        rep.client.close()
+        _replicas_total.set(len(self._reps))
+
+    def replicas(self):
+        with self._mu:
+            return list(self._reps)
+
+    def idle_replica(self):
+        """A healthy replica with no in-flight streams (scale-in
+        victim), preferring the newest; None when all are busy."""
+        with self._mu:
+            idle = [r for r in self._reps.values()
+                    if r.healthy and not r.active and not r.draining]
+            if not idle:
+                return None
+            return max(idle, key=lambda r: r.order).endpoint
+
+    def wait_healthy(self, n=None, timeout=60.0):
+        """Block until `n` replicas (default: all) answered a probe."""
+        if n is None:
+            n = len(self._reps)
+        deadline = time.monotonic() + timeout
+        while True:
+            with self._mu:
+                healthy = sum(1 for r in self._reps.values()
+                              if r.healthy)
+            if healthy >= n:
+                return healthy
+            if time.monotonic() >= deadline:
+                raise TimeoutError(
+                    'only %d/%d replicas healthy after %.1fs'
+                    % (healthy, n, timeout))
+            time.sleep(0.02)
+
+    def attach_autoscaler(self, autoscaler):
+        self._autoscaler = autoscaler
+        return autoscaler
+
+    # -- client surface ----------------------------------------------------
+    def submit(self, prompt, max_new_tokens=16, eos_id=None,
+               session=None):
+        """Admit a stream into the fleet; raises OverloadError while
+        shedding (or when the hold queue is at its hard bound)."""
+        req = FleetRequest(prompt, max_new_tokens, eos_id, session)
+        if not req.prompt:
+            raise ValueError('empty prompt')
+        with self._mu:
+            if self._shedding:
+                self._shed_n += 1
+                _shed.inc()
+                raise OverloadError(
+                    'fleet is shedding: admission rule %r breached %d '
+                    'consecutive checks' % (self._breach_rule,
+                                            self._breach_streak))
+            if len(self._hold) >= self._max_hold:
+                self._shed_n += 1
+                _shed.inc()
+                raise OverloadError('fleet hold queue full (%d)'
+                                    % self._max_hold)
+            self._hold.append(req)
+            self._submitted_n += 1
+            _submitted.inc()
+            _queue_depth.set(len(self._hold))
+            self._mu.notify_all()
+        return req
+
+    def generate(self, prompt, max_new_tokens=16, eos_id=None,
+                 session=None, timeout=None):
+        return self.submit(prompt, max_new_tokens, eos_id=eos_id,
+                           session=session).result(timeout)
+
+    def cancel(self, req):
+        with self._mu:
+            if req.state == QUEUED and req.replica is None:
+                try:
+                    self._hold.remove(req)
+                except ValueError:
+                    pass
+                else:
+                    req._finish(CANCELLED)
+                    self._cancelled_n += 1
+                    _cancelled.inc()
+                    return req
+            rep = self._reps.get(req.replica)
+            rid = req.rid
+        if rep is not None and rid is not None:
+            try:
+                rep.client.call(wire.SRV_CANCEL, {'rid': rid})
+            except (ConnectionError, OSError, _ReplicaError):
+                pass                  # the pump will finalize either way
+        return req
+
+    def stats(self):
+        with self._mu:
+            reps = {ep: {'healthy': r.healthy, 'draining': r.draining,
+                         'active': len(r.active),
+                         'capacity': r.capacity,
+                         'queue_depth': r.queue_depth,
+                         'param_version': r.param_version}
+                    for ep, r in self._reps.items()}
+            return {'replicas': reps,
+                    'queue_depth': len(self._hold),
+                    'active': sum(len(r.active)
+                                  for r in self._reps.values()),
+                    'submitted': self._submitted_n,
+                    'completed': self._completed_n,
+                    'failed': self._failed_n,
+                    'cancelled': self._cancelled_n,
+                    'shed': self._shed_n,
+                    'failovers': self._failovers_n,
+                    'deploys': self._deploys_n,
+                    'dispatches': self._dispatches_n,
+                    'tokens': self._tokens_n,
+                    'shedding': self._shedding}
+
+    def admission_snapshot(self):
+        """The snapshot-dict the admission rules (and any external SLO
+        check) evaluate — fleet.* series from the router's local
+        accounting, telemetry-shaped."""
+        with self._mu:
+            return self._snapshot_locked()
+
+    def _snapshot_locked(self):
+        active = sum(len(r.active) for r in self._reps.values())
+        healthy = sum(1 for r in self._reps.values() if r.healthy)
+        return {
+            'counters': {'fleet.requests.submitted': self._submitted_n,
+                         'fleet.requests.completed': self._completed_n,
+                         'fleet.shed': self._shed_n,
+                         'fleet.failovers': self._failovers_n,
+                         'fleet.tokens_generated': self._tokens_n},
+            'gauges': {'fleet.queue_depth': float(len(self._hold)),
+                       'fleet.active_streams': float(active),
+                       'fleet.replicas_healthy': float(healthy)},
+            'hists': {'fleet.ttft': self._ttft_local.snapshot()}}
+
+    # -- pump: dispatch + stream progress ----------------------------------
+    def _pump_loop(self):
+        while not self._stop_evt.is_set():
+            try:
+                self._dispatch_held()
+                self._poll_streams()
+            except Exception as e:    # noqa: BLE001 — router survives
+                _trace.event('fleet.pump_error', error=repr(e))
+            self._stop_evt.wait(self._poll_secs)
+
+    def _dispatch_held(self):
+        while not self._stop_evt.is_set():
+            with self._mu:
+                if not self._hold:
+                    return
+                req = self._hold[0]
+                if req.state == CANCELLED:
+                    self._hold.popleft()
+                    req._finish(CANCELLED)
+                    self._cancelled_n += 1
+                    _cancelled.inc()
+                    continue
+                remaining = req.max_new_tokens - len(req.tokens)
+                if remaining <= 0:    # failover landed exactly at budget
+                    self._hold.popleft()
+                    self._finalize_locked(req, DONE)
+                    continue
+                rep = self._pick_locked(req)
+                if rep is None:
+                    return            # no eligible replica right now
+                self._hold.popleft()
+                _queue_depth.set(len(self._hold))
+                req.replica = rep.endpoint
+                req.base = len(req.tokens)
+                req.rid = '%s/%d/%d' % (self._nonce, req.id,
+                                        req.segment)
+                rep.active[req.id] = req
+                if req.session is not None:
+                    self._sessions[req.session] = rep.endpoint
+                prompt = req.prompt + req.tokens
+                rid, mnt, eos = req.rid, remaining, req.eos_id
+                if rep.max_len is not None and len(prompt) > rep.max_len:
+                    # a failover prefix past the context window cannot
+                    # be re-prefilled bit-exactly (ring slide)
+                    rep.active.pop(req.id, None)
+                    self._finalize_locked(
+                        req, FAILED,
+                        'failover prefix %d exceeds replica max_len %d'
+                        % (len(prompt), rep.max_len))
+                    continue
+            try:
+                rep.client.call(
+                    wire.SRV_SUBMIT,
+                    {'rid': rid, 'mnt': mnt, 'eos': eos},
+                    value=np.asarray(prompt, np.int64))
+            except _ReplicaError as e:
+                with self._mu:
+                    rep.active.pop(req.id, None)
+                    req.replica = None
+                    if e.retryable:   # full / draining: try elsewhere
+                        rep.hold_until = time.monotonic() + 0.05
+                        self._hold.appendleft(req)
+                        _queue_depth.set(len(self._hold))
+                    else:
+                        self._finalize_locked(req, FAILED, str(e))
+            except (ConnectionError, OSError):
+                self._on_replica_down(rep)
+            else:
+                with self._mu:
+                    req.state = RUNNING
+                    if req.dispatched_at is None:
+                        req.dispatched_at = time.perf_counter()
+                        _dispatch_wait.observe(req.dispatched_at
+                                               - req.submitted_at)
+                    self._dispatches_n += 1
+                _dispatches.inc()
+
+    def _pick_locked(self, req):
+        now = time.monotonic()
+        elig = [r for r in self._reps.values()
+                if r.healthy and not r.draining
+                and now >= r.hold_until
+                and len(r.active) < max(1, r.capacity)]
+        if not elig:
+            return None
+        if req.session is not None:
+            ep = self._sessions.get(req.session)
+            for r in elig:
+                if r.endpoint == ep:
+                    return r
+        return min(elig, key=lambda r: (
+            (len(r.active) + r.queue_depth) / max(1, r.capacity),
+            r.order))
+
+    def _poll_streams(self):
+        for rep in list(self._reps.values()):
+            with self._mu:
+                pairs = {r.rid: r for r in rep.active.values()}
+            if not pairs:
+                continue
+            try:
+                reply = rep.client.call(wire.SRV_POLL,
+                                        {'rids': list(pairs)})
+            except (ConnectionError, OSError):
+                self._on_replica_down(rep)
+                continue
+            except _ReplicaError:
+                continue
+            streams = reply.get('streams', {})
+            for rid, req in pairs.items():
+                st = streams.get(rid)
+                if st is not None:
+                    self._apply_poll(rep, req, st)
+
+    def _apply_poll(self, rep, req, st):
+        state = st.get('state')
+        toks = [int(t) for t in st.get('tokens', ())]
+        with self._mu:
+            if req.state not in (QUEUED, RUNNING):
+                rep.active.pop(req.id, None)
+                return
+            if req.rid not in (None,) and rep.active.get(req.id) is not req:
+                return                # already failed over elsewhere
+            if state == 'UNKNOWN':
+                # replica restarted underneath its streams: same
+                # failover as a dead connection, per stream
+                rep.active.pop(req.id, None)
+                self._requeue_locked(req)
+                return
+            old = len(req.tokens)
+            if toks:
+                req.tokens[req.base:] = toks
+            new = len(req.tokens)
+            if new > old:
+                self._tokens_n += new - old
+                _tokens_out.inc(new - old)
+                if req.first_token_at is None:
+                    req.first_token_at = time.perf_counter()
+                    ttft = req.first_token_at - req.submitted_at
+                    self._ttft_local.observe(ttft)
+                    _ttft.observe(ttft)
+            if state in (DONE, CANCELLED, FAILED):
+                rep.active.pop(req.id, None)
+                self._finalize_locked(req, state, st.get('error'))
+
+    def _finalize_locked(self, req, state, error=None):
+        req._finish(state, error)
+        if state == DONE:
+            self._completed_n += 1
+            _completed.inc()
+        elif state == CANCELLED:
+            self._cancelled_n += 1
+            _cancelled.inc()
+        else:
+            self._failed_n += 1
+            _failed.inc()
+
+    def _requeue_locked(self, req):
+        if req.state not in (QUEUED, RUNNING):
+            return
+        req.segment += 1
+        req.replica = None
+        req.state = QUEUED
+        self._hold.appendleft(req)
+        self._failovers_n += 1
+        _failovers.inc()
+        _queue_depth.set(len(self._hold))
+
+    def _on_replica_down(self, rep):
+        with self._mu:
+            if rep is not self._reps.get(rep.endpoint):
+                return                # already removed
+            was_live = rep.healthy or bool(rep.active)
+            rep.healthy = False
+            rep.fails = max(rep.fails, self._probe_fail_threshold)
+            victims = list(rep.active.values())
+            rep.active.clear()
+            for s, ep in list(self._sessions.items()):
+                if ep == rep.endpoint:
+                    del self._sessions[s]
+            for req in victims:
+                self._requeue_locked(req)
+        rep.client.close()
+        if was_live:
+            self._deaths_inc(rep, len(victims))
+
+    def _deaths_inc(self, rep, n_streams):
+        _deaths.inc()
+        _trace.event('fleet.replica_down', endpoint=rep.endpoint,
+                     failover_streams=n_streams)
+
+    # -- control: probes, admission, autoscale, auto-deploy ----------------
+    def _control_loop(self):
+        while not self._stop_evt.is_set():
+            try:
+                self._control_once()
+            except Exception as e:    # noqa: BLE001 — router survives
+                _trace.event('fleet.control_error', error=repr(e))
+            self._stop_evt.wait(self._probe_secs)
+
+    def _control_once(self):
+        for rep in list(self._reps.values()):
+            try:
+                h = rep.client.call(wire.SRV_HEALTH, {},
+                                    timeout=self._call_timeout)
+            except (ConnectionError, OSError, _ReplicaError):
+                with self._mu:
+                    rep.fails += 1
+                    dead = (rep.fails >= self._probe_fail_threshold
+                            and (rep.healthy or rep.active))
+                if dead:
+                    self._on_replica_down(rep)
+                continue
+            with self._mu:
+                rep.fails = 0
+                rep.queue_depth = int(h.get('queue_depth', 0))
+                rep.capacity = int(h.get('capacity') or rep.capacity)
+                rep.max_len = h.get('max_len', rep.max_len)
+                rep.param_version = h.get('param_version')
+                rep.healthy = True
+        now = time.monotonic()
+        snap = self.admission_snapshot()
+        dt = (now - self._prev_snap_t) if self._prev_snap_t else None
+        self._evaluate_admission(snap, dt)
+        if self._autoscaler is not None:
+            self._autoscaler.tick(self, snap, self._prev_snap, dt)
+        self._prev_snap, self._prev_snap_t = snap, now
+        gauges = snap['gauges']
+        _active_streams.set(gauges['fleet.active_streams'])
+        _replicas_healthy.set(gauges['fleet.replicas_healthy'])
+        _replicas_total.set(len(self._reps))
+
+    def _evaluate_admission(self, snap, dt):
+        breached = None
+        for rule in self._admission_rules:
+            out = rule.evaluate(snap, prev=self._prev_snap, dt=dt)
+            if out is not None and out[1]:
+                breached = rule.name
+        with self._mu:
+            if breached is not None:
+                self._breach_streak += 1
+                self._breach_rule = breached
+            else:
+                self._breach_streak = 0
+            shed = self._breach_streak >= self._shed_consecutive
+            flipped = shed != self._shedding
+            self._shedding = shed
+        if flipped:
+            _shedding_g.set(1.0 if shed else 0.0)
+            _trace.event('fleet.shed_on' if shed else 'fleet.shed_off',
+                         rule=self._breach_rule or '',
+                         streak=self._breach_streak)
+
+    # -- rolling deploys ---------------------------------------------------
+    def rolling_deploy(self, min_version=None, timeout=None):
+        """One replica at a time: drain -> refresh -> health-check ->
+        rejoin. Returns {endpoint: installed version} (None for a
+        replica that was down and skipped). Raises FleetDeployError
+        when a step misses its per-replica deadline — the replica is
+        un-drained and keeps serving its old verified weights; already-
+        deployed replicas keep the new ones (versions are
+        forward-compatible by the PR-9 contract)."""
+        timeout = float(timeout if timeout is not None
+                        else get_flag('fleet_deploy_timeout'))
+        results = {}
+        with _trace.span('fleet.deploy', kind='fleet',
+                         replicas=len(self._reps),
+                         min_version=min_version or 0):
+            for ep in self.replicas():
+                with self._mu:
+                    rep = self._reps.get(ep)
+                    if rep is None or not rep.healthy:
+                        results[ep] = None
+                        continue
+                    rep.draining = True
+                try:
+                    results[ep] = self._deploy_one(rep, min_version,
+                                                   timeout)
+                finally:
+                    with self._mu:
+                        rep.draining = False
+                    try:
+                        rep.client.call(wire.SRV_DRAIN, {'on': False})
+                    except (ConnectionError, OSError, _ReplicaError):
+                        pass
+        self._deploys_n += 1
+        _deploys.inc()
+        return results
+
+    def _deploy_one(self, rep, min_version, timeout):
+        deadline = time.monotonic() + timeout
+        try:
+            rep.client.call(wire.SRV_DRAIN, {'on': True})
+        except (ConnectionError, OSError):
+            self._on_replica_down(rep)
+            return None
+        with _trace.span('fleet.drain', kind='fleet',
+                         endpoint=rep.endpoint):
+            while True:
+                with self._mu:
+                    n = len(rep.active)
+                if not n:
+                    break
+                if time.monotonic() >= deadline:
+                    raise FleetDeployError(
+                        'deploy drain of %s timed out with %d streams '
+                        'in flight' % (rep.endpoint, n))
+                time.sleep(0.01)
+        version = None
+        while True:
+            try:
+                r = rep.client.call(wire.SRV_REFRESH, {},
+                                    timeout=max(5.0, timeout))
+                version = int(r['param_version'])
+                if min_version is None or version >= int(min_version):
+                    break
+            except _ReplicaError as e:
+                if not e.retryable:
+                    raise FleetDeployError(
+                        'refresh of %s failed: %s' % (rep.endpoint, e))
+            except (ConnectionError, OSError):
+                self._on_replica_down(rep)
+                return None
+            if time.monotonic() >= deadline:
+                raise FleetDeployError(
+                    'refresh of %s did not reach version %r in %.1fs '
+                    '(installed %r)' % (rep.endpoint, min_version,
+                                        timeout, version))
+            time.sleep(0.05)
+        # the rejoin health check: the replica must REPORT the version
+        # it claims it installed before it takes traffic again
+        try:
+            h = rep.client.call(wire.SRV_HEALTH, {})
+        except (ConnectionError, OSError):
+            self._on_replica_down(rep)
+            return None
+        if h.get('param_version') != version:
+            raise FleetDeployError(
+                'replica %s installed version %d but reports %r'
+                % (rep.endpoint, version, h.get('param_version')))
+        with self._mu:
+            rep.param_version = version
+        return version
+
+    def published_version(self):
+        """max published param version across the pserver fleet (None
+        when unreachable / no pservers configured)."""
+        from ..distributed import rpc
+        out = []
+        for ep in self._pservers:
+            try:
+                r = rpc.get_serving_client(
+                    ep, self._subscriber_id).get_version()
+                out.append(int(r.get('version', 0)))
+            except (ConnectionError, OSError, RuntimeError):
+                continue
+        return max(out) if out else None
+
+    def enable_rolling_deploys(self, poll_secs=1.0):
+        """Watch the pservers' published version; on a bump, run a
+        rolling deploy targeting it. Requires pservers=[...]."""
+        if not self._pservers:
+            raise ValueError('enable_rolling_deploys needs pservers')
+        t = threading.Thread(target=self._deploy_watch,
+                             args=(float(poll_secs),),
+                             name='fleet-deploy-watch', daemon=True)
+        self._threads.append(t)
+        t.start()
+        return t
+
+    def _deploy_watch(self, poll_secs):
+        while not self._stop_evt.is_set():
+            try:
+                v = self.published_version()
+                if v is not None and v > self._deployed_version:
+                    self.rolling_deploy(min_version=v)
+                    self._deployed_version = v
+            except FleetDeployError as e:
+                _trace.event('fleet.deploy_error', error=str(e))
+            except Exception as e:    # noqa: BLE001 — router survives
+                _trace.event('fleet.control_error', error=repr(e))
+            self._stop_evt.wait(poll_secs)

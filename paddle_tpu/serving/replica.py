@@ -1,0 +1,210 @@
+"""ReplicaServer: one LMServer behind the wire, fleet-addressable.
+
+The serving half of the fleet topology (serving/fleet.py): a thin
+threaded TCP server — same framing, accept loop and reply conventions
+as distributed/rpc.PSServer — dispatching the SRV_* message types into
+a local LMServer. A FleetRouter talks to N of these:
+
+  SRV_SUBMIT   open a stream (rid, prompt ids, budget, eos)
+  SRV_POLL     batched progress of many rids -> {state, tokens}
+  SRV_CANCEL   cancel one stream
+  SRV_HEALTH   liveness + load probe (queue depth, active, capacity,
+               param version, draining; optional param digests)
+  SRV_DRAIN    admission fence on/off (rolling-deploy drain step)
+  SRV_REFRESH  orchestrator-driven ParamSubscriber.refresh_once()
+  COMPLETE     clean shutdown (the tools/serve_replica.py exit path)
+
+Error classification crosses the wire like the pserver's: a reply
+REPLY_ERR with retryable=True (queue full, draining, a failed-but-
+retryable refresh) invites the router to try elsewhere/later; anything
+else is stream-fatal. Every reply echoes the request's seq.
+
+Stream state is process-local: a kill-9'd replica loses its rids, and
+its restarted incarnation answers SRV_POLL for them with UNKNOWN — the
+router's failover treats both the dead connection and the UNKNOWN
+answer as the same signal and re-prefills the stream elsewhere.
+"""
+from __future__ import annotations
+
+import socket
+import threading
+import time
+
+import numpy as np
+
+from ..distributed import wire
+
+__all__ = ['ReplicaServer']
+
+UNKNOWN = 'UNKNOWN'
+
+
+class ReplicaServer(object):
+    def __init__(self, server, endpoint='127.0.0.1:0',
+                 bind_retry_secs=30.0):
+        """server: the LMServer to expose. Binds immediately (with the
+        PSServer restart-race retry) so `.port` is known before
+        serve_forever()."""
+        self._srv = server
+        host, port = endpoint.rsplit(':', 1)
+        self._lsock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._lsock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        deadline = time.monotonic() + bind_retry_secs
+        while True:
+            try:
+                self._lsock.bind((host, int(port)))
+                break
+            except OSError:
+                if time.monotonic() >= deadline:
+                    raise
+                time.sleep(0.2)
+        self._lsock.listen(64)
+        self.port = self._lsock.getsockname()[1]
+        self._done = threading.Event()
+        self._threads = []
+        self._lock = threading.Lock()
+        self._streams = {}            # rid -> LMServer handle
+        self._draining = False
+
+    # -- lifecycle ---------------------------------------------------------
+    def serve_forever(self):
+        accept_t = threading.Thread(target=self._accept_loop,
+                                    daemon=True)
+        accept_t.start()
+        self._done.wait()
+        try:
+            self._lsock.close()
+        except OSError:
+            pass
+        for t in self._threads:
+            t.join(timeout=5.0)
+
+    def shutdown(self):
+        self._done.set()
+
+    def _accept_loop(self):
+        while not self._done.is_set():
+            try:
+                conn, _ = self._lsock.accept()
+            except OSError:
+                return
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            t = threading.Thread(target=self._serve_conn, args=(conn,),
+                                 daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    # -- dispatch ----------------------------------------------------------
+    def _serve_conn(self, conn):
+        try:
+            while True:
+                msg_type, meta, value = wire.read_msg(conn)
+                ack = {'seq': meta['seq']} if 'seq' in meta else {}
+                try:
+                    self._dispatch(conn, msg_type, meta, value, ack)
+                except (ConnectionError, OSError):
+                    return
+                except Exception as e:   # noqa: BLE001 — cross the wire
+                    err = dict(ack)
+                    err.update({'error': str(e),
+                                'retryable': _retryable(e)})
+                    wire.write_msg(conn, wire.REPLY_ERR, err)
+        except (ConnectionError, OSError):
+            return
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _dispatch(self, conn, msg_type, meta, value, ack):
+        if msg_type == wire.SRV_SUBMIT:
+            self._on_submit(conn, meta, value, ack)
+        elif msg_type == wire.SRV_POLL:
+            self._on_poll(conn, meta, ack)
+        elif msg_type == wire.SRV_CANCEL:
+            with self._lock:
+                handle = self._streams.get(meta['rid'])
+            if handle is not None:
+                self._srv.cancel(handle)
+            wire.write_msg(conn, wire.REPLY_OK, ack)
+        elif msg_type == wire.SRV_HEALTH:
+            reply = dict(ack)
+            reply.update(self._health(bool(meta.get('digests'))))
+            wire.write_msg(conn, wire.REPLY_OK, reply)
+        elif msg_type == wire.SRV_DRAIN:
+            self._draining = bool(meta.get('on', True))
+            reply = dict(ack)
+            reply['draining'] = self._draining
+            wire.write_msg(conn, wire.REPLY_OK, reply)
+        elif msg_type == wire.SRV_REFRESH:
+            if self._srv.subscriber is None:
+                err = dict(ack)
+                err.update({'error': 'no refresh attached — replica '
+                                     'launched without pserver '
+                                     'endpoints', 'retryable': False})
+                wire.write_msg(conn, wire.REPLY_ERR, err)
+                return
+            version = self._srv.refresh_once()
+            reply = dict(ack)
+            reply['param_version'] = int(version)
+            wire.write_msg(conn, wire.REPLY_OK, reply)
+        elif msg_type == wire.COMPLETE:
+            wire.write_msg(conn, wire.REPLY_OK, ack)
+            self.shutdown()
+        else:
+            err = dict(ack)
+            err.update({'error': 'replica cannot serve msg type %d'
+                                 % msg_type, 'retryable': False})
+            wire.write_msg(conn, wire.REPLY_ERR, err)
+
+    def _on_submit(self, conn, meta, value, ack):
+        rid = meta['rid']
+        if self._draining:
+            err = dict(ack)
+            err.update({'error': 'replica draining', 'retryable': True})
+            wire.write_msg(conn, wire.REPLY_ERR, err)
+            return
+        prompt = [int(t) for t in np.asarray(value).reshape(-1)]
+        handle = self._srv.submit(prompt,
+                                  max_new_tokens=int(meta['mnt']),
+                                  eos_id=meta.get('eos'))
+        with self._lock:
+            self._streams[rid] = handle
+        wire.write_msg(conn, wire.REPLY_OK, ack)
+
+    def _on_poll(self, conn, meta, ack):
+        out = {}
+        for rid in meta.get('rids', ()):
+            with self._lock:
+                handle = self._streams.get(rid)
+            if handle is None:
+                out[rid] = {'state': UNKNOWN, 'tokens': []}
+            else:
+                out[rid] = self._srv.poll(handle)
+        reply = dict(ack)
+        reply['streams'] = out
+        wire.write_msg(conn, wire.REPLY_OK, reply)
+
+    def _health(self, with_digests):
+        stats = self._srv.stats()
+        out = {'queue_depth': stats['queue_depth'],
+               'active': stats['active'],
+               'workers': stats['workers'],
+               'capacity': stats['workers'] * stats['slots_per_worker'],
+               'max_len': self._srv.max_len,
+               'param_version': stats.get('param_version'),
+               'staleness_rounds': stats.get('staleness_rounds'),
+               'draining': self._draining}
+        if with_digests:
+            out['digests'] = self._srv.param_digests()
+        return out
+
+
+def _retryable(e):
+    """queue-full / draining / a retryable refresh invite the router to
+    come back; a bad prompt or a missing subscriber is stream-fatal."""
+    from ..online.subscriber import RefreshError
+    if isinstance(e, RefreshError):
+        return True
+    return isinstance(e, RuntimeError) and not isinstance(e, ValueError)

@@ -1,0 +1,132 @@
+"""Subprocess worker for the fleet serving tests and
+tools/chaos_sweep.py --fleet.
+
+Two roles over one tiny transformer LM (replica processes themselves
+run tools/serve_replica.py — this file covers what sits around them):
+
+- build: construct the seeded model once and save_inference_model it
+  into FLEET_MODEL_DIR — every replica (and the in-process reference
+  predictor) loads the same bytes, so greedy streams are comparable
+  across processes and runs.
+
+- driver: a FleetRouter over FLEET_REPLICAS; submits FLEET_STREAMS
+  seeded prompts (sessions cycling over a small pool), waits for every
+  stream, then prints 'RESULT <json>' with the token streams, states
+  and failover count, and finally COMPLETEs each replica so it exits
+  0. The driver is itself a chaos victim: a restarted driver re-runs
+  the whole workload from scratch (same seed -> same prompts -> same
+  greedy streams), so the LAST RESULT line in its log is always a
+  full, comparable answer.
+"""
+import json
+import os
+import socket
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from paddle_tpu.models.transformer import TransformerConfig  # noqa: E402
+
+CFG = TransformerConfig(vocab=64, dim=32, heads=2, layers=2, ffn=64,
+                        max_len=16, use_tp=False, use_sp=False)
+SEED = 11
+SESSIONS = 4
+
+
+def build_model(model_dir):
+    import jax
+    jax.config.update('jax_platforms', 'cpu')
+    import paddle_tpu as fluid
+    prog, startup = fluid.Program(), fluid.Program()
+    prog.random_seed = startup.random_seed = SEED
+    with fluid.program_guard(prog, startup):
+        toks = fluid.layers.data(name='tokens',
+                                 shape=[1, CFG.max_len, 1],
+                                 dtype='int64', append_batch_size=False)
+        from paddle_tpu.models.transformer import language_model_logits
+        logits = language_model_logits(toks, CFG)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        fluid.io.save_inference_model(model_dir, ['tokens'], [logits],
+                                      exe, main_program=prog)
+
+
+def make_prompts(seed, n, budget):
+    """The workload: n (prompt, session) pairs, prompt + budget inside
+    CFG.max_len. Deterministic in seed — the driver, a restarted
+    driver, and the in-process reference all derive the same list."""
+    rng = np.random.RandomState(seed)
+    out = []
+    for i in range(n):
+        plen = int(rng.randint(2, 5))
+        prompt = [int(t) for t in rng.randint(1, CFG.vocab, plen)]
+        out.append((prompt, i % SESSIONS))
+    return out
+
+
+def complete_replica(endpoint, timeout=30.0):
+    """COMPLETE one replica (clean exit 0), retrying through a restart
+    window — the killed replica may be mid-respawn."""
+    from paddle_tpu.distributed import wire
+    host, port = endpoint.rsplit(':', 1)
+    deadline = time.monotonic() + timeout
+    while True:
+        try:
+            with socket.create_connection((host, int(port)),
+                                          timeout=2.0) as s:
+                wire.write_msg(s, wire.COMPLETE, {'seq': 0})
+                wire.read_msg(s)
+            return True
+        except (ConnectionError, OSError):
+            if time.monotonic() >= deadline:
+                return False
+            time.sleep(0.2)
+
+
+def run_driver():
+    from paddle_tpu.serving import FleetRouter
+    replicas = os.environ['FLEET_REPLICAS'].split(',')
+    seed = int(os.environ.get('FLEET_SEED', '0'))
+    n = int(os.environ.get('FLEET_STREAMS', '24'))
+    budget = int(os.environ.get('FLEET_BUDGET', '10'))
+    router = FleetRouter(replicas, probe_secs=0.1)
+    router.start()
+    try:
+        router.wait_healthy(timeout=120.0)
+        reqs = [router.submit(p, max_new_tokens=budget, session=s)
+                for p, s in make_prompts(seed, n, budget)]
+        streams, states = [], []
+        for r in reqs:
+            r.wait(timeout=300.0)
+            streams.append([int(t) for t in r.tokens])
+            states.append(r.state)
+        stats = router.stats()
+    finally:
+        router.stop()
+    print('RESULT ' + json.dumps({
+        'streams': streams, 'states': states,
+        'failovers': stats['failovers'],
+        'completed': stats['completed']}), flush=True)
+    if os.environ.get('FLEET_COMPLETE', '1') == '1':
+        for ep in replicas:
+            complete_replica(ep)
+
+
+def main():
+    role = os.environ['FLEET_ROLE']
+    if role == 'build':
+        build_model(os.environ['FLEET_MODEL_DIR'])
+    elif role == 'driver':
+        run_driver()
+    else:
+        raise SystemExit('unknown FLEET_ROLE %r' % role)
+
+
+if __name__ == '__main__':
+    main()

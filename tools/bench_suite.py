@@ -177,6 +177,10 @@ def run_one(model, mode, steps, full, quick=False):
             row['decode_speedup'] = serving['infer_decode_speedup']
         if serving.get('refresh_p99_ratio'):
             row['refresh_p99_ratio'] = serving['refresh_p99_ratio']
+        if serving.get('fleet_tokens_per_sec'):
+            row['fleet_tokens_per_sec'] = serving['fleet_tokens_per_sec']
+        if serving.get('fleet_p99_ttft_ms'):
+            row['fleet_p99_ttft_ms'] = serving['fleet_p99_ttft_ms']
     return row
 
 
@@ -376,19 +380,23 @@ _SERVING_QUICK = [None]     # serve_bench --quick, measured at most once
 
 def _serving_quick():
     """Headline serving numbers (tools/serve_bench.py --quick
-    --refresh) stamped onto the transformer local-mode row: the
-    cached-vs-recompute decode speedup plus the online-refresh tail
+    --refresh --fleet) stamped onto the transformer local-mode row:
+    the cached-vs-recompute decode speedup, the online-refresh tail
     cost (refresh_p99_ratio — token p99 with a live ParamSubscriber
-    install loop over the undisturbed p99). One subprocess, cached
-    across invocations; {} on any failure."""
+    install loop over the undisturbed p99), and the fleet leg
+    (fleet_tokens_per_sec / fleet_p99_ttft_ms through a FleetRouter
+    over 2 replica subprocesses — perf_gate infers the direction from
+    each suffix). One subprocess, cached across invocations; {} on
+    any failure."""
     if _SERVING_QUICK[0] is None:
         try:
             env = dict(os.environ, JAX_PLATFORMS='cpu')
             out = subprocess.run(
                 [sys.executable,
                  os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                              'serve_bench.py'), '--quick', '--refresh'],
-                capture_output=True, text=True, timeout=300, env=env)
+                              'serve_bench.py'), '--quick', '--refresh',
+                 '--fleet'],
+                capture_output=True, text=True, timeout=600, env=env)
             line = [ln for ln in out.stdout.splitlines()
                     if ln.startswith('{') and '"summary"' in ln][-1]
             _SERVING_QUICK[0] = json.loads(line)

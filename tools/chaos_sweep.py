@@ -62,6 +62,20 @@ restarted / kill point never fired), `diverged` (serving's installed
 bytes differ from the trainer's — a refresh-integrity bug, report the
 seed), plus the usual `fatal`/`hung`.
 
+`--fleet` chaoses the fleet serving topology (paddle_tpu/serving/
+fleet.py): two serve_replica.py processes plus one FleetRouter driver
+(tests/fleet_worker.py) run a fixed seeded workload of greedy streams,
+and each seed kill-9's EITHER replica 0 (a seeded `exit` on its recv
+side) or the router driver itself (seeded `exit` on its send side) —
+both speak the wire, so the kill lands at a deterministic message.
+The restarting Supervisor brings the victim back; acceptance is that
+the driver's final RESULT (a restarted driver re-runs the whole
+workload from the same seed) matches the fault-free fleet baseline
+BIT-exactly: greedy failover re-prefill must change no stream.
+Verdicts: `recovered`/`nokill` (kill fired / kill point never
+reached), `diverged` (a stream changed — a failover-determinism bug,
+report the seed), plus the usual `fatal`/`hung`.
+
 `--quick` is the CI smoke shape: 3 seeds by default, and the exit
 status is ALSO non-zero on any fatal/hung seed (a quick sweep exists
 to gate regressions, so every non-ok outcome fails it).
@@ -74,6 +88,7 @@ Usage:
     python tools/chaos_sweep.py --corrupt --quick   # integrity smoke
     python tools/chaos_sweep.py --mesh-kill --quick # sharded-mesh kill
     python tools/chaos_sweep.py --refresh --quick   # online-refresh chaos
+    python tools/chaos_sweep.py --fleet --quick     # fleet replica/router kill
 
 Exit status is non-zero iff any seed DIVERGED (or, under --quick, any
 seed was fatal/hung): fatal/hung seeds of the full sweep are
@@ -97,6 +112,8 @@ sys.path.insert(0, os.path.join(_ROOT, 'tests'))
 _WORKER = os.path.join(_ROOT, 'tests', 'ps_worker.py')
 _MESH_WORKER = os.path.join(_ROOT, 'tests', 'mesh_worker.py')
 _ONLINE_WORKER = os.path.join(_ROOT, 'tests', 'online_worker.py')
+_FLEET_WORKER = os.path.join(_ROOT, 'tests', 'fleet_worker.py')
+_SERVE_REPLICA = os.path.join(_ROOT, 'tools', 'serve_replica.py')
 
 
 def _free_ports(n):
@@ -381,6 +398,78 @@ def _run_refresh_seed(seed, steps, pservers, budget, workdir,
         sup.stop()
 
 
+def _run_fleet_seed(seed, budget, workdir, model_dir, baseline,
+                    n_replicas=2, streams=24, gen=10, obs_dir=None):
+    """One --fleet seed: n serve_replica.py processes + a FleetRouter
+    driver (tests/fleet_worker.py) under the Supervisor, with a seeded
+    exit fault on either replica 0 (recv side) or the driver (send
+    side). baseline=None is the fault-free reference run (returns its
+    streams); otherwise the driver's LAST RESULT line — a restarted
+    driver re-runs the identical seeded workload from scratch — must
+    match the baseline streams bit-exactly. The workload seed is FIXED
+    (only the kill point varies per sweep seed) so every run is
+    comparable. Returns (verdict, streams, victim, plan_json, outs)."""
+    import random
+
+    from paddle_tpu.distributed.supervisor import Supervisor
+
+    ports = _free_ports(n_replicas)
+    eps = ['127.0.0.1:%d' % p for p in ports]
+    rng = random.Random(('fleet', seed).__repr__())
+    victim, plan_json = None, ''
+    if baseline is not None:
+        victim = rng.choice(['replica0', 'driver'])
+        plan_json = json.dumps({'rules': [{
+            'when': 'recv' if victim == 'replica0' else 'send',
+            'type': '*', 'nth': rng.randint(15, 90),
+            'action': 'exit'}]})
+    base_env = dict(os.environ)
+    base_env.pop('JAX_PLATFORMS', None)
+    base_env.pop('XLA_FLAGS', None)
+    if obs_dir:
+        base_env['FLAGS_obs_flush_secs'] = '0.5'
+    sup = Supervisor(max_restarts=2, backoff=0.5, log_dir=workdir,
+                     obs_dir=obs_dir)
+    for i, ep in enumerate(eps):
+        # fixed ports (not ephemeral): a restarted replica rebinds the
+        # SAME endpoint, so the router's reconnects find it again
+        env = dict(base_env, SERVE_MODEL_DIR=model_dir,
+                   SERVE_ENDPOINT=ep, SERVE_SLOTS='4',
+                   SERVE_WORKERS='1')
+        if victim == 'replica0' and i == 0:
+            env['FLAGS_fault_plan'] = plan_json
+        sup.add_role('replica%d' % i,
+                     [sys.executable, _SERVE_REPLICA], env=env)
+    env = dict(base_env, FLEET_ROLE='driver',
+               FLEET_REPLICAS=','.join(eps), FLEET_SEED='0',
+               FLEET_STREAMS=str(streams), FLEET_BUDGET=str(gen))
+    if victim == 'driver':
+        env['FLAGS_fault_plan'] = plan_json
+    sup.add_role('driver', [sys.executable, _FLEET_WORKER], env=env)
+    sup.start()
+    states = sup.wait(timeout=budget)
+    outs = [sup.output(n) for n in sorted(states)]
+    try:
+        if any(s in ('running', 'backoff') for s in states.values()):
+            return 'hung', None, victim, plan_json, outs
+        if any(s == 'failed' for s in states.values()):
+            return 'fatal', None, victim, plan_json, outs
+        result = None
+        for ln in sup.output('driver').splitlines():
+            if ln.startswith('RESULT '):
+                result = json.loads(ln[len('RESULT '):])
+        if result is None or any(s != 'DONE' for s in result['states']):
+            return 'fatal', None, victim, plan_json, outs
+        if baseline is None:
+            return 'ok', result['streams'], victim, plan_json, outs
+        if result['streams'] != baseline:
+            return 'diverged', result['streams'], victim, plan_json, outs
+        return (('recovered' if sup.restarts[victim] else 'nokill'),
+                result['streams'], victim, plan_json, outs)
+    finally:
+        sup.stop()
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument('--seeds', type=int, default=None,
@@ -410,6 +499,11 @@ def main(argv=None):
                          'while a serving process tracks its published '
                          'param versions; serving must converge to the '
                          "trainer's final digests without restarting")
+    ap.add_argument('--fleet', action='store_true',
+                    help='fleet serving chaos: kill-9 a serving replica '
+                         'or the router driver mid-stream at a seeded '
+                         'wire message; the recovered fleet must '
+                         'reproduce the fault-free streams bit-exactly')
     ap.add_argument('--quick', action='store_true',
                     help='CI smoke: 3 seeds unless --seeds given, and '
                          'fatal/hung seeds fail the sweep too')
@@ -423,9 +517,9 @@ def main(argv=None):
                          '(default: a ./chaos_report.<pid> dir)')
     args = ap.parse_args(argv)
     if sum((args.kill, args.corrupt, args.mesh_kill,
-            args.refresh)) > 1:
-        ap.error('--kill, --corrupt, --mesh-kill and --refresh are '
-                 'mutually exclusive')
+            args.refresh, args.fleet)) > 1:
+        ap.error('--kill, --corrupt, --mesh-kill, --refresh and '
+                 '--fleet are mutually exclusive')
     if args.seeds is None:
         args.seeds = 3 if args.quick else 20
 
@@ -440,6 +534,31 @@ def main(argv=None):
         # no external baseline: the trainer's OWN final-pull digests
         # (printed by online_worker) are the acceptance reference, so
         # the comparison lives inside _run_refresh_seed
+        local_w = {}
+    elif args.fleet:
+        # one model for the whole sweep (every replica and every seed
+        # serves the identical bytes), then a fault-free fleet run for
+        # the bit-exact stream baseline
+        import atexit
+        import shutil
+        fleet_root = tempfile.mkdtemp(prefix='fleet_sweep.')
+        atexit.register(shutil.rmtree, fleet_root, ignore_errors=True)
+        model_dir = os.path.join(fleet_root, 'model')
+        build_env = dict(os.environ, FLEET_ROLE='build',
+                         FLEET_MODEL_DIR=model_dir)
+        build_env.pop('XLA_FLAGS', None)
+        subprocess.run([sys.executable, _FLEET_WORKER], env=build_env,
+                       check=True)
+        print('baseline: fault-free fleet ...')
+        with tempfile.TemporaryDirectory() as workdir:
+            verdict, fleet_baseline, _, _, outs = _run_fleet_seed(
+                0, args.budget, workdir, model_dir, None)
+        if verdict != 'ok':
+            print('fleet baseline failed (%s)' % verdict)
+            if args.verbose:
+                for out in outs:
+                    print('  | ' + '\n  | '.join(out.splitlines()[-15:]))
+            return 1
         local_w = {}
     elif args.mesh_kill:
         # the mesh sweep's baseline is the same worker, fault-free —
@@ -471,7 +590,8 @@ def main(argv=None):
 
     ok_verdicts = (('ok', 'recovered', 'nokill') if args.refresh
                    else ('recovered', 'nokill')
-                   if (args.kill or args.mesh_kill) else ('ok',))
+                   if (args.kill or args.mesh_kill or args.fleet)
+                   else ('ok',))
     tally = {'ok': 0, 'recovered': 0, 'nokill': 0, 'diverged': 0,
              'fatal': 0, 'hung': 0}
     bad_seeds, rows = [], []
@@ -488,6 +608,14 @@ def main(argv=None):
                     workdir, obs_dir)
             weights = {}
             label = 'refresh/%s %s' % (fmode, plan_json)
+        elif args.fleet:
+            with tempfile.TemporaryDirectory() as workdir:
+                verdict, _streams, victim, plan_json, outs = \
+                    _run_fleet_seed(seed, args.budget, workdir,
+                                    model_dir, fleet_baseline,
+                                    obs_dir=obs_dir)
+            weights = {}
+            label = '%s %s' % (victim, plan_json)
         elif args.mesh_kill:
             # kill inside the live step range; nth counts on_step calls
             kill_nth = random.Random(('mesh', seed).__repr__()).randint(
@@ -554,6 +682,7 @@ def main(argv=None):
              tally['diverged'], tally['fatal'], tally['hung']))
     if report_root:
         mode = ('refresh' if args.refresh
+                else 'fleet' if args.fleet
                 else 'mesh-kill' if args.mesh_kill
                 else 'kill' if args.kill
                 else 'corrupt' if args.corrupt else 'fault')

@@ -235,6 +235,114 @@ def _refresh_leg(pred, cfg, slots, n_requests, new_tokens):
             'refresh_p99_ratio': round(ratio, 3)}
 
 
+def _fleet_leg(cfg, quick, replicas=2):
+    """Fleet serving leg: `replicas` serve_replica.py subprocesses
+    behind an in-process FleetRouter, one concurrent burst through the
+    whole fleet. fleet_tokens_per_sec is aggregate decode throughput
+    across replicas; fleet_p99_ttft_ms prices dispatch + replica queue
+    + prefill at burst concurrency (the admission-control SLO's raw
+    signal). Both land in the acceptance summary for perf_gate.py."""
+    import socket as _socket
+    import subprocess
+
+    import paddle_tpu as fluid
+    from paddle_tpu.distributed import wire
+    from paddle_tpu.models import transformer as tfm
+    from paddle_tpu.serving import FleetRouter
+
+    n_requests = 16 if quick else 64
+    new_tokens = 4 if quick else 16
+    slots = 4 if quick else 8
+    here = os.path.dirname(os.path.abspath(__file__))
+    rng = np.random.RandomState(5)
+    procs = []
+    with tempfile.TemporaryDirectory() as tmp:
+        # the replicas load from disk, so this leg persists its own
+        # save_inference_model dir for their lifetime
+        model_dir = os.path.join(tmp, 'model')
+        main_prog, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main_prog, startup):
+            tokens = fluid.layers.data(
+                'tokens', shape=[1, cfg.max_len, 1], dtype='int64',
+                append_batch_size=False)
+            logits = tfm.language_model_logits(tokens, cfg)
+        exe = fluid.Executor(fluid.TPUPlace())
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            fluid.io.save_inference_model(model_dir, ['tokens'],
+                                          [logits], exe,
+                                          main_program=main_prog)
+        eps = []
+        for _ in range(replicas):
+            s = _socket.socket()
+            s.bind(('127.0.0.1', 0))
+            eps.append('127.0.0.1:%d' % s.getsockname()[1])
+            s.close()
+        env = dict(os.environ)
+        env.pop('XLA_FLAGS', None)
+        try:
+            for ep in eps:
+                procs.append(subprocess.Popen(
+                    [sys.executable,
+                     os.path.join(here, 'serve_replica.py')],
+                    env=dict(env, SERVE_MODEL_DIR=model_dir,
+                             SERVE_ENDPOINT=ep,
+                             SERVE_SLOTS=str(slots)),
+                    stdout=subprocess.DEVNULL,
+                    stderr=subprocess.DEVNULL))
+            router = FleetRouter(eps, probe_secs=0.1).start()
+            try:
+                router.wait_healthy(timeout=300.0)
+                prompts = [rng.randint(1, cfg.vocab,
+                                       max(1, cfg.max_len // 2))
+                           for _ in range(n_requests)]
+                # warm every replica's jit cache outside the window:
+                # least-loaded dispatch spreads one prompt per slot
+                warm = [router.submit(prompts[0],
+                                      max_new_tokens=new_tokens)
+                        for _ in range(replicas * slots)]
+                for r in warm:
+                    r.wait(600.0)
+                t0 = time.perf_counter()
+                reqs = [router.submit(p, max_new_tokens=new_tokens)
+                        for p in prompts]
+                for r in reqs:
+                    r.wait(600.0)
+                wall = time.perf_counter() - t0
+                total = sum(len(r.tokens) for r in reqs)
+                ttfts = sorted(r.first_token_at - r.submitted_at
+                               for r in reqs if r.first_token_at)
+                p99 = ttfts[int(0.99 * (len(ttfts) - 1))]
+                stats = router.stats()
+            finally:
+                router.stop()
+            for ep in eps:
+                host, port = ep.rsplit(':', 1)
+                try:
+                    with _socket.create_connection(
+                            (host, int(port)), timeout=5.0) as s:
+                        wire.write_msg(s, wire.COMPLETE, {'seq': 0})
+                        wire.read_msg(s)
+                except (ConnectionError, OSError):
+                    pass
+            for p in procs:
+                try:
+                    p.wait(timeout=30)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+    return {'mode': 'fleet', 'replicas': replicas, 'slots': slots,
+            'requests': n_requests,
+            'fleet_tokens_per_sec': round(total / wall, 2),
+            'fleet_p99_ttft_ms': round(p99 * 1e3, 1),
+            'failovers': stats['failovers'],
+            'completed': stats['completed']}
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument('--quick', action='store_true',
@@ -246,6 +354,11 @@ def main():
                          'burst with vs without a concurrent '
                          'ParamSubscriber install loop '
                          '(refresh_p99_ratio in the summary)')
+    ap.add_argument('--fleet', action='store_true',
+                    help='add the fleet serving leg: a FleetRouter '
+                         'over 2 replica subprocesses under burst '
+                         'load (fleet_tokens_per_sec + '
+                         'fleet_p99_ttft_ms in the summary)')
     ap.add_argument('--iters', type=int, default=20)
     args = ap.parse_args()
     if not args.full:
@@ -311,6 +424,14 @@ def main():
         print(json.dumps(ref_row), flush=True)
         summary['refresh_p99_ratio'] = ref_row['refresh_p99_ratio']
         summary['refresh_installs'] = ref_row['refresh']['refreshes']
+
+    if args.fleet:
+        fleet_row = _fleet_leg(cfg, args.quick)
+        fleet_row['config'] = label
+        print(json.dumps(fleet_row), flush=True)
+        summary['fleet_tokens_per_sec'] = \
+            fleet_row['fleet_tokens_per_sec']
+        summary['fleet_p99_ttft_ms'] = fleet_row['fleet_p99_ttft_ms']
 
     print(json.dumps(summary), flush=True)
     return summary
