@@ -104,6 +104,14 @@ Known flags:
                          unboundedly
   serving_idle_wait      seconds an idle serving worker sleeps between
                          queue polls
+  serving_page_tokens    paged KV cache: tokens per page (page-pool
+                         granularity for alloc/COW/prefix sharing)
+  serving_kv_pages       paged KV cache: physical pages in the pool
+                         (0 = auto-size to dense-equivalent capacity,
+                         slots * ceil(max_len/page_tokens) + 1)
+  serving_prefill_chunk  chunked prefill: tokens admitted per engine
+                         iteration while a prompt prefills, so long
+                         prompts never stall live decode lanes
   ckpt_verify            legacy host checkpoint path (io.py): write a
                          CHECKPOINT_DIGESTS manifest on save_vars and
                          verify it before load_vars, sharing the mesh
@@ -284,6 +292,12 @@ _DEFAULTS = {
     'serving_prefill_batch': 1,
     'serving_max_queue': 256,
     'serving_idle_wait': 0.05,
+    # paged KV cache (serving/paging.py): tokens per page, pool size in
+    # pages (0 = auto: slots * pages_per_slot + the reserved null page),
+    # and the chunked-prefill slice width in tokens
+    'serving_page_tokens': 16,
+    'serving_kv_pages': 0,
+    'serving_prefill_chunk': 64,
     # sharded checkpointing (paddle_tpu/checkpoint/): digest-verify the
     # legacy host save/load path, async writer pool size, and the
     # MeshConfig.from_flags axis spec ('dp=2,tp=2'; '' = pure dp)

@@ -157,12 +157,24 @@ class AnalysisPredictor(Predictor):
     def clone(self):
         return AnalysisPredictor(self._config, _clone_of=self)
 
-    def prepare_decoding(self, slots=None, prefill_batch=None):
+    def prepare_decoding(self, slots=None, prefill_batch=None,
+                         paged=False, page_tokens=None, kv_pages=None,
+                         prefill_chunk=None):
         """Transpile the loaded LM into the KV-cached prefill + decode
         pair and return a serving.DecodePredictor over this predictor's
-        weight scope (see paddle_tpu/serving/decode.py). Raises
+        weight scope (see paddle_tpu/serving/decode.py). paged=True
+        returns a serving.PagedDecodePredictor instead — page-pool
+        cache with copy-on-write prefix sharing and chunked prefill
+        (serving/paged.py; page_tokens / kv_pages / prefill_chunk
+        default from FLAGS_serving_*). Raises
         transpiler.DecodeTranspileError if the program is not a
         recognizable decoder-only LM."""
+        if paged:
+            from .serving import PagedDecodePredictor
+            return PagedDecodePredictor(self, slots=slots,
+                                        page_tokens=page_tokens,
+                                        kv_pages=kv_pages,
+                                        prefill_chunk=prefill_chunk)
         from .serving import DecodePredictor
         return DecodePredictor(self, slots=slots,
                                prefill_batch=prefill_batch)

@@ -247,6 +247,19 @@ class DecodeSpec(object):
     def cache_shape(self, slots):
         return (slots, self.max_len, self.heads, self.dh)
 
+    def pool_names(self, layer=None):
+        """Paged K/V pool var names; shared by the paged pair."""
+        if layer is not None:
+            return ('kv_pool.layer%d.k' % layer,
+                    'kv_pool.layer%d.v' % layer)
+        out = []
+        for i in range(self.layers):
+            out.extend(self.pool_names(i))
+        return out
+
+    def pool_shape(self, num_pages, page_tokens):
+        return (num_pages, page_tokens, self.heads, self.dh)
+
     def param_names(self):
         names = [self.emb_w, self.pos_w,
                  self.final_ln[0], self.final_ln[1], self.head[0]]
@@ -465,3 +478,234 @@ def build_decode_program(spec, slots):
         logits = L.reshape(logits3, shape=[-1, spec.vocab])
         ids = L.argmax(logits, axis=-1)
     return prog, ['decode_tokens', 'decode_step_idx'], [logits, ids]
+
+
+# ---------------------------------------------------------------------------
+# Paged-cache mode (paddle_tpu/serving/paged.py): page-table builders
+# ---------------------------------------------------------------------------
+#
+# The dense ring generalized to a vLLM-style page pool: one
+# [num_pages, page_tokens, H, dk] pool var per layer per K/V, and a
+# per-slot page TABLE fed each step mapping logical position j to
+# pool[table[j // pt], j % pt]. Both programs stay static-shape (pool
+# size, table width, chunk width fixed at build time), so each compiles
+# exactly once; allocation, COW and prefix sharing are HOST decisions
+# (serving/paging.py) that only ever change feed VALUES. Physical page
+# 0 is the reserved null page — dead rows write there, reads of it are
+# always masked. Validity is absolute (j <= position): no ring wrap,
+# so running out of pages is a typed host-side error, never a silent
+# slide (COVERAGE divergence 8).
+
+
+def _create_pool_vars(spec, num_pages, page_tokens):
+    """Per-layer K/V page-pool vars: persistable + donated like the
+    ring caches (in-place device update), is_cache (never checkpointed)."""
+    from ..framework import default_main_program
+    block = default_main_program().global_block()
+    pools = []
+    for i in range(spec.layers):
+        kn, vn = spec.pool_names(i)
+        pools.append(tuple(
+            block.create_var(name=n,
+                             shape=spec.pool_shape(num_pages, page_tokens),
+                             dtype='float32', persistable=True,
+                             stop_gradient=True, is_cache=True)
+            for n in (kn, vn)))
+    return pools
+
+
+def _paged_gather(pool_var, table):
+    g = _tmp_var()
+    _block_op('kv_page_gather',
+              inputs={'Pool': [pool_var], 'Table': [table]},
+              outputs={'Out': [g]})                    # [B, J, H, dh]
+    return L.transpose(g, perm=[0, 2, 1, 3])           # [B, H, J, dh]
+
+
+def _paged_prefill_attention(x, spec, blk, pool, table, positions,
+                             length, cow_src, cow_dst, chunk):
+    """One chunk of prefill attention: COW any forked page, scatter the
+    chunk's K/V rows through the table, then attend the chunk's queries
+    over the WHOLE gathered history (earlier pages + this chunk)."""
+    q4, k4, v4 = _qkv_parts(x, spec, blk, chunk)       # [1, C, H, dh]
+    for pool_var, new in ((pool[0], k4), (pool[1], v4)):
+        _block_op('kv_page_cow',
+                  inputs={'Pool': [pool_var], 'Src': [cow_src],
+                          'Dst': [cow_dst]},
+                  outputs={'Out': [pool_var]})
+        _block_op('kv_page_write',
+                  inputs={'Pool': [pool_var], 'X': [new],
+                          'Table': [table], 'Positions': [positions],
+                          'Len': [length]},
+                  outputs={'Out': [pool_var]})
+    q = L.transpose(q4, perm=[0, 2, 1, 3])             # [1, H, C, dh]
+    kt = _paged_gather(pool[0], table)                 # [1, H, J, dh]
+    vt = _paged_gather(pool[1], table)
+    scores = L.matmul(q, kt, transpose_y=True,
+                      alpha=1.0 / np.sqrt(spec.dh))    # [1, H, C, J]
+    masked = _tmp_var()
+    _block_op('paged_prefill_mask',
+              inputs={'X': [scores], 'Positions': [positions]},
+              outputs={'Out': [masked]})
+    probs = L.softmax(masked)
+    ctx = L.matmul(probs, vt)                          # [1, H, C, dh]
+    ctx = L.transpose(ctx, perm=[0, 2, 1, 3])
+    ctx = L.reshape(ctx, shape=[-1, chunk, spec.dim])
+    return _named_fc(ctx, spec.dim, blk['proj'])
+
+
+def _paged_decode_attention(x, spec, blk, pool, table, positions,
+                            cow_src, cow_dst):
+    q1, k1, v1 = _qkv_parts(x, spec, blk, 1)           # [S, 1, H, dh]
+    for pool_var, new in ((pool[0], k1), (pool[1], v1)):
+        _block_op('kv_page_cow',
+                  inputs={'Pool': [pool_var], 'Src': [cow_src],
+                          'Dst': [cow_dst]},
+                  outputs={'Out': [pool_var]})
+        _block_op('kv_page_append',
+                  inputs={'Pool': [pool_var], 'X': [new],
+                          'Table': [table], 'Positions': [positions]},
+                  outputs={'Out': [pool_var]})
+    q = L.transpose(q1, perm=[0, 2, 1, 3])             # [S, H, 1, dh]
+    kt = _paged_gather(pool[0], table)                 # [S, H, J, dh]
+    vt = _paged_gather(pool[1], table)
+    scores = L.matmul(q, kt, transpose_y=True,
+                      alpha=1.0 / np.sqrt(spec.dh))    # [S, H, 1, J]
+    masked = _tmp_var()
+    _block_op('paged_decode_mask',
+              inputs={'X': [scores], 'Positions': [positions]},
+              outputs={'Out': [masked]})
+    probs = L.softmax(masked)
+    ctx = L.matmul(probs, vt)                          # [S, H, 1, dh]
+    ctx = L.transpose(ctx, perm=[0, 2, 1, 3])
+    ctx = L.reshape(ctx, shape=[-1, 1, spec.dim])
+    return _named_fc(ctx, spec.dim, blk['proj'])
+
+
+def _paged_pos_embedding(spec, index, rows):
+    """Positional rows gathered by absolute index (paged positions
+    never wrap): Index [rows] -> [1, rows, D] / [rows, 1, D]."""
+    from ..layer_helper import LayerHelper
+    helper = LayerHelper('position_embedding',
+                         param_attr=_named_attr(spec.pos_w))
+    pos_var = helper.create_parameter(
+        attr=helper.param_attr, shape=[spec.pos_len, spec.dim],
+        dtype='float32')
+    pos = _tmp_var()
+    _block_op('position_embedding_at',
+              inputs={'Pos': [pos_var], 'Index': [index]},
+              outputs={'Out': [pos]})                  # [rows, 1, D]
+    return pos
+
+
+def build_paged_prefill_program(spec, chunk, num_pages, page_tokens,
+                                pages_per_slot):
+    """One prefill CHUNK through one stream's page table.
+
+    Feeds:  prefill_tokens [1, C, 1] int64 (chunk tokens, zero-padded),
+            prefill_positions [C] int32 (absolute position per row —
+            chunk start + arange, rows >= Len are padding),
+            prefill_len [1] int32 (live rows this chunk),
+            prefill_last [1] int32 (chunk-local index of the last live
+            row, Len - 1 — the gather_time row for the logits),
+            prefill_page_table [1, P] int32 (the stream's table; entries
+            past the written extent are 0, the null page),
+            prefill_cow_src / prefill_cow_dst [1] int32 (page copy to
+            apply before the write — (0, 0) when no fork this chunk).
+    The same program serves chunked prefill AND prefix-hit suffix
+    prefill: shared pages arrive pre-populated in the table and the
+    chunk simply starts at the first unshared position. Logits are the
+    last live row's — only the FINAL chunk's logits mean anything.
+    Returns (program, feed_names, fetch_vars[logits, ids]).
+    """
+    from ..framework import Program, program_guard
+    prog, startup = Program(), Program()
+    prog._is_test = True
+    with program_guard(prog, startup):
+        tokens = L.data('prefill_tokens', [1, chunk, 1],
+                        append_batch_size=False, dtype='int64')
+        positions = L.data('prefill_positions', [chunk],
+                           append_batch_size=False, dtype='int32')
+        length = L.data('prefill_len', [1],
+                        append_batch_size=False, dtype='int32')
+        last = L.data('prefill_last', [1],
+                      append_batch_size=False, dtype='int32')
+        table = L.data('prefill_page_table', [1, pages_per_slot],
+                       append_batch_size=False, dtype='int32')
+        cow_src = L.data('prefill_cow_src', [1],
+                         append_batch_size=False, dtype='int32')
+        cow_dst = L.data('prefill_cow_dst', [1],
+                         append_batch_size=False, dtype='int32')
+        pools = _create_pool_vars(spec, num_pages, page_tokens)
+        emb = L.embedding(tokens, size=[spec.vocab, spec.dim],
+                          param_attr=_named_attr(spec.emb_w))  # [1, C, D]
+        pos = _paged_pos_embedding(spec, positions, chunk)     # [C, 1, D]
+        pos = L.reshape(pos, shape=[-1, chunk, spec.dim])      # [1, C, D]
+        x = L.elementwise_add(emb, pos)
+        for i in range(spec.layers):
+            x = _cached_block(
+                x, spec, i,
+                lambda ln, sp, blk, _i=i: _paged_prefill_attention(
+                    ln, sp, blk, pools[_i], table, positions, length,
+                    cow_src, cow_dst, chunk))
+        x = _named_ln(x, spec.final_ln)
+        gathered = _tmp_var()
+        _block_op('gather_time',
+                  inputs={'X': [x], 'Index': [last]},
+                  outputs={'Out': [gathered]})                 # [1, D]
+        logits = _named_fc(gathered, spec.vocab, spec.head,
+                           num_flatten_dims=1)                 # [1, V]
+        ids = L.argmax(logits, axis=-1)
+    return prog, ['prefill_tokens', 'prefill_positions', 'prefill_len',
+                  'prefill_last', 'prefill_page_table',
+                  'prefill_cow_src', 'prefill_cow_dst'], [logits, ids]
+
+
+def build_paged_decode_program(spec, slots, num_pages, page_tokens,
+                               pages_per_slot):
+    """One-token decode step over the whole slot pool, page-indexed.
+
+    Feeds:  decode_tokens [slots, 1, 1] int64,
+            decode_step_idx [slots] int32 (absolute position of the
+            incoming token — same ABI as the dense step, but the write
+            lands at pool[table[pos // pt], pos % pt], never wrapped),
+            decode_page_table [slots, P] int32 (all-zero rows for idle
+            or mid-prefill slots: their appends hit the null page),
+            decode_cow_src / decode_cow_dst [slots] int32 (page copies
+            to apply before the appends — (0, 0) where no slot forked).
+    Admission, COW and page allocation are host decisions that only
+    change these feed values — the program compiles exactly once.
+    Returns (program, feed_names, fetch_vars[logits, ids]).
+    """
+    from ..framework import Program, program_guard
+    prog, startup = Program(), Program()
+    prog._is_test = True
+    with program_guard(prog, startup):
+        tokens = L.data('decode_tokens', [slots, 1, 1],
+                        append_batch_size=False, dtype='int64')
+        step_idx = L.data('decode_step_idx', [slots],
+                          append_batch_size=False, dtype='int32')
+        table = L.data('decode_page_table', [slots, pages_per_slot],
+                       append_batch_size=False, dtype='int32')
+        cow_src = L.data('decode_cow_src', [slots],
+                         append_batch_size=False, dtype='int32')
+        cow_dst = L.data('decode_cow_dst', [slots],
+                         append_batch_size=False, dtype='int32')
+        pools = _create_pool_vars(spec, num_pages, page_tokens)
+        emb = L.embedding(tokens, size=[spec.vocab, spec.dim],
+                          param_attr=_named_attr(spec.emb_w))  # [S, 1, D]
+        pos = _paged_pos_embedding(spec, step_idx, slots)      # [S, 1, D]
+        x = L.elementwise_add(emb, pos)
+        for i in range(spec.layers):
+            x = _cached_block(
+                x, spec, i,
+                lambda ln, sp, blk, _i=i: _paged_decode_attention(
+                    ln, sp, blk, pools[_i], table, step_idx,
+                    cow_src, cow_dst))
+        x = _named_ln(x, spec.final_ln)
+        logits3 = _named_fc(x, spec.vocab, spec.head)          # [S, 1, V]
+        logits = L.reshape(logits3, shape=[-1, spec.vocab])
+        ids = L.argmax(logits, axis=-1)
+    return prog, ['decode_tokens', 'decode_step_idx',
+                  'decode_page_table', 'decode_cow_src',
+                  'decode_cow_dst'], [logits, ids]

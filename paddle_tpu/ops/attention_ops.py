@@ -154,6 +154,127 @@ def _gather_time_emit(ctx, op):
     ctx.set(op.single_output('Out'), x[rows, jnp.clip(idx, 0, x.shape[1] - 1)])
 
 
+# ---------------------------------------------------------------------------
+# Paged KV-cache primitives (serving/paging.py + serving/paged.py)
+#
+# The ring idiom generalized to a page-indexed address space: one
+# [num_pages, page_tokens, H, dk] pool per layer instead of per-slot
+# rings, a per-slot page TABLE (a feed) mapping logical position j to
+# pool[table[j // pt], j % pt]. Physical page 0 is RESERVED as the null
+# page: never allocated, the redirect target for dead rows and
+# unpopulated table entries, always masked on read — so every slot can
+# be written every step (the static-shape contract) without liveness
+# ever becoming a shape question. Validity is absolute (j <= position):
+# pages are allocated on demand rather than wrapped, which is what lets
+# exhaustion surface as a typed host-side error instead of the dense
+# ring's silent slide (COVERAGE divergence 8).
+# ---------------------------------------------------------------------------
+
+@op_emitter('kv_page_cow')
+def _kv_page_cow_emit(ctx, op):
+    """Copy-on-write page copies: Pool [N, pt, H, dk], Src [n] int32,
+    Dst [n] int32 -> pool with pool[dst[i]] = pool[src[i]]. All sources
+    are read before any destination is written (functional scatter), so
+    a page freed and reallocated within the same step still donates its
+    pre-step contents. (0, 0) pairs are the no-op padding — the null
+    page copied onto itself — which keeps COW inside the ONE compiled
+    program whether or not any fork happened this step."""
+    pool = ctx.get(op.single_input('Pool'))
+    src = ctx.get(op.single_input('Src')).astype(jnp.int32)
+    dst = ctx.get(op.single_input('Dst')).astype(jnp.int32)
+    ctx.set(op.single_output('Out'), pool.at[dst].set(pool[src]))
+
+
+@op_emitter('kv_page_write')
+def _kv_page_write_emit(ctx, op):
+    """Chunked prefill: scatter a chunk's K or V rows through one page
+    table. Pool [N, pt, H, dk], X [1, C, H, dk], Table [1, P] int32,
+    Positions [C] int32 (absolute position of each chunk row), Len [1]
+    int32 (live rows; rows >= Len are padding). Row i lands at
+    pool[table[positions[i] // pt], positions[i] % pt]; dead rows are
+    redirected to the null page at offset 0, where their identical
+    duplicate scatters are deterministic and never read unmasked."""
+    pool = ctx.get(op.single_input('Pool'))
+    x = ctx.get(op.single_input('X'))
+    table = ctx.get(op.single_input('Table')).astype(jnp.int32).reshape(-1)
+    positions = ctx.get(op.single_input('Positions')).astype(jnp.int32)
+    length = ctx.get(op.single_input('Len')).astype(jnp.int32).reshape(-1)
+    pt, P = pool.shape[1], table.shape[0]
+    live = jnp.arange(positions.shape[0], dtype=jnp.int32) < length[0]
+    page = jnp.where(live, table[jnp.clip(positions // pt, 0, P - 1)], 0)
+    off = jnp.where(live, positions % pt, 0)
+    rows = x.reshape((-1,) + x.shape[2:]).astype(pool.dtype)
+    ctx.set(op.single_output('Out'), pool.at[page, off].set(rows))
+
+
+@op_emitter('kv_page_append')
+def _kv_page_append_emit(ctx, op):
+    """Decode: append one K or V row per slot through its page table.
+    Pool [N, pt, H, dk], X [slots, 1, H, dk], Table [slots, P] int32,
+    Positions [slots] int32 (absolute position of the incoming token).
+    Every slot writes every step — idle or mid-prefill slots are fed an
+    all-zero table row and position 0, so their writes land in the null
+    page (the paged analog of the ring's dead-weight write)."""
+    pool = ctx.get(op.single_input('Pool'))
+    x = ctx.get(op.single_input('X'))
+    table = ctx.get(op.single_input('Table')).astype(jnp.int32)
+    positions = ctx.get(op.single_input('Positions')).astype(jnp.int32)
+    pt, P = pool.shape[1], table.shape[1]
+    rows = jnp.arange(table.shape[0], dtype=jnp.int32)
+    page = table[rows, jnp.clip(positions // pt, 0, P - 1)]
+    ctx.set(op.single_output('Out'),
+            pool.at[page, positions % pt].set(x[:, 0].astype(pool.dtype)))
+
+
+@op_emitter('kv_page_gather')
+def _kv_page_gather_emit(ctx, op):
+    """Assemble each row's logical K or V sequence from the pool:
+    Pool [N, pt, H, dk], Table [B, P] int32 -> [B, P*pt, H, dk] (the
+    dense-cache layout attention already knows how to contract over).
+    Unpopulated table entries gather the null page — garbage that the
+    paged masks set to -1e9 before the softmax."""
+    pool = ctx.get(op.single_input('Pool'))
+    table = ctx.get(op.single_input('Table')).astype(jnp.int32)
+    B, P = table.shape
+    out = pool[table].reshape(B, P * pool.shape[1],
+                              pool.shape[2], pool.shape[3])
+    ctx.set(op.single_output('Out'), out)
+
+
+@op_emitter('paged_decode_mask')
+def _paged_decode_mask_emit(ctx, op):
+    """Validity mask for paged decode scores: X [slots, H, 1, J]
+    (J = P*pt gathered positions), Positions [slots]. The page table is
+    an absolute address space — logical index j holds the token at
+    position j, valid iff j <= positions[s] (the token being appended
+    this step included). No ring wrap to undo; same set-to--1e9
+    semantics as decode_mask so masked lanes underflow to exactly 0.0
+    after the softmax's exp — the bit-exactness contract."""
+    x = ctx.get(op.single_input('X'))
+    positions = ctx.get(op.single_input('Positions')).astype(jnp.int32)
+    j = jnp.arange(x.shape[-1], dtype=jnp.int32)
+    valid = j[None, :] <= positions[:, None]           # [slots, J]
+    valid = valid[:, None, None, :]                    # [slots, 1, 1, J]
+    ctx.set(op.single_output('Out'), jnp.where(valid, x, -1e9))
+
+
+@op_emitter('paged_prefill_mask')
+def _paged_prefill_mask_emit(ctx, op):
+    """Causal mask for a prefill chunk against the gathered history:
+    X [1, H, C, J] scores, Positions [C] (absolute position of each
+    chunk row). Row i may see logical index j iff j <= positions[i] —
+    plain causality expressed against the page-table address space, so
+    a chunk attends to every previously written page plus its own
+    already-written rows. Padding rows carry garbage positions; their
+    score rows are never gathered downstream."""
+    x = ctx.get(op.single_input('X'))
+    positions = ctx.get(op.single_input('Positions')).astype(jnp.int32)
+    j = jnp.arange(x.shape[-1], dtype=jnp.int32)
+    valid = j[None, :] <= positions[:, None]           # [C, J]
+    valid = valid[None, None, :, :]                    # [1, 1, C, J]
+    ctx.set(op.single_output('Out'), jnp.where(valid, x, -1e9))
+
+
 def _kv_cache_update_infer(op, block):
     cache = block.var_recursive(op.single_input('Cache'))
     out = block.var_recursive(op.single_output('Out'))
@@ -183,11 +304,39 @@ def _gather_time_infer(op, block):
     out.dtype = x.dtype
 
 
+def _kv_pool_update_infer(op, block):
+    pool = block.var_recursive(op.single_input('Pool'))
+    out = block.var_recursive(op.single_output('Out'))
+    out.shape = pool.shape
+    out.dtype = pool.dtype
+
+
+def _kv_page_gather_infer(op, block):
+    pool = block.var_recursive(op.single_input('Pool'))
+    table = block.var_recursive(op.single_input('Table'))
+    out = block.var_recursive(op.single_output('Out'))
+    out.shape = (table.shape[0], table.shape[1] * pool.shape[1],
+                 pool.shape[2], pool.shape[3])
+    out.dtype = pool.dtype
+
+
 register_op('kv_cache_write', infer_shape=_kv_cache_update_infer,
             no_grad=True)
 register_op('kv_cache_append', infer_shape=_kv_cache_update_infer,
             no_grad=True)
 register_op('decode_mask', infer_shape=_decode_mask_infer, no_grad=True)
+register_op('kv_page_cow', infer_shape=_kv_pool_update_infer,
+            no_grad=True)
+register_op('kv_page_write', infer_shape=_kv_pool_update_infer,
+            no_grad=True)
+register_op('kv_page_append', infer_shape=_kv_pool_update_infer,
+            no_grad=True)
+register_op('kv_page_gather', infer_shape=_kv_page_gather_infer,
+            no_grad=True)
+register_op('paged_decode_mask', infer_shape=_decode_mask_infer,
+            no_grad=True)
+register_op('paged_prefill_mask', infer_shape=_decode_mask_infer,
+            no_grad=True)
 register_op('position_embedding_at', infer_shape=_position_embedding_at_infer,
             no_grad=True)
 register_op('gather_time', infer_shape=_gather_time_infer, no_grad=True)

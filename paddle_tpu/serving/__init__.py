@@ -9,6 +9,18 @@ layered on the inference Predictor ABI:
               in a child Scope — weights shared with the base
               Predictor (and every clone) through the parent Scope,
               cache state private per worker.
+- paging.py   Host-side paged-cache bookkeeping: PagePool (refcounted
+              free-list allocator over [num_pages, page_tokens, H, dk]
+              pools, typed retryable CacheExhaustedError when dry),
+              PageTable (per-stream logical -> physical map with
+              copy-on-write forks), PrefixCache (content-hash chain
+              over full pages + partial tails — shared system prompts
+              map their prefix pages read-only, zero recompute).
+- paged.py    PagedDecodePredictor: the DecodePredictor contract over
+              the page pool — chunked prefill (one
+              FLAGS_serving_prefill_chunk slice per engine iteration),
+              page index as a decode feed (no recompile per
+              admission), transactional on-demand page allocation.
 - engine.py   ServingEngine: continuous batching over a fixed slot
               pool — requests are admitted into the running batch
               between decode steps, finished/cancelled slots are
@@ -33,12 +45,16 @@ path (tests/test_serving.py); the same determinism makes fleet
 failover bit-exact (tests/test_fleet.py).
 """
 from .decode import DecodePredictor
+from .paging import CacheExhaustedError, PagePool, PageTable, PrefixCache
+from .paged import PagedDecodePredictor
 from .engine import ServingEngine, Request
 from .api import LMServer
 from .replica import ReplicaServer
 from .fleet import (FleetRouter, FleetAutoscaler, FleetRequest,
                     OverloadError, FleetDeployError)
 
-__all__ = ['DecodePredictor', 'ServingEngine', 'Request', 'LMServer',
+__all__ = ['DecodePredictor', 'PagedDecodePredictor',
+           'CacheExhaustedError', 'PagePool', 'PageTable', 'PrefixCache',
+           'ServingEngine', 'Request', 'LMServer',
            'ReplicaServer', 'FleetRouter', 'FleetAutoscaler',
            'FleetRequest', 'OverloadError', 'FleetDeployError']

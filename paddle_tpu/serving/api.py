@@ -21,9 +21,15 @@ __all__ = ['LMServer']
 
 class LMServer(object):
     def __init__(self, model_dir_or_predictor, place=None, slots=None,
-                 prefill_batch=None, workers=1, max_queue=None):
+                 prefill_batch=None, workers=1, max_queue=None,
+                 paged=False, page_tokens=None, kv_pages=None,
+                 prefill_chunk=None):
         """model_dir_or_predictor: a save_inference_model directory, an
-        AnalysisPredictor, or an already-prepared DecodePredictor."""
+        AnalysisPredictor, or an already-prepared DecodePredictor.
+        paged=True serves from the page-pool cache (serving/paged.py):
+        copy-on-write prefix sharing plus chunked prefill, sized by
+        page_tokens / kv_pages / prefill_chunk (each None defaults
+        from FLAGS_serving_*)."""
         from .decode import DecodePredictor
         obj = model_dir_or_predictor
         if isinstance(obj, DecodePredictor):
@@ -32,8 +38,14 @@ class LMServer(object):
             if isinstance(obj, str):
                 from ..inference import AnalysisConfig, AnalysisPredictor
                 obj = AnalysisPredictor(AnalysisConfig(obj, place=place))
-            dec = obj.prepare_decoding(slots=slots,
-                                       prefill_batch=prefill_batch)
+            if paged:
+                dec = obj.prepare_decoding(slots=slots, paged=True,
+                                           page_tokens=page_tokens,
+                                           kv_pages=kv_pages,
+                                           prefill_chunk=prefill_chunk)
+            else:
+                dec = obj.prepare_decoding(slots=slots,
+                                           prefill_batch=prefill_batch)
         self._decode = dec
         self._engine = ServingEngine(dec, workers=workers,
                                      max_queue=max_queue)

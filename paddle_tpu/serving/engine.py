@@ -30,6 +30,7 @@ import numpy as np
 
 from ..flags import get_flag
 from ..obs import telemetry
+from .paging import CacheExhaustedError
 
 __all__ = ['Request', 'ServingEngine']
 
@@ -52,6 +53,7 @@ _token_latency = telemetry.histogram('serving.token_latency')
 _decode_batch = telemetry.histogram('serving.decode_batch')
 _weight_swaps = telemetry.counter('serving.weight_swaps')
 _swap_wait = telemetry.histogram('serving.swap_wait')
+_cache_exhausted = telemetry.counter('serving.cache_exhausted')
 
 
 class _StepGate(object):
@@ -144,11 +146,14 @@ class Request(object):
 
 class _Lane(object):
     """One occupied slot: the request plus the position its NEXT token
-    will be appended at (== absolute position of the token being fed)."""
-    __slots__ = ('req', 'pos', 'tok')
+    will be appended at (== absolute position of the token being fed).
+    `ready` is False while a paged stream is still prefilling in
+    chunks — the lane occupies its slot but sits out decode steps."""
+    __slots__ = ('req', 'pos', 'tok', 'ready')
 
-    def __init__(self, req, pos, tok):
+    def __init__(self, req, pos, tok, ready=True):
         self.req, self.pos, self.tok = req, pos, tok
+        self.ready = ready
 
 
 class ServingEngine(object):
@@ -175,6 +180,7 @@ class ServingEngine(object):
         self._slo = None
         self._gate = _StepGate()
         self._swaps = 0
+        self._slot_tokens = {}        # worker idx -> {slot: tokens held}
 
     # -- lifecycle ---------------------------------------------------------
     def start(self):
@@ -191,7 +197,7 @@ class ServingEngine(object):
         if self._slo is not None:
             self._slo.start()
         self._threads = [
-            threading.Thread(target=self._worker_loop, args=(p,),
+            threading.Thread(target=self._worker_loop, args=(i, p),
                              name='serving-worker-%d' % i, daemon=True)
             for i, p in enumerate(self._predictors)]
         for t in self._threads:
@@ -330,11 +336,37 @@ class ServingEngine(object):
     def stats(self):
         with self._cond:
             depth = len(self._queue)
-        return {'queue_depth': depth, 'active': self._active_total,
-                'workers': len(self._predictors),
-                'slots_per_worker': self._predictors[0].slots,
-                'weight_swaps': self._swaps,
-                'jit': self._predictors[0].jit_cache_stats()}
+        p0 = self._predictors[0]
+        paged = getattr(p0, 'paged', False)
+        slot_tokens = [dict(self._slot_tokens.get(i, {}))
+                       for i in range(len(self._predictors))]
+        out = {'queue_depth': depth, 'active': self._active_total,
+               'workers': len(self._predictors),
+               'slots_per_worker': p0.slots,
+               'weight_swaps': self._swaps,
+               'paged': paged,
+               # per-worker {slot: tokens held} — actual cache pressure,
+               # so the fleet router's least-loaded dispatch can weigh
+               # a worker near its token capacity over one holding the
+               # same lane count of short streams
+               'slot_tokens': slot_tokens,
+               'cache_tokens': sum(sum(d.values()) for d in slot_tokens),
+               'jit': p0.jit_cache_stats()}
+        if paged:
+            kv = {'pages_in_use': 0, 'pages_free': 0, 'prefix_hits': 0,
+                  'prefix_tokens_reused': 0, 'prefix_entries': 0}
+            for p in self._predictors:
+                for key in kv:
+                    kv[key] += p.pool_stats()[key]
+            kv['page_tokens'] = p0.page_tokens
+            kv['num_pages'] = p0.num_pages
+            out['kv'] = kv
+            out['cache_capacity'] = (len(self._predictors)
+                                     * (p0.num_pages - 1) * p0.page_tokens)
+        else:
+            out['cache_capacity'] = (len(self._predictors)
+                                     * p0.slots * p0.max_len)
+        return out
 
     # -- scheduler ---------------------------------------------------------
     def _pop_next(self):
@@ -349,11 +381,17 @@ class ServingEngine(object):
                 return req
         return None
 
-    def _finish_lane(self, lanes, slot, state, error=None):
+    def _finish_lane(self, lanes, slot, state, error=None, pred=None,
+                     wstate=None):
         lane = lanes.pop(slot)
         self._inflight.pop(lane.req.id, None)
         lane.req._finish(state, error)
         self._active_total -= 1
+        if pred is not None and getattr(pred, 'paged', False):
+            # freed pages un-stick any admission waiting on the pool
+            pred.release(slot)
+            if wstate is not None:
+                wstate['cache_wait'] = False
         if state == DONE:
             _completed.inc()
         elif state == CANCELLED:
@@ -361,13 +399,14 @@ class ServingEngine(object):
         else:
             _failed.inc()
 
-    def _lane_accept(self, lanes, slot, tok):
+    def _lane_accept(self, lanes, slot, tok, pred=None, wstate=None):
         """Record one generated token; returns False if the lane is
         done (eos / budget / cancelled) and was evicted."""
         lane = lanes[slot]
         req = lane.req
         if req.state == CANCELLED:
-            self._finish_lane(lanes, slot, CANCELLED)
+            self._finish_lane(lanes, slot, CANCELLED, pred=pred,
+                              wstate=wstate)
             return False
         req.tokens.append(int(tok))
         _tokens_out.inc()
@@ -376,7 +415,8 @@ class ServingEngine(object):
             _ttft.observe(req.first_token_at - req.submitted_at)
         if len(req.tokens) >= req.max_new_tokens or \
                 (req.eos_id is not None and int(tok) == req.eos_id):
-            self._finish_lane(lanes, slot, DONE)
+            self._finish_lane(lanes, slot, DONE, pred=pred,
+                              wstate=wstate)
             return False
         lane.tok = int(tok)
         return True
@@ -414,8 +454,98 @@ class ServingEngine(object):
                                     tok=int(tok))
                 self._lane_accept(lanes, slot, int(tok))
 
-    def _worker_loop(self, pred):
+    def _admit_paged(self, pred, lanes, prefilling, wstate):
+        """Paged admission: open a stream per free slot (a prefix-cache
+        match + read-only page adoption — allocates nothing, so
+        admission itself can never exhaust the pool) and queue it for
+        chunked prefill. While cache_wait is set, a requeued
+        exhaustion victim is waiting for a live stream to release
+        pages — admitting more streams would only deepen the hole."""
+        if wstate['cache_wait'] and lanes:
+            return
+        wstate['cache_wait'] = False
+        free = [s for s in range(pred.slots) if s not in lanes]
+        while free:
+            req = self._pop_next()
+            if req is None:
+                break
+            slot = free.pop(0)
+            req.state = RUNNING
+            self._inflight[req.id] = req
+            self._active_total += 1
+            try:
+                pred.open_stream(slot, req.prompt)
+            except Exception as e:  # noqa: BLE001 — lane-fatal only
+                self._inflight.pop(req.id, None)
+                req._finish(FAILED, error=repr(e))
+                self._active_total -= 1
+                _failed.inc()
+                continue
+            lanes[slot] = _Lane(req, pos=len(req.prompt), tok=0,
+                                ready=False)
+            prefilling.append(slot)
+            _admitted.inc()
+
+    def _prefill_tick(self, pred, lanes, prefilling, wstate):
+        """Advance chunked prefill by AT MOST one chunk per engine
+        iteration — the head-of-line bound: a 4k-token prompt costs
+        the live decode lanes one chunk's latency per step, never a
+        whole-prompt stall. Pool exhaustion mid-prefill is a shed with
+        retry: pages go back, the request requeues at the FRONT, and
+        admission pauses until a live stream releases (with no live
+        stream left to wait on, the request can never fit and fails
+        with the typed error)."""
+        while prefilling:
+            slot = prefilling[0]
+            lane = lanes.get(slot)
+            if lane is None:
+                prefilling.popleft()
+                continue
+            req = lane.req
+            if req.state == CANCELLED:
+                prefilling.popleft()
+                self._finish_lane(lanes, slot, CANCELLED, pred=pred,
+                                  wstate=wstate)
+                continue
+            try:
+                out = pred.prefill_step(slot)
+            except CacheExhaustedError as e:
+                _cache_exhausted.inc()
+                prefilling.popleft()
+                lanes.pop(slot)
+                pred.release(slot)
+                self._inflight.pop(req.id, None)
+                self._active_total -= 1
+                if lanes:
+                    req.state = QUEUED
+                    with self._cond:
+                        self._queue.appendleft(req)
+                        _queue_depth.set(len(self._queue))
+                    wstate['cache_wait'] = True
+                else:
+                    req._finish(FAILED,
+                                error='CacheExhaustedError: %s' % e)
+                    _failed.inc()
+                return
+            except Exception as e:  # noqa: BLE001 — lane-fatal only
+                prefilling.popleft()
+                self._finish_lane(lanes, slot, FAILED, error=repr(e),
+                                  pred=pred, wstate=wstate)
+                return
+            _prefills.inc()
+            if out is None:
+                return               # more chunks remain — next iteration
+            prefilling.popleft()
+            lane.ready = True
+            self._lane_accept(lanes, slot, int(out), pred=pred,
+                              wstate=wstate)
+            return
+
+    def _worker_loop(self, wid, pred):
+        paged = getattr(pred, 'paged', False)
         lanes = {}                       # slot -> _Lane
+        prefilling = collections.deque()  # paged: slots mid-prefill
+        wstate = {'cache_wait': False}
         tokens = np.zeros((pred.slots,), np.int64)
         positions = np.zeros((pred.slots,), np.int32)
         while True:
@@ -428,26 +558,53 @@ class ServingEngine(object):
             # swap (request_swap) runs between iterations — i.e. at a
             # step boundary — never under a prefill or decode step
             with self._gate.read():
-                self._admit(pred, lanes)
+                if paged:
+                    self._admit_paged(pred, lanes, prefilling, wstate)
+                    self._prefill_tick(pred, lanes, prefilling, wstate)
+                else:
+                    self._admit(pred, lanes)
                 _occupancy.set(self._active_total)
-                if not lanes:
+                self._slot_tokens[wid] = {s: ln.pos
+                                          for s, ln in lanes.items()}
+                ready = [s for s, ln in lanes.items() if ln.ready]
+                if not ready:
                     continue
-                for slot, lane in lanes.items():
-                    tokens[slot] = lane.tok
-                    positions[slot] = lane.pos
+                for slot in ready:
+                    tokens[slot] = lanes[slot].tok
+                    positions[slot] = lanes[slot].pos
                 t0 = time.perf_counter()
                 try:
                     ids = pred.decode_step(tokens, positions)
+                except CacheExhaustedError as e:
+                    # the pool cannot grow the named victims while they
+                    # and every other lane stay live: fail them typed
+                    # (the fleet router retries them as a shed); the
+                    # survivors retry the identical step next iteration
+                    _cache_exhausted.inc()
+                    for slot in e.slots:
+                        if slot in lanes:
+                            self._finish_lane(
+                                lanes, slot, FAILED,
+                                error='CacheExhaustedError: %s' % e,
+                                pred=pred, wstate=wstate)
+                    continue
                 except Exception as e:   # noqa: BLE001 — engine survives
-                    for slot in list(lanes):
-                        self._finish_lane(lanes, slot, FAILED,
-                                          error=repr(e))
+                    for slot in ready:
+                        if slot in lanes:
+                            self._finish_lane(lanes, slot, FAILED,
+                                              error=repr(e), pred=pred,
+                                              wstate=wstate)
                     continue
                 dt = time.perf_counter() - t0
                 _decode_steps.inc()
                 _token_latency.observe(dt)
-                _decode_batch.observe(len(lanes))
-                for slot in list(lanes):
+                _decode_batch.observe(len(ready))
+                for slot in ready:
                     lanes[slot].pos += 1
-                    self._lane_accept(lanes, slot, int(ids[slot]))
+                    self._lane_accept(lanes, slot, int(ids[slot]),
+                                      pred=pred, wstate=wstate)
                 _occupancy.set(self._active_total)
+                # re-snapshot after evictions so an idle worker reports
+                # zero held tokens, not its last busy state
+                self._slot_tokens[wid] = {s: ln.pos
+                                          for s, ln in lanes.items()}

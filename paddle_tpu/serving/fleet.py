@@ -162,6 +162,7 @@ class FleetRequest(object):
         self.error = None
         self.replica = None           # endpoint currently serving it
         self.segment = 0              # bumps on every failover
+        self.cache_sheds = 0          # CacheExhausted retry budget used
         self.base = 0                 # len(tokens) at segment dispatch
         self.rid = None
         self.submitted_at = time.perf_counter()
@@ -246,7 +247,8 @@ class _ReplicaClient(object):
 class _Replica(object):
     __slots__ = ('endpoint', 'client', 'order', 'healthy', 'draining',
                  'fails', 'active', 'capacity', 'queue_depth',
-                 'max_len', 'param_version', 'hold_until')
+                 'max_len', 'param_version', 'hold_until',
+                 'cache_tokens', 'cache_capacity')
 
     def __init__(self, endpoint, order, timeout):
         self.endpoint = endpoint
@@ -261,6 +263,8 @@ class _Replica(object):
         self.max_len = None
         self.param_version = None
         self.hold_until = 0.0         # brief dispatch backoff (full)
+        self.cache_tokens = 0         # tokens held in the KV cache
+        self.cache_capacity = None    # total cache tokens (paged)
 
 
 class FleetAutoscaler(object):
@@ -709,7 +713,13 @@ class FleetRouter(object):
                 if r.endpoint == ep:
                     return r
         return min(elig, key=lambda r: (
-            (len(r.active) + r.queue_depth) / max(1, r.capacity),
+            (len(r.active) + r.queue_depth) / max(1, r.capacity)
+            # cache-pressure term (paged replicas report token
+            # occupancy): two replicas with equal lane counts tie-break
+            # toward the one holding fewer KV tokens, so long streams
+            # spread out instead of stacking onto one page pool
+            + (r.cache_tokens / r.cache_capacity
+               if r.cache_capacity else 0.0),
             r.order))
 
     def _poll_streams(self):
@@ -759,6 +769,20 @@ class FleetRouter(object):
                     ttft = req.first_token_at - req.submitted_at
                     self._ttft_local.observe(ttft)
                     _ttft.observe(ttft)
+            if state == FAILED and req.cache_sheds < 5 and \
+                    'CacheExhausted' in (st.get('error') or ''):
+                # typed retryable shed (COVERAGE divergence 8): the
+                # replica's page pool was dry, not the stream's fault —
+                # requeue onto a (hopefully cooler) replica with a brief
+                # hold on this one; budget of 5 bounds the livelock when
+                # the whole fleet is saturated
+                rep.active.pop(req.id, None)
+                rep.hold_until = time.monotonic() + 0.05
+                req.cache_sheds += 1
+                self._shed_n += 1
+                _shed.inc()
+                self._requeue_locked(req)
+                return
             if state in (DONE, CANCELLED, FAILED):
                 rep.active.pop(req.id, None)
                 self._finalize_locked(req, state, st.get('error'))
@@ -837,6 +861,9 @@ class FleetRouter(object):
                 rep.capacity = int(h.get('capacity') or rep.capacity)
                 rep.max_len = h.get('max_len', rep.max_len)
                 rep.param_version = h.get('param_version')
+                rep.cache_tokens = int(h.get('cache_tokens', 0))
+                rep.cache_capacity = (h.get('cache_capacity')
+                                      or rep.cache_capacity)
                 rep.healthy = True
         now = time.monotonic()
         snap = self.admission_snapshot()

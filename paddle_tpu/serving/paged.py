@@ -1,0 +1,351 @@
+"""PagedDecodePredictor: page-table cached decoding over a shared pool.
+
+The DecodePredictor contract (prefill / decode_step / generate /
+clone, weights pinned once in the parent Scope) re-based onto the
+paged cache: per-layer [num_pages, page_tokens, H, dk] pools live in
+this predictor's child Scope, a host-side PagePool/PrefixCache
+(serving/paging.py) decides which physical page every logical position
+maps to, and both compiled programs take the page index as a FEED —
+admission, copy-on-write and prefix sharing never recompile anything.
+
+Streams replace the dense path's whole-row prefill:
+
+    open_stream(slot, prompt)   match the prefix cache, adopt shared
+                                pages read-only (zero recompute),
+                                allocate nothing yet
+    prefill_step(slot)          run ONE prefill_chunk-token chunk;
+                                returns the first greedy token once the
+                                prompt is complete (None before that)
+    decode_step(tokens, pos)    one compiled step over ALL slots; pages
+                                are allocated on demand per live stream
+    release(slot)               drop the stream's page refs
+
+Exhaustion is typed: when the pool runs dry (after prefix-cache LRU
+eviction) prefill_step/decode_step raise CacheExhaustedError — the
+dense ring's silent slide past max_len (COVERAGE divergence 8) cannot
+happen here. decode_step is transactional: on exhaustion every page
+allocated for THAT call is rolled back, so retrying the same feed
+after a release is deterministic and bit-exact.
+
+Telemetry: serving.kv_pages_in_use / serving.kv_pages_free gauges,
+serving.prefix_hits / serving.prefix_tokens_reused counters,
+serving.prefill_chunks histogram (chunks per admitted prompt).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..executor import Executor, Scope
+from ..flags import get_flag
+from ..obs import telemetry
+from .decode import DecodePredictor
+from .paging import CacheExhaustedError, PagePool, PageTable, PrefixCache
+
+__all__ = ['PagedDecodePredictor']
+
+_pages_in_use = telemetry.gauge('serving.kv_pages_in_use')
+_pages_free = telemetry.gauge('serving.kv_pages_free')
+_prefix_hits = telemetry.counter('serving.prefix_hits')
+_prefix_tokens = telemetry.counter('serving.prefix_tokens_reused')
+_prefill_chunks = telemetry.histogram('serving.prefill_chunks')
+
+
+class _PendingPrefill(object):
+    __slots__ = ('prompt', 'chunks')
+
+    def __init__(self, prompt):
+        self.prompt = prompt
+        self.chunks = 0
+
+
+class PagedDecodePredictor(DecodePredictor):
+    """Drop-in replacement for DecodePredictor with a paged cache.
+    prefer AnalysisPredictor.prepare_decoding(paged=True) over calling
+    this directly."""
+
+    paged = True
+
+    def __init__(self, predictor, slots=None, page_tokens=None,
+                 kv_pages=None, prefill_chunk=None, _clone_of=None):
+        self._base = predictor
+        if _clone_of is not None:
+            self._pair = _clone_of._pair
+            self._weight_scope = _clone_of._weight_scope
+        else:
+            from ..transpiler.decode_transpiler import DecodeTranspiler
+            slots = int(slots or get_flag('serving_slots'))
+            self._pair = DecodeTranspiler().transpile(
+                predictor._program, slots=slots, paged=True,
+                page_tokens=page_tokens, kv_pages=kv_pages,
+                prefill_chunk=prefill_chunk)
+            self._weight_scope = predictor._scope
+        self._exe = Executor(predictor._place)
+        if _clone_of is None:
+            self._pin_weights()
+        self._scope = Scope(parent=self._weight_scope)
+        self.reset()
+
+    # -- introspection -----------------------------------------------------
+    @property
+    def page_tokens(self):
+        return self._pair.page_tokens
+
+    @property
+    def num_pages(self):
+        return self._pair.num_pages
+
+    @property
+    def pages_per_slot(self):
+        return self._pair.pages_per_slot
+
+    @property
+    def prefill_chunk(self):
+        return self._pair.prefill_chunk
+
+    @property
+    def window(self):
+        """Max tokens (prompt + generated) one stream can hold."""
+        return self.pages_per_slot * self.page_tokens
+
+    def slot_tokens(self):
+        """{slot: tokens held} for every open stream — the per-slot
+        cache pressure LMServer.stats() exposes to the fleet router."""
+        return {slot: t.length for slot, t in self._tables.items()}
+
+    def pool_stats(self):
+        return {'page_tokens': self.page_tokens,
+                'num_pages': self.num_pages,
+                'pages_in_use': self._pool.pages_in_use,
+                'pages_free': self._pool.pages_free,
+                'prefix_entries': len(self._prefix),
+                'prefix_hits': self._prefix.hits,
+                'prefix_tokens_reused': self._prefix.tokens_reused}
+
+    def _update_gauges(self):
+        _pages_in_use.set(self._pool.pages_in_use)
+        _pages_free.set(self._pool.pages_free)
+
+    # -- lifecycle ---------------------------------------------------------
+    def reset(self):
+        """Zero the page pools and forget every stream and cached
+        prefix (fresh allocator state)."""
+        shape = self._pair.pool_shape
+        for name in self._pair.cache_names:
+            self._scope.set_var(name, np.zeros(shape, np.float32))
+        self._pool = PagePool(self.num_pages, self.page_tokens)
+        self._prefix = PrefixCache(self._pool)
+        self._pool.set_evict(self._prefix.evict_one)
+        self._tables = {}             # slot -> PageTable
+        self._pending = {}            # slot -> _PendingPrefill
+        self._update_gauges()
+
+    def clone(self):
+        return PagedDecodePredictor(self._base, _clone_of=self)
+
+    # -- streams -----------------------------------------------------------
+    def open_stream(self, slot, prompt):
+        """Begin a stream on `slot`: match the prefix cache and adopt
+        any shared pages (read-only, zero recompute). Allocates no new
+        pages, so admission itself can never exhaust the pool. Returns
+        {'shared_tokens', 'chunks'} — the suffix prefill plan."""
+        slot = int(slot)
+        if not 0 <= slot < self.slots:
+            raise ValueError('slot %r outside [0, %d)' % (slot, self.slots))
+        if slot in self._tables:
+            raise RuntimeError('slot %d already holds a stream — '
+                               'release() it first' % slot)
+        prompt = [int(t) for t in np.asarray(prompt).reshape(-1)]
+        if not 1 <= len(prompt) <= self.max_len:
+            raise ValueError('prompt length %d outside [1, %d] (max_len)'
+                             % (len(prompt), self.max_len))
+        table = PageTable(self._pool, self.pages_per_slot)
+        pages, shared = self._prefix.match(prompt, limit=len(prompt) - 1)
+        if shared:
+            table.adopt_shared(pages, shared)
+            _prefix_hits.inc()
+            _prefix_tokens.inc(shared)
+        self._tables[slot] = table
+        self._pending[slot] = _PendingPrefill(prompt)
+        self._update_gauges()
+        chunk = self.prefill_chunk
+        return {'slot': slot, 'prompt_tokens': len(prompt),
+                'shared_tokens': shared,
+                'chunks': -(-(len(prompt) - shared) // chunk)}
+
+    def release(self, slot):
+        """Drop a stream's page refs (cache-registered prefix pages
+        stay resident for future hits)."""
+        slot = int(slot)
+        table = self._tables.pop(slot, None)
+        self._pending.pop(slot, None)
+        if table is not None:
+            table.release()
+            self._update_gauges()
+
+    @staticmethod
+    def _rollback(cows, grows):
+        """Undo page mutations from a failed (never-run) step: COW
+        sources were NOT unref'd yet, so restoring them is pure
+        bookkeeping and the device state is untouched."""
+        for table, before in reversed(grows):
+            while len(table.pages) > before:
+                table.pool.unref(table.pages.pop())
+        for table, idx, (src, dst) in reversed(cows):
+            table.pages[idx] = src
+            table.shared.add(idx)
+            table.pool.unref(dst)
+
+    # -- execution ---------------------------------------------------------
+    def prefill_step(self, slot, return_logits=False):
+        """Advance one stream's prefill by ONE chunk. Returns None
+        while more chunks remain; on the final chunk, registers the
+        prompt with the prefix cache and returns the first greedy
+        token (with return_logits: (token, logits [vocab])). Raises
+        CacheExhaustedError — with this call's allocations rolled
+        back — when the pool cannot cover the chunk."""
+        slot = int(slot)
+        st = self._pending[slot]
+        table = self._tables[slot]
+        prompt, start = st.prompt, table.length
+        C, P, pt = self.prefill_chunk, self.pages_per_slot, self.page_tokens
+        n = min(C, len(prompt) - start)
+        cows, grows = [], []
+        before = len(table.pages)
+        try:
+            pair = table.cow_for_append(start)
+            if pair is not None:
+                cows.append((table, start // pt, pair))
+            table.ensure(start + n)
+        except CacheExhaustedError as e:
+            self._rollback(cows, grows)
+            raise CacheExhaustedError(str(e), slots=[slot])
+        if len(table.pages) > before:
+            grows.append((table, before))
+        tokens = np.zeros((1, C, 1), np.int64)
+        tokens[0, :n, 0] = prompt[start:start + n]
+        positions = (start + np.arange(C, dtype=np.int32))
+        table_feed = np.zeros((1, P), np.int32)
+        table.row(table_feed[0])
+        cow_src = np.zeros((1,), np.int32)
+        cow_dst = np.zeros((1,), np.int32)
+        if cows:
+            cow_src[0], cow_dst[0] = cows[0][2]
+        logits, ids = self._exe.run(
+            self._pair.prefill_program,
+            feed={'prefill_tokens': tokens,
+                  'prefill_positions': positions,
+                  'prefill_len': np.array([n], np.int32),
+                  'prefill_last': np.array([n - 1], np.int32),
+                  'prefill_page_table': table_feed,
+                  'prefill_cow_src': cow_src,
+                  'prefill_cow_dst': cow_dst},
+            fetch_list=self._pair.prefill_fetches,
+            scope=self._scope, return_numpy=False)
+        for table_, _idx, (src, _dst) in cows:
+            table_.pool.unref(src)
+        table.length = start + n
+        st.chunks += 1
+        self._update_gauges()
+        if table.length < len(prompt):
+            return None
+        self._prefix.register(prompt, table)
+        del self._pending[slot]
+        _prefill_chunks.observe(st.chunks)
+        tok = int(np.asarray(ids)[0])
+        if return_logits:
+            return tok, np.asarray(logits)[0]
+        return tok
+
+    def decode_step(self, tokens, positions, return_logits=False):
+        """One step for the WHOLE pool — same ABI as the dense path:
+        tokens [slots], positions [slots] (each stream's next append
+        position, which must be its current length). Only open,
+        fully-prefilled streams take part; every other lane is fed the
+        null-page table row, so its mandatory write is dead weight
+        exactly like the dense ring's idle-slot append. New pages are
+        allocated on demand; if ANY stream cannot grow, the step runs
+        nothing, this call's allocations are rolled back, and
+        CacheExhaustedError(slots=[...]) names the victims — the
+        caller releases or evicts them and retries the same feed."""
+        S, P, pt = self.slots, self.pages_per_slot, self.page_tokens
+        tokens = np.asarray(tokens, np.int64).reshape(S, 1, 1)
+        positions = np.asarray(positions, np.int32).reshape(S)
+        table_feed = np.zeros((S, P), np.int32)
+        pos_feed = np.zeros((S,), np.int32)
+        cow_src = np.zeros((S,), np.int32)
+        cow_dst = np.zeros((S,), np.int32)
+        cows, grows, failed, live = [], [], [], []
+        for slot in sorted(self._tables):
+            if slot in self._pending:
+                continue              # mid-prefill: stays on null pages
+            table = self._tables[slot]
+            pos = int(positions[slot])
+            before = len(table.pages)
+            try:
+                pair = table.cow_for_append(pos)
+                if pair is not None:
+                    cows.append((table, pos // pt, pair))
+                table.ensure(pos + 1)
+            except CacheExhaustedError:
+                failed.append(slot)
+                continue
+            if len(table.pages) > before:
+                grows.append((table, before))
+            table.row(table_feed[slot])
+            pos_feed[slot] = pos
+            if pair is not None:
+                cow_src[slot], cow_dst[slot] = pair
+            live.append(slot)
+        if failed:
+            self._rollback(cows, grows)
+            self._update_gauges()
+            raise CacheExhaustedError(
+                'KV page pool exhausted for slot(s) %s'
+                % ','.join(map(str, failed)), slots=failed)
+        logits, ids = self._exe.run(
+            self._pair.decode_program,
+            feed={'decode_tokens': tokens,
+                  'decode_step_idx': pos_feed,
+                  'decode_page_table': table_feed,
+                  'decode_cow_src': cow_src,
+                  'decode_cow_dst': cow_dst},
+            fetch_list=self._pair.decode_fetches,
+            scope=self._scope, return_numpy=False)
+        for table, _idx, (src, _dst) in cows:
+            table.pool.unref(src)
+        for slot in live:
+            table = self._tables[slot]
+            table.length = max(table.length, int(positions[slot]) + 1)
+        self._update_gauges()
+        if return_logits:
+            return np.asarray(ids), np.asarray(logits)
+        return np.asarray(ids)
+
+    def prefill(self, prompts, slot_ids, return_logits=False):
+        """Dense-ABI prefill (the parity / generate() path): each
+        prompt is streamed chunk by chunk to completion; a slot that
+        already holds a stream is released first (the dense path's
+        overwrite-on-admission semantics). Returns first greedy ids
+        [len(prompts)] (+ last-position logits with return_logits)."""
+        if not prompts or len(prompts) != len(slot_ids):
+            raise ValueError('%d prompts for %d slots'
+                             % (len(prompts), len(slot_ids)))
+        out_ids = np.zeros((len(prompts),), np.int64)
+        out_logits = []
+        for i, (prompt, slot) in enumerate(zip(prompts, slot_ids)):
+            slot = int(slot)
+            if slot in self._tables:
+                self.release(slot)
+            self.open_stream(slot, prompt)
+            result = None
+            while result is None:
+                result = self.prefill_step(slot,
+                                           return_logits=return_logits)
+            if return_logits:
+                out_ids[i], logits = result
+                out_logits.append(logits)
+            else:
+                out_ids[i] = result
+        if return_logits:
+            return out_ids, np.stack(out_logits)
+        return out_ids

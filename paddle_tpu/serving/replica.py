@@ -195,6 +195,12 @@ class ReplicaServer(object):
                'max_len': self._srv.max_len,
                'param_version': stats.get('param_version'),
                'staleness_rounds': stats.get('staleness_rounds'),
+               # paged-cache pressure: tokens held across live slots vs
+               # total cache capacity — the router weighs this beyond
+               # lane counts (a worker full of 4k streams is hotter
+               # than one full of 16-token streams)
+               'cache_tokens': stats.get('cache_tokens', 0),
+               'cache_capacity': stats.get('cache_capacity'),
                'draining': self._draining}
         if with_digests:
             out['digests'] = self._srv.param_digests()

@@ -21,10 +21,12 @@ at prepare time than a silently wrong cache layout at serve time.
 from __future__ import annotations
 
 from ..models.transformer import (DecodeSpec, build_prefill_program,
-                                  build_decode_program)
+                                  build_decode_program,
+                                  build_paged_prefill_program,
+                                  build_paged_decode_program)
 
-__all__ = ['DecodeTranspileError', 'DecodePair', 'DecodeTranspiler',
-           'extract_decode_spec']
+__all__ = ['DecodeTranspileError', 'DecodePair', 'PagedDecodePair',
+           'DecodeTranspiler', 'extract_decode_spec']
 
 
 class DecodeTranspileError(ValueError):
@@ -55,6 +57,39 @@ class DecodePair(object):
     @property
     def cache_names(self):
         return self.spec.cache_names()
+
+    paged = False
+
+
+class PagedDecodePair(DecodePair):
+    """Paged transpile result: the cache state is per-layer page POOLS
+    ([num_pages, page_tokens, H, dk]) instead of per-slot rings, the
+    prefill program runs one `prefill_chunk`-token chunk through one
+    stream's page table, and both programs take the page index as a
+    feed (serving/paged.py computes it)."""
+
+    paged = True
+
+    def __init__(self, spec, slots, page_tokens, pages_per_slot,
+                 num_pages, prefill_chunk,
+                 prefill_program, prefill_feeds, prefill_fetches,
+                 decode_program, decode_feeds, decode_fetches):
+        DecodePair.__init__(self, spec, slots, 1,
+                            prefill_program, prefill_feeds,
+                            prefill_fetches, decode_program,
+                            decode_feeds, decode_fetches)
+        self.page_tokens = page_tokens
+        self.pages_per_slot = pages_per_slot
+        self.num_pages = num_pages
+        self.prefill_chunk = prefill_chunk
+
+    @property
+    def cache_names(self):
+        return self.spec.pool_names()
+
+    @property
+    def pool_shape(self):
+        return self.spec.pool_shape(self.num_pages, self.page_tokens)
 
 
 def _fail(msg):
@@ -152,17 +187,51 @@ def extract_decode_spec(program):
 
 
 class DecodeTranspiler(object):
-    def transpile(self, program, slots=8, prefill_batch=1):
+    def transpile(self, program, slots=8, prefill_batch=1, paged=False,
+                  page_tokens=None, kv_pages=None, prefill_chunk=None):
         """program: a loaded inference Program (AnalysisPredictor's).
-        Returns a DecodePair; raises DecodeTranspileError if the
+        Returns a DecodePair (or, with paged=True, a PagedDecodePair
+        whose cache is a page pool sized by page_tokens / kv_pages and
+        whose prefill runs prefill_chunk-token chunks; each None
+        defaults from FLAGS_serving_*, kv_pages 0 auto-sizes to
+        dense-equivalent capacity). Raises DecodeTranspileError if the
         program is not a recognizable decoder-only LM."""
         if slots < 1:
             raise ValueError('slots must be >= 1, got %r' % (slots,))
         if not 1 <= prefill_batch <= slots:
             raise ValueError('prefill_batch must be in [1, slots]')
         spec = extract_decode_spec(program)
+        if paged:
+            return self._transpile_paged(spec, slots, page_tokens,
+                                         kv_pages, prefill_chunk)
         pp, pf, pv = build_prefill_program(spec, slots,
                                            batch=prefill_batch)
         dp, df, dv = build_decode_program(spec, slots)
         return DecodePair(spec, slots, prefill_batch,
                           pp, pf, pv, dp, df, dv)
+
+    def _transpile_paged(self, spec, slots, page_tokens, kv_pages,
+                         prefill_chunk):
+        from ..flags import get_flag
+        pt = int(page_tokens or get_flag('serving_page_tokens'))
+        if pt < 1:
+            raise ValueError('page_tokens must be >= 1, got %r' % pt)
+        pages_per_slot = -(-spec.max_len // pt)         # ceil
+        num_pages = int(kv_pages if kv_pages is not None
+                        else get_flag('serving_kv_pages'))
+        if num_pages == 0:
+            # dense-equivalent HBM: every slot can hold a full window,
+            # plus the reserved null page
+            num_pages = slots * pages_per_slot + 1
+        if num_pages < 2:
+            raise ValueError('kv_pages must be >= 2 (page 0 is the '
+                             'reserved null page), got %d' % num_pages)
+        chunk = int(prefill_chunk or get_flag('serving_prefill_chunk'))
+        chunk = max(1, min(chunk, spec.max_len))
+        pp, pf, pv = build_paged_prefill_program(
+            spec, chunk, num_pages, pt, pages_per_slot)
+        dp, df, dv = build_paged_decode_program(
+            spec, slots, num_pages, pt, pages_per_slot)
+        return PagedDecodePair(spec, slots, pt, pages_per_slot,
+                               num_pages, chunk,
+                               pp, pf, pv, dp, df, dv)

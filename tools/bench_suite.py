@@ -181,6 +181,12 @@ def run_one(model, mode, steps, full, quick=False):
             row['fleet_tokens_per_sec'] = serving['fleet_tokens_per_sec']
         if serving.get('fleet_p99_ttft_ms'):
             row['fleet_p99_ttft_ms'] = serving['fleet_p99_ttft_ms']
+        if serving.get('paged_tokens_per_sec'):
+            row['paged_tokens_per_sec'] = serving['paged_tokens_per_sec']
+        if serving.get('paged_max_streams'):
+            row['paged_max_streams'] = serving['paged_max_streams']
+        if serving.get('prefix_hit_ttft_ms'):
+            row['prefix_hit_ttft_ms'] = serving['prefix_hit_ttft_ms']
     return row
 
 
@@ -380,14 +386,16 @@ _SERVING_QUICK = [None]     # serve_bench --quick, measured at most once
 
 def _serving_quick():
     """Headline serving numbers (tools/serve_bench.py --quick
-    --refresh --fleet) stamped onto the transformer local-mode row:
-    the cached-vs-recompute decode speedup, the online-refresh tail
-    cost (refresh_p99_ratio — token p99 with a live ParamSubscriber
-    install loop over the undisturbed p99), and the fleet leg
-    (fleet_tokens_per_sec / fleet_p99_ttft_ms through a FleetRouter
-    over 2 replica subprocesses — perf_gate infers the direction from
-    each suffix). One subprocess, cached across invocations; {} on
-    any failure."""
+    --refresh --fleet --paged) stamped onto the transformer
+    local-mode row: the cached-vs-recompute decode speedup, the
+    online-refresh tail cost (refresh_p99_ratio — token p99 with a
+    live ParamSubscriber install loop over the undisturbed p99), the
+    fleet leg (fleet_tokens_per_sec / fleet_p99_ttft_ms through a
+    FleetRouter over 2 replica subprocesses — perf_gate infers the
+    direction from each suffix), and the paged-cache A/B
+    (paged_tokens_per_sec / paged_max_streams at dense-equal HBM,
+    prefix_hit_ttft_ms). One subprocess, cached across invocations;
+    {} on any failure."""
     if _SERVING_QUICK[0] is None:
         try:
             env = dict(os.environ, JAX_PLATFORMS='cpu')
@@ -395,7 +403,7 @@ def _serving_quick():
                 [sys.executable,
                  os.path.join(os.path.dirname(os.path.abspath(__file__)),
                               'serve_bench.py'), '--quick', '--refresh',
-                 '--fleet'],
+                 '--fleet', '--paged'],
                 capture_output=True, text=True, timeout=600, env=env)
             line = [ln for ln in out.stdout.splitlines()
                     if ln.startswith('{') and '"summary"' in ln][-1]

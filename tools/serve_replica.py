@@ -15,6 +15,10 @@ Environment contract (everything a Supervisor role env can carry):
   SERVE_SLOTS           decode slots per worker      (default flags)
   SERVE_WORKERS         engine worker threads        (default 1)
   SERVE_PREFILL_BATCH   prefill batch                (default flags)
+  SERVE_PAGED           '1' -> paged KV cache (copy-on-write prefix
+                        sharing + chunked prefill); sized by
+                        SERVE_PAGE_TOKENS / SERVE_KV_PAGES /
+                        SERVE_PREFILL_CHUNK   (defaults from flags)
   SERVE_PS_ENDPOINTS    comma-separated pserver endpoints; attaches a
                         ParamSubscriber. Default posture is PAUSED —
                         staleness is measured but only an
@@ -47,10 +51,17 @@ def main():
     slots = os.environ.get('SERVE_SLOTS')
     workers = int(os.environ.get('SERVE_WORKERS', '1'))
     prefill = os.environ.get('SERVE_PREFILL_BATCH')
+    paged = os.environ.get('SERVE_PAGED') == '1'
+    page_tokens = os.environ.get('SERVE_PAGE_TOKENS')
+    kv_pages = os.environ.get('SERVE_KV_PAGES')
+    chunk = os.environ.get('SERVE_PREFILL_CHUNK')
     srv = LMServer(model_dir,
                    slots=int(slots) if slots else None,
                    prefill_batch=int(prefill) if prefill else None,
-                   workers=workers)
+                   workers=workers, paged=paged,
+                   page_tokens=int(page_tokens) if page_tokens else None,
+                   kv_pages=int(kv_pages) if kv_pages else None,
+                   prefill_chunk=int(chunk) if chunk else None)
     ps_eps = os.environ.get('SERVE_PS_ENDPOINTS')
     if ps_eps:
         srv.enable_refresh(
