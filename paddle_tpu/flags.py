@@ -178,6 +178,24 @@ Known flags:
   fleet_deploy_timeout   seconds rolling_deploy() may spend per replica
                          on drain + refresh + health-check before the
                          deploy aborts (the replica is un-drained)
+  spec_k                 speculative decoding (serving/speculative.py):
+                         draft proposals per verify pass (the CEILING —
+                         the predictor adapts k per slot between 1 and
+                         this from the rolling accept rate; 0 disables
+                         speculation)
+  spec_draft_layers      self-draft depth: the draft model is the
+                         target truncated to its first N transformer
+                         blocks (same weights, zero extra weight HBM);
+                         ignored when an explicit draft program is
+                         given
+  wire_binary_meta       frame the wire meta header in the compact
+                         binary codec (wire version 3) instead of JSON
+                         — negotiated per connection: a sender
+                         advertises in its JSON meta, and only
+                         upgrades after the peer has proven it speaks
+                         v3, so old peers keep working (PERF round 10:
+                         the JSON header is the 320×256B row's
+                         remaining 2×)
 """
 from __future__ import annotations
 
@@ -338,6 +356,14 @@ _DEFAULTS = {
     'fleet_shed_consecutive': 2,
     'fleet_admission_rules': '',
     'fleet_deploy_timeout': 120.0,
+    # speculative decoding (serving/speculative.py): max draft
+    # proposals per verify pass (adaptive k's ceiling; 0 = off), and
+    # the self-draft truncation depth in transformer blocks
+    'spec_k': 4,
+    'spec_draft_layers': 1,
+    # wire meta header codec (distributed/wire.py): binary (v3 frames,
+    # negotiated per connection with JSON fallback for old peers)
+    'wire_binary_meta': False,
     # batch_norm under data parallelism: compute statistics per device
     # (the reference's semantics — multi_devices_graph_pass.cc replicates
     # batch_norm per device, so stats are local and un-synced) instead of

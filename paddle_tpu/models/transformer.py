@@ -582,6 +582,40 @@ def _paged_decode_attention(x, spec, blk, pool, table, positions,
     return _named_fc(ctx, spec.dim, blk['proj'])
 
 
+def _paged_verify_attention(x, spec, blk, pool, table, positions,
+                            cow_src, cow_dst, k1):
+    """Speculative verify attention: append K1 = k+1 proposed rows per
+    slot through its page table in ONE kv_page_append (2-D positions),
+    then attend every row over the gathered history with the per-row
+    causal spec_verify_mask. Same ops, same reduction lengths as the
+    decode step, so each verify row's output is bit-exact with the
+    decode step the target would have run at that position."""
+    q4, k4, v4 = _qkv_parts(x, spec, blk, k1)          # [S, K1, H, dh]
+    for pool_var, new in ((pool[0], k4), (pool[1], v4)):
+        _block_op('kv_page_cow',
+                  inputs={'Pool': [pool_var], 'Src': [cow_src],
+                          'Dst': [cow_dst]},
+                  outputs={'Out': [pool_var]})
+        _block_op('kv_page_append',
+                  inputs={'Pool': [pool_var], 'X': [new],
+                          'Table': [table], 'Positions': [positions]},
+                  outputs={'Out': [pool_var]})
+    q = L.transpose(q4, perm=[0, 2, 1, 3])             # [S, H, K1, dh]
+    kt = _paged_gather(pool[0], table)                 # [S, H, J, dh]
+    vt = _paged_gather(pool[1], table)
+    scores = L.matmul(q, kt, transpose_y=True,
+                      alpha=1.0 / np.sqrt(spec.dh))    # [S, H, K1, J]
+    masked = _tmp_var()
+    _block_op('spec_verify_mask',
+              inputs={'X': [scores], 'Positions': [positions]},
+              outputs={'Out': [masked]})
+    probs = L.softmax(masked)
+    ctx = L.matmul(probs, vt)                          # [S, H, K1, dh]
+    ctx = L.transpose(ctx, perm=[0, 2, 1, 3])
+    ctx = L.reshape(ctx, shape=[-1, k1, spec.dim])
+    return _named_fc(ctx, spec.dim, blk['proj'])
+
+
 def _paged_pos_embedding(spec, index, rows):
     """Positional rows gathered by absolute index (paged positions
     never wrap): Index [rows] -> [1, rows, D] / [rows, 1, D]."""
@@ -709,3 +743,59 @@ def build_paged_decode_program(spec, slots, num_pages, page_tokens,
     return prog, ['decode_tokens', 'decode_step_idx',
                   'decode_page_table', 'decode_cow_src',
                   'decode_cow_dst'], [logits, ids]
+
+
+def build_verify_program(spec, slots, k1, num_pages, page_tokens,
+                         pages_per_slot):
+    """Speculative verify: the TARGET model over K1 = k+1 proposed
+    positions for every slot in ONE pass — the paged prefill program
+    generalized to a batch of slots with a fixed row count.
+
+    Feeds:  verify_tokens [slots, K1, 1] int64 (row 0 is the stream's
+            last committed token, rows 1..k the draft proposals),
+            verify_positions [slots, K1] int32 (absolute position per
+            row — base..base+k for live slots, all zero for idle ones,
+            whose writes land in the null page),
+            verify_page_table [slots, P] int32,
+            verify_cow_src / verify_cow_dst [slots] int32 (at most ONE
+            fork per slot per verify: only the shared frontier page can
+            COW — pages grown for the proposals are born private).
+    Appends all K1 rows per layer per slot, attends with the per-row
+    causal spec_verify_mask, and returns logits [slots*K1, vocab] +
+    greedy ids [slots, K1]: ids[s, r] is the target's next token AFTER
+    verify row r — compare against the draft chain for the longest
+    accepted prefix, and ids[s, a] is the free bonus token.
+    Returns (program, feed_names, fetch_vars[logits, ids]).
+    """
+    from ..framework import Program, program_guard
+    prog, startup = Program(), Program()
+    prog._is_test = True
+    with program_guard(prog, startup):
+        tokens = L.data('verify_tokens', [slots, k1, 1],
+                        append_batch_size=False, dtype='int64')
+        positions = L.data('verify_positions', [slots, k1],
+                           append_batch_size=False, dtype='int32')
+        table = L.data('verify_page_table', [slots, pages_per_slot],
+                       append_batch_size=False, dtype='int32')
+        cow_src = L.data('verify_cow_src', [slots],
+                         append_batch_size=False, dtype='int32')
+        cow_dst = L.data('verify_cow_dst', [slots],
+                         append_batch_size=False, dtype='int32')
+        pools = _create_pool_vars(spec, num_pages, page_tokens)
+        emb = L.embedding(tokens, size=[spec.vocab, spec.dim],
+                          param_attr=_named_attr(spec.emb_w))  # [S, K1, D]
+        pos = _paged_pos_embedding(spec, positions, k1)        # [S, K1, D]
+        x = L.elementwise_add(emb, pos)
+        for i in range(spec.layers):
+            x = _cached_block(
+                x, spec, i,
+                lambda ln, sp, blk, _i=i: _paged_verify_attention(
+                    ln, sp, blk, pools[_i], table, positions,
+                    cow_src, cow_dst, k1))
+        x = _named_ln(x, spec.final_ln)
+        logits3 = _named_fc(x, spec.vocab, spec.head)          # [S, K1, V]
+        ids = L.argmax(logits3, axis=-1)                       # [S, K1]
+        logits = L.reshape(logits3, shape=[-1, spec.vocab])
+    return prog, ['verify_tokens', 'verify_positions',
+                  'verify_page_table', 'verify_cow_src',
+                  'verify_cow_dst'], [logits, ids]

@@ -135,10 +135,14 @@ def _decode_mask_emit(ctx, op):
 def _position_embedding_at_emit(ctx, op):
     """Gather one positional-embedding row per slot: Pos [max_len, D],
     Index [slots] int32 -> [slots, 1, D] (ring position Index % T_pos,
-    matching the prefill path's pos[:T] table slice)."""
+    matching the prefill path's pos[:T] table slice). A 2-D Index
+    [slots, R] gathers a row per (slot, row) -> [slots, R, D] — the
+    verify program's per-proposal positions."""
     pos = ctx.get(op.single_input('Pos'))
     idx = ctx.get(op.single_input('Index')).astype(jnp.int32)
-    out = jnp.take(pos, idx % pos.shape[0], axis=0)[:, None, :]
+    out = jnp.take(pos, idx % pos.shape[0], axis=0)
+    if idx.ndim == 1:
+        out = out[:, None, :]
     ctx.set(op.single_output('Out'), out)
 
 
@@ -214,12 +218,29 @@ def _kv_page_append_emit(ctx, op):
     Positions [slots] int32 (absolute position of the incoming token).
     Every slot writes every step — idle or mid-prefill slots are fed an
     all-zero table row and position 0, so their writes land in the null
-    page (the paged analog of the ring's dead-weight write)."""
+    page (the paged analog of the ring's dead-weight write). With 2-D
+    Positions [slots, R] and X [slots, R, H, dk], R rows are appended
+    per slot in one shot — the speculative verify pass's multi-token
+    append."""
     pool = ctx.get(op.single_input('Pool'))
     x = ctx.get(op.single_input('X'))
     table = ctx.get(op.single_input('Table')).astype(jnp.int32)
     positions = ctx.get(op.single_input('Positions')).astype(jnp.int32)
     pt, P = pool.shape[1], table.shape[1]
+    if positions.ndim == 2:
+        # verify: R rows per slot in one append — X [slots, R, H, dk],
+        # Positions [slots, R]. Distinct live positions never collide;
+        # padding rows carry an out-of-range position (>= P * pt) and
+        # are redirected to the always-masked null page, so a slot
+        # proposing fewer than R tokens never scribbles on real pages.
+        srow = jnp.arange(table.shape[0], dtype=jnp.int32)[:, None]
+        idx = positions // pt
+        live = idx < P
+        page = jnp.where(live, table[srow, jnp.clip(idx, 0, P - 1)], 0)
+        off = jnp.where(live, positions % pt, 0)
+        ctx.set(op.single_output('Out'),
+                pool.at[page, off].set(x.astype(pool.dtype)))
+        return
     rows = jnp.arange(table.shape[0], dtype=jnp.int32)
     page = table[rows, jnp.clip(positions // pt, 0, P - 1)]
     ctx.set(op.single_output('Out'),
@@ -258,6 +279,25 @@ def _paged_decode_mask_emit(ctx, op):
     ctx.set(op.single_output('Out'), jnp.where(valid, x, -1e9))
 
 
+@op_emitter('spec_verify_mask')
+def _spec_verify_mask_emit(ctx, op):
+    """Causal validity mask for the speculative verify pass: X
+    [slots, H, K1, J] scores (K1 = k proposals + the base token),
+    Positions [slots, K1] (absolute position of each verify row).
+    Row r of slot s may see logical index j iff j <= positions[s, r] —
+    paged_decode_mask per row, paged_prefill_mask per slot. Same
+    set-to--1e9 semantics so masked lanes underflow to exactly 0.0
+    after the softmax's exp — the bit-exactness contract that makes
+    verify-row logits identical to the plain decode step's at the same
+    position over the same cache."""
+    x = ctx.get(op.single_input('X'))
+    positions = ctx.get(op.single_input('Positions')).astype(jnp.int32)
+    j = jnp.arange(x.shape[-1], dtype=jnp.int32)
+    valid = j[None, None, :] <= positions[:, :, None]  # [slots, K1, J]
+    valid = valid[:, None, :, :]                       # [slots, 1, K1, J]
+    ctx.set(op.single_output('Out'), jnp.where(valid, x, -1e9))
+
+
 @op_emitter('paged_prefill_mask')
 def _paged_prefill_mask_emit(ctx, op):
     """Causal mask for a prefill chunk against the gathered history:
@@ -293,7 +333,10 @@ def _position_embedding_at_infer(op, block):
     pos = block.var_recursive(op.single_input('Pos'))
     idx = block.var_recursive(op.single_input('Index'))
     out = block.var_recursive(op.single_output('Out'))
-    out.shape = (idx.shape[0], 1, pos.shape[-1])
+    if len(idx.shape) == 2:
+        out.shape = (idx.shape[0], idx.shape[1], pos.shape[-1])
+    else:
+        out.shape = (idx.shape[0], 1, pos.shape[-1])
     out.dtype = pos.dtype
 
 
@@ -336,6 +379,8 @@ register_op('kv_page_gather', infer_shape=_kv_page_gather_infer,
 register_op('paged_decode_mask', infer_shape=_decode_mask_infer,
             no_grad=True)
 register_op('paged_prefill_mask', infer_shape=_decode_mask_infer,
+            no_grad=True)
+register_op('spec_verify_mask', infer_shape=_decode_mask_infer,
             no_grad=True)
 register_op('position_embedding_at', infer_shape=_position_embedding_at_infer,
             no_grad=True)

@@ -21,6 +21,15 @@ layered on the inference Predictor ABI:
               FLAGS_serving_prefill_chunk slice per engine iteration),
               page index as a decode feed (no recompile per
               admission), transactional on-demand page allocation.
+- speculative.py  SpeculativeDecodePredictor: draft/verify speculative
+              decoding over the paged cache — a layer-truncated
+              self-draft (or explicit draft LM) proposes FLAGS_spec_k
+              tokens per stream, one batched verify pass scores all
+              k+1 positions for every slot, and greedy acceptance
+              (longest matching prefix + free bonus token) keeps the
+              emitted stream token-for-token identical to plain greedy
+              decode. Mid-verify pool exhaustion rolls the whole
+              speculation back and retries as a plain decode step.
 - engine.py   ServingEngine: continuous batching over a fixed slot
               pool — requests are admitted into the running batch
               between decode steps, finished/cancelled slots are
@@ -47,6 +56,7 @@ failover bit-exact (tests/test_fleet.py).
 from .decode import DecodePredictor
 from .paging import CacheExhaustedError, PagePool, PageTable, PrefixCache
 from .paged import PagedDecodePredictor
+from .speculative import DraftModel, SpeculativeDecodePredictor
 from .engine import ServingEngine, Request
 from .api import LMServer
 from .replica import ReplicaServer
@@ -54,6 +64,7 @@ from .fleet import (FleetRouter, FleetAutoscaler, FleetRequest,
                     OverloadError, FleetDeployError)
 
 __all__ = ['DecodePredictor', 'PagedDecodePredictor',
+           'DraftModel', 'SpeculativeDecodePredictor',
            'CacheExhaustedError', 'PagePool', 'PageTable', 'PrefixCache',
            'ServingEngine', 'Request', 'LMServer',
            'ReplicaServer', 'FleetRouter', 'FleetAutoscaler',

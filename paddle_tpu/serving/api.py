@@ -23,13 +23,16 @@ class LMServer(object):
     def __init__(self, model_dir_or_predictor, place=None, slots=None,
                  prefill_batch=None, workers=1, max_queue=None,
                  paged=False, page_tokens=None, kv_pages=None,
-                 prefill_chunk=None):
+                 prefill_chunk=None, speculative=False, spec_k=None,
+                 draft_layers=None):
         """model_dir_or_predictor: a save_inference_model directory, an
         AnalysisPredictor, or an already-prepared DecodePredictor.
         paged=True serves from the page-pool cache (serving/paged.py):
         copy-on-write prefix sharing plus chunked prefill, sized by
         page_tokens / kv_pages / prefill_chunk (each None defaults
-        from FLAGS_serving_*)."""
+        from FLAGS_serving_*). speculative=True (implies paged) serves
+        through draft/verify speculation (serving/speculative.py);
+        spec_k / draft_layers default from FLAGS_spec_*."""
         from .decode import DecodePredictor
         obj = model_dir_or_predictor
         if isinstance(obj, DecodePredictor):
@@ -38,7 +41,14 @@ class LMServer(object):
             if isinstance(obj, str):
                 from ..inference import AnalysisConfig, AnalysisPredictor
                 obj = AnalysisPredictor(AnalysisConfig(obj, place=place))
-            if paged:
+            if speculative:
+                dec = obj.prepare_decoding(slots=slots, speculative=True,
+                                           spec_k=spec_k,
+                                           draft_layers=draft_layers,
+                                           page_tokens=page_tokens,
+                                           kv_pages=kv_pages,
+                                           prefill_chunk=prefill_chunk)
+            elif paged:
                 dec = obj.prepare_decoding(slots=slots, paged=True,
                                            page_tokens=page_tokens,
                                            kv_pages=kv_pages,

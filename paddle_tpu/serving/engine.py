@@ -366,6 +366,28 @@ class ServingEngine(object):
         else:
             out['cache_capacity'] = (len(self._predictors)
                                      * p0.slots * p0.max_len)
+        if getattr(p0, 'speculative', False):
+            sp = [p.spec_stats() for p in self._predictors]
+            drafted = sum(s['draft_tokens'] for s in sp)
+            accepted = sum(s['accepted_tokens'] for s in sp)
+            steps = sum(s['steps'] for s in sp)
+            emitted = sum(s['effective_tokens_per_step'] * s['steps']
+                          for s in sp)
+            out['spec'] = {
+                'spec_k': sp[0]['spec_k'],
+                'k_live': sp[0]['k_live'],
+                'steps': steps,
+                'draft_tokens': drafted,
+                'accepted_tokens': accepted,
+                'rejected_tokens': drafted - accepted,
+                'fallback_steps': sum(s['fallback_steps'] for s in sp),
+                'accept_rate': (accepted / drafted if drafted else 0.0)}
+            # tokens emitted per verify iteration — the fleet router's
+            # effective-throughput weight (1.0 would be plain decode)
+            out['effective_tokens_per_step'] = (emitted / steps
+                                                if steps else 0.0)
+            out['spec']['effective_tokens_per_step'] = \
+                out['effective_tokens_per_step']
         return out
 
     # -- scheduler ---------------------------------------------------------
@@ -543,6 +565,10 @@ class ServingEngine(object):
 
     def _worker_loop(self, wid, pred):
         paged = getattr(pred, 'paged', False)
+        # a speculative predictor's step is one draft->verify iteration
+        # (serving/speculative.py): same feed ABI, but each live lane
+        # gets 1..k+1 tokens back instead of exactly one
+        speculative = getattr(pred, 'speculative', False)
         lanes = {}                       # slot -> _Lane
         prefilling = collections.deque()  # paged: slots mid-prefill
         wstate = {'cache_wait': False}
@@ -574,7 +600,10 @@ class ServingEngine(object):
                     positions[slot] = lanes[slot].pos
                 t0 = time.perf_counter()
                 try:
-                    ids = pred.decode_step(tokens, positions)
+                    if speculative:
+                        emitted = pred.spec_step(tokens, positions)
+                    else:
+                        ids = pred.decode_step(tokens, positions)
                 except CacheExhaustedError as e:
                     # the pool cannot grow the named victims while they
                     # and every other lane stay live: fail them typed
@@ -599,10 +628,23 @@ class ServingEngine(object):
                 _decode_steps.inc()
                 _token_latency.observe(dt)
                 _decode_batch.observe(len(ready))
-                for slot in ready:
-                    lanes[slot].pos += 1
-                    self._lane_accept(lanes, slot, int(ids[slot]),
-                                      pred=pred, wstate=wstate)
+                if speculative:
+                    # per-slot mixed accept lengths in the SAME
+                    # iteration: each lane consumes its own emitted
+                    # prefix, stopping early on eos/budget/cancel
+                    for slot in ready:
+                        for tok in emitted.get(slot, ()):
+                            lanes[slot].pos += 1
+                            if not self._lane_accept(lanes, slot,
+                                                     int(tok),
+                                                     pred=pred,
+                                                     wstate=wstate):
+                                break
+                else:
+                    for slot in ready:
+                        lanes[slot].pos += 1
+                        self._lane_accept(lanes, slot, int(ids[slot]),
+                                          pred=pred, wstate=wstate)
                 _occupancy.set(self._active_total)
                 # re-snapshot after evictions so an idle worker reports
                 # zero held tokens, not its last busy state

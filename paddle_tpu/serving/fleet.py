@@ -248,7 +248,8 @@ class _Replica(object):
     __slots__ = ('endpoint', 'client', 'order', 'healthy', 'draining',
                  'fails', 'active', 'capacity', 'queue_depth',
                  'max_len', 'param_version', 'hold_until',
-                 'cache_tokens', 'cache_capacity')
+                 'cache_tokens', 'cache_capacity',
+                 'effective_tokens_per_step', 'spec_accept_rate')
 
     def __init__(self, endpoint, order, timeout):
         self.endpoint = endpoint
@@ -265,6 +266,11 @@ class _Replica(object):
         self.hold_until = 0.0         # brief dispatch backoff (full)
         self.cache_tokens = 0         # tokens held in the KV cache
         self.cache_capacity = None    # total cache tokens (paged)
+        # speculative replicas: mean tokens emitted per decode step
+        # (>= 1.0 once speculation engages; 1.0 == plain decode) and
+        # the measured draft accept rate, both from SRV_HEALTH
+        self.effective_tokens_per_step = 1.0
+        self.spec_accept_rate = None
 
 
 class FleetAutoscaler(object):
@@ -584,7 +590,10 @@ class FleetRouter(object):
                          'active': len(r.active),
                          'capacity': r.capacity,
                          'queue_depth': r.queue_depth,
-                         'param_version': r.param_version}
+                         'param_version': r.param_version,
+                         'effective_tokens_per_step':
+                             r.effective_tokens_per_step,
+                         'spec_accept_rate': r.spec_accept_rate}
                     for ep, r in self._reps.items()}
             return {'replicas': reps,
                     'queue_depth': len(self._hold),
@@ -713,13 +722,18 @@ class FleetRouter(object):
                 if r.endpoint == ep:
                     return r
         return min(elig, key=lambda r: (
-            (len(r.active) + r.queue_depth) / max(1, r.capacity)
-            # cache-pressure term (paged replicas report token
-            # occupancy): two replicas with equal lane counts tie-break
-            # toward the one holding fewer KV tokens, so long streams
-            # spread out instead of stacking onto one page pool
-            + (r.cache_tokens / r.cache_capacity
-               if r.cache_capacity else 0.0),
+            ((len(r.active) + r.queue_depth) / max(1, r.capacity)
+             # cache-pressure term (paged replicas report token
+             # occupancy): two replicas with equal lane counts tie-break
+             # toward the one holding fewer KV tokens, so long streams
+             # spread out instead of stacking onto one page pool
+             + (r.cache_tokens / r.cache_capacity
+                if r.cache_capacity else 0.0))
+            # speculative replicas retire a lane's tokens in fewer
+            # steps: divide the load score by the measured tokens per
+            # step so a high-accept-rate replica absorbs more streams
+            # (neutral 1.0 for plain replicas keeps the old ordering)
+            / max(1.0, r.effective_tokens_per_step),
             r.order))
 
     def _poll_streams(self):
@@ -864,6 +878,12 @@ class FleetRouter(object):
                 rep.cache_tokens = int(h.get('cache_tokens', 0))
                 rep.cache_capacity = (h.get('cache_capacity')
                                       or rep.cache_capacity)
+                eff = h.get('effective_tokens_per_step')
+                # a replica that has not decoded yet reports 0.0 — keep
+                # the neutral weight until speculation actually engages
+                rep.effective_tokens_per_step = (float(eff)
+                                                 if eff else 1.0)
+                rep.spec_accept_rate = h.get('spec_accept_rate')
                 rep.healthy = True
         now = time.monotonic()
         snap = self.admission_snapshot()

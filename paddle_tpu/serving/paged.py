@@ -66,11 +66,18 @@ class PagedDecodePredictor(DecodePredictor):
     paged = True
 
     def __init__(self, predictor, slots=None, page_tokens=None,
-                 kv_pages=None, prefill_chunk=None, _clone_of=None):
+                 kv_pages=None, prefill_chunk=None, _clone_of=None,
+                 pair=None):
+        """With `pair` (an already-transpiled PagedDecodePair) the
+        transpile is skipped — the speculative path builds its target
+        and draft pairs in one transpile_spec and hands them here."""
         self._base = predictor
         if _clone_of is not None:
             self._pair = _clone_of._pair
             self._weight_scope = _clone_of._weight_scope
+        elif pair is not None:
+            self._pair = pair
+            self._weight_scope = predictor._scope
         else:
             from ..transpiler.decode_transpiler import DecodeTranspiler
             slots = int(slots or get_flag('serving_slots'))

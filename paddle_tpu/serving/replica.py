@@ -201,6 +201,13 @@ class ReplicaServer(object):
                # than one full of 16-token streams)
                'cache_tokens': stats.get('cache_tokens', 0),
                'cache_capacity': stats.get('cache_capacity'),
+               # speculative replicas emit >1 token per step on
+               # average: the router divides its load score by this so
+               # a high-accept-rate replica looks proportionally roomier
+               'effective_tokens_per_step':
+                   stats.get('effective_tokens_per_step'),
+               'spec_accept_rate':
+                   stats.get('spec', {}).get('accept_rate'),
                'draining': self._draining}
         if with_digests:
             out['digests'] = self._srv.param_digests()

@@ -23,10 +23,11 @@ from __future__ import annotations
 from ..models.transformer import (DecodeSpec, build_prefill_program,
                                   build_decode_program,
                                   build_paged_prefill_program,
-                                  build_paged_decode_program)
+                                  build_paged_decode_program,
+                                  build_verify_program)
 
 __all__ = ['DecodeTranspileError', 'DecodePair', 'PagedDecodePair',
-           'DecodeTranspiler', 'extract_decode_spec']
+           'SpecDecodePair', 'DecodeTranspiler', 'extract_decode_spec']
 
 
 class DecodeTranspileError(ValueError):
@@ -90,6 +91,49 @@ class PagedDecodePair(DecodePair):
     @property
     def pool_shape(self):
         return self.spec.pool_shape(self.num_pages, self.page_tokens)
+
+
+class SpecDecodePair(object):
+    """Speculative transpile result: the TARGET PagedDecodePair plus a
+    verify program over K1 = spec_k + 1 rows per slot, and a DRAFT
+    PagedDecodePair — either transpiled from an explicit draft program
+    (its own weights) or a self-draft: the target spec truncated to its
+    first `draft_layers` blocks, whose parameter names are a subset of
+    the target's, so the SAME weight scope serves both models with zero
+    extra weight HBM. The verify program binds the target's pool var
+    names, so target prefill / decode / verify share one cache scope;
+    the draft pair's pools live in the draft predictor's own scope."""
+
+    def __init__(self, target, draft, spec_k, verify_program,
+                 verify_feeds, verify_fetches, self_draft):
+        self.target = target
+        self.draft = draft
+        self.spec_k = int(spec_k)
+        self.verify_program = verify_program
+        self.verify_feeds = verify_feeds
+        self.verify_fetches = verify_fetches
+        self.self_draft = bool(self_draft)
+
+    @property
+    def spec(self):
+        return self.target.spec
+
+
+def _truncate_spec(spec, draft_layers):
+    """Self-draft spec: the target's first `draft_layers` blocks with
+    the same embedding / final-norm / head names."""
+    draft_layers = int(draft_layers)
+    if not 1 <= draft_layers <= spec.layers:
+        raise DecodeTranspileError(
+            'spec_draft_layers %d outside [1, %d] (target layers)'
+            % (draft_layers, spec.layers))
+    return DecodeSpec(vocab=spec.vocab, dim=spec.dim, heads=spec.heads,
+                      layers=draft_layers, ffn=spec.ffn,
+                      max_len=spec.max_len, pos_len=spec.pos_len,
+                      emb_w=spec.emb_w, pos_w=spec.pos_w,
+                      blocks=spec.blocks[:draft_layers],
+                      final_ln=spec.final_ln, head=spec.head,
+                      use_flash=spec.use_flash)
 
 
 def _fail(msg):
@@ -209,6 +253,50 @@ class DecodeTranspiler(object):
         dp, df, dv = build_decode_program(spec, slots)
         return DecodePair(spec, slots, prefill_batch,
                           pp, pf, pv, dp, df, dv)
+
+    def transpile_spec(self, program, draft_program=None, slots=8,
+                       spec_k=None, draft_layers=None, page_tokens=None,
+                       kv_pages=None, prefill_chunk=None):
+        """Speculative-decoding transpile: target program (+ optional
+        draft program) -> SpecDecodePair. With no draft_program the
+        draft is a SELF-draft — the target truncated to its first
+        `draft_layers` (default FLAGS_spec_draft_layers) transformer
+        blocks, sharing the target's weight scope. spec_k defaults from
+        FLAGS_spec_k. The draft pair reuses the target's page geometry
+        so both sides price the same window."""
+        from ..flags import get_flag
+        spec_k = int(spec_k if spec_k is not None else get_flag('spec_k'))
+        if spec_k < 1:
+            raise ValueError('spec_k must be >= 1, got %r' % spec_k)
+        target = self.transpile(program, slots=slots, paged=True,
+                                page_tokens=page_tokens,
+                                kv_pages=kv_pages,
+                                prefill_chunk=prefill_chunk)
+        spec = target.spec
+        if draft_program is not None:
+            draft_spec = extract_decode_spec(draft_program)
+            if draft_spec.vocab != spec.vocab:
+                raise DecodeTranspileError(
+                    'draft vocab %d != target vocab %d — proposals '
+                    'would not index the target logits'
+                    % (draft_spec.vocab, spec.vocab))
+            if draft_spec.max_len < spec.max_len:
+                raise DecodeTranspileError(
+                    'draft max_len %d < target max_len %d — the draft '
+                    'cannot cover the target window'
+                    % (draft_spec.max_len, spec.max_len))
+        else:
+            draft_spec = _truncate_spec(
+                spec, draft_layers if draft_layers is not None
+                else get_flag('spec_draft_layers'))
+        draft = self._transpile_paged(draft_spec, target.slots,
+                                      target.page_tokens, kv_pages,
+                                      prefill_chunk)
+        vp, vf, vv = build_verify_program(
+            spec, target.slots, spec_k + 1, target.num_pages,
+            target.page_tokens, target.pages_per_slot)
+        return SpecDecodePair(target, draft, spec_k, vp, vf, vv,
+                              self_draft=draft_program is None)
 
     def _transpile_paged(self, spec, slots, page_tokens, kv_pages,
                          prefill_chunk):

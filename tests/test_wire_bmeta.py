@@ -1,0 +1,124 @@
+"""Binary wire metas (frame version 3, FLAGS_wire_binary_meta).
+
+The contract:
+- the tag codec (bm_dumps/bm_loads) round-trips everything
+  json.dumps(meta) can carry — with JSON's semantics (dict keys
+  stringified) — plus raw bytes, and rejects corrupt buffers with the
+  framing's typed FrameCorruptError
+- version-3 frames carry the same payloads as version 2; readers
+  (read_msg AND the journal scanner) accept both unconditionally, so
+  a journal interleaving both versions replays fine
+- the upgrade is NEGOTIATED per connection: a flag-on sender keeps
+  emitting version-2 JSON metas (with a one-key 'bmeta' capability
+  advert) until the peer proves it speaks v3 — an old peer that never
+  adverts keeps the connection on JSON forever, and a flag-off sender
+  never adverts at all
+"""
+import socket
+
+import numpy as np
+import pytest
+
+from paddle_tpu import flags
+from paddle_tpu.distributed import wire
+
+
+@pytest.fixture
+def bmeta_flag():
+    old = flags.get_flag('wire_binary_meta')
+    yield
+    flags.set_flags({'FLAGS_wire_binary_meta': old})
+
+
+def _version_byte(sock):
+    # u32 crc | u32 body_len | u8 version — peek, don't consume
+    raw = sock.recv(9, socket.MSG_PEEK)
+    assert len(raw) == 9
+    return raw[8]
+
+
+def test_bm_codec_json_semantics_round_trip():
+    meta = {'seq': 7, 'name': 'w@2', 'ok': True, 'off': False,
+            'none': None, 'f': -1.5, 'big': 2 ** 40, 'neg': -3,
+            'list': [1, 'two', [3.0, False, None]],
+            'nested': {'a': {'b': 'c'}, 'd': [1, 2]},
+            'uni': 'héllo ✓'}
+    assert wire.bm_loads(wire.bm_dumps(meta)) == meta
+    # JSON key semantics: non-string keys are stringified
+    assert wire.bm_loads(wire.bm_dumps({1: 'x'})) == {'1': 'x'}
+    # beyond JSON: raw bytes survive (digest metas need this)
+    out = wire.bm_loads(wire.bm_dumps({'dig': b'\x00\xff\x01'}))
+    assert out['dig'] == b'\x00\xff\x01'
+
+
+def test_bm_codec_rejects_corrupt_buffers():
+    with pytest.raises(wire.FrameCorruptError):
+        wire.bm_loads(b'\xee\x00\x00\x00\x00')       # unknown tag
+    with pytest.raises(wire.FrameCorruptError):
+        wire.bm_loads(wire.bm_dumps({'a': 1}) + b'\x01')  # trailing
+
+
+def test_v3_frames_round_trip_and_mix_with_v2_in_one_buffer():
+    val = np.arange(6, dtype='f4').reshape(2, 3)
+    buf = (wire.pack_msg(wire.REPLY_OK, {'seq': 1})
+           + wire.pack_msg(wire.REPLY_VAR, {'seq': 2, 'name': 'w'},
+                           value=val,
+                           version=wire.WIRE_VERSION_BMETA)
+           + wire.pack_msg(wire.REPLY_OK, {'seq': 3}))
+    msgs = list(wire.unpack_msgs(buf))
+    assert [m[0] for m in msgs] == [wire.REPLY_OK, wire.REPLY_VAR,
+                                    wire.REPLY_OK]
+    assert [m[1]['seq'] for m in msgs] == [1, 2, 3]
+    assert msgs[1][1]['name'] == 'w'
+    assert np.array_equal(msgs[1][2], val)
+
+
+def test_negotiated_upgrade_and_flag_off_default(bmeta_flag):
+    flags.set_flags({'FLAGS_wire_binary_meta': False})
+    a, b = socket.socketpair()
+    try:
+        # flag off: plain v2, no capability advert
+        wire.write_msg(a, wire.REPLY_OK, {'seq': 0})
+        assert _version_byte(b) == wire.WIRE_VERSION
+        _t, meta, _v = wire.read_msg(b)
+        assert 'bmeta' not in meta
+
+        flags.set_flags({'FLAGS_wire_binary_meta': True})
+        # first flag-on send: peer unproven -> still v2, adverts
+        wire.write_msg(a, wire.REPLY_OK, {'seq': 1})
+        assert _version_byte(b) == wire.WIRE_VERSION
+        _t, meta, _v = wire.read_msg(b)
+        assert meta['seq'] == 1 and meta.get('bmeta') == 1
+        # b saw the advert: its reply upgrades to v3
+        wire.write_msg(b, wire.REPLY_OK, {'seq': 2})
+        assert _version_byte(a) == wire.WIRE_VERSION_BMETA
+        _t, meta, _v = wire.read_msg(a)
+        assert meta == {'seq': 2}
+        # a saw a v3 frame: the connection is now v3 both ways
+        wire.write_msg(a, wire.REPLY_OK, {'seq': 3})
+        assert _version_byte(b) == wire.WIRE_VERSION_BMETA
+        assert wire.read_msg(b)[1] == {'seq': 3}
+    finally:
+        a.close()
+        b.close()
+
+
+def test_old_peer_keeps_connection_on_json(bmeta_flag):
+    flags.set_flags({'FLAGS_wire_binary_meta': True})
+    a, b = socket.socketpair()
+    try:
+        wire.write_msg(a, wire.REPLY_OK, {'seq': 1})
+        _t, meta, _v = wire.read_msg(b)
+        assert meta.get('bmeta') == 1
+        # an old peer ignores the advert and answers plain v2 (raw
+        # pack_msg, the pre-v3 binary's only wire format)
+        b.sendall(wire.pack_msg(wire.REPLY_OK, {'seq': 2}))
+        _t, meta, _v = wire.read_msg(a)
+        assert meta == {'seq': 2}
+        # no proof the peer speaks v3 -> a stays on JSON + advert
+        wire.write_msg(a, wire.REPLY_OK, {'seq': 3})
+        assert _version_byte(b) == wire.WIRE_VERSION
+        assert wire.read_msg(b)[1].get('bmeta') == 1
+    finally:
+        a.close()
+        b.close()

@@ -386,15 +386,17 @@ _SERVING_QUICK = [None]     # serve_bench --quick, measured at most once
 
 def _serving_quick():
     """Headline serving numbers (tools/serve_bench.py --quick
-    --refresh --fleet --paged) stamped onto the transformer
+    --refresh --fleet --paged --spec) stamped onto the transformer
     local-mode row: the cached-vs-recompute decode speedup, the
     online-refresh tail cost (refresh_p99_ratio — token p99 with a
     live ParamSubscriber install loop over the undisturbed p99), the
     fleet leg (fleet_tokens_per_sec / fleet_p99_ttft_ms through a
     FleetRouter over 2 replica subprocesses — perf_gate infers the
-    direction from each suffix), and the paged-cache A/B
+    direction from each suffix), the paged-cache A/B
     (paged_tokens_per_sec / paged_max_streams at dense-equal HBM,
-    prefix_hit_ttft_ms). One subprocess, cached across invocations;
+    prefix_hit_ttft_ms), and the speculative-decoding A/B
+    (spec_tokens_per_sec / spec_accept_rate vs plain paged decode at
+    equal HBM). One subprocess, cached across invocations;
     {} on any failure."""
     if _SERVING_QUICK[0] is None:
         try:
@@ -403,7 +405,7 @@ def _serving_quick():
                 [sys.executable,
                  os.path.join(os.path.dirname(os.path.abspath(__file__)),
                               'serve_bench.py'), '--quick', '--refresh',
-                 '--fleet', '--paged'],
+                 '--fleet', '--paged', '--spec'],
                 capture_output=True, text=True, timeout=600, env=env)
             line = [ln for ln in out.stdout.splitlines()
                     if ln.startswith('{') and '"summary"' in ln][-1]

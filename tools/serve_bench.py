@@ -334,6 +334,125 @@ def _paged_leg(pred, cfg, quick):
             'prefix_hit_ttft_ms': round(warm_ttft * 1e3, 2)}
 
 
+def _spec_leg(cfg, quick):
+    """Speculative-decoding A/B leg at EQUAL cache HBM: plain paged
+    greedy decode vs draft/verify speculation over the same page-pool
+    machinery (serving/speculative.py), measuring steady-state decode
+    tokens/s over full slot pools.
+
+    The model is a deeper variant of the bench config whose tail
+    blocks' residual contributions (attention proj + FFN down) are
+    zeroed — a stand-in for a well-distilled draft: the
+    FLAGS_spec_draft_layers-deep self-draft then AGREES with the
+    target, so the leg exercises the high-accept regime the
+    optimization targets while the accept rate stays MEASURED, not
+    assumed (nothing in the harness forces acceptance — the verify
+    pass scores every proposal). Equal HBM: the draft cache costs
+    pages * draft_layers/target_layers extra, so the plain baseline's
+    pool gets that many more pages instead."""
+    from paddle_tpu.models import transformer as tfm
+    from paddle_tpu.transpiler.decode_transpiler import \
+        extract_decode_spec
+
+    layers = 2 if quick else 4
+    draft_layers = 1
+    spec_k = 3 if quick else 4
+    slots = 4 if quick else 8
+    scfg = tfm.TransformerConfig(vocab=cfg.vocab, dim=cfg.dim,
+                                 heads=cfg.heads, layers=layers,
+                                 ffn=cfg.ffn, max_len=cfg.max_len,
+                                 use_tp=False, use_sp=False)
+    label = 'L%d_D%d_T%d' % (scfg.layers, scfg.dim, scfg.max_len)
+    spred = _build_predictor(scfg)
+    dspec = extract_decode_spec(spred._program)
+    for blk in dspec.blocks[draft_layers:]:
+        for w, b in (blk['proj'], blk['down']):
+            for name in (w, b):
+                if name is None:
+                    continue
+                old = np.asarray(spred._scope.find_var(name))
+                spred._scope.set_var(name, np.zeros_like(old))
+
+    pt = max(2, scfg.max_len // 8)
+    pages_per_slot = -(-scfg.max_len // pt)
+    spec_pages = slots * pages_per_slot + 1
+    # plain baseline absorbs the draft pool's HBM as extra target pages
+    plain_pages = (slots * pages_per_slot
+                   + -(-slots * pages_per_slot * draft_layers // layers)
+                   + 1)
+    rng = np.random.RandomState(9)
+    prompts = [list(rng.randint(1, scfg.vocab, 2)) for _ in range(slots)]
+    iters = scfg.max_len - 4
+
+    plain = spred.prepare_decoding(slots=slots, paged=True,
+                                   page_tokens=pt, kv_pages=plain_pages,
+                                   prefill_chunk=scfg.max_len)
+    ids = plain.prefill(prompts, list(range(slots)))
+    toks = np.asarray(ids, np.int64)
+    pos = np.array([len(p) for p in prompts], np.int32)
+    plain.decode_step(toks, pos)        # compile outside the window
+    plain.reset()
+    ids = plain.prefill(prompts, list(range(slots)))
+    toks = np.asarray(ids, np.int64)
+    pos = np.array([len(p) for p in prompts], np.int32)
+    total_p, t_p = 0, 0.0
+    ref_streams = [[int(t)] for t in toks]
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        ids = plain.decode_step(toks, pos)
+        t_p += time.perf_counter() - t0
+        toks = np.asarray(ids, np.int64)
+        pos += 1
+        total_p += slots
+        for s in range(slots):
+            ref_streams[s].append(int(ids[s]))
+    plain_tps = total_p / t_p
+
+    sdec = spred.prepare_decoding(slots=slots, speculative=True,
+                                  spec_k=spec_k,
+                                  draft_layers=draft_layers,
+                                  page_tokens=pt, kv_pages=spec_pages,
+                                  prefill_chunk=scfg.max_len)
+    ids = sdec.prefill(prompts, list(range(slots)))
+    toks = np.asarray(ids, np.int64)
+    pos = np.array([len(p) for p in prompts], np.int32)
+    sdec.spec_step(toks, pos)           # compile outside the window
+    sdec.reset()
+    ids = sdec.prefill(prompts, list(range(slots)))
+    toks = np.asarray(ids, np.int64)
+    pos = np.array([len(p) for p in prompts], np.int32)
+    total_s, t_s = 0, 0.0
+    spec_streams = [[int(t)] for t in toks]
+    while int(pos.max()) < scfg.max_len - 1:
+        t0 = time.perf_counter()
+        out = sdec.spec_step(toks, pos)
+        t_s += time.perf_counter() - t0
+        for s, emitted in out.items():
+            toks[s] = emitted[-1]
+            pos[s] += len(emitted)
+            total_s += len(emitted)
+            spec_streams[s].extend(int(t) for t in emitted)
+    spec_tps = total_s / t_s
+    # the acceptance rule's guarantee, checked in the harness itself:
+    # speculation changed throughput, not one emitted token
+    for s in range(slots):
+        n = min(len(ref_streams[s]), len(spec_streams[s]))
+        assert spec_streams[s][:n] == ref_streams[s][:n], \
+            'speculative stream %d diverged from plain greedy' % s
+    st = sdec.spec_stats()
+    return {'mode': 'spec', 'config': label, 'slots': slots,
+            'spec_k': spec_k, 'draft_layers': draft_layers,
+            'target_layers': layers, 'page_tokens': pt,
+            'plain_kv_pages': plain_pages, 'spec_kv_pages': spec_pages,
+            'plain_paged_tokens_per_sec': round(plain_tps, 2),
+            'spec_tokens_per_sec': round(spec_tps, 2),
+            'spec_accept_rate': round(st['accept_rate'], 4),
+            'spec_effective_tokens_per_step':
+                round(st['effective_tokens_per_step'], 3),
+            'spec_fallback_steps': st['fallback_steps'],
+            'spec_speedup': round(spec_tps / plain_tps, 2)}
+
+
 def _fleet_leg(cfg, quick, replicas=2):
     """Fleet serving leg: `replicas` serve_replica.py subprocesses
     behind an in-process FleetRouter, one concurrent burst through the
@@ -464,6 +583,12 @@ def main():
                          'over 2 replica subprocesses under burst '
                          'load (fleet_tokens_per_sec + '
                          'fleet_p99_ttft_ms in the summary)')
+    ap.add_argument('--spec', action='store_true',
+                    help='add the speculative-decoding A/B leg: '
+                         'draft/verify speculation vs plain paged '
+                         'greedy decode at equal cache HBM '
+                         '(spec_tokens_per_sec, spec_accept_rate, '
+                         'spec_speedup in the summary)')
     ap.add_argument('--iters', type=int, default=20)
     args = ap.parse_args()
     if not args.full:
@@ -546,6 +671,13 @@ def main():
         summary['fleet_tokens_per_sec'] = \
             fleet_row['fleet_tokens_per_sec']
         summary['fleet_p99_ttft_ms'] = fleet_row['fleet_p99_ttft_ms']
+
+    if args.spec:
+        spec_row = _spec_leg(cfg, args.quick)
+        print(json.dumps(spec_row), flush=True)
+        for key in ('spec_tokens_per_sec', 'plain_paged_tokens_per_sec',
+                    'spec_accept_rate', 'spec_speedup'):
+            summary[key] = spec_row[key]
 
     print(json.dumps(summary), flush=True)
     return summary
