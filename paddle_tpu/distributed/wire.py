@@ -95,10 +95,12 @@ GET_VERSION = 14    # serving -> pserver: current param version; with
                     # per-param crc32 digest manifest the subscriber
                     # verifies pulled bytes against
 SRV_SUBMIT = 20     # router -> replica: open a generation stream
-                    # (meta rid/max_new_tokens/eos_id, value = prompt
-                    # token ids). A failover re-submit carries the
-                    # original prompt PLUS the tokens already decoded —
-                    # greedy determinism makes the re-prefilled stream
+                    # (meta rid/max_new_tokens/eos_id + 'prio', the SLO
+                    # tier — higher = more important, absent reads as
+                    # the lowest tier 0; value = prompt token ids). A
+                    # failover re-submit carries the original prompt
+                    # PLUS the tokens already decoded — greedy
+                    # determinism makes the re-prefilled stream
                     # bit-exact with the unkilled one
 SRV_POLL = 21       # router -> replica: progress of meta['rids'];
                     # reply meta['streams'] maps rid -> {state, tokens}

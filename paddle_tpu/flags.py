@@ -112,6 +112,20 @@ Known flags:
   serving_prefill_chunk  chunked prefill: tokens admitted per engine
                          iteration while a prompt prefills, so long
                          prompts never stall live decode lanes
+  serving_preempt_policy paged-cache exhaustion response
+                         (serving/preempt.py): 'swap' preempts the
+                         lowest-tier longest-idle stream and copies its
+                         pages to host RAM (falling back to
+                         drop-and-re-prefill when the host budget is
+                         dry), 'reprefill' always drops pages and
+                         re-prefills from the accumulated tokens on
+                         resume, 'off' restores the legacy behavior
+                         (fail the victim typed; the fleet router
+                         retries it as a shed)
+  serving_swap_host_mb   host-RAM budget (MiB per engine) for swapped
+                         KV pages; a preemption past the budget
+                         degrades to the re-prefill path instead of
+                         growing host memory unboundedly
   ckpt_verify            legacy host checkpoint path (io.py): write a
                          CHECKPOINT_DIGESTS manifest on save_vars and
                          verify it before load_vars, sharing the mesh
@@ -178,6 +192,12 @@ Known flags:
   fleet_deploy_timeout   seconds rolling_deploy() may spend per replica
                          on drain + refresh + health-check before the
                          deploy aborts (the replica is un-drained)
+  fleet_cache_shed_budget  cross-replica retries a stream that FAILED
+                         with CacheExhaustedError gets (the router
+                         requeues it onto a cooler replica) before the
+                         failure is final — bounds the livelock when
+                         the whole fleet is saturated; counted in
+                         fleet.cache_sheds
   spec_k                 speculative decoding (serving/speculative.py):
                          draft proposals per verify pass (the CEILING —
                          the predictor adapts k per slot between 1 and
@@ -316,6 +336,12 @@ _DEFAULTS = {
     'serving_page_tokens': 16,
     'serving_kv_pages': 0,
     'serving_prefill_chunk': 64,
+    # preempt-first capacity (serving/preempt.py): what CacheExhausted
+    # does to the lowest-tier longest-idle stream ('swap' pages to host
+    # RAM, 'reprefill' from accumulated tokens, 'off' = legacy typed
+    # shed), and the host-RAM budget (MiB) for swapped pages
+    'serving_preempt_policy': 'swap',
+    'serving_swap_host_mb': 64,
     # sharded checkpointing (paddle_tpu/checkpoint/): digest-verify the
     # legacy host save/load path, async writer pool size, and the
     # MeshConfig.from_flags axis spec ('dp=2,tp=2'; '' = pure dp)
@@ -356,6 +382,7 @@ _DEFAULTS = {
     'fleet_shed_consecutive': 2,
     'fleet_admission_rules': '',
     'fleet_deploy_timeout': 120.0,
+    'fleet_cache_shed_budget': 5,
     # speculative decoding (serving/speculative.py): max draft
     # proposals per verify pass (adaptive k's ceiling; 0 = off), and
     # the self-draft truncation depth in transformer blocks

@@ -35,6 +35,13 @@ layered on the inference Predictor ABI:
               between decode steps, finished/cancelled slots are
               evicted and masked, worker threads share weights via
               clone(). serving.* telemetry flows into paddle_tpu/obs/.
+- preempt.py  Preempt-first capacity policy: SLO tiers
+              (submit(priority=)), victim selection (lowest tier,
+              longest idle), and a host-RAM swap budget
+              (FLAGS_serving_swap_host_mb) — on pool exhaustion the
+              engine swaps a low-tier stream's pages to host memory
+              (or drops and re-prefills when the budget is dry) and
+              resumes it bit-exactly once pressure clears.
 - api.py      LMServer: the user-facing blocking generate() + async
               submit/poll surface (reference
               inference/api/paddle_inference_api.h PaddlePredictor
@@ -58,6 +65,7 @@ from .paging import CacheExhaustedError, PagePool, PageTable, PrefixCache
 from .paged import PagedDecodePredictor
 from .speculative import DraftModel, SpeculativeDecodePredictor
 from .engine import ServingEngine, Request
+from .preempt import HostSwapBudget
 from .api import LMServer
 from .replica import ReplicaServer
 from .fleet import (FleetRouter, FleetAutoscaler, FleetRequest,
@@ -66,6 +74,6 @@ from .fleet import (FleetRouter, FleetAutoscaler, FleetRequest,
 __all__ = ['DecodePredictor', 'PagedDecodePredictor',
            'DraftModel', 'SpeculativeDecodePredictor',
            'CacheExhaustedError', 'PagePool', 'PageTable', 'PrefixCache',
-           'ServingEngine', 'Request', 'LMServer',
+           'ServingEngine', 'Request', 'HostSwapBudget', 'LMServer',
            'ReplicaServer', 'FleetRouter', 'FleetAutoscaler',
            'FleetRequest', 'OverloadError', 'FleetDeployError']

@@ -110,9 +110,14 @@ class LMServer(object):
                                      eos_id=eos_id, timeout=timeout)
 
     # -- async -------------------------------------------------------------
-    def submit(self, prompt, max_new_tokens=16, eos_id=None):
-        """Enqueue; returns an opaque handle for poll()/result()."""
-        req = self._engine.submit(prompt, max_new_tokens, eos_id=eos_id)
+    def submit(self, prompt, max_new_tokens=16, eos_id=None,
+               priority=0):
+        """Enqueue; returns an opaque handle for poll()/result().
+        priority is the SLO tier (higher = more important, 0 = the
+        default lowest tier — the only tier admission ever rejects;
+        see ServingEngine.submit)."""
+        req = self._engine.submit(prompt, max_new_tokens, eos_id=eos_id,
+                                  priority=priority)
         self._requests[req.id] = req
         return req.id
 

@@ -10,13 +10,24 @@ writes). Worker threads each own a DecodePredictor clone — private
 cache scope + executor, weights shared through the parent Scope — and
 pull from one shared queue.
 
+Requests carry a PRIORITY tier (submit(priority=), higher = more
+important, 0 = the default lowest tier): one queue per tier, popped
+highest-tier first, and the queue-full admission bound applies only to
+the lowest tier. On paged-cache exhaustion the engine preempts the
+lowest-tier longest-idle stream (serving/preempt.py — swap its pages
+to host RAM or drop them for re-prefill) instead of shedding it; the
+victim re-enters the FRONT of its own tier and resumes bit-exact.
+
 Telemetry (paddle_tpu/obs/, exported when FLAGS_obs_dir is set):
   serving.requests.{submitted,admitted,completed,cancelled,rejected,
   failed}  counters; serving.tokens_generated / serving.decode_steps /
   serving.prefills  counters; serving.queue_depth /
   serving.slot_occupancy  gauges; serving.ttft /
   serving.token_latency / serving.decode_batch  histograms (seconds /
-  seconds / active lanes per step).
+  seconds / active lanes per step); plus the preemption set from
+  serving/preempt.py (serving.preemptions / serving.swapped_pages /
+  serving.swap_bytes / serving.resume_latency /
+  serving.preempted_streams).
 """
 from __future__ import annotations
 
@@ -30,7 +41,9 @@ import numpy as np
 
 from ..flags import get_flag
 from ..obs import telemetry
+from . import preempt as _preempt
 from .paging import CacheExhaustedError
+from .preempt import HostSwapBudget, pick_victim, preempt_policy
 
 __all__ = ['Request', 'ServingEngine']
 
@@ -106,18 +119,23 @@ class _StepGate(object):
 
 class Request(object):
     """One generation request. tokens grows as the stream decodes;
-    wait() blocks until a terminal state (DONE/CANCELLED/FAILED)."""
+    wait() blocks until a terminal state (DONE/CANCELLED/FAILED).
+    priority is the SLO tier (higher = more important, 0 = the default
+    lowest tier — the only tier queue-full admission rejects)."""
 
     _ids = itertools.count()
 
-    def __init__(self, prompt, max_new_tokens, eos_id):
+    def __init__(self, prompt, max_new_tokens, eos_id, priority=0):
         self.id = next(Request._ids)
         self.prompt = [int(t) for t in np.asarray(prompt).reshape(-1)]
         self.max_new_tokens = int(max_new_tokens)
         self.eos_id = eos_id
+        self.priority = int(priority)
         self.state = QUEUED
         self.tokens = []
         self.error = None
+        self.snapshot = None          # swapped pages while preempted
+        self.preempted_at = None      # set while waiting to resume
         self.submitted_at = time.perf_counter()
         self.first_token_at = None
         self.done_at = None
@@ -148,12 +166,15 @@ class _Lane(object):
     """One occupied slot: the request plus the position its NEXT token
     will be appended at (== absolute position of the token being fed).
     `ready` is False while a paged stream is still prefilling in
-    chunks — the lane occupies its slot but sits out decode steps."""
-    __slots__ = ('req', 'pos', 'tok', 'ready')
+    chunks — the lane occupies its slot but sits out decode steps.
+    `last_active` (last accepted-token time) is the idleness key the
+    preemption policy sorts victims by within a tier."""
+    __slots__ = ('req', 'pos', 'tok', 'ready', 'last_active')
 
     def __init__(self, req, pos, tok, ready=True):
         self.req, self.pos, self.tok = req, pos, tok
         self.ready = ready
+        self.last_active = time.perf_counter()
 
 
 class ServingEngine(object):
@@ -170,7 +191,7 @@ class ServingEngine(object):
         self._idle_wait = float(idle_wait
                                 if idle_wait is not None
                                 else get_flag('serving_idle_wait'))
-        self._queue = collections.deque()
+        self._queues = {}             # priority tier -> deque
         self._cond = threading.Condition()
         self._running = False
         self._threads = []
@@ -181,6 +202,10 @@ class ServingEngine(object):
         self._gate = _StepGate()
         self._swaps = 0
         self._slot_tokens = {}        # worker idx -> {slot: tokens held}
+        self._swap_budget = HostSwapBudget()
+        self._preempted = 0           # streams waiting to resume
+        self._preemptions_n = 0
+        self._resumes_n = 0
 
     # -- lifecycle ---------------------------------------------------------
     def start(self):
@@ -211,12 +236,12 @@ class ServingEngine(object):
         whether to escalate). On a never-started engine the queue has
         no one to drain it: returns immediately."""
         if not self._threads:
-            return not self._queue and not self._inflight
+            return not self._qsize_locked() and not self._inflight
         deadline = None if timeout is None \
             else time.monotonic() + timeout
         while True:
             with self._cond:
-                if not self._queue and not self._inflight:
+                if not self._qsize_locked() and not self._inflight:
                     return True
             if deadline is not None and time.monotonic() >= deadline:
                 return False
@@ -236,10 +261,12 @@ class ServingEngine(object):
             clean = self.drain(timeout)
         with self._cond:
             if not drain or not clean:
-                while self._queue:
-                    req = self._queue.popleft()
-                    req._finish(CANCELLED)
-                    _cancelled.inc()
+                for q in self._queues.values():
+                    while q:
+                        req = q.popleft()
+                        self._forget_preempted(req)
+                        req._finish(CANCELLED)
+                        _cancelled.inc()
                 if not clean:
                     # running lanes notice the CANCELLED state at the
                     # next step boundary and evict (cancel() semantics)
@@ -274,7 +301,12 @@ class ServingEngine(object):
         self.stop(drain=not any(exc))
 
     # -- client surface ----------------------------------------------------
-    def submit(self, prompt, max_new_tokens=16, eos_id=None):
+    def submit(self, prompt, max_new_tokens=16, eos_id=None,
+               priority=0):
+        """priority: SLO tier, higher = more important (default 0 =
+        the lowest tier). Tiers dequeue highest-first, and the
+        queue-full rejection applies only to the lowest tier — shed
+        rules cost low-tier latency, never high-tier admission."""
         prompt = np.asarray(prompt).reshape(-1)
         max_len = self._predictors[0].max_len
         if not 1 <= prompt.size <= max_len:
@@ -284,19 +316,19 @@ class ServingEngine(object):
         if max_new_tokens < 1:
             _rejected.inc()
             raise ValueError('max_new_tokens must be >= 1')
-        req = Request(prompt, max_new_tokens, eos_id)
+        req = Request(prompt, max_new_tokens, eos_id,
+                      priority=priority)
         with self._cond:
             if self._running and not self._accepting:
                 _rejected.inc()
                 raise RuntimeError(
                     'serving engine is draining — submission rejected')
-            if len(self._queue) >= self._max_queue:
+            if req.priority <= 0 and \
+                    self._qsize_locked() >= self._max_queue:
                 _rejected.inc()
                 raise RuntimeError('serving queue full (%d)'
                                    % self._max_queue)
-            self._queue.append(req)
-            _queue_depth.set(len(self._queue))
-            self._cond.notify_all()
+            self._push_locked(req)
         _submitted.inc()
         return req
 
@@ -335,7 +367,8 @@ class ServingEngine(object):
 
     def stats(self):
         with self._cond:
-            depth = len(self._queue)
+            depth = self._qsize_locked()
+            preempted = self._preempted
         p0 = self._predictors[0]
         paged = getattr(p0, 'paged', False)
         slot_tokens = [dict(self._slot_tokens.get(i, {}))
@@ -344,6 +377,13 @@ class ServingEngine(object):
                'workers': len(self._predictors),
                'slots_per_worker': p0.slots,
                'weight_swaps': self._swaps,
+               # preempt-first capacity (serving/preempt.py): lifetime
+               # preemptions/resumes, streams currently swapped out or
+               # waiting to re-prefill, and host RAM held by swaps
+               'preemptions': self._preemptions_n,
+               'resumes': self._resumes_n,
+               'preempted_streams': preempted,
+               'swap_host_bytes': self._swap_budget.used_bytes,
                'paged': paged,
                # per-worker {slot: tokens held} — actual cache pressure,
                # so the fleet router's least-loaded dispatch can weigh
@@ -391,17 +431,95 @@ class ServingEngine(object):
         return out
 
     # -- scheduler ---------------------------------------------------------
+    def _qsize_locked(self):
+        return sum(len(q) for q in self._queues.values())
+
+    def _push_locked(self, req, front=False):
+        """Enqueue into the request's own tier (front=True: a
+        requeued exhaustion victim or preempted stream resumes ahead
+        of its tier's waiting admissions — but never jumps a higher
+        tier, which is always drained first)."""
+        q = self._queues.get(req.priority)
+        if q is None:
+            q = self._queues[req.priority] = collections.deque()
+        if front:
+            q.appendleft(req)
+        else:
+            q.append(req)
+        _queue_depth.set(self._qsize_locked())
+        self._cond.notify_all()
+
     def _pop_next(self):
         with self._cond:
-            while self._queue:
-                req = self._queue.popleft()
-                _queue_depth.set(len(self._queue))
-                if req.state == CANCELLED:
-                    req._finish(CANCELLED)
-                    _cancelled.inc()
-                    continue
-                return req
+            for prio in sorted(self._queues, reverse=True):
+                q = self._queues[prio]
+                while q:
+                    req = q.popleft()
+                    _queue_depth.set(self._qsize_locked())
+                    if req.state == CANCELLED:
+                        self._forget_preempted(req)
+                        req._finish(CANCELLED)
+                        _cancelled.inc()
+                        continue
+                    return req
         return None
+
+    # -- preemption (serving/preempt.py) -----------------------------------
+    def _forget_preempted(self, req):
+        """A preempted request leaving the queue for a terminal state:
+        give back its host budget and the preempted-streams gauge."""
+        snap, req.snapshot = req.snapshot, None
+        if snap is not None:
+            self._swap_budget.release(snap['nbytes'])
+        if req.preempted_at is not None:
+            req.preempted_at = None
+            with self._cond:
+                self._preempted -= 1
+            _preempt.preempted_streams.set(self._preempted)
+
+    def _resume(self, req):
+        """Preempt -> back-in-a-slot accounting (the request is being
+        re-admitted; its snapshot, if any, was already restored)."""
+        if req.preempted_at is None:
+            return
+        _preempt.resume_latency.observe(time.perf_counter()
+                                        - req.preempted_at)
+        req.preempted_at = None
+        with self._cond:
+            self._preempted -= 1
+            self._resumes_n += 1
+        _preempt.preempted_streams.set(self._preempted)
+
+    def _preempt_lane(self, pred, lanes, slot, wstate, policy):
+        """Preempt one READY lane: swap its pages to pinned host memory
+        (budget permitting) or drop them for re-prefill, release the
+        slot, and requeue the request at the FRONT of its own tier.
+        Admission then waits (cache_wait) until a live stream releases
+        pages, so the victim cannot immediately steal back what it
+        just gave up."""
+        lane = lanes.pop(slot)
+        req = lane.req
+        snap = None
+        if policy == 'swap':
+            snap = pred.save_stream(slot)
+            if self._swap_budget.reserve(snap['nbytes']):
+                _preempt.swapped_pages.inc(snap['pages'])
+                _preempt.swap_bytes.inc(snap['nbytes'])
+            else:
+                snap = None       # host budget dry: re-prefill instead
+        pred.release(slot)
+        self._inflight.pop(req.id, None)
+        self._active_total -= 1
+        with self._cond:
+            self._preempted += 1
+            self._preemptions_n += 1
+            req.state = QUEUED
+            req.snapshot = snap
+            req.preempted_at = time.perf_counter()
+            self._push_locked(req, front=True)
+        _preempt.preemptions.inc()
+        _preempt.preempted_streams.set(self._preempted)
+        wstate['cache_wait'] = True
 
     def _finish_lane(self, lanes, slot, state, error=None, pred=None,
                      wstate=None):
@@ -441,6 +559,7 @@ class ServingEngine(object):
                               wstate=wstate)
             return False
         lane.tok = int(tok)
+        lane.last_active = time.perf_counter()
         return True
 
     def _admit(self, pred, lanes):
@@ -482,7 +601,15 @@ class ServingEngine(object):
         admission itself can never exhaust the pool) and queue it for
         chunked prefill. While cache_wait is set, a requeued
         exhaustion victim is waiting for a live stream to release
-        pages — admitting more streams would only deepen the hole."""
+        pages — admitting more streams would only deepen the hole.
+
+        A resuming PREEMPTED stream takes one of two paths: a swap
+        snapshot restores its pages device-side before the next decode
+        step it joins (bit-exact — float32 bytes round-trip exactly);
+        without one, the stream re-prefills (prompt + tokens so far),
+        and the final chunk's output token IS its next stream token —
+        the fleet-failover contract, equally bit-exact by greedy
+        determinism."""
         if wstate['cache_wait'] and lanes:
             return
         wstate['cache_wait'] = False
@@ -492,18 +619,56 @@ class ServingEngine(object):
             if req is None:
                 break
             slot = free.pop(0)
+            # a resumed stream continues from its accumulated tokens;
+            # a fresh one has none and seq is just its prompt
+            seq = req.prompt + req.tokens
+            if req.snapshot is not None:
+                try:
+                    pred.restore_stream(slot, req.snapshot, prompt=seq)
+                except CacheExhaustedError:
+                    if lanes:
+                        # pool still too tight: back to the tier front
+                        # until a live stream releases
+                        with self._cond:
+                            self._push_locked(req, front=True)
+                        wstate['cache_wait'] = True
+                        return
+                    # nothing live will ever free pages for this
+                    # snapshot: drop it and re-prefill instead (the
+                    # pool may fit a chunked prefill it cannot fit
+                    # whole)
+                    self._swap_budget.release(req.snapshot['nbytes'])
+                    req.snapshot = None
+                except Exception as e:  # noqa: BLE001 — lane-fatal
+                    self._forget_preempted(req)
+                    req._finish(FAILED, error=repr(e))
+                    _failed.inc()
+                    continue
+                else:
+                    self._swap_budget.release(req.snapshot['nbytes'])
+                    req.snapshot = None
+                    self._resume(req)
+                    req.state = RUNNING
+                    self._inflight[req.id] = req
+                    self._active_total += 1
+                    lanes[slot] = _Lane(req, pos=len(seq) - 1,
+                                        tok=req.tokens[-1])
+                    _admitted.inc()
+                    continue
             req.state = RUNNING
             self._inflight[req.id] = req
             self._active_total += 1
             try:
-                pred.open_stream(slot, req.prompt)
+                pred.open_stream(slot, seq)
             except Exception as e:  # noqa: BLE001 — lane-fatal only
+                self._forget_preempted(req)
                 self._inflight.pop(req.id, None)
                 req._finish(FAILED, error=repr(e))
                 self._active_total -= 1
                 _failed.inc()
                 continue
-            lanes[slot] = _Lane(req, pos=len(req.prompt), tok=0,
+            self._resume(req)
+            lanes[slot] = _Lane(req, pos=len(seq), tok=0,
                                 ready=False)
             prefilling.append(slot)
             _admitted.inc()
@@ -512,11 +677,14 @@ class ServingEngine(object):
         """Advance chunked prefill by AT MOST one chunk per engine
         iteration — the head-of-line bound: a 4k-token prompt costs
         the live decode lanes one chunk's latency per step, never a
-        whole-prompt stall. Pool exhaustion mid-prefill is a shed with
-        retry: pages go back, the request requeues at the FRONT, and
-        admission pauses until a live stream releases (with no live
-        stream left to wait on, the request can never fit and fails
-        with the typed error)."""
+        whole-prompt stall. Pool exhaustion mid-prefill first tries to
+        PREEMPT a strictly lower-tier ready lane (the prefilling
+        stream keeps its slot and retries the same chunk next
+        iteration); with no lower-tier victim, it requeues at the
+        front of its OWN tier — never jumping a higher tier's waiting
+        admissions — and admission pauses until a live stream releases
+        (with no live stream left to wait on, the request can never
+        fit and fails with the typed error)."""
         while prefilling:
             slot = prefilling[0]
             lane = lanes.get(slot)
@@ -533,6 +701,16 @@ class ServingEngine(object):
                 out = pred.prefill_step(slot)
             except CacheExhaustedError as e:
                 _cache_exhausted.inc()
+                policy = preempt_policy()
+                if policy != 'off':
+                    victim = pick_victim(lanes, below=req.priority)
+                    if victim is not None:
+                        # a lower-tier stream gives way; this prefill
+                        # keeps its slot and retries the same chunk
+                        # next iteration
+                        self._preempt_lane(pred, lanes, victim,
+                                           wstate, policy)
+                        return
                 prefilling.popleft()
                 lanes.pop(slot)
                 pred.release(slot)
@@ -541,8 +719,7 @@ class ServingEngine(object):
                 if lanes:
                     req.state = QUEUED
                     with self._cond:
-                        self._queue.appendleft(req)
-                        _queue_depth.set(len(self._queue))
+                        self._push_locked(req, front=True)
                     wstate['cache_wait'] = True
                 else:
                     req._finish(FAILED,
@@ -576,9 +753,11 @@ class ServingEngine(object):
         positions = np.zeros((pred.slots,), np.int32)
         while True:
             with self._cond:
-                while self._running and not self._queue and not lanes:
+                while self._running and not self._qsize_locked() \
+                        and not lanes:
                     self._cond.wait(self._idle_wait)
-                if not self._running and not self._queue and not lanes:
+                if not self._running and not self._qsize_locked() \
+                        and not lanes:
                     return
             # one gate-read section per iteration: a waiting weight
             # swap (request_swap) runs between iterations — i.e. at a
@@ -605,17 +784,41 @@ class ServingEngine(object):
                     else:
                         ids = pred.decode_step(tokens, positions)
                 except CacheExhaustedError as e:
-                    # the pool cannot grow the named victims while they
-                    # and every other lane stay live: fail them typed
-                    # (the fleet router retries them as a shed); the
-                    # survivors retry the identical step next iteration
+                    # preempt-first (serving/preempt.py): instead of
+                    # failing the named victims, the lowest-tier
+                    # longest-idle stream gives its pages back (swap or
+                    # drop) and every survivor retries the IDENTICAL
+                    # step next iteration — the transactional rollback
+                    # already undid this call's allocations, so the
+                    # retry is bit-exact. policy 'off' restores the
+                    # legacy typed shed (the fleet router retries it
+                    # cross-replica).
                     _cache_exhausted.inc()
-                    for slot in e.slots:
-                        if slot in lanes:
-                            self._finish_lane(
-                                lanes, slot, FAILED,
-                                error='CacheExhaustedError: %s' % e,
-                                pred=pred, wstate=wstate)
+                    policy = preempt_policy()
+                    preempted = False
+                    if policy != 'off':
+                        for slot in list(e.slots):
+                            lane = lanes.get(slot)
+                            if lane is not None and \
+                                    lane.pos + 1 > pred.window:
+                                # outgrew its own page window: no
+                                # preemption can ever make it fit
+                                self._finish_lane(
+                                    lanes, slot, FAILED,
+                                    error='CacheExhaustedError: %s' % e,
+                                    pred=pred, wstate=wstate)
+                        victim = pick_victim(lanes)
+                        if victim is not None:
+                            self._preempt_lane(pred, lanes, victim,
+                                               wstate, policy)
+                            preempted = True
+                    if not preempted:
+                        for slot in e.slots:
+                            if slot in lanes:
+                                self._finish_lane(
+                                    lanes, slot, FAILED,
+                                    error='CacheExhaustedError: %s' % e,
+                                    pred=pred, wstate=wstate)
                     continue
                 except Exception as e:   # noqa: BLE001 — engine survives
                     for slot in ready:

@@ -10,7 +10,12 @@ dispatch    queue-depth/occupancy-aware: a held request goes to the
             in-flight count + the replica's own serving.queue_depth
             from the SRV_HEALTH probe, normalized by capacity), with
             session affinity — a multi-turn session sticks to its
-            replica while that replica stays eligible.
+            replica while that replica stays eligible. The hold queue
+            is tiered by priority (submit(priority=), higher = more
+            important): dispatch always serves the highest non-empty
+            tier first, and a replica's count of swapped-out preempted
+            streams (SRV_HEALTH) raises its load score — a replica
+            busy preempting is already out of cache headroom.
 
 failover    greedy decode is deterministic, so a stream is fully
             described by (original prompt + tokens so far, remaining
@@ -28,7 +33,11 @@ admission   obs/slo.py rules evaluated every control tick against the
             router into shedding: submit() raises a typed
             OverloadError (counted in fleet.shed) instead of letting
             queue depth grow until the TTFT SLO breaks. The hold-queue
-            bound (fleet_max_hold) is a hard backstop.
+            bound (fleet_max_hold) is a hard backstop. BOTH rejections
+            apply only to the lowest tier (priority <= 0): a paying
+            tier is always admitted — under pressure the replicas
+            preempt lowest-tier streams to make room rather than the
+            router turning important work away at the door.
 
 deploys     rolling_deploy(): one replica at a time — stop dispatching
             to it (+ SRV_DRAIN fence), wait for its in-flight streams,
@@ -46,8 +55,8 @@ and router.add_replica), sustained idle -> drain + remove + scale_down.
 Telemetry (exported when FLAGS_obs_dir is set; the router ALSO keeps
 local counts for stats() and the admission snapshot):
   fleet.requests.{submitted,completed,failed,cancelled} / fleet.shed /
-  fleet.failovers / fleet.replica_deaths / fleet.dispatches /
-  fleet.deploys / fleet.tokens_generated   counters;
+  fleet.cache_sheds / fleet.failovers / fleet.replica_deaths /
+  fleet.dispatches / fleet.deploys / fleet.tokens_generated  counters;
   fleet.queue_depth / fleet.active_streams / fleet.replicas_healthy /
   fleet.replicas_total / fleet.shedding    gauges;
   fleet.ttft / fleet.dispatch_wait         histograms;
@@ -78,6 +87,7 @@ _completed = telemetry.counter('fleet.requests.completed')
 _failed = telemetry.counter('fleet.requests.failed')
 _cancelled = telemetry.counter('fleet.requests.cancelled')
 _shed = telemetry.counter('fleet.shed')
+_cache_sheds = telemetry.counter('fleet.cache_sheds')
 _failovers = telemetry.counter('fleet.failovers')
 _deaths = telemetry.counter('fleet.replica_deaths')
 _dispatches = telemetry.counter('fleet.dispatches')
@@ -151,12 +161,14 @@ class FleetRequest(object):
 
     _ids = itertools.count()
 
-    def __init__(self, prompt, max_new_tokens, eos_id, session):
+    def __init__(self, prompt, max_new_tokens, eos_id, session,
+                 priority=0):
         self.id = next(FleetRequest._ids)
         self.prompt = [int(t) for t in np.asarray(prompt).reshape(-1)]
         self.max_new_tokens = int(max_new_tokens)
         self.eos_id = eos_id
         self.session = session
+        self.priority = int(priority)
         self.state = QUEUED
         self.tokens = []
         self.error = None
@@ -249,7 +261,8 @@ class _Replica(object):
                  'fails', 'active', 'capacity', 'queue_depth',
                  'max_len', 'param_version', 'hold_until',
                  'cache_tokens', 'cache_capacity',
-                 'effective_tokens_per_step', 'spec_accept_rate')
+                 'effective_tokens_per_step', 'spec_accept_rate',
+                 'preemptions', 'preempted_streams')
 
     def __init__(self, endpoint, order, timeout):
         self.endpoint = endpoint
@@ -271,6 +284,10 @@ class _Replica(object):
         # the measured draft accept rate, both from SRV_HEALTH
         self.effective_tokens_per_step = 1.0
         self.spec_accept_rate = None
+        # preempt-first replicas: lifetime preemptions plus streams
+        # currently swapped out awaiting resume (both from SRV_HEALTH)
+        self.preemptions = 0
+        self.preempted_streams = 0
 
 
 class FleetAutoscaler(object):
@@ -384,7 +401,7 @@ class FleetRouter(object):
         self._pservers = list(pservers or [])
         self._subscriber_id = int(subscriber_id)
         self._mu = threading.Condition()
-        self._hold = collections.deque()
+        self._hold = {}               # priority tier -> deque
         self._reps = {}
         self._order = itertools.count()
         self._sessions = {}           # session -> endpoint
@@ -395,6 +412,7 @@ class FleetRouter(object):
         self._failed_n = 0
         self._cancelled_n = 0
         self._shed_n = 0
+        self._cache_sheds_n = 0
         self._failovers_n = 0
         self._deploys_n = 0
         self._tokens_n = 0
@@ -431,7 +449,7 @@ class FleetRouter(object):
             t.join(timeout=10.0)
         self._threads = []
         with self._mu:
-            victims = list(self._hold)
+            victims = [r for q in self._hold.values() for r in q]
             self._hold.clear()
             for rep in self._reps.values():
                 victims.extend(rep.active.values())
@@ -530,44 +548,69 @@ class FleetRouter(object):
         self._autoscaler = autoscaler
         return autoscaler
 
+    # -- hold queue (tiered by priority) -----------------------------------
+    def _hold_len_locked(self):
+        return sum(len(q) for q in self._hold.values())
+
+    def _hold_push_locked(self, req, front=False):
+        q = self._hold.get(req.priority)
+        if q is None:
+            q = self._hold[req.priority] = collections.deque()
+        (q.appendleft if front else q.append)(req)
+        _queue_depth.set(self._hold_len_locked())
+
+    def _hold_front_locked(self):
+        """The highest non-empty tier's deque, or None."""
+        for prio in sorted(self._hold, reverse=True):
+            if self._hold[prio]:
+                return self._hold[prio]
+        return None
+
     # -- client surface ----------------------------------------------------
     def submit(self, prompt, max_new_tokens=16, eos_id=None,
-               session=None):
-        """Admit a stream into the fleet; raises OverloadError while
-        shedding (or when the hold queue is at its hard bound)."""
-        req = FleetRequest(prompt, max_new_tokens, eos_id, session)
+               session=None, priority=0):
+        """Admit a stream into the fleet. priority is the SLO tier
+        (higher = more important, 0 = the default lowest). Raises
+        OverloadError while shedding (or when the hold queue is at its
+        hard bound) — but only for the lowest tier (priority <= 0):
+        higher tiers are always admitted, and the replicas preempt
+        lowest-tier streams to make room for them."""
+        req = FleetRequest(prompt, max_new_tokens, eos_id, session,
+                           priority=priority)
         if not req.prompt:
             raise ValueError('empty prompt')
         with self._mu:
-            if self._shedding:
-                self._shed_n += 1
-                _shed.inc()
-                raise OverloadError(
-                    'fleet is shedding: admission rule %r breached %d '
-                    'consecutive checks' % (self._breach_rule,
-                                            self._breach_streak))
-            if len(self._hold) >= self._max_hold:
-                self._shed_n += 1
-                _shed.inc()
-                raise OverloadError('fleet hold queue full (%d)'
-                                    % self._max_hold)
-            self._hold.append(req)
+            if req.priority <= 0:
+                if self._shedding:
+                    self._shed_n += 1
+                    _shed.inc()
+                    raise OverloadError(
+                        'fleet is shedding: admission rule %r breached '
+                        '%d consecutive checks' % (self._breach_rule,
+                                                   self._breach_streak))
+                if self._hold_len_locked() >= self._max_hold:
+                    self._shed_n += 1
+                    _shed.inc()
+                    raise OverloadError('fleet hold queue full (%d)'
+                                        % self._max_hold)
+            self._hold_push_locked(req)
             self._submitted_n += 1
             _submitted.inc()
-            _queue_depth.set(len(self._hold))
             self._mu.notify_all()
         return req
 
     def generate(self, prompt, max_new_tokens=16, eos_id=None,
-                 session=None, timeout=None):
+                 session=None, priority=0, timeout=None):
         return self.submit(prompt, max_new_tokens, eos_id=eos_id,
-                           session=session).result(timeout)
+                           session=session,
+                           priority=priority).result(timeout)
 
     def cancel(self, req):
         with self._mu:
             if req.state == QUEUED and req.replica is None:
                 try:
-                    self._hold.remove(req)
+                    self._hold.get(req.priority,
+                                   collections.deque()).remove(req)
                 except ValueError:
                     pass
                 else:
@@ -593,10 +636,12 @@ class FleetRouter(object):
                          'param_version': r.param_version,
                          'effective_tokens_per_step':
                              r.effective_tokens_per_step,
-                         'spec_accept_rate': r.spec_accept_rate}
+                         'spec_accept_rate': r.spec_accept_rate,
+                         'preemptions': r.preemptions,
+                         'preempted_streams': r.preempted_streams}
                     for ep, r in self._reps.items()}
             return {'replicas': reps,
-                    'queue_depth': len(self._hold),
+                    'queue_depth': self._hold_len_locked(),
                     'active': sum(len(r.active)
                                   for r in self._reps.values()),
                     'submitted': self._submitted_n,
@@ -604,6 +649,9 @@ class FleetRouter(object):
                     'failed': self._failed_n,
                     'cancelled': self._cancelled_n,
                     'shed': self._shed_n,
+                    'cache_sheds': self._cache_sheds_n,
+                    'preemptions': sum(r.preemptions
+                                       for r in self._reps.values()),
                     'failovers': self._failovers_n,
                     'deploys': self._deploys_n,
                     'dispatches': self._dispatches_n,
@@ -626,7 +674,8 @@ class FleetRouter(object):
                          'fleet.shed': self._shed_n,
                          'fleet.failovers': self._failovers_n,
                          'fleet.tokens_generated': self._tokens_n},
-            'gauges': {'fleet.queue_depth': float(len(self._hold)),
+            'gauges': {'fleet.queue_depth':
+                           float(self._hold_len_locked()),
                        'fleet.active_streams': float(active),
                        'fleet.replicas_healthy': float(healthy)},
             'hists': {'fleet.ttft': self._ttft_local.snapshot()}}
@@ -644,25 +693,26 @@ class FleetRouter(object):
     def _dispatch_held(self):
         while not self._stop_evt.is_set():
             with self._mu:
-                if not self._hold:
+                q = self._hold_front_locked()
+                if q is None:
                     return
-                req = self._hold[0]
+                req = q[0]
                 if req.state == CANCELLED:
-                    self._hold.popleft()
+                    q.popleft()
                     req._finish(CANCELLED)
                     self._cancelled_n += 1
                     _cancelled.inc()
                     continue
                 remaining = req.max_new_tokens - len(req.tokens)
                 if remaining <= 0:    # failover landed exactly at budget
-                    self._hold.popleft()
+                    q.popleft()
                     self._finalize_locked(req, DONE)
                     continue
                 rep = self._pick_locked(req)
                 if rep is None:
                     return            # no eligible replica right now
-                self._hold.popleft()
-                _queue_depth.set(len(self._hold))
+                q.popleft()
+                _queue_depth.set(self._hold_len_locked())
                 req.replica = rep.endpoint
                 req.base = len(req.tokens)
                 req.rid = '%s/%d/%d' % (self._nonce, req.id,
@@ -672,6 +722,7 @@ class FleetRouter(object):
                     self._sessions[req.session] = rep.endpoint
                 prompt = req.prompt + req.tokens
                 rid, mnt, eos = req.rid, remaining, req.eos_id
+                prio = req.priority
                 if rep.max_len is not None and len(prompt) > rep.max_len:
                     # a failover prefix past the context window cannot
                     # be re-prefilled bit-exactly (ring slide)
@@ -684,7 +735,7 @@ class FleetRouter(object):
             try:
                 rep.client.call(
                     wire.SRV_SUBMIT,
-                    {'rid': rid, 'mnt': mnt, 'eos': eos},
+                    {'rid': rid, 'mnt': mnt, 'eos': eos, 'prio': prio},
                     value=np.asarray(prompt, np.int64))
             except _ReplicaError as e:
                 with self._mu:
@@ -692,8 +743,7 @@ class FleetRouter(object):
                     req.replica = None
                     if e.retryable:   # full / draining: try elsewhere
                         rep.hold_until = time.monotonic() + 0.05
-                        self._hold.appendleft(req)
-                        _queue_depth.set(len(self._hold))
+                        self._hold_push_locked(req, front=True)
                     else:
                         self._finalize_locked(req, FAILED, str(e))
             except (ConnectionError, OSError):
@@ -728,7 +778,12 @@ class FleetRouter(object):
              # toward the one holding fewer KV tokens, so long streams
              # spread out instead of stacking onto one page pool
              + (r.cache_tokens / r.cache_capacity
-                if r.cache_capacity else 0.0))
+                if r.cache_capacity else 0.0)
+             # preemption-pressure term: every stream a replica has
+             # swapped out is a stream its cache could NOT hold — count
+             # it like an active lane so new work flows to replicas
+             # that are not already evicting
+             + r.preempted_streams / max(1, r.capacity))
             # speculative replicas retire a lane's tokens in fewer
             # steps: divide the load score by the measured tokens per
             # step so a high-accept-rate replica absorbs more streams
@@ -783,18 +838,19 @@ class FleetRouter(object):
                     ttft = req.first_token_at - req.submitted_at
                     self._ttft_local.observe(ttft)
                     _ttft.observe(ttft)
-            if state == FAILED and req.cache_sheds < 5 and \
+            shed_budget = int(get_flag('fleet_cache_shed_budget'))
+            if state == FAILED and req.cache_sheds < shed_budget and \
                     'CacheExhausted' in (st.get('error') or ''):
                 # typed retryable shed (COVERAGE divergence 8): the
                 # replica's page pool was dry, not the stream's fault —
                 # requeue onto a (hopefully cooler) replica with a brief
-                # hold on this one; budget of 5 bounds the livelock when
-                # the whole fleet is saturated
+                # hold on this one; FLAGS_fleet_cache_shed_budget bounds
+                # the livelock when the whole fleet is saturated
                 rep.active.pop(req.id, None)
                 rep.hold_until = time.monotonic() + 0.05
                 req.cache_sheds += 1
-                self._shed_n += 1
-                _shed.inc()
+                self._cache_sheds_n += 1
+                _cache_sheds.inc()
                 self._requeue_locked(req)
                 return
             if state in (DONE, CANCELLED, FAILED):
@@ -819,10 +875,12 @@ class FleetRouter(object):
         req.segment += 1
         req.replica = None
         req.state = QUEUED
-        self._hold.appendleft(req)
+        # front of the request's OWN tier: a failover victim already
+        # waited its turn once — but it must not cut ahead of a higher
+        # tier, nor be buried behind its own tier's backlog
+        self._hold_push_locked(req, front=True)
         self._failovers_n += 1
         _failovers.inc()
-        _queue_depth.set(len(self._hold))
 
     def _on_replica_down(self, rep):
         with self._mu:
@@ -884,6 +942,9 @@ class FleetRouter(object):
                 rep.effective_tokens_per_step = (float(eff)
                                                  if eff else 1.0)
                 rep.spec_accept_rate = h.get('spec_accept_rate')
+                rep.preemptions = int(h.get('preemptions', 0) or 0)
+                rep.preempted_streams = int(
+                    h.get('preempted_streams', 0) or 0)
                 rep.healthy = True
         now = time.monotonic()
         snap = self.admission_snapshot()
@@ -961,6 +1022,16 @@ class FleetRouter(object):
         except (ConnectionError, OSError):
             self._on_replica_down(rep)
             return None
+        # drain ordering: lowest-tier streams fail over to another
+        # replica right away (their prefix re-prefills elsewhere,
+        # bit-exact), so the wait below covers only the higher-tier
+        # streams finishing in place — the most important streams are
+        # the last ones a deploy disturbs
+        with self._mu:
+            for req in list(rep.active.values()):
+                if req.priority <= 0:
+                    rep.active.pop(req.id, None)
+                    self._requeue_locked(req)
         with _trace.span('fleet.drain', kind='fleet',
                          endpoint=rep.endpoint):
             while True:

@@ -19,6 +19,10 @@ Streams replace the dense path's whole-row prefill:
     decode_step(tokens, pos)    one compiled step over ALL slots; pages
                                 are allocated on demand per live stream
     release(slot)               drop the stream's page refs
+    save_stream(slot)           copy the stream's pages to host RAM
+                                (preempt-first capacity); paired with
+    restore_stream(slot, snap)  write them back onto fresh pages and
+                                resume bit-exact
 
 Exhaustion is typed: when the pool runs dry (after prefix-cache LRU
 eviction) prefill_step/decode_step raise CacheExhaustedError — the
@@ -188,6 +192,50 @@ class PagedDecodePredictor(DecodePredictor):
         if table is not None:
             table.release()
             self._update_gauges()
+
+    # -- preempt / resume (serving/preempt.py) -----------------------------
+    def save_stream(self, slot):
+        """Snapshot one open, fully prefilled stream's page contents
+        device -> host (preempt-first capacity, serving/preempt.py).
+        Returns {'length', 'pages', 'data', 'nbytes'}; the stream
+        itself is untouched — the caller release()s the slot only after
+        the copy succeeded, so a failed gather never loses pages."""
+        slot = int(slot)
+        if slot in self._pending:
+            raise RuntimeError('slot %d is still prefilling — requeue '
+                               'it, there is nothing worth swapping'
+                               % slot)
+        table = self._tables[slot]
+        pools = [self._scope.find_var(name)
+                 for name in self._pair.cache_names]
+        data = self._pool.save_pages(pools, table.pages)
+        return {'length': table.length, 'pages': len(table.pages),
+                'data': data,
+                'nbytes': int(sum(d.nbytes for d in data))}
+
+    def restore_stream(self, slot, snapshot, prompt=None):
+        """Re-seat a save_stream() snapshot on `slot`: allocate fresh
+        pages (all-or-nothing — CacheExhaustedError with nothing taken
+        when the pool is still too tight, so the resuming stream just
+        stays queued), write the host copies back, and rebuild the page
+        table at the saved length. Every restored page is private (the
+        stream owns the fresh copies), so later appends never fork.
+        `prompt` (the committed token sequence) is unused here; the
+        speculative override re-prefills its draft from it."""
+        slot = int(slot)
+        if slot in self._tables:
+            raise RuntimeError('slot %d already holds a stream — '
+                               'release() it first' % slot)
+        names = self._pair.cache_names
+        pools = [self._scope.find_var(name) for name in names]
+        ids, pools = self._pool.restore_pages(pools, snapshot['data'])
+        for name, pool in zip(names, pools):
+            self._scope.set_var(name, pool)
+        table = PageTable(self._pool, self.pages_per_slot)
+        table.pages = list(ids)
+        table.length = int(snapshot['length'])
+        self._tables[slot] = table
+        self._update_gauges()
 
     @staticmethod
     def _rollback(cows, grows):

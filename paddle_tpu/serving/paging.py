@@ -12,6 +12,11 @@ values; everything stateful lives HERE, on the host, in plain Python:
                PrefixCache LRU) to give a page back, then raises the
                typed, retryable CacheExhaustedError — the paged answer
                to COVERAGE divergence 8's silent ring slide.
+               save_pages/restore_pages move page contents device<->
+               host for the preempt-first capacity engine
+               (serving/preempt.py): float32 copies onto freshly
+               allocated pages, so a swapped-out stream resumes
+               bit-exact.
   PageTable    one stream's logical -> physical mapping. Pages adopted
                from the prefix cache are marked SHARED; the first
                append into a shared page forks it (copy-on-write): a
@@ -40,6 +45,8 @@ from __future__ import annotations
 
 import collections
 import hashlib
+
+import numpy as np
 
 __all__ = ['CacheExhaustedError', 'PagePool', 'PageTable', 'PrefixCache']
 
@@ -146,6 +153,43 @@ class PagePool(object):
         self._ref[page] -= 1
         if self._ref[page] == 0:
             self._free.append(page)
+
+    # -- host swap (preempt-first capacity, serving/preempt.py) ------------
+    def save_pages(self, pools, page_ids):
+        """Device -> host: gather the `page_ids` rows of every pool
+        array into float32 host copies (one np.ndarray per pool, shape
+        [len(page_ids), page_tokens, ...]). A pure read — refcounts and
+        the free list are untouched; the caller releases the stream's
+        refs AFTER the copy so a failed gather never strands a page.
+        Float32 bytes copy exactly, so a later restore_pages is
+        bit-identical."""
+        idx = [int(p) for p in page_ids]
+        for p in idx:
+            if p == NULL_PAGE or not 0 < p < self.num_pages \
+                    or self._ref[p] <= 0:
+                raise ValueError('cannot save dead/null page %d' % p)
+        idx = np.asarray(idx, np.int32)
+        return [np.asarray(pool[idx]) for pool in pools]
+
+    def restore_pages(self, pools, saved):
+        """Host -> device: allocate len(saved[0]) FRESH pages
+        (all-or-nothing — raises the retryable CacheExhaustedError
+        with nothing taken when the pool cannot fit, so a resuming
+        stream just stays queued) and write each saved row back at the
+        new physical ids. Returns (page_ids, pools); device-resident
+        pools are functionally updated (`.at[ids].set`), so the caller
+        must reinstall the returned arrays in its scope."""
+        n = len(saved[0]) if saved else 0
+        ids = self.alloc_many(n)
+        idx = np.asarray(ids, np.int32)
+        out = []
+        for pool, host in zip(pools, saved):
+            if hasattr(pool, 'at'):            # jax array: functional
+                pool = pool.at[idx].set(host)
+            else:                              # numpy: in-place
+                pool[idx] = host
+            out.append(pool)
+        return ids, out
 
 
 class PageTable(object):

@@ -168,7 +168,8 @@ class ReplicaServer(object):
         prompt = [int(t) for t in np.asarray(value).reshape(-1)]
         handle = self._srv.submit(prompt,
                                   max_new_tokens=int(meta['mnt']),
-                                  eos_id=meta.get('eos'))
+                                  eos_id=meta.get('eos'),
+                                  priority=int(meta.get('prio', 0)))
         with self._lock:
             self._streams[rid] = handle
         wire.write_msg(conn, wire.REPLY_OK, ack)
@@ -208,6 +209,12 @@ class ReplicaServer(object):
                    stats.get('effective_tokens_per_step'),
                'spec_accept_rate':
                    stats.get('spec', {}).get('accept_rate'),
+               # preempt-first capacity (serving/preempt.py): lifetime
+               # preemptions plus streams currently swapped out and
+               # waiting to resume — the router's dispatch score
+               # treats waiting preempted streams as cache pressure
+               'preemptions': stats.get('preemptions', 0),
+               'preempted_streams': stats.get('preempted_streams', 0),
                'draining': self._draining}
         if with_digests:
             out['digests'] = self._srv.param_digests()

@@ -194,6 +194,31 @@ class SpeculativeDecodePredictor(PagedDecodePredictor):
         self._draft.release(slot)
         self._draft_dead.discard(int(slot))
 
+    def restore_stream(self, slot, snapshot, prompt=None):
+        """Resume a preempted stream (serving/preempt.py): the TARGET
+        pages restore bit-exact from the snapshot; the draft cache was
+        dropped at preemption, so it re-prefills from the committed
+        sequence (prompt + tokens so far) — its last position is later
+        re-fed by the chain as an identical K/V rewrite, the same
+        safe idiom as a frozen chain slot. A draft that cannot fit
+        leaves the slot decoding unassisted (plain decode, exactly the
+        mid-verify exhaustion escape), which never changes the emitted
+        tokens — verify trusts only the target."""
+        slot = int(slot)
+        PagedDecodePredictor.restore_stream(self, slot, snapshot,
+                                            prompt=prompt)
+        self._draft_dead.add(slot)
+        if prompt is None:
+            return
+        try:
+            self._draft.open_stream(slot, prompt)
+            while self._draft.prefill_step(slot) is None:
+                pass
+        except (CacheExhaustedError, RuntimeError):
+            self._draft.release(slot)
+            return
+        self._draft_dead.discard(slot)
+
     def prefill_step(self, slot, return_logits=False):
         out = PagedDecodePredictor.prefill_step(self, slot,
                                                 return_logits)

@@ -17,6 +17,17 @@ run tools/serve_replica.py — this file covers what sits around them):
   the whole workload from scratch (same seed -> same prompts -> same
   greedy streams), so the LAST RESULT line in its log is always a
   full, comparable answer.
+
+- overload: the chaos_sweep --overload driver — a seeded mixed-tier
+  burst of FLEET_STREAMS prompts (every 3rd priority 1, the rest tier
+  0) submitted all at once against a fleet whose paged replicas are
+  sized well below the burst, so the replicas MUST preempt low-tier
+  streams to finish. OverloadError is tolerated (and counted) only
+  for tier 0; every completed stream is checked bit-exact against an
+  in-process solo-decode reference over the same FLEET_MODEL_DIR
+  bytes, so the RESULT json carries verdict-ready counts
+  (high_sheds / high_bad / low_failed / mismatches / preemptions)
+  instead of raw streams.
 """
 import json
 import os
@@ -118,12 +129,86 @@ def run_driver():
             complete_replica(ep)
 
 
+def run_overload_driver():
+    # the bit-exact reference below runs jax in THIS process — pin it
+    # to CPU before anything touches a backend (the chaos sweep strips
+    # JAX_PLATFORMS from every role's env, and TPU probing takes
+    # minutes to give up)
+    import jax
+    jax.config.update('jax_platforms', 'cpu')
+    from paddle_tpu.serving import FleetRouter, OverloadError
+    replicas = os.environ['FLEET_REPLICAS'].split(',')
+    seed = int(os.environ.get('FLEET_SEED', '0'))
+    n = int(os.environ.get('FLEET_STREAMS', '40'))
+    budget = int(os.environ.get('FLEET_BUDGET', '8'))
+    model_dir = os.environ['FLEET_MODEL_DIR']
+    prompts = make_prompts(seed, n, budget)
+    # mixed tiers: every 3rd stream is the paying tier (priority 1),
+    # the rest are best-effort tier 0 — the only tier allowed to shed
+    prios = [1 if i % 3 == 0 else 0 for i in range(n)]
+    router = FleetRouter(replicas, probe_secs=0.1)
+    router.start()
+    sheds = {0: 0, 1: 0}
+    reqs = []
+    try:
+        router.wait_healthy(timeout=120.0)
+        for (p, s), prio in zip(prompts, prios):
+            try:
+                reqs.append(router.submit(p, max_new_tokens=budget,
+                                          session=s, priority=prio))
+            except OverloadError:
+                sheds[prio] += 1
+                reqs.append(None)
+        streams, states = [], []
+        for r in reqs:
+            if r is None:
+                streams.append([])
+                states.append('SHED')
+                continue
+            r.wait(timeout=600.0)
+            streams.append([int(t) for t in r.tokens])
+            states.append(r.state)
+        stats = router.stats()
+    finally:
+        router.stop()
+    # every stream that completed must be bit-exact against a solo
+    # dense-decode reference over the same saved bytes — preemption,
+    # swap/re-prefill resume and failover may reorder work, never
+    # change tokens
+    from paddle_tpu.inference import AnalysisConfig, AnalysisPredictor
+    ref = AnalysisPredictor(AnalysisConfig(model_dir)).prepare_decoding(
+        slots=1, prefill_batch=1)
+    mismatches = 0
+    for (p, _), st, toks in zip(prompts, states, streams):
+        if st == 'DONE' and toks != [int(t) for t in
+                                     ref.generate(p, budget)]:
+            mismatches += 1
+    print('RESULT ' + json.dumps({
+        'submitted': n,
+        'done': sum(1 for s in states if s == 'DONE'),
+        'high_sheds': sheds[1],
+        'high_bad': sum(1 for s, pr in zip(states, prios)
+                        if pr > 0 and s != 'DONE'),
+        'low_sheds': sheds[0],
+        'low_failed': sum(1 for s, pr in zip(states, prios)
+                          if pr <= 0 and s == 'FAILED'),
+        'mismatches': mismatches,
+        'failovers': stats['failovers'],
+        'preemptions': stats['preemptions'],
+        'cache_sheds': stats['cache_sheds']}), flush=True)
+    if os.environ.get('FLEET_COMPLETE', '1') == '1':
+        for ep in replicas:
+            complete_replica(ep)
+
+
 def main():
     role = os.environ['FLEET_ROLE']
     if role == 'build':
         build_model(os.environ['FLEET_MODEL_DIR'])
     elif role == 'driver':
         run_driver()
+    elif role == 'overload':
+        run_overload_driver()
     else:
         raise SystemExit('unknown FLEET_ROLE %r' % role)
 

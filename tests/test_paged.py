@@ -524,7 +524,7 @@ def test_fleet_ingests_cache_pressure_and_sheds_exhaustion():
         'error': "RuntimeError('CacheExhaustedError: KV page pool "
                  "exhausted for slot(s) 0')"})
     assert req.state == fl.QUEUED and req.cache_sheds == 1
-    assert router._hold and router._hold[0] is req
+    assert router._hold and router._hold[req.priority][0] is req
     assert req.id not in a.active
     # the retry budget bounds saturation livelock: the 6th is fatal
     router._hold.clear()

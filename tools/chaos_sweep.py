@@ -76,6 +76,20 @@ Verdicts: `recovered`/`nokill` (kill fired / kill point never
 reached), `diverged` (a stream changed — a failover-determinism bug,
 report the seed), plus the usual `fatal`/`hung`.
 
+`--overload` chaoses the preempt-first capacity path (serving/
+preempt.py + the engine tier queues): each seed fires a 10x
+mixed-tier burst (every 3rd stream priority 1) from an overload
+driver (tests/fleet_worker.py) at two PAGED replicas sized far below
+the burst (2 slots over 6 four-token pages), and kill-9's replica 0
+at a seeded wire message under the restarting Supervisor. The
+replicas must preempt tier-0 streams (host-RAM page swap, or drop +
+re-prefill when the budget is dry) to make room. Verdicts:
+`recovered`/`nokill` as usual, `diverged` when the SLO contract
+breaks — ANY high-tier shed or failure, any low-tier FAILED stream,
+or any completed stream whose tokens differ from the solo reference —
+and `fatal` additionally when serving.preemptions stayed 0 (the seed
+never exercised the machinery it gates).
+
 `--quick` is the CI smoke shape: 3 seeds by default, and the exit
 status is ALSO non-zero on any fatal/hung seed (a quick sweep exists
 to gate regressions, so every non-ok outcome fails it).
@@ -89,6 +103,7 @@ Usage:
     python tools/chaos_sweep.py --mesh-kill --quick # sharded-mesh kill
     python tools/chaos_sweep.py --refresh --quick   # online-refresh chaos
     python tools/chaos_sweep.py --fleet --quick     # fleet replica/router kill
+    python tools/chaos_sweep.py --overload --quick  # preempt-first capacity
 
 Exit status is non-zero iff any seed DIVERGED (or, under --quick, any
 seed was fatal/hung): fatal/hung seeds of the full sweep are
@@ -470,6 +485,83 @@ def _run_fleet_seed(seed, budget, workdir, model_dir, baseline,
         sup.stop()
 
 
+def _run_overload_seed(seed, budget, workdir, model_dir, n_replicas=2,
+                       streams=40, gen=8, obs_dir=None):
+    """One --overload seed: a seeded 10x mixed-tier burst (every 3rd
+    stream priority 1) against paged replicas sized far below the
+    burst (2 slots, 6 pages of 4 tokens each), plus a seeded kill-9 of
+    replica 0 under the restarting Supervisor. The replicas MUST
+    preempt tier-0 streams (swap or re-prefill) to finish; acceptance
+    is the preempt-first SLO contract — ZERO high-tier sheds or
+    failures, every completed stream bit-exact against the solo
+    reference (the driver self-checks), and serving.preemptions >= 1
+    so the machinery demonstrably fired. Returns (verdict, result,
+    victim, plan_json, outs)."""
+    import random
+
+    from paddle_tpu.distributed.supervisor import Supervisor
+
+    ports = _free_ports(n_replicas)
+    eps = ['127.0.0.1:%d' % p for p in ports]
+    rng = random.Random(('overload', seed).__repr__())
+    victim = 'replica0'
+    plan_json = json.dumps({'rules': [{
+        'when': 'recv', 'type': '*', 'nth': rng.randint(15, 90),
+        'action': 'exit'}]})
+    base_env = dict(os.environ)
+    base_env.pop('JAX_PLATFORMS', None)
+    base_env.pop('XLA_FLAGS', None)
+    if obs_dir:
+        base_env['FLAGS_obs_flush_secs'] = '0.5'
+    sup = Supervisor(max_restarts=2, backoff=0.5, log_dir=workdir,
+                     obs_dir=obs_dir)
+    for i, ep in enumerate(eps):
+        # tight paged pool: 2 slots over 6 x 4-token pages — two
+        # concurrent full-budget streams cannot both fit, so decode
+        # pressure forces preemption instead of merely queueing
+        env = dict(base_env, SERVE_MODEL_DIR=model_dir,
+                   SERVE_ENDPOINT=ep, SERVE_SLOTS='2',
+                   SERVE_WORKERS='1', SERVE_PAGED='1',
+                   SERVE_PAGE_TOKENS='4', SERVE_KV_PAGES='6',
+                   SERVE_PREFILL_CHUNK='16')
+        if i == 0:
+            env['FLAGS_fault_plan'] = plan_json
+        sup.add_role('replica%d' % i,
+                     [sys.executable, _SERVE_REPLICA], env=env)
+    env = dict(base_env, FLEET_ROLE='overload',
+               FLEET_MODEL_DIR=model_dir,
+               FLEET_REPLICAS=','.join(eps), FLEET_SEED='0',
+               FLEET_STREAMS=str(streams), FLEET_BUDGET=str(gen))
+    sup.add_role('driver', [sys.executable, _FLEET_WORKER], env=env)
+    sup.start()
+    states = sup.wait(timeout=budget)
+    outs = [sup.output(n) for n in sorted(states)]
+    try:
+        if any(s in ('running', 'backoff') for s in states.values()):
+            return 'hung', None, victim, plan_json, outs
+        if any(s == 'failed' for s in states.values()):
+            return 'fatal', None, victim, plan_json, outs
+        result = None
+        for ln in sup.output('driver').splitlines():
+            if ln.startswith('RESULT '):
+                result = json.loads(ln[len('RESULT '):])
+        if result is None:
+            return 'fatal', None, victim, plan_json, outs
+        if (result['high_sheds'] or result['high_bad'] or
+                result['low_failed'] or result['mismatches']):
+            # an SLO breach or a token divergence — the bug class this
+            # sweep exists to catch
+            return 'diverged', result, victim, plan_json, outs
+        if result['preemptions'] < 1:
+            # the burst never forced a preemption: the seed did not
+            # exercise the machinery it gates on
+            return 'fatal', result, victim, plan_json, outs
+        return (('recovered' if sup.restarts[victim] else 'nokill'),
+                result, victim, plan_json, outs)
+    finally:
+        sup.stop()
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument('--seeds', type=int, default=None,
@@ -504,6 +596,12 @@ def main(argv=None):
                          'or the router driver mid-stream at a seeded '
                          'wire message; the recovered fleet must '
                          'reproduce the fault-free streams bit-exactly')
+    ap.add_argument('--overload', action='store_true',
+                    help='preempt-first capacity chaos: a seeded 10x '
+                         'mixed-tier burst against tight paged '
+                         'replicas plus a replica kill-9; requires '
+                         'zero high-tier sheds, bit-exact completed '
+                         'streams, and at least one preemption')
     ap.add_argument('--quick', action='store_true',
                     help='CI smoke: 3 seeds unless --seeds given, and '
                          'fatal/hung seeds fail the sweep too')
@@ -517,9 +615,9 @@ def main(argv=None):
                          '(default: a ./chaos_report.<pid> dir)')
     args = ap.parse_args(argv)
     if sum((args.kill, args.corrupt, args.mesh_kill,
-            args.refresh, args.fleet)) > 1:
-        ap.error('--kill, --corrupt, --mesh-kill, --refresh and '
-                 '--fleet are mutually exclusive')
+            args.refresh, args.fleet, args.overload)) > 1:
+        ap.error('--kill, --corrupt, --mesh-kill, --refresh, --fleet '
+                 'and --overload are mutually exclusive')
     if args.seeds is None:
         args.seeds = 3 if args.quick else 20
 
@@ -535,10 +633,12 @@ def main(argv=None):
         # (printed by online_worker) are the acceptance reference, so
         # the comparison lives inside _run_refresh_seed
         local_w = {}
-    elif args.fleet:
+    elif args.fleet or args.overload:
         # one model for the whole sweep (every replica and every seed
-        # serves the identical bytes), then a fault-free fleet run for
-        # the bit-exact stream baseline
+        # serves the identical bytes), then — for --fleet — a
+        # fault-free fleet run for the bit-exact stream baseline
+        # (--overload needs no external baseline: its driver checks
+        # every completed stream against an in-process reference)
         import atexit
         import shutil
         fleet_root = tempfile.mkdtemp(prefix='fleet_sweep.')
@@ -549,16 +649,18 @@ def main(argv=None):
         build_env.pop('XLA_FLAGS', None)
         subprocess.run([sys.executable, _FLEET_WORKER], env=build_env,
                        check=True)
-        print('baseline: fault-free fleet ...')
-        with tempfile.TemporaryDirectory() as workdir:
-            verdict, fleet_baseline, _, _, outs = _run_fleet_seed(
-                0, args.budget, workdir, model_dir, None)
-        if verdict != 'ok':
-            print('fleet baseline failed (%s)' % verdict)
-            if args.verbose:
-                for out in outs:
-                    print('  | ' + '\n  | '.join(out.splitlines()[-15:]))
-            return 1
+        if args.fleet:
+            print('baseline: fault-free fleet ...')
+            with tempfile.TemporaryDirectory() as workdir:
+                verdict, fleet_baseline, _, _, outs = _run_fleet_seed(
+                    0, args.budget, workdir, model_dir, None)
+            if verdict != 'ok':
+                print('fleet baseline failed (%s)' % verdict)
+                if args.verbose:
+                    for out in outs:
+                        print('  | ' +
+                              '\n  | '.join(out.splitlines()[-15:]))
+                return 1
         local_w = {}
     elif args.mesh_kill:
         # the mesh sweep's baseline is the same worker, fault-free —
@@ -590,7 +692,8 @@ def main(argv=None):
 
     ok_verdicts = (('ok', 'recovered', 'nokill') if args.refresh
                    else ('recovered', 'nokill')
-                   if (args.kill or args.mesh_kill or args.fleet)
+                   if (args.kill or args.mesh_kill or args.fleet or
+                       args.overload)
                    else ('ok',))
     tally = {'ok': 0, 'recovered': 0, 'nokill': 0, 'diverged': 0,
              'fatal': 0, 'hung': 0}
@@ -616,6 +719,13 @@ def main(argv=None):
                                     obs_dir=obs_dir)
             weights = {}
             label = '%s %s' % (victim, plan_json)
+        elif args.overload:
+            with tempfile.TemporaryDirectory() as workdir:
+                verdict, result, victim, plan_json, outs = \
+                    _run_overload_seed(seed, args.budget, workdir,
+                                       model_dir, obs_dir=obs_dir)
+            weights = {}
+            label = '%s %s %s' % (victim, plan_json, json.dumps(result))
         elif args.mesh_kill:
             # kill inside the live step range; nth counts on_step calls
             kill_nth = random.Random(('mesh', seed).__repr__()).randint(
@@ -683,6 +793,7 @@ def main(argv=None):
     if report_root:
         mode = ('refresh' if args.refresh
                 else 'fleet' if args.fleet
+                else 'overload' if args.overload
                 else 'mesh-kill' if args.mesh_kill
                 else 'kill' if args.kill
                 else 'corrupt' if args.corrupt else 'fault')

@@ -453,6 +453,90 @@ def _spec_leg(cfg, quick):
             'spec_speedup': round(spec_tps / plain_tps, 2)}
 
 
+def _preempt_leg(pred, cfg, quick):
+    """Preempt-first capacity leg: a mixed-tier overload burst (every
+    3rd request priority 1) through a ServingEngine whose paged pool
+    holds only ~half its lanes at full window — finishing the burst
+    REQUIRES preempting low-tier streams (host-RAM swap, or drop +
+    re-prefill when FLAGS_serving_swap_host_mb is dry) and resuming
+    them bit-exactly. Two acceptance numbers: overload_completion_rate
+    (completed / attempted, higher is better — preempt-first capacity
+    means overload costs low-tier latency, not completions) and
+    preempt_resume_p99_ms (p99 of serving.resume_latency: queue-front
+    re-entry + page restore or re-prefill until the stream decodes
+    again, lower is better)."""
+    from paddle_tpu.obs import telemetry
+    from paddle_tpu.serving import ServingEngine
+
+    lanes = 4
+    pt = max(2, cfg.max_len // 8)
+    chunk = max(1, cfg.max_len // 4)
+    new_tokens = 4 if quick else 8
+    prompt_len = max(1, cfg.max_len // 2 - new_tokens)
+    # the pool holds HALF the lanes at their full stream footprint
+    # (prompt + budget): decode pressure must preempt, not queue
+    pages_per_stream = -(-(prompt_len + new_tokens) // pt)
+    num_pages = (lanes // 2) * pages_per_stream + 1
+    n_requests = 24 if quick else 48
+    rng = np.random.RandomState(13)
+    prompts = [rng.randint(1, cfg.vocab, prompt_len)
+               for _ in range(n_requests)]
+    prios = [1 if i % 3 == 0 else 0 for i in range(n_requests)]
+
+    dec = pred.prepare_decoding(slots=lanes, paged=True, page_tokens=pt,
+                                kv_pages=num_pages, prefill_chunk=chunk)
+    dec.open_stream(0, list(prompts[0]))    # compile outside the window
+    while dec.prefill_step(0) is None:
+        pass
+    warm_pos = np.zeros(lanes, 'int32')
+    warm_pos[0] = prompt_len
+    dec.decode_step(np.zeros(lanes, 'int64'), warm_pos)
+    dec.reset()
+
+    telemetry.enable()
+    try:
+        telemetry.reset()
+        sheds = 0
+        t0 = time.perf_counter()
+        with ServingEngine(dec) as eng:
+            reqs = []
+            for p, prio in zip(prompts, prios):
+                try:
+                    reqs.append(eng.submit(p, max_new_tokens=new_tokens,
+                                           priority=prio))
+                except RuntimeError:    # queue full: tier-0 only
+                    sheds += 1
+            for r in reqs:
+                r.result(600)
+            stats = eng.stats()
+        wall = time.perf_counter() - t0
+        done = sum(1 for r in reqs if r.state == 'DONE')
+        total = sum(len(r.tokens) for r in reqs)
+        snap = telemetry.snapshot()
+        h = snap['hists'].get('serving.resume_latency')
+        p99 = telemetry.hist_quantile(h, 0.99) if h else None
+        p50 = telemetry.hist_quantile(h, 0.50) if h else None
+        ctrs = snap['counters']
+    finally:
+        telemetry.disable()
+        telemetry.reset()
+    return {'mode': 'preempt', 'lanes': lanes, 'page_tokens': pt,
+            'kv_pages': num_pages, 'requests': n_requests,
+            'high_tier_requests': sum(prios), 'queue_sheds': sheds,
+            'preempt_tokens_per_sec': round(total / wall, 2),
+            'overload_completion_rate':
+                round(done / float(n_requests), 4),
+            'preemptions': ctrs.get('serving.preemptions', 0),
+            'swapped_pages': ctrs.get('serving.swapped_pages', 0),
+            'swap_bytes': ctrs.get('serving.swap_bytes', 0),
+            'resumes': h['count'] if h else 0,
+            'preempted_streams_now': stats.get('preempted_streams', 0),
+            'preempt_resume_p50_ms':
+                round(p50 * 1e3, 3) if p50 else 0.0,
+            'preempt_resume_p99_ms':
+                round(p99 * 1e3, 3) if p99 else 0.0}
+
+
 def _fleet_leg(cfg, quick, replicas=2):
     """Fleet serving leg: `replicas` serve_replica.py subprocesses
     behind an in-process FleetRouter, one concurrent burst through the
@@ -583,6 +667,13 @@ def main():
                          'over 2 replica subprocesses under burst '
                          'load (fleet_tokens_per_sec + '
                          'fleet_p99_ttft_ms in the summary)')
+    ap.add_argument('--preempt', action='store_true',
+                    help='add the preempt-first capacity leg: a '
+                         'mixed-tier overload burst against a paged '
+                         'pool half the burst size, forcing SLO-tiered '
+                         'preemption + bit-exact resume '
+                         '(overload_completion_rate + '
+                         'preempt_resume_p99_ms in the summary)')
     ap.add_argument('--spec', action='store_true',
                     help='add the speculative-decoding A/B leg: '
                          'draft/verify speculation vs plain paged '
@@ -671,6 +762,14 @@ def main():
         summary['fleet_tokens_per_sec'] = \
             fleet_row['fleet_tokens_per_sec']
         summary['fleet_p99_ttft_ms'] = fleet_row['fleet_p99_ttft_ms']
+
+    if args.preempt:
+        pre_row = _preempt_leg(pred, cfg, args.quick)
+        pre_row['config'] = label
+        print(json.dumps(pre_row), flush=True)
+        for key in ('overload_completion_rate', 'preempt_resume_p99_ms',
+                    'preemptions', 'preempt_tokens_per_sec'):
+            summary[key] = pre_row[key]
 
     if args.spec:
         spec_row = _spec_leg(cfg, args.quick)
