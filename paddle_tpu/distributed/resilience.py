@@ -34,8 +34,8 @@ cluster test can fault exactly one role. Schema::
         "type": "SEND_VAR",       # wire/master msg-type name, or "*"
         "nth": 3,                 # fire on the Nth matching event
         "action": "drop",         # drop | close | delay | error | exit
-                                  #   | corrupt | nan
-        "secs": 0.2,              # delay only
+                                  #   | corrupt | nan | stall
+        "secs": 0.2,              # delay / stall only
         "retryable": true,        # error only (default true)
         "code": 137,              # exit only (default 137, = kill -9)
         "bits": 1}]}              # corrupt only: bits to flip (default 1)
@@ -64,6 +64,15 @@ in deterministic order. Actions:
   transport fault) so the pserver's finite-gradient guard rejects it;
   on step, the trainer poisons one feed value so the numeric-anomaly
   guard (FLAGS_anomaly_action) sees a non-finite loss.
+- ``stall`` (send or recv): hold the connection open for `secs`
+  without letting the message proceed — the gray-failure primitive
+  (Huang et al.): the process is alive, the socket stays connected,
+  health probes on OTHER connections keep answering, but the stalled
+  connection makes no progress. Unlike ``delay`` (a short, silent
+  hiccup the retry layer absorbs), ``stall`` writes an audit line to
+  stderr when it fires and is sized to outlast progress timeouts, so
+  chaos harnesses can assert the watchdog — not the stall ending —
+  unwedged the stream.
 
 The wire layer cooperates on ``close``/``corrupt``/``nan``: `on_send`
 returns a `SendEffect` whose `action` tells `wire.write_msg` what to do
@@ -161,7 +170,8 @@ class RetryPolicy(object):
 # fault plan
 # ---------------------------------------------------------------------------
 
-_ACTIONS = ('drop', 'close', 'delay', 'error', 'exit', 'corrupt', 'nan')
+_ACTIONS = ('drop', 'close', 'delay', 'error', 'exit', 'corrupt', 'nan',
+            'stall')
 _WHENS = ('send', 'recv', 'step')
 
 
@@ -191,6 +201,9 @@ class FaultRule(object):
             raise ValueError("action 'nan' requires when='send' or "
                              "'step' (the poison is injected at the "
                              'producer)')
+        if action == 'stall' and when == 'step':
+            raise ValueError("action 'stall' requires when='send' or "
+                             "'recv' (it holds a wire connection open)")
         self.when = when
         self.type = type
         self.nth = int(nth)
@@ -203,7 +216,7 @@ class FaultRule(object):
     def to_dict(self):
         d = {'when': self.when, 'type': self.type, 'nth': self.nth,
              'action': self.action}
-        if self.action == 'delay':
+        if self.action in ('delay', 'stall'):
             d['secs'] = self.secs
         if self.action == 'error':
             d['retryable'] = self.retryable
@@ -233,8 +246,9 @@ class FaultPlan(object):
 
     @classmethod
     def from_spec(cls, spec):
-        """``seed:N`` | ``kill:ROLE:N`` | ``corrupt:N`` | a JSON object
-        string | a path to a JSON file.
+        """``seed:N`` | ``kill:ROLE:N`` | ``corrupt:N`` |
+        ``grayfail:ROLE:N`` | a JSON object string | a path to a JSON
+        file.
 
         A malformed spec fails HERE, loudly, with the offending text —
         install time is the only moment anyone is looking; a deferred
@@ -248,6 +262,9 @@ class FaultPlan(object):
                 return cls.from_kill_seed(int(seed), role)
             if spec.startswith('corrupt:'):
                 return cls.from_corrupt_seed(int(spec[len('corrupt:'):]))
+            if spec.startswith('grayfail:'):
+                role, seed = spec[len('grayfail:'):].split(':', 1)
+                return cls.from_grayfail_seed(int(seed), role)
             if spec.startswith('{'):
                 return cls.from_json(spec)
             with open(spec) as f:
@@ -313,6 +330,29 @@ class FaultPlan(object):
                              % (role,))
         rule = FaultRule(when, rng.randint(2, max_nth), 'exit',
                          type=rng.choice(types))
+        return cls([rule], seed=seed)
+
+    @classmethod
+    def from_grayfail_seed(cls, seed, role, max_nth=6):
+        """One seeded ``stall`` rule: at the Nth inbound SRV_POLL the
+        replica's data connection freezes for 20-40s — alive-but-slow,
+        the chaos_sweep --grayfail distribution.
+
+        SRV_POLL recv is the canonical gray-failure point: the stream
+        was accepted, tokens are being generated, health probes (their
+        own connection, their own server thread) keep passing — but the
+        router's view of progress stops dead. The stall is sized to
+        outlast any sane FLAGS_fleet_progress_timeout_secs, so a run
+        that completes did so because the watchdog gray-marked the
+        replica and failed streams over, never because the stall
+        expired first. max_nth stays small relative to the polls a
+        driver burst actually generates (one batched SRV_POLL per
+        FLAGS_fleet_poll_secs tick while streams are live) so the rule
+        reliably fires before the burst drains."""
+        rng = random.Random(('grayfail', role, seed).__repr__())
+        rule = FaultRule('recv', rng.randint(2, max_nth), 'stall',
+                         type='SRV_POLL',
+                         secs=round(20.0 + 20.0 * rng.random(), 1))
         return cls([rule], seed=seed)
 
     @classmethod
@@ -476,6 +516,19 @@ def _raise_for(rule, where):
     raise RetryableRPCError(msg)
 
 
+def _stall_for(rule, where):
+    """The 'stall' action: freeze this connection for rule.secs while
+    the process stays alive and every other connection keeps serving.
+    The audit line lands on stderr BEFORE the sleep — a chaos harness
+    greps for it to prove the gray failure actually fired even when the
+    watchdog unwedges the victim long before the stall expires."""
+    import sys
+    sys.stderr.write('fault injection: stall %.1fs at %s (rule %s)\n'
+                     % (rule.secs, where, rule.to_dict()))
+    sys.stderr.flush()
+    time.sleep(rule.secs)
+
+
 def _exit_for(rule, where):
     """The 'exit' action: die NOW, with no cleanup of any kind.
     sys.stderr is flushed (it carries the audit line chaos tests grep
@@ -502,6 +555,9 @@ def on_send(sock, msg_type, meta):
         return None
     if rule.action == 'delay':
         time.sleep(rule.secs)
+        return None
+    if rule.action == 'stall':
+        _stall_for(rule, 'send of msg type %s' % msg_type)
         return None
     if rule.action == 'drop':
         _close_quietly(sock)
@@ -543,6 +599,9 @@ def on_send_vars(sock, msg_type, entries):
     for i, rule in fired:
         if rule.action == 'delay':
             time.sleep(rule.secs)
+        elif rule.action == 'stall':
+            _stall_for(rule, 'send of msg type %s (batch var %d)'
+                       % (msg_type, i))
     for i, rule in fired:
         if rule.action == 'drop':
             _close_quietly(sock)
@@ -580,6 +639,9 @@ def on_recv_vars(sock, msg_type, count):
     for i, rule in fired:
         if rule.action == 'delay':
             time.sleep(rule.secs)
+        elif rule.action == 'stall':
+            _stall_for(rule, 'recv of msg type %s (batch var %d)'
+                       % (msg_type, i))
     for i, rule in fired:
         if rule.action == 'drop':
             return 'drop'
@@ -606,6 +668,9 @@ def on_recv(sock, msg_type, meta):
         return None
     if rule.action == 'delay':
         time.sleep(rule.secs)
+        return None
+    if rule.action == 'stall':
+        _stall_for(rule, 'recv of msg type %s' % msg_type)
         return None
     if rule.action == 'drop':
         return 'drop'

@@ -192,6 +192,33 @@ Known flags:
   fleet_deploy_timeout   seconds rolling_deploy() may spend per replica
                          on drain + refresh + health-check before the
                          deploy aborts (the replica is un-drained)
+  fleet_connect_timeout  cap (seconds) on the TCP connect step of one
+                         router->replica call; the effective connect
+                         timeout is min(per-call timeout, this) so a
+                         short probe call can never spend longer
+                         connecting than it was given overall
+  fleet_probe_timeout    SRV_HEALTH probe RPC timeout (seconds) on the
+                         router's DEDICATED per-replica probe
+                         connection — deliberately far below
+                         call_timeout so one stalled replica delays the
+                         probe loop by at most this, not 10s
+  fleet_progress_timeout_secs  gray-failure watchdog (serving/fleet.py):
+                         a dispatched stream with no new token for this
+                         long — or a router->replica RPC in flight this
+                         long — gray-marks the replica and fails its
+                         streams over through the re-prefill path
+                         (bit-exact by greedy determinism). 0 = off
+  fleet_hedge_ms         hedged dispatch: a stream with no first token
+                         this many ms after dispatch is duplicated to a
+                         second replica; first token wins, the loser is
+                         SRV_CANCELled. Greedy determinism makes both
+                         streams identical, so hedging can never change
+                         output. 0 = off
+  fleet_gray_probes      clean (in-time) SRV_HEALTH probes a gray-marked
+                         replica must answer consecutively before it
+                         rejoins dispatch (the half-open probation
+                         length); a slow or failed probe resets the
+                         count
   fleet_cache_shed_budget  cross-replica retries a stream that FAILED
                          with CacheExhaustedError gets (the router
                          requeues it onto a cooler replica) before the
@@ -383,6 +410,16 @@ _DEFAULTS = {
     'fleet_admission_rules': '',
     'fleet_deploy_timeout': 120.0,
     'fleet_cache_shed_budget': 5,
+    # gray-failure tolerance (serving/fleet.py): connect-step cap and
+    # the dedicated probe-connection timeout (both seconds), the
+    # no-progress watchdog horizon (0 = off), the hedged-dispatch
+    # trigger in ms (0 = off), and the half-open probation length in
+    # clean probes before a gray-marked replica rejoins dispatch
+    'fleet_connect_timeout': 2.0,
+    'fleet_probe_timeout': 1.0,
+    'fleet_progress_timeout_secs': 0.0,
+    'fleet_hedge_ms': 0.0,
+    'fleet_gray_probes': 3,
     # speculative decoding (serving/speculative.py): max draft
     # proposals per verify pass (adaptive k's ceiling; 0 = off), and
     # the self-draft truncation depth in transformer blocks

@@ -64,7 +64,7 @@ from .decode import DecodePredictor
 from .paging import CacheExhaustedError, PagePool, PageTable, PrefixCache
 from .paged import PagedDecodePredictor
 from .speculative import DraftModel, SpeculativeDecodePredictor
-from .engine import ServingEngine, Request
+from .engine import ServingEngine, Request, DeadlineExceededError
 from .preempt import HostSwapBudget
 from .api import LMServer
 from .replica import ReplicaServer
@@ -74,6 +74,7 @@ from .fleet import (FleetRouter, FleetAutoscaler, FleetRequest,
 __all__ = ['DecodePredictor', 'PagedDecodePredictor',
            'DraftModel', 'SpeculativeDecodePredictor',
            'CacheExhaustedError', 'PagePool', 'PageTable', 'PrefixCache',
-           'ServingEngine', 'Request', 'HostSwapBudget', 'LMServer',
+           'ServingEngine', 'Request', 'DeadlineExceededError',
+           'HostSwapBudget', 'LMServer',
            'ReplicaServer', 'FleetRouter', 'FleetAutoscaler',
            'FleetRequest', 'OverloadError', 'FleetDeployError']

@@ -111,13 +111,15 @@ class LMServer(object):
 
     # -- async -------------------------------------------------------------
     def submit(self, prompt, max_new_tokens=16, eos_id=None,
-               priority=0):
+               priority=0, deadline_ms=None):
         """Enqueue; returns an opaque handle for poll()/result().
         priority is the SLO tier (higher = more important, 0 = the
-        default lowest tier — the only tier admission ever rejects;
-        see ServingEngine.submit)."""
+        default lowest tier — the only tier admission ever rejects),
+        deadline_ms the optional end-to-end budget (None = no deadline;
+        see ServingEngine.submit for the expiry semantics)."""
         req = self._engine.submit(prompt, max_new_tokens, eos_id=eos_id,
-                                  priority=priority)
+                                  priority=priority,
+                                  deadline_ms=deadline_ms)
         self._requests[req.id] = req
         return req.id
 
@@ -129,9 +131,15 @@ class LMServer(object):
 
     def poll(self, handle):
         """Non-blocking progress snapshot: {'state', 'tokens'} — tokens
-        is the stream generated SO FAR, safe to read mid-decode."""
+        is the stream generated SO FAR, safe to read mid-decode. A
+        FAILED stream carries 'error' too, so the failure class (e.g.
+        a typed DeadlineExceededError) survives the SRV_POLL hop to
+        the router; peers that predate the key simply ignore it."""
         req = self._req(handle)
-        return {'state': req.state, 'tokens': list(req.tokens)}
+        out = {'state': req.state, 'tokens': list(req.tokens)}
+        if req.error is not None:
+            out['error'] = str(req.error)
+        return out
 
     def result(self, handle, timeout=None):
         """Block for the final token stream (see Request.result)."""

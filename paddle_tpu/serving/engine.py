@@ -45,10 +45,19 @@ from . import preempt as _preempt
 from .paging import CacheExhaustedError
 from .preempt import HostSwapBudget, pick_victim, preempt_policy
 
-__all__ = ['Request', 'ServingEngine']
+__all__ = ['Request', 'ServingEngine', 'DeadlineExceededError']
 
 QUEUED, RUNNING, DONE, CANCELLED, FAILED = \
     'QUEUED', 'RUNNING', 'DONE', 'CANCELLED', 'FAILED'
+
+
+class DeadlineExceededError(RuntimeError):
+    """The request's end-to-end deadline_ms budget expired before it
+    finished. Typed and NON-retryable (serving/replica.py special-cases
+    it): retrying elsewhere can only spend more of a budget that is
+    already gone. As a lane/queue failure it crosses poll() as a FAILED
+    state whose error string leads with this class name — the fleet
+    router string-matches it the same way it matches CacheExhausted."""
 
 _submitted = telemetry.counter('serving.requests.submitted')
 _admitted = telemetry.counter('serving.requests.admitted')
@@ -67,6 +76,7 @@ _decode_batch = telemetry.histogram('serving.decode_batch')
 _weight_swaps = telemetry.counter('serving.weight_swaps')
 _swap_wait = telemetry.histogram('serving.swap_wait')
 _cache_exhausted = telemetry.counter('serving.cache_exhausted')
+_deadline_expired = telemetry.counter('serving.deadline_expired')
 
 
 class _StepGate(object):
@@ -125,7 +135,8 @@ class Request(object):
 
     _ids = itertools.count()
 
-    def __init__(self, prompt, max_new_tokens, eos_id, priority=0):
+    def __init__(self, prompt, max_new_tokens, eos_id, priority=0,
+                 deadline_ms=None):
         self.id = next(Request._ids)
         self.prompt = [int(t) for t in np.asarray(prompt).reshape(-1)]
         self.max_new_tokens = int(max_new_tokens)
@@ -137,6 +148,10 @@ class Request(object):
         self.snapshot = None          # swapped pages while preempted
         self.preempted_at = None      # set while waiting to resume
         self.submitted_at = time.perf_counter()
+        # end-to-end budget, absolute against THIS process's clock from
+        # arrival — None (the old-peer / no-key path) means no deadline
+        self.deadline_at = None if deadline_ms is None \
+            else self.submitted_at + float(deadline_ms) / 1000.0
         self.first_token_at = None
         self.done_at = None
         self._done = threading.Event()
@@ -302,11 +317,17 @@ class ServingEngine(object):
 
     # -- client surface ----------------------------------------------------
     def submit(self, prompt, max_new_tokens=16, eos_id=None,
-               priority=0):
+               priority=0, deadline_ms=None):
         """priority: SLO tier, higher = more important (default 0 =
         the lowest tier). Tiers dequeue highest-first, and the
         queue-full rejection applies only to the lowest tier — shed
-        rules cost low-tier latency, never high-tier admission."""
+        rules cost low-tier latency, never high-tier admission.
+
+        deadline_ms: optional end-to-end budget. An expired request is
+        rejected at dequeue (before its prefill is wasted) and an
+        expired lane is cancelled between decode steps with its pages
+        freed — both FAILED with a typed, non-retryable
+        DeadlineExceededError. None = no deadline."""
         prompt = np.asarray(prompt).reshape(-1)
         max_len = self._predictors[0].max_len
         if not 1 <= prompt.size <= max_len:
@@ -316,8 +337,14 @@ class ServingEngine(object):
         if max_new_tokens < 1:
             _rejected.inc()
             raise ValueError('max_new_tokens must be >= 1')
+        if deadline_ms is not None and float(deadline_ms) <= 0:
+            _rejected.inc()
+            _deadline_expired.inc()
+            raise DeadlineExceededError(
+                'deadline_ms %r already spent at submit'
+                % (deadline_ms,))
         req = Request(prompt, max_new_tokens, eos_id,
-                      priority=priority)
+                      priority=priority, deadline_ms=deadline_ms)
         with self._cond:
             if self._running and not self._accepting:
                 _rejected.inc()
@@ -460,6 +487,17 @@ class ServingEngine(object):
                         self._forget_preempted(req)
                         req._finish(CANCELLED)
                         _cancelled.inc()
+                        continue
+                    if req.deadline_at is not None and \
+                            time.perf_counter() > req.deadline_at:
+                        # expired while queued: reject BEFORE wasting a
+                        # prefill on tokens nobody is waiting for
+                        self._forget_preempted(req)
+                        req._finish(FAILED,
+                                    error='DeadlineExceededError: '
+                                          'expired in queue')
+                        _failed.inc()
+                        _deadline_expired.inc()
                         continue
                     return req
         return None
@@ -697,6 +735,15 @@ class ServingEngine(object):
                 self._finish_lane(lanes, slot, CANCELLED, pred=pred,
                                   wstate=wstate)
                 continue
+            if req.deadline_at is not None and \
+                    time.perf_counter() > req.deadline_at:
+                prefilling.popleft()
+                self._finish_lane(lanes, slot, FAILED,
+                                  error='DeadlineExceededError: '
+                                        'expired mid-prefill',
+                                  pred=pred, wstate=wstate)
+                _deadline_expired.inc()
+                continue
             try:
                 out = pred.prefill_step(slot)
             except CacheExhaustedError as e:
@@ -771,6 +818,21 @@ class ServingEngine(object):
                 _occupancy.set(self._active_total)
                 self._slot_tokens[wid] = {s: ln.pos
                                           for s, ln in lanes.items()}
+                # deadline check at the step boundary: an expired ready
+                # lane is evicted (pages freed) before it buys another
+                # decode step. Prefilling lanes are checked at the
+                # prefill-queue head (_prefill_tick), matching how
+                # cancellation reaches them.
+                now = time.perf_counter()
+                for slot, ln in list(lanes.items()):
+                    if ln.ready and ln.req.deadline_at is not None \
+                            and now > ln.req.deadline_at:
+                        self._finish_lane(
+                            lanes, slot, FAILED,
+                            error='DeadlineExceededError: expired '
+                                  'mid-decode',
+                            pred=pred, wstate=wstate)
+                        _deadline_expired.inc()
                 ready = [s for s, ln in lanes.items() if ln.ready]
                 if not ready:
                     continue

@@ -39,6 +39,30 @@ admission   obs/slo.py rules evaluated every control tick against the
             preempt lowest-tier streams to make room rather than the
             router turning important work away at the door.
 
+gray        the fail-SLOW half of the failure model (Huang et al.
+            "Gray Failure"; Dean & Barroso "The Tail at Scale"): a
+            replica that answers SRV_HEALTH while its streams hang. A
+            progress watchdog (FLAGS_fleet_progress_timeout_secs)
+            gray-marks a replica whose streams — or whose in-flight
+            RPC — made no progress within the horizon, fails its
+            streams over through the same bit-exact re-prefill path,
+            and interrupts the wedged connection so the pump never
+            waits out the full RPC timeout. Gray replicas keep
+            answering probes on a DEDICATED short-timeout probe
+            connection (FLAGS_fleet_probe_timeout) in half-open
+            probation and rejoin after FLAGS_fleet_gray_probes clean
+            probes (a circuit breaker over a probe-latency EWMA +
+            progress strikes). Hedged dispatch
+            (FLAGS_fleet_hedge_ms) covers the slow-prefill tail: a
+            stream with no first token past the horizon is duplicated
+            to a second replica, first token wins, the loser is
+            SRV_CANCELled — greedy determinism makes both copies
+            identical, so hedging can never change output. Optional
+            end-to-end deadlines (submit(deadline_ms=)) ride the
+            SRV_SUBMIT meta with the ELAPSED time deducted at every
+            failover/hedge re-dispatch; expiry is a typed,
+            non-retryable DeadlineExceededError.
+
 deploys     rolling_deploy(): one replica at a time — stop dispatching
             to it (+ SRV_DRAIN fence), wait for its in-flight streams,
             SRV_REFRESH (the PR-9 ParamSubscriber pull/verify/install
@@ -56,10 +80,12 @@ Telemetry (exported when FLAGS_obs_dir is set; the router ALSO keeps
 local counts for stats() and the admission snapshot):
   fleet.requests.{submitted,completed,failed,cancelled} / fleet.shed /
   fleet.cache_sheds / fleet.failovers / fleet.replica_deaths /
-  fleet.dispatches / fleet.deploys / fleet.tokens_generated  counters;
+  fleet.dispatches / fleet.deploys / fleet.tokens_generated /
+  fleet.hedges / fleet.hedge_wins / fleet.gray_marks /
+  fleet.deadline_expired                   counters;
   fleet.queue_depth / fleet.active_streams / fleet.replicas_healthy /
   fleet.replicas_total / fleet.shedding    gauges;
-  fleet.ttft / fleet.dispatch_wait         histograms;
+  fleet.ttft / fleet.dispatch_wait / fleet.probe_latency  histograms;
   fleet.deploy / fleet.drain               spans.
 """
 from __future__ import annotations
@@ -100,6 +126,11 @@ _replicas_total = telemetry.gauge('fleet.replicas_total')
 _shedding_g = telemetry.gauge('fleet.shedding')
 _ttft = telemetry.histogram('fleet.ttft')
 _dispatch_wait = telemetry.histogram('fleet.dispatch_wait')
+_hedges = telemetry.counter('fleet.hedges')
+_hedge_wins = telemetry.counter('fleet.hedge_wins')
+_gray_marks = telemetry.counter('fleet.gray_marks')
+_deadline_expired = telemetry.counter('fleet.deadline_expired')
+_probe_latency = telemetry.histogram('fleet.probe_latency')
 
 
 class OverloadError(RuntimeError):
@@ -162,7 +193,7 @@ class FleetRequest(object):
     _ids = itertools.count()
 
     def __init__(self, prompt, max_new_tokens, eos_id, session,
-                 priority=0):
+                 priority=0, deadline_ms=None):
         self.id = next(FleetRequest._ids)
         self.prompt = [int(t) for t in np.asarray(prompt).reshape(-1)]
         self.max_new_tokens = int(max_new_tokens)
@@ -178,6 +209,17 @@ class FleetRequest(object):
         self.base = 0                 # len(tokens) at segment dispatch
         self.rid = None
         self.submitted_at = time.perf_counter()
+        # end-to-end budget: absolute perf_counter expiry, None = no
+        # deadline. Every re-dispatch (failover, hedge) forwards only
+        # the REMAINING milliseconds — elapsed time is never refunded.
+        self.deadline_at = (None if deadline_ms is None
+                            else self.submitted_at
+                            + float(deadline_ms) / 1000.0)
+        # progress clock for the gray-failure watchdog: stamped at
+        # dispatch and on every token growth
+        self.last_progress_at = None
+        self.hedge_ep = None          # endpoint holding the duplicate
+        self.hedge_rid = None
         self.dispatched_at = None
         self.first_token_at = None
         self.done_at = None
@@ -213,35 +255,53 @@ class _ReplicaClient(object):
         self._sock = None
         self._mu = threading.Lock()
         self._seq = itertools.count()
+        # perf_counter at the start of the in-flight call, None when
+        # idle — the gray-failure watchdog reads this (racily, without
+        # the lock: a stale glimpse only delays detection one tick) to
+        # catch a replica that accepted a request and then went silent
+        self.inflight_since = None
 
     def call(self, msg_type, meta=None, value=None, timeout=None):
         with self._mu:
-            seq = next(self._seq)
-            m = dict(meta or {})
-            m['seq'] = seq
+            self.inflight_since = time.perf_counter()
             try:
-                if self._sock is None:
-                    host, port = self.endpoint.rsplit(':', 1)
-                    self._sock = socket.create_connection(
-                        (host, int(port)), timeout=2.0)
-                    self._sock.setsockopt(socket.IPPROTO_TCP,
-                                          socket.TCP_NODELAY, 1)
-                self._sock.settimeout(timeout or self._timeout)
-                wire.write_msg(self._sock, msg_type, m, value)
-                rt, rmeta, _rv = wire.read_msg(self._sock)
-            except (ConnectionError, OSError):
-                self._reset_locked()
-                raise
-            if rmeta.get('seq') != seq:
-                self._reset_locked()
-                raise ConnectionError(
-                    'replica %s reply seq %r != %d — desynced'
-                    % (self.endpoint, rmeta.get('seq'), seq))
-            if rt == wire.REPLY_ERR:
-                raise _ReplicaError(
-                    '%s: %s' % (self.endpoint, rmeta.get('error')),
-                    retryable=bool(rmeta.get('retryable')))
-            return rmeta
+                return self._call_locked(msg_type, meta, value, timeout)
+            finally:
+                self.inflight_since = None
+
+    def _call_locked(self, msg_type, meta, value, timeout):
+        seq = next(self._seq)
+        m = dict(meta or {})
+        m['seq'] = seq
+        try:
+            if self._sock is None:
+                host, port = self.endpoint.rsplit(':', 1)
+                # the dial honors the caller's budget: a short-timeout
+                # probe must not spend the full connect allowance on a
+                # SYN blackhole (FLAGS_fleet_connect_timeout caps the
+                # dial fleet-wide; the per-call timeout caps it tighter)
+                dial = min(float(timeout or self._timeout),
+                           float(get_flag('fleet_connect_timeout')))
+                self._sock = socket.create_connection(
+                    (host, int(port)), timeout=dial)
+                self._sock.setsockopt(socket.IPPROTO_TCP,
+                                      socket.TCP_NODELAY, 1)
+            self._sock.settimeout(timeout or self._timeout)
+            wire.write_msg(self._sock, msg_type, m, value)
+            rt, rmeta, _rv = wire.read_msg(self._sock)
+        except (ConnectionError, OSError):
+            self._reset_locked()
+            raise
+        if rmeta.get('seq') != seq:
+            self._reset_locked()
+            raise ConnectionError(
+                'replica %s reply seq %r != %d — desynced'
+                % (self.endpoint, rmeta.get('seq'), seq))
+        if rt == wire.REPLY_ERR:
+            raise _ReplicaError(
+                '%s: %s' % (self.endpoint, rmeta.get('error')),
+                retryable=bool(rmeta.get('retryable')))
+        return rmeta
 
     def _reset_locked(self):
         if self._sock is not None:
@@ -251,15 +311,29 @@ class _ReplicaClient(object):
                 pass
             self._sock = None
 
+    def interrupt(self):
+        """Unblock a stalled in-flight call WITHOUT taking the call
+        lock — the stalled caller HOLDS it, so close() here would
+        deadlock the watchdog behind the very stall it is breaking.
+        shutdown() makes the blocked read raise immediately; the
+        call's own error path then closes and resets the socket."""
+        sock = self._sock
+        if sock is not None:
+            try:
+                sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+
     def close(self):
         with self._mu:
             self._reset_locked()
 
 
 class _Replica(object):
-    __slots__ = ('endpoint', 'client', 'order', 'healthy', 'draining',
-                 'fails', 'active', 'capacity', 'queue_depth',
-                 'max_len', 'param_version', 'hold_until',
+    __slots__ = ('endpoint', 'client', 'probe', 'order', 'healthy',
+                 'draining', 'fails', 'active', 'hedges', 'capacity',
+                 'queue_depth', 'max_len', 'param_version', 'hold_until',
+                 'gray', 'strikes', 'clean_probes', 'probe_ewma',
                  'cache_tokens', 'cache_capacity',
                  'effective_tokens_per_step', 'spec_accept_rate',
                  'preemptions', 'preempted_streams')
@@ -267,11 +341,22 @@ class _Replica(object):
     def __init__(self, endpoint, order, timeout):
         self.endpoint = endpoint
         self.client = _ReplicaClient(endpoint, timeout=timeout)
+        # health probes ride a DEDICATED connection: a gray replica
+        # stalls its data connection while this one keeps answering —
+        # exactly the split that lets the router keep measuring a
+        # replica it no longer trusts with streams
+        self.probe = _ReplicaClient(endpoint, timeout=timeout)
         self.order = order
         self.healthy = False          # flips on the first good probe
         self.draining = False
         self.fails = 0
         self.active = {}              # req.id -> FleetRequest
+        self.hedges = {}              # req.id -> FleetRequest (duplicates
+        #                               hedged ONTO this replica)
+        self.gray = False             # gray-marked: probe-only probation
+        self.strikes = 0              # consecutive slow-probe strikes
+        self.clean_probes = 0         # clean probes while gray
+        self.probe_ewma = None        # probe-latency EWMA (secs)
         self.capacity = 1
         self.queue_depth = 0
         self.max_len = None
@@ -392,6 +477,12 @@ class FleetRouter(object):
             probe_fail_threshold if probe_fail_threshold is not None
             else get_flag('fleet_probe_fails'))
         self._call_timeout = float(call_timeout)
+        self._probe_timeout = min(
+            float(get_flag('fleet_probe_timeout')), self._call_timeout)
+        self._progress_timeout = float(
+            get_flag('fleet_progress_timeout_secs'))
+        self._hedge_ms = float(get_flag('fleet_hedge_ms'))
+        self._gray_probes = max(1, int(get_flag('fleet_gray_probes')))
         if admission_rules is None:
             admission_rules = get_flag('fleet_admission_rules') or [
                 {'name': 'fleet_queue_depth',
@@ -417,6 +508,13 @@ class FleetRouter(object):
         self._deploys_n = 0
         self._tokens_n = 0
         self._dispatches_n = 0
+        self._hedges_n = 0
+        self._hedge_wins_n = 0
+        self._gray_marks_n = 0
+        self._deadline_expired_n = 0
+        self._cancelq = []            # [(endpoint, rid)] — loser rids
+        #                               the pump SRV_CANCELs best-effort
+        self._pollers = {}            # endpoint -> poller thread
         self._shedding = False
         self._breach_streak = 0
         self._breach_rule = None
@@ -445,15 +543,25 @@ class FleetRouter(object):
 
     def stop(self):
         self._stop_evt.set()
+        with self._mu:
+            reps = list(self._reps.values())
+        for rep in reps:
+            # unblock any poller/pump call wedged on a stalled replica
+            # so the joins below do not wait out a full RPC timeout
+            rep.client.interrupt()
         for t in self._threads:
             t.join(timeout=10.0)
         self._threads = []
+        for t in self._pollers.values():
+            t.join(timeout=5.0)
+        self._pollers.clear()
         with self._mu:
             victims = [r for q in self._hold.values() for r in q]
             self._hold.clear()
             for rep in self._reps.values():
                 victims.extend(rep.active.values())
                 rep.active.clear()
+                rep.hedges.clear()
         for req in victims:
             if req.state in (QUEUED, RUNNING):
                 req._finish(CANCELLED)
@@ -461,6 +569,7 @@ class FleetRouter(object):
                 _cancelled.inc()
         for rep in self._reps.values():
             rep.client.close()
+            rep.probe.close()
 
     def __enter__(self):
         return self.start()
@@ -506,11 +615,14 @@ class FleetRouter(object):
             for req in list(rep.active.values()):
                 rep.active.pop(req.id, None)
                 self._requeue_locked(req)
+            for req in list(rep.hedges.values()):
+                self._drop_hedge_locked(req, cancel=False)
             self._reps.pop(endpoint, None)
             for s, ep in list(self._sessions.items()):
                 if ep == endpoint:
                     del self._sessions[s]
         rep.client.close()
+        rep.probe.close()
         _replicas_total.set(len(self._reps))
 
     def replicas(self):
@@ -568,15 +680,20 @@ class FleetRouter(object):
 
     # -- client surface ----------------------------------------------------
     def submit(self, prompt, max_new_tokens=16, eos_id=None,
-               session=None, priority=0):
+               session=None, priority=0, deadline_ms=None):
         """Admit a stream into the fleet. priority is the SLO tier
         (higher = more important, 0 = the default lowest). Raises
         OverloadError while shedding (or when the hold queue is at its
         hard bound) — but only for the lowest tier (priority <= 0):
         higher tiers are always admitted, and the replicas preempt
-        lowest-tier streams to make room for them."""
+        lowest-tier streams to make room for them. deadline_ms is the
+        optional end-to-end budget (None = no deadline): expiry fails
+        the stream with a typed, non-retryable DeadlineExceededError —
+        at dispatch (before wasting a prefill) or replica-side at
+        dequeue / per decode step — and the REMAINING budget, elapsed
+        deducted, rides every failover or hedge re-dispatch."""
         req = FleetRequest(prompt, max_new_tokens, eos_id, session,
-                           priority=priority)
+                           priority=priority, deadline_ms=deadline_ms)
         if not req.prompt:
             raise ValueError('empty prompt')
         with self._mu:
@@ -630,6 +747,7 @@ class FleetRouter(object):
     def stats(self):
         with self._mu:
             reps = {ep: {'healthy': r.healthy, 'draining': r.draining,
+                         'gray': r.gray,
                          'active': len(r.active),
                          'capacity': r.capacity,
                          'queue_depth': r.queue_depth,
@@ -656,6 +774,10 @@ class FleetRouter(object):
                     'deploys': self._deploys_n,
                     'dispatches': self._dispatches_n,
                     'tokens': self._tokens_n,
+                    'hedges': self._hedges_n,
+                    'hedge_wins': self._hedge_wins_n,
+                    'gray_marks': self._gray_marks_n,
+                    'deadline_expired': self._deadline_expired_n,
                     'shedding': self._shedding}
 
     def admission_snapshot(self):
@@ -684,11 +806,56 @@ class FleetRouter(object):
     def _pump_loop(self):
         while not self._stop_evt.is_set():
             try:
+                self._ensure_pollers()
                 self._dispatch_held()
-                self._poll_streams()
+                self._drain_cancelq()
             except Exception as e:    # noqa: BLE001 — router survives
                 _trace.event('fleet.pump_error', error=repr(e))
             self._stop_evt.wait(self._poll_secs)
+
+    def _ensure_pollers(self):
+        """One poll thread PER replica (started lazily here, pump
+        thread only): a gray replica stalls its own poll for the full
+        RPC timeout, and with a shared poll loop that stall would
+        freeze progress for every healthy replica too — the exact
+        amplification gray failures are famous for."""
+        with self._mu:
+            reps = list(self._reps.values())
+        for rep in reps:
+            t = self._pollers.get(rep.endpoint)
+            if t is not None and t.is_alive():
+                continue
+            t = threading.Thread(target=self._poller_loop, args=(rep,),
+                                 name='fleet-poll-%s' % rep.endpoint,
+                                 daemon=True)
+            self._pollers[rep.endpoint] = t
+            t.start()
+
+    def _poller_loop(self, rep):
+        while not self._stop_evt.is_set():
+            if self._reps.get(rep.endpoint) is not rep:
+                return                # replica removed (or replaced)
+            try:
+                self._poll_one(rep)
+            except Exception as e:    # noqa: BLE001 — router survives
+                _trace.event('fleet.pump_error', error=repr(e))
+            self._stop_evt.wait(self._poll_secs)
+
+    def _drain_cancelq(self):
+        """Best-effort SRV_CANCEL of hedge-loser rids, off the poller
+        threads so a slow loser cannot block progress accounting."""
+        while True:
+            with self._mu:
+                if not self._cancelq:
+                    return
+                ep, rid = self._cancelq.pop(0)
+                rep = self._reps.get(ep)
+            if rep is None:
+                continue
+            try:
+                rep.client.call(wire.SRV_CANCEL, {'rid': rid})
+            except (ConnectionError, OSError, _ReplicaError):
+                pass                  # loser dies with its replica
 
     def _dispatch_held(self):
         while not self._stop_evt.is_set():
@@ -702,6 +869,17 @@ class FleetRouter(object):
                     req._finish(CANCELLED)
                     self._cancelled_n += 1
                     _cancelled.inc()
+                    continue
+                if req.deadline_at is not None and \
+                        time.perf_counter() > req.deadline_at:
+                    # spent budget: fail BEFORE wasting a prefill
+                    q.popleft()
+                    _queue_depth.set(self._hold_len_locked())
+                    self._deadline_expired_n += 1
+                    _deadline_expired.inc()
+                    self._finalize_locked(
+                        req, FAILED,
+                        'DeadlineExceededError: expired before dispatch')
                     continue
                 remaining = req.max_new_tokens - len(req.tokens)
                 if remaining <= 0:    # failover landed exactly at budget
@@ -718,11 +896,23 @@ class FleetRouter(object):
                 req.rid = '%s/%d/%d' % (self._nonce, req.id,
                                         req.segment)
                 rep.active[req.id] = req
+                # the progress clock starts NOW, covering the submit
+                # RPC itself: a replica that accepts the connection and
+                # never replies is as gray as one that stops decoding
+                req.last_progress_at = time.perf_counter()
                 if req.session is not None:
                     self._sessions[req.session] = rep.endpoint
                 prompt = req.prompt + req.tokens
                 rid, mnt, eos = req.rid, remaining, req.eos_id
                 prio = req.priority
+                meta = {'rid': rid, 'mnt': mnt, 'eos': eos,
+                        'prio': prio}
+                if req.deadline_at is not None:
+                    # forward only the REMAINING budget — elapsed time
+                    # (queueing, earlier segments) is never refunded
+                    meta['deadline_ms'] = max(
+                        1.0, (req.deadline_at - req.last_progress_at)
+                        * 1000.0)
                 if rep.max_len is not None and len(prompt) > rep.max_len:
                     # a failover prefix past the context window cannot
                     # be re-prefilled bit-exactly (ring slide)
@@ -733,18 +923,24 @@ class FleetRouter(object):
                         % (len(prompt), rep.max_len))
                     continue
             try:
-                rep.client.call(
-                    wire.SRV_SUBMIT,
-                    {'rid': rid, 'mnt': mnt, 'eos': eos, 'prio': prio},
-                    value=np.asarray(prompt, np.int64))
+                rep.client.call(wire.SRV_SUBMIT, meta,
+                                value=np.asarray(prompt, np.int64))
             except _ReplicaError as e:
                 with self._mu:
+                    if rep.active.get(req.id) is not req:
+                        # superseded while the submit was in flight (a
+                        # hedge won, or the watchdog failed it over):
+                        # this reply belongs to a dead dispatch
+                        continue
                     rep.active.pop(req.id, None)
                     req.replica = None
                     if e.retryable:   # full / draining: try elsewhere
                         rep.hold_until = time.monotonic() + 0.05
                         self._hold_push_locked(req, front=True)
                     else:
+                        if 'DeadlineExceeded' in str(e):
+                            self._deadline_expired_n += 1
+                            _deadline_expired.inc()
                         self._finalize_locked(req, FAILED, str(e))
             except (ConnectionError, OSError):
                 self._on_replica_down(rep)
@@ -758,10 +954,11 @@ class FleetRouter(object):
                     self._dispatches_n += 1
                 _dispatches.inc()
 
-    def _pick_locked(self, req):
+    def _pick_locked(self, req, exclude=None):
         now = time.monotonic()
         elig = [r for r in self._reps.values()
-                if r.healthy and not r.draining
+                if r.healthy and not r.draining and not r.gray
+                and r.endpoint != exclude
                 and now >= r.hold_until
                 and len(r.active) < max(1, r.capacity)]
         if not elig:
@@ -791,34 +988,65 @@ class FleetRouter(object):
             / max(1.0, r.effective_tokens_per_step),
             r.order))
 
-    def _poll_streams(self):
-        for rep in list(self._reps.values()):
-            with self._mu:
-                pairs = {r.rid: r for r in rep.active.values()}
-            if not pairs:
-                continue
-            try:
-                reply = rep.client.call(wire.SRV_POLL,
-                                        {'rids': list(pairs)})
-            except (ConnectionError, OSError):
-                self._on_replica_down(rep)
-                continue
-            except _ReplicaError:
-                continue
-            streams = reply.get('streams', {})
-            for rid, req in pairs.items():
-                st = streams.get(rid)
-                if st is not None:
-                    self._apply_poll(rep, req, st)
+    def _poll_one(self, rep):
+        with self._mu:
+            pairs = {r.rid: (r, False) for r in rep.active.values()}
+            for r in rep.hedges.values():
+                pairs[r.hedge_rid] = (r, True)
+        if not pairs:
+            return
+        try:
+            reply = rep.client.call(wire.SRV_POLL,
+                                    {'rids': list(pairs)})
+        except (ConnectionError, OSError):
+            self._on_replica_down(rep)
+            return
+        except _ReplicaError:
+            return
+        streams = reply.get('streams', {})
+        for rid, (req, hedged) in pairs.items():
+            st = streams.get(rid)
+            if st is not None:
+                self._apply_poll(rep, req, st, hedged=hedged)
 
-    def _apply_poll(self, rep, req, st):
+    def _apply_poll(self, rep, req, st, hedged=False):
         state = st.get('state')
         toks = [int(t) for t in st.get('tokens', ())]
         with self._mu:
             if req.state not in (QUEUED, RUNNING):
-                rep.active.pop(req.id, None)
+                (rep.hedges if hedged else rep.active).pop(req.id, None)
                 return
-            if req.rid not in (None,) and rep.active.get(req.id) is not req:
+            if hedged:
+                if rep.hedges.get(req.id) is not req:
+                    return            # hedge already resolved away
+                if state == 'UNKNOWN' or state in (CANCELLED, FAILED):
+                    # the duplicate died (replica restart, cache
+                    # pressure, its own deadline): drop it quietly —
+                    # the primary stream is untouched
+                    self._drop_hedge_locked(req, cancel=False)
+                    return
+                if not toks:
+                    return            # duplicate has nothing yet
+                # first token came from the DUPLICATE: the hedge wins.
+                # Promote it to primary — queue a cancel for the slow
+                # copy, rebind the stream — then fall through to plain
+                # token accounting. Greedy determinism makes both
+                # copies emit identical tokens, so whichever side wins
+                # the stream is the same.
+                prim = self._reps.get(req.replica)
+                if prim is not None and prim.active.get(req.id) is req:
+                    prim.active.pop(req.id, None)
+                    self._cancelq.append((req.replica, req.rid))
+                rep.hedges.pop(req.id, None)
+                req.replica = rep.endpoint
+                req.rid = req.hedge_rid
+                req.hedge_ep = req.hedge_rid = None
+                rep.active[req.id] = req
+                if req.session is not None:
+                    self._sessions[req.session] = rep.endpoint
+                self._hedge_wins_n += 1
+                _hedge_wins.inc()
+            elif rep.active.get(req.id) is not req:
                 return                # already failed over elsewhere
             if state == 'UNKNOWN':
                 # replica restarted underneath its streams: same
@@ -831,6 +1059,11 @@ class FleetRouter(object):
                 req.tokens[req.base:] = toks
             new = len(req.tokens)
             if new > old:
+                req.last_progress_at = time.perf_counter()
+                if req.hedge_ep is not None:
+                    # the PRIMARY produced the first token: its
+                    # duplicate loses and is cancelled
+                    self._drop_hedge_locked(req)
                 self._tokens_n += new - old
                 _tokens_out.inc(new - old)
                 if req.first_token_at is None:
@@ -855,6 +1088,11 @@ class FleetRouter(object):
                 return
             if state in (DONE, CANCELLED, FAILED):
                 rep.active.pop(req.id, None)
+                self._drop_hedge_locked(req)
+                if state == FAILED and \
+                        'DeadlineExceeded' in (st.get('error') or ''):
+                    self._deadline_expired_n += 1
+                    _deadline_expired.inc()
                 self._finalize_locked(req, state, st.get('error'))
 
     def _finalize_locked(self, req, state, error=None):
@@ -869,9 +1107,24 @@ class FleetRouter(object):
             self._failed_n += 1
             _failed.inc()
 
+    def _drop_hedge_locked(self, req, cancel=True):
+        """Forget a stream's pending duplicate (under _mu). cancel=True
+        queues the loser's rid for a best-effort SRV_CANCEL by the
+        pump — never inline, so a slow loser cannot block the caller."""
+        ep, rid = req.hedge_ep, req.hedge_rid
+        req.hedge_ep = req.hedge_rid = None
+        if ep is None:
+            return
+        hrep = self._reps.get(ep)
+        if hrep is not None:
+            hrep.hedges.pop(req.id, None)
+        if cancel and rid is not None:
+            self._cancelq.append((ep, rid))
+
     def _requeue_locked(self, req):
         if req.state not in (QUEUED, RUNNING):
             return
+        self._drop_hedge_locked(req)
         req.segment += 1
         req.replica = None
         req.state = QUEUED
@@ -891,6 +1144,10 @@ class FleetRouter(object):
             rep.fails = max(rep.fails, self._probe_fail_threshold)
             victims = list(rep.active.values())
             rep.active.clear()
+            # duplicates hedged ONTO the dead replica die with it; their
+            # primaries are untouched
+            for req in list(rep.hedges.values()):
+                self._drop_hedge_locked(req, cancel=False)
             for s, ep in list(self._sessions.items()):
                 if ep == rep.endpoint:
                     del self._sessions[s]
@@ -916,18 +1173,27 @@ class FleetRouter(object):
 
     def _control_once(self):
         for rep in list(self._reps.values()):
+            t0 = time.perf_counter()
             try:
-                h = rep.client.call(wire.SRV_HEALTH, {},
-                                    timeout=self._call_timeout)
+                # the dedicated probe connection with its OWN short
+                # timeout (FLAGS_fleet_probe_timeout): liveness checks
+                # must stay cheap and honest while the data connection
+                # is wedged behind a gray stall
+                h = rep.probe.call(wire.SRV_HEALTH, {},
+                                   timeout=self._probe_timeout)
             except (ConnectionError, OSError, _ReplicaError):
                 with self._mu:
                     rep.fails += 1
+                    rep.clean_probes = 0
                     dead = (rep.fails >= self._probe_fail_threshold
                             and (rep.healthy or rep.active))
                 if dead:
                     self._on_replica_down(rep)
                 continue
+            lat = time.perf_counter() - t0
+            _probe_latency.observe(lat)
             with self._mu:
+                self._probe_ok_locked(rep, lat)
                 rep.fails = 0
                 rep.queue_depth = int(h.get('queue_depth', 0))
                 rep.capacity = int(h.get('capacity') or rep.capacity)
@@ -946,6 +1212,8 @@ class FleetRouter(object):
                 rep.preempted_streams = int(
                     h.get('preempted_streams', 0) or 0)
                 rep.healthy = True
+        self._watchdog_tick()
+        self._hedge_tick()
         now = time.monotonic()
         snap = self.admission_snapshot()
         dt = (now - self._prev_snap_t) if self._prev_snap_t else None
@@ -957,6 +1225,161 @@ class FleetRouter(object):
         _active_streams.set(gauges['fleet.active_streams'])
         _replicas_healthy.set(gauges['fleet.replicas_healthy'])
         _replicas_total.set(len(self._reps))
+
+    # -- gray-failure machinery --------------------------------------------
+    def _probe_ok_locked(self, rep, lat):
+        """Probe-latency circuit breaker + half-open probation. A probe
+        that answered but took far longer than the replica's own EWMA
+        (and a floor of half the probe timeout — cold-start latency
+        must not poison the baseline) is a STRIKE; three consecutive
+        strikes gray-mark without waiting for a stream to starve. A
+        gray replica rejoins after FLAGS_fleet_gray_probes consecutive
+        clean probes. The strike path rides the watchdog arm
+        (FLAGS_fleet_progress_timeout_secs > 0): an unarmed router
+        must never gray-mark — a host-wide compile or GC pause slows
+        probes 4x without the replica being at fault. The EWMA warms
+        either way so arming starts from a real baseline."""
+        if rep.probe_ewma is None:
+            rep.probe_ewma = lat
+        slow = lat > max(4.0 * rep.probe_ewma,
+                         0.5 * self._probe_timeout)
+        rep.probe_ewma += 0.2 * (lat - rep.probe_ewma)
+        if self._progress_timeout <= 0:
+            return
+        if rep.gray:
+            if slow:
+                rep.clean_probes = 0
+            else:
+                rep.clean_probes += 1
+                if rep.clean_probes >= self._gray_probes:
+                    rep.gray = False
+                    rep.strikes = 0
+                    rep.clean_probes = 0
+                    _trace.event('fleet.gray_rejoin',
+                                 endpoint=rep.endpoint)
+            return
+        if slow:
+            rep.strikes += 1
+            if rep.strikes >= 3:
+                self._gray_mark_locked(
+                    rep, 'probe latency %.3fs vs ewma %.3fs (3 strikes)'
+                    % (lat, rep.probe_ewma))
+        else:
+            rep.strikes = 0
+
+    def _gray_mark_locked(self, rep, reason):
+        """Stop trusting a live-but-stalled replica: fail its streams
+        over (the same bit-exact re-prefill path a death takes), drop
+        duplicates hedged onto it, and demote it to probe-only
+        probation. Its data connection is interrupted by the CALLER
+        (outside _mu) so a wedged pump/poller call surfaces now
+        instead of after the full RPC timeout."""
+        fresh = not rep.gray
+        rep.gray = True
+        rep.strikes = 0
+        rep.clean_probes = 0
+        victims = list(rep.active.values())
+        rep.active.clear()
+        for req in list(rep.hedges.values()):
+            self._drop_hedge_locked(req, cancel=False)
+        for s, ep in list(self._sessions.items()):
+            if ep == rep.endpoint:
+                del self._sessions[s]
+        for req in victims:
+            self._requeue_locked(req)
+        if fresh:
+            self._gray_marks_n += 1
+            _gray_marks.inc()
+            _trace.event('fleet.gray_mark', endpoint=rep.endpoint,
+                         reason=reason, failover_streams=len(victims))
+
+    def _watchdog_tick(self):
+        """The progress watchdog — the anti-gray-failure check health
+        probes cannot make: a replica is only as healthy as its
+        streams. No token growth (and no reply to an in-flight RPC)
+        within FLAGS_fleet_progress_timeout_secs gray-marks the
+        replica even though SRV_HEALTH still answers."""
+        horizon = self._progress_timeout
+        if horizon <= 0:
+            return                    # watchdog disabled (default)
+        now = time.perf_counter()
+        for rep in list(self._reps.values()):
+            stuck = None
+            with self._mu:
+                if self._reps.get(rep.endpoint) is not rep or rep.gray:
+                    continue
+                inflight = rep.client.inflight_since
+                if inflight is not None and now - inflight > horizon:
+                    stuck = 'rpc in flight %.2fs' % (now - inflight)
+                else:
+                    for r in rep.active.values():
+                        lp = r.last_progress_at
+                        if lp is not None and now - lp > horizon:
+                            stuck = ('stream %d no progress %.2fs'
+                                     % (r.id, now - lp))
+                            break
+                if stuck:
+                    self._gray_mark_locked(rep, stuck)
+            if stuck:
+                rep.client.interrupt()
+
+    def _hedge_tick(self):
+        """Hedged dispatch for the slow-prefill tail: a RUNNING stream
+        with no first token FLAGS_fleet_hedge_ms after dispatch is
+        duplicated to a second replica; whichever copy produces a
+        token first becomes the stream, the loser is SRV_CANCELled.
+        Greedy determinism makes both copies identical, so hedging
+        never changes output — it only moves the tail."""
+        hedge_ms = self._hedge_ms
+        if hedge_ms <= 0:
+            return                    # hedging disabled (default)
+        now = time.perf_counter()
+        jobs = []
+        with self._mu:
+            for rep in list(self._reps.values()):
+                for req in list(rep.active.values()):
+                    # anything registered in rep.active is dispatched
+                    # (or dispatchING — a stream whose SRV_SUBMIT is
+                    # itself wedged on a gray replica is still QUEUED
+                    # and needs the hedge MOST)
+                    if req.state not in (QUEUED, RUNNING) \
+                            or req.hedge_ep is not None:
+                        continue
+                    if len(req.tokens) > req.base:
+                        continue      # first token already landed
+                    lp = req.last_progress_at
+                    if lp is None or (now - lp) * 1000.0 < hedge_ms:
+                        continue
+                    second = self._pick_locked(req,
+                                               exclude=rep.endpoint)
+                    if second is None:
+                        continue
+                    rid = '%s/%d/%dh' % (self._nonce, req.id,
+                                         req.segment)
+                    req.hedge_ep = second.endpoint
+                    req.hedge_rid = rid
+                    second.hedges[req.id] = req
+                    meta = {'rid': rid,
+                            'mnt': req.max_new_tokens - len(req.tokens),
+                            'eos': req.eos_id, 'prio': req.priority}
+                    if req.deadline_at is not None:
+                        meta['deadline_ms'] = max(
+                            1.0, (req.deadline_at - now) * 1000.0)
+                    prompt = np.asarray(req.prompt + req.tokens,
+                                        np.int64)
+                    jobs.append((req, second, meta, prompt))
+                    self._hedges_n += 1
+                    _hedges.inc()
+        for req, second, meta, prompt in jobs:
+            try:
+                second.client.call(wire.SRV_SUBMIT, meta, value=prompt)
+            except _ReplicaError:
+                with self._mu:
+                    self._drop_hedge_locked(req, cancel=False)
+            except (ConnectionError, OSError):
+                with self._mu:
+                    self._drop_hedge_locked(req, cancel=False)
+                self._on_replica_down(second)
 
     def _evaluate_admission(self, snap, dt):
         breached = None

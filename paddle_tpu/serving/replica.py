@@ -166,10 +166,15 @@ class ReplicaServer(object):
             wire.write_msg(conn, wire.REPLY_ERR, err)
             return
         prompt = [int(t) for t in np.asarray(value).reshape(-1)]
+        # deadline_ms rides the meta only when the peer set one — an
+        # old router's meta simply lacks the key and decodes to None
+        ddl = meta.get('deadline_ms')
         handle = self._srv.submit(prompt,
                                   max_new_tokens=int(meta['mnt']),
                                   eos_id=meta.get('eos'),
-                                  priority=int(meta.get('prio', 0)))
+                                  priority=int(meta.get('prio', 0)),
+                                  deadline_ms=None if ddl is None
+                                  else float(ddl))
         with self._lock:
             self._streams[rid] = handle
         wire.write_msg(conn, wire.REPLY_OK, ack)
@@ -223,8 +228,13 @@ class ReplicaServer(object):
 
 def _retryable(e):
     """queue-full / draining / a retryable refresh invite the router to
-    come back; a bad prompt or a missing subscriber is stream-fatal."""
+    come back; a bad prompt, a missing subscriber, or a spent deadline
+    is stream-fatal — retrying a DeadlineExceededError elsewhere can
+    only burn more of a budget that is already gone."""
     from ..online.subscriber import RefreshError
+    from .engine import DeadlineExceededError
     if isinstance(e, RefreshError):
         return True
+    if isinstance(e, DeadlineExceededError):
+        return False
     return isinstance(e, RuntimeError) and not isinstance(e, ValueError)

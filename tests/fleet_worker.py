@@ -28,6 +28,19 @@ run tools/serve_replica.py — this file covers what sits around them):
   bytes, so the RESULT json carries verdict-ready counts
   (high_sheds / high_bad / low_failed / mismatches / preemptions)
   instead of raw streams.
+
+- grayfail: the chaos_sweep --grayfail driver — replica 0 carries a
+  seeded ``stall`` FaultPlan (alive-but-frozen: health keeps passing,
+  its data connection stops mid-stream), and the router runs with the
+  gray-failure watchdog armed (FLAGS_fleet_progress_timeout_secs).
+  Every replica is jit-warmed FIRST over a direct wire connection
+  that completion-checks via SRV_HEALTH — never SRV_POLL — so warmup
+  can neither trip the cold-compile watchdog false positive nor
+  consume the stall rule's SRV_POLL trigger count. Every 3rd stream
+  is priority 1 with a generous deadline_ms; acceptance is every
+  stream bit-exact (np.array_equal) against the in-process solo
+  reference, gray_marks >= 1 once the stall fired, and ZERO high-tier
+  deadline violations.
 """
 import json
 import os
@@ -201,6 +214,117 @@ def run_overload_driver():
             complete_replica(ep)
 
 
+def _warm_replica(endpoint, prompt, budget, timeout=180.0):
+    """Heat one replica's compile caches with a throwaway stream over a
+    direct wire connection. Completion is watched via SRV_HEALTH (the
+    active/queue counters), NOT SRV_POLL: a seeded grayfail stall
+    triggers on the Nth SRV_POLL, and warmup must not consume that
+    count — nor may cold-compile first-token latency ever be visible
+    to the progress watchdog, which is why warmup happens before the
+    driver arms it."""
+    from paddle_tpu.distributed import wire
+    host, port = endpoint.rsplit(':', 1)
+    deadline = time.monotonic() + timeout
+    while True:       # the replica binds only after its model loads
+        try:
+            s = socket.create_connection((host, int(port)), timeout=5.0)
+            break
+        except OSError:
+            if time.monotonic() >= deadline:
+                raise
+            time.sleep(0.25)
+    with s:
+        s.settimeout(timeout)
+        wire.write_msg(s, wire.SRV_SUBMIT,
+                       {'seq': 0, 'rid': 'warm', 'mnt': int(budget)},
+                       np.asarray(prompt, np.int64))
+        wire.read_msg(s)
+        seq = 1
+        while True:
+            wire.write_msg(s, wire.SRV_HEALTH, {'seq': seq})
+            _, meta, _ = wire.read_msg(s)
+            if not meta.get('active') and not meta.get('queue_depth'):
+                return
+            if time.monotonic() >= deadline:
+                raise RuntimeError('warmup of %s timed out' % endpoint)
+            seq += 1
+            time.sleep(0.25)
+
+
+def run_grayfail_driver():
+    # the bit-exact reference runs jax in THIS process — pin CPU first
+    import jax
+    jax.config.update('jax_platforms', 'cpu')
+    replicas = os.environ['FLEET_REPLICAS'].split(',')
+    seed = int(os.environ.get('FLEET_SEED', '0'))
+    n = int(os.environ.get('FLEET_STREAMS', '12'))
+    budget = int(os.environ.get('FLEET_BUDGET', '10'))
+    model_dir = os.environ['FLEET_MODEL_DIR']
+    prompts = make_prompts(seed, n, budget)
+    # every 3rd stream is the paying tier, carrying an end-to-end
+    # deadline generous enough that only a LOST stream (not a slow
+    # one) could breach it — the acceptance is zero tier-1 violations
+    # even while replica 0 stalls mid-stream
+    prios = [1 if i % 3 == 0 else 0 for i in range(n)]
+    for ep in replicas:
+        _warm_replica(ep, prompts[0][0], budget)
+    # arm the gray-failure machinery only now, with all replicas warm
+    # (the router reads these flags at construction; env was already
+    # bootstrapped at import, so go through set_flags)
+    from paddle_tpu import flags
+    flags.set_flags({'FLAGS_fleet_progress_timeout_secs':
+                     os.environ.get('GRAYFAIL_PROGRESS_TIMEOUT', '2.0')})
+    from paddle_tpu.serving import FleetRouter
+    # fast polling so the seeded stall's Nth-SRV_POLL trigger lands
+    # well inside the burst window on any machine speed
+    router = FleetRouter(replicas, poll_secs=0.005, probe_secs=0.1)
+    router.start()
+    try:
+        router.wait_healthy(timeout=120.0)
+        reqs = [router.submit(p, max_new_tokens=budget, session=s,
+                              priority=prio,
+                              deadline_ms=120000.0 if prio > 0 else None)
+                for (p, s), prio in zip(prompts, prios)]
+        streams, states = [], []
+        for r in reqs:
+            r.wait(timeout=300.0)
+            streams.append([int(t) for t in r.tokens])
+            states.append(r.state)
+        stats = router.stats()
+    finally:
+        router.stop()
+    # the in-harness bit-exactness gate: a stream that survived a
+    # gray-mark failover (or a deadline near-miss) must be
+    # np.array_equal to the solo dense-decode reference — gray
+    # tolerance may move work, never change tokens
+    from paddle_tpu.inference import AnalysisConfig, AnalysisPredictor
+    ref = AnalysisPredictor(AnalysisConfig(model_dir)).prepare_decoding(
+        slots=1, prefill_batch=1)
+    mismatches = 0
+    for (p, _), st, toks in zip(prompts, states, streams):
+        want = np.asarray([int(t) for t in ref.generate(p, budget)],
+                          np.int64)
+        if st != 'DONE' or not np.array_equal(
+                np.asarray(toks, np.int64), want):
+            mismatches += 1
+    print('RESULT ' + json.dumps({
+        'submitted': n,
+        'done': sum(1 for s in states if s == 'DONE'),
+        'states': states,
+        'streams': streams,
+        'mismatches': mismatches,
+        'high_bad': sum(1 for s, pr in zip(states, prios)
+                        if pr > 0 and s != 'DONE'),
+        'gray_marks': stats['gray_marks'],
+        'hedges': stats['hedges'],
+        'hedge_wins': stats['hedge_wins'],
+        'deadline_expired': stats['deadline_expired'],
+        'failovers': stats['failovers']}), flush=True)
+    if os.environ.get('FLEET_COMPLETE', '1') == '1':
+        for ep in replicas:
+            complete_replica(ep)
+
+
 def main():
     role = os.environ['FLEET_ROLE']
     if role == 'build':
@@ -209,6 +333,8 @@ def main():
         run_driver()
     elif role == 'overload':
         run_overload_driver()
+    elif role == 'grayfail':
+        run_grayfail_driver()
     else:
         raise SystemExit('unknown FLEET_ROLE %r' % role)
 

@@ -19,6 +19,7 @@ Replica processes for the kill test are real subprocesses
 (tools/serve_replica.py) — SIGKILL needs a pid; everything else runs
 in-process (ReplicaServer threads) to keep tier-1 wall-clock down.
 """
+import json
 import os
 import socket
 import subprocess
@@ -67,15 +68,18 @@ def ref_dec(model_dir):
     return pred.prepare_decoding(slots=4, prefill_batch=1)
 
 
-def _launch_replicas(model_dir, n, slots=4):
+def _launch_replicas(model_dir, n, slots=4, extra_env=None):
+    """extra_env: {replica index: {env overrides}} — how a single
+    replica gets a FaultPlan while its peers run clean."""
     eps, procs = [], []
-    for port in _free_ports(n):
+    for i, port in enumerate(_free_ports(n)):
         ep = '127.0.0.1:%d' % port
         env = dict(os.environ, SERVE_MODEL_DIR=model_dir,
                    SERVE_ENDPOINT=ep, SERVE_SLOTS=str(slots),
                    SERVE_WORKERS='1')
         env.pop('XLA_FLAGS', None)
         env.pop('JAX_PLATFORMS', None)
+        env.update((extra_env or {}).get(i, {}))
         procs.append(subprocess.Popen(
             [sys.executable, _SERVE_REPLICA], env=env,
             stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL))
@@ -367,6 +371,131 @@ def test_replica_server_wire_roundtrip(model_dir, ref_dec):
         sock.close()
         rep.stop()
         srv.close(drain=False)
+
+
+# -- satellite: SRV_SUBMIT prio/deadline meta across both encodings --------
+
+@pytest.mark.timeout(600)
+def test_replica_submit_prio_deadline_meta_roundtrip(model_dir):
+    """priority + deadline_ms must survive the SRV_SUBMIT hop under
+    BOTH meta encodings (JSON and binary-meta v3), and a peer that
+    predates the keys (meta simply lacks them) must decode to the
+    defaults — tier 0, no deadline — not an error."""
+    srv = LMServer(model_dir, slots=2)
+    seen = []
+    orig_submit = srv.submit
+
+    def spy(prompt, **kw):
+        seen.append(dict(kw))
+        return orig_submit(prompt, **kw)
+
+    srv.submit = spy
+    rep = _InprocReplica(srv)
+    try:
+        for bmeta in (False, True):
+            sock = socket.create_connection(
+                ('127.0.0.1', rep.rs.port), timeout=10)
+            if bmeta:
+                wire._mark_peer_bmeta(sock)    # force bmeta v3 framing
+            seq = [0]
+
+            def call(mt, meta=None, value=None, _sock=sock, _seq=seq):
+                _seq[0] += 1
+                m = dict(meta or {}, seq=_seq[0])
+                wire.write_msg(_sock, mt, m, value)
+                rt, rmeta, _ = wire.read_msg(_sock)
+                assert rmeta['seq'] == _seq[0]
+                return rt, rmeta
+
+            try:
+                tag = 'b' if bmeta else 'j'
+                rt, _m = call(wire.SRV_SUBMIT,
+                              {'rid': tag + '1', 'mnt': 4, 'prio': 2,
+                               'deadline_ms': 60000.0},
+                              np.asarray([3, 1, 4], np.int64))
+                assert rt == wire.REPLY_OK
+                assert seen[-1]['priority'] == 2
+                assert seen[-1]['deadline_ms'] == pytest.approx(60000.0)
+
+                # old-peer meta: absent keys mean defaults, not errors
+                rt, _m = call(wire.SRV_SUBMIT,
+                              {'rid': tag + '2', 'mnt': 2},
+                              np.asarray([5], np.int64))
+                assert rt == wire.REPLY_OK
+                assert seen[-1]['priority'] == 0
+                assert seen[-1]['deadline_ms'] is None
+
+                # a near-spent deadline expires inside the engine and
+                # the typed failure class crosses SRV_POLL back out
+                rt, _m = call(wire.SRV_SUBMIT,
+                              {'rid': tag + '3', 'mnt': 10 ** 6,
+                               'deadline_ms': 1.0},
+                              np.asarray([2, 6], np.int64))
+                assert rt == wire.REPLY_OK
+                deadline = time.monotonic() + 120
+                while True:
+                    rt, pr = call(wire.SRV_POLL,
+                                  {'rids': [tag + '3']})
+                    st = pr['streams'][tag + '3']
+                    if st['state'] in ('DONE', 'FAILED', 'CANCELLED'):
+                        break
+                    assert time.monotonic() < deadline
+                    time.sleep(0.01)
+                assert st['state'] == 'FAILED'
+                assert 'DeadlineExceeded' in st['error']
+            finally:
+                sock.close()
+    finally:
+        rep.stop()
+        srv.close(drain=False)
+
+
+# -- satellite: progress watchdog gray-marks a stalled replica -------------
+
+@pytest.mark.timeout(600)
+def test_fleet_watchdog_fails_over_stalled_replica_bit_exact(
+        model_dir, ref_dec):
+    """Gray failure, not fail-stop: replica0's data path freezes for
+    25s mid-burst (FaultPlan stall on its 2nd inbound SRV_POLL) while
+    its health probes keep answering. The progress watchdog must
+    gray-mark it, interrupt the wedged connection, and fail its streams
+    over — every stream completing bit-exact vs the solo reference."""
+    plan = json.dumps({'rules': [{'when': 'recv', 'type': 'SRV_POLL',
+                                  'nth': 2, 'action': 'stall',
+                                  'secs': 25.0}]})
+    from paddle_tpu import flags
+    procs, eps = _launch_replicas(
+        model_dir, 2, extra_env={0: {'FLAGS_fault_plan': plan}})
+    router = None
+    old_timeout = flags.get_flag('fleet_progress_timeout_secs')
+    try:
+        work = fw.make_prompts(3, 8, GEN)
+        # warm both replicas over direct connections (SRV_SUBMIT +
+        # SRV_HEALTH only): the cold jit compile happens before the
+        # watchdog is armed, and the stall rule's SRV_POLL count
+        # survives untouched into the measured burst
+        for ep in eps:
+            fw._warm_replica(ep, work[0][0], GEN)
+        flags.set_flags({'FLAGS_fleet_progress_timeout_secs': 2.5})
+        router = FleetRouter(eps, poll_secs=0.005, probe_secs=0.05,
+                             probe_fail_threshold=2)
+        router.start()
+        router.wait_healthy(timeout=240.0)
+        reqs = [router.submit(p, max_new_tokens=GEN, session=s)
+                for p, s in work]
+        for r in reqs:
+            assert r.wait(timeout=240.0), (r.id, r.state)
+        st = router.stats()
+        assert st['gray_marks'] >= 1, st
+        for r, (p, _s) in zip(reqs, work):
+            assert r.state == 'DONE'
+            assert np.array_equal(r.result(), ref_dec.generate(p, GEN))
+    finally:
+        flags.set_flags(
+            {'FLAGS_fleet_progress_timeout_secs': old_timeout})
+        if router is not None:
+            router.stop()
+        _cleanup_replicas(procs, eps)
 
 
 # -- satellite: supervisor restart-budget reset ----------------------------

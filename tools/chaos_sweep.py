@@ -90,6 +90,20 @@ or any completed stream whose tokens differ from the solo reference —
 and `fatal` additionally when serving.preemptions stayed 0 (the seed
 never exercised the machinery it gates).
 
+`--grayfail` chaoses the fail-SLOW half of the failure model: replica
+0 runs under `FaultPlan.from_grayfail_seed` (one seeded ``stall`` rule
+— at the Nth inbound SRV_POLL its data connection freezes for 20-40s
+while SRV_HEALTH keeps answering on other connections), and the
+grayfail driver (tests/fleet_worker.py) runs a warmed mixed-tier
+workload with the router's progress watchdog armed. Acceptance:
+every stream completes bit-exact (np.array_equal, in-driver) against
+the solo reference, the watchdog gray-marked the stalled replica
+(fleet.gray_marks >= 1 — `fatal` when the stall fired unseen), and
+zero high-tier deadline violations. Verdicts: `recovered` (stall
+fired, caught, streams intact), `nokill` (the Nth poll was never
+reached), `diverged` (a stream changed or a tier-1 SLO broke), plus
+the usual `fatal`/`hung`.
+
 `--quick` is the CI smoke shape: 3 seeds by default, and the exit
 status is ALSO non-zero on any fatal/hung seed (a quick sweep exists
 to gate regressions, so every non-ok outcome fails it).
@@ -104,6 +118,7 @@ Usage:
     python tools/chaos_sweep.py --refresh --quick   # online-refresh chaos
     python tools/chaos_sweep.py --fleet --quick     # fleet replica/router kill
     python tools/chaos_sweep.py --overload --quick  # preempt-first capacity
+    python tools/chaos_sweep.py --grayfail --quick  # gray-failure watchdog
 
 Exit status is non-zero iff any seed DIVERGED (or, under --quick, any
 seed was fatal/hung): fatal/hung seeds of the full sweep are
@@ -562,6 +577,74 @@ def _run_overload_seed(seed, budget, workdir, model_dir, n_replicas=2,
         sup.stop()
 
 
+def _run_grayfail_seed(seed, budget, workdir, model_dir, n_replicas=2,
+                       streams=24, gen=12, obs_dir=None):
+    """One --grayfail seed: replica 0 is alive-but-stalled (a seeded
+    ``stall`` rule freezes its data connection at the Nth SRV_POLL for
+    20-40s while health probes keep passing) and the grayfail driver
+    (tests/fleet_worker.py) runs a warmed mixed-tier workload with the
+    progress watchdog armed. Nothing dies and nothing restarts — the
+    whole point is that fail-slow looks NOTHING like fail-stop — so
+    the verdict comes from the driver's RESULT counters: bit-exact
+    streams (in-driver np.array_equal against the solo reference),
+    fleet.gray_marks >= 1 once the stall demonstrably fired (the
+    audit line in replica 0's log), zero high-tier violations.
+    Returns (verdict, result, victim, plan_spec, outs)."""
+    from paddle_tpu.distributed.supervisor import Supervisor
+
+    ports = _free_ports(n_replicas)
+    eps = ['127.0.0.1:%d' % p for p in ports]
+    victim = 'replica0'
+    plan_spec = 'grayfail:replica0:%d' % seed
+    base_env = dict(os.environ)
+    base_env.pop('JAX_PLATFORMS', None)
+    base_env.pop('XLA_FLAGS', None)
+    if obs_dir:
+        base_env['FLAGS_obs_flush_secs'] = '0.5'
+    sup = Supervisor(max_restarts=2, backoff=0.5, log_dir=workdir,
+                     obs_dir=obs_dir)
+    for i, ep in enumerate(eps):
+        env = dict(base_env, SERVE_MODEL_DIR=model_dir,
+                   SERVE_ENDPOINT=ep, SERVE_SLOTS='4',
+                   SERVE_WORKERS='1')
+        if i == 0:
+            env['FLAGS_fault_plan'] = plan_spec
+        sup.add_role('replica%d' % i,
+                     [sys.executable, _SERVE_REPLICA], env=env)
+    env = dict(base_env, FLEET_ROLE='grayfail',
+               FLEET_MODEL_DIR=model_dir,
+               FLEET_REPLICAS=','.join(eps), FLEET_SEED=str(seed),
+               FLEET_STREAMS=str(streams), FLEET_BUDGET=str(gen))
+    sup.add_role('driver', [sys.executable, _FLEET_WORKER], env=env)
+    sup.start()
+    states = sup.wait(timeout=budget)
+    outs = [sup.output(n) for n in sorted(states)]
+    try:
+        if any(s in ('running', 'backoff') for s in states.values()):
+            return 'hung', None, victim, plan_spec, outs
+        if any(s == 'failed' for s in states.values()):
+            return 'fatal', None, victim, plan_spec, outs
+        result = None
+        for ln in sup.output('driver').splitlines():
+            if ln.startswith('RESULT '):
+                result = json.loads(ln[len('RESULT '):])
+        if result is None:
+            return 'fatal', None, victim, plan_spec, outs
+        if result['mismatches'] or result['high_bad'] or \
+                result['deadline_expired']:
+            return 'diverged', result, victim, plan_spec, outs
+        if 'fault injection: stall' not in sup.output('replica0'):
+            # the workload finished before the Nth poll: a clean run
+            return 'nokill', result, victim, plan_spec, outs
+        if result['gray_marks'] < 1:
+            # the stall fired but the watchdog never caught it — the
+            # machinery this sweep exists to gate did not engage
+            return 'fatal', result, victim, plan_spec, outs
+        return 'recovered', result, victim, plan_spec, outs
+    finally:
+        sup.stop()
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument('--seeds', type=int, default=None,
@@ -602,6 +685,12 @@ def main(argv=None):
                          'replicas plus a replica kill-9; requires '
                          'zero high-tier sheds, bit-exact completed '
                          'streams, and at least one preemption')
+    ap.add_argument('--grayfail', action='store_true',
+                    help='gray-failure chaos: replica 0 stalls its data '
+                         'connection (health still passing) at a seeded '
+                         'SRV_POLL; the progress watchdog must gray-mark '
+                         'it, fail streams over bit-exactly, and honor '
+                         'every high-tier deadline')
     ap.add_argument('--quick', action='store_true',
                     help='CI smoke: 3 seeds unless --seeds given, and '
                          'fatal/hung seeds fail the sweep too')
@@ -614,10 +703,10 @@ def main(argv=None):
                     help='where --report keeps per-seed obs output '
                          '(default: a ./chaos_report.<pid> dir)')
     args = ap.parse_args(argv)
-    if sum((args.kill, args.corrupt, args.mesh_kill,
-            args.refresh, args.fleet, args.overload)) > 1:
-        ap.error('--kill, --corrupt, --mesh-kill, --refresh, --fleet '
-                 'and --overload are mutually exclusive')
+    if sum((args.kill, args.corrupt, args.mesh_kill, args.refresh,
+            args.fleet, args.overload, args.grayfail)) > 1:
+        ap.error('--kill, --corrupt, --mesh-kill, --refresh, --fleet, '
+                 '--overload and --grayfail are mutually exclusive')
     if args.seeds is None:
         args.seeds = 3 if args.quick else 20
 
@@ -633,12 +722,12 @@ def main(argv=None):
         # (printed by online_worker) are the acceptance reference, so
         # the comparison lives inside _run_refresh_seed
         local_w = {}
-    elif args.fleet or args.overload:
+    elif args.fleet or args.overload or args.grayfail:
         # one model for the whole sweep (every replica and every seed
         # serves the identical bytes), then — for --fleet — a
         # fault-free fleet run for the bit-exact stream baseline
-        # (--overload needs no external baseline: its driver checks
-        # every completed stream against an in-process reference)
+        # (--overload and --grayfail need no external baseline: their
+        # drivers check every stream against an in-process reference)
         import atexit
         import shutil
         fleet_root = tempfile.mkdtemp(prefix='fleet_sweep.')
@@ -693,7 +782,7 @@ def main(argv=None):
     ok_verdicts = (('ok', 'recovered', 'nokill') if args.refresh
                    else ('recovered', 'nokill')
                    if (args.kill or args.mesh_kill or args.fleet or
-                       args.overload)
+                       args.overload or args.grayfail)
                    else ('ok',))
     tally = {'ok': 0, 'recovered': 0, 'nokill': 0, 'diverged': 0,
              'fatal': 0, 'hung': 0}
@@ -725,6 +814,16 @@ def main(argv=None):
                     _run_overload_seed(seed, args.budget, workdir,
                                        model_dir, obs_dir=obs_dir)
             weights = {}
+            label = '%s %s %s' % (victim, plan_json, json.dumps(result))
+        elif args.grayfail:
+            with tempfile.TemporaryDirectory() as workdir:
+                verdict, result, victim, plan_json, outs = \
+                    _run_grayfail_seed(seed, args.budget, workdir,
+                                       model_dir, obs_dir=obs_dir)
+            weights = {}
+            if result is not None:    # streams are bulky; counts only
+                result = {k: v for k, v in result.items()
+                          if k not in ('streams', 'states')}
             label = '%s %s %s' % (victim, plan_json, json.dumps(result))
         elif args.mesh_kill:
             # kill inside the live step range; nth counts on_step calls
@@ -794,6 +893,7 @@ def main(argv=None):
         mode = ('refresh' if args.refresh
                 else 'fleet' if args.fleet
                 else 'overload' if args.overload
+                else 'grayfail' if args.grayfail
                 else 'mesh-kill' if args.mesh_kill
                 else 'kill' if args.kill
                 else 'corrupt' if args.corrupt else 'fault')

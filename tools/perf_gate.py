@@ -50,7 +50,8 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 # suffix -> direction: +1 = higher is better, -1 = lower is better
 _HIGHER = ('_per_sec', 'mfu', 'value', 'tflops', 'speedup',
            'vs_baseline', 'samples_per_sec', 'efficiency', 'hits',
-           '_max_streams', '_accept_rate', '_completion_rate')
+           '_max_streams', '_accept_rate', '_completion_rate',
+           '_win_rate')
 _LOWER = ('_ms', '_secs', 'compile_ms', 'hbm_peak', 'peak_hbm_gb',
           '_bytes', 'misses', 'latency')
 
@@ -218,6 +219,20 @@ def smoke():
     # unknown-direction metrics are skipped, never gated
     _, _, skipped = gate(traj, {'some_config': 3.0})
     expect(skipped == ['some_config'], 'direction inference leak')
+    # gray-failure leg metrics (serve_bench --hedge): hedge_win_rate
+    # is higher-better, degraded_p99_ttft_ms rides the _ms ceiling
+    traj_gray = [{'hedge_win_rate': 0.9, 'degraded_p99_ttft_ms': 400.0}]
+    fails, _, _ = gate(traj_gray, {'hedge_win_rate': 0.5,
+                                   'degraded_p99_ttft_ms': 390.0})
+    expect(any(f[0] == 'hedge_win_rate' for f in fails),
+           'hedge_win_rate collapse missed')
+    fails, _, _ = gate(traj_gray, {'hedge_win_rate': 0.92,
+                                   'degraded_p99_ttft_ms': 900.0})
+    expect(any(f[0] == 'degraded_p99_ttft_ms' for f in fails),
+           'degraded TTFT regression missed')
+    fails, _, _ = gate(traj_gray, {'hedge_win_rate': 0.88,
+                                   'degraded_p99_ttft_ms': 200.0})
+    expect(not fails, 'healthy gray-failure metrics flagged: %r' % fails)
     # per-metric tolerance override: longcontext 11% swing passes
     traj2 = [{'longcontext_mfu': 0.46}]
     fails, _, _ = gate(traj2, {'longcontext_mfu': 0.41})

@@ -645,6 +645,180 @@ def _fleet_leg(cfg, quick, replicas=2):
             'completed': stats['completed']}
 
 
+def _warm_replica_direct(ep, prompt, budget, timeout=300.0):
+    """Warm one replica's jit cache over a direct wire connection —
+    SRV_SUBMIT then SRV_HEALTH until idle. Deliberately avoids
+    SRV_POLL so a fault plan keyed on poll events (the --hedge leg's
+    stalled replica) is not consumed by warmup."""
+    import socket as _socket
+
+    from paddle_tpu.distributed import wire
+
+    host, port = ep.rsplit(':', 1)
+    deadline = time.monotonic() + timeout
+    while True:       # the replica binds only after its model loads
+        try:
+            s = _socket.create_connection((host, int(port)),
+                                          timeout=5.0)
+            break
+        except OSError:
+            if time.monotonic() >= deadline:
+                raise
+            time.sleep(0.25)
+    with s:
+        wire.write_msg(s, wire.SRV_SUBMIT,
+                       {'seq': 0, 'rid': 'warm', 'mnt': int(budget)},
+                       np.asarray(prompt, np.int64))
+        wire.read_msg(s)
+        seq = 1
+        while True:
+            wire.write_msg(s, wire.SRV_HEALTH, {'seq': seq})
+            _, meta, _ = wire.read_msg(s)
+            seq += 1
+            if not meta.get('active') and not meta.get('queue_depth'):
+                return
+            if time.monotonic() > deadline:
+                raise RuntimeError('warmup did not drain on %s' % ep)
+            time.sleep(0.25)
+
+
+def _hedge_leg(cfg, quick, replicas=2):
+    """Gray-failure tail-tolerance leg: the fleet topology of
+    _fleet_leg, but replica0 carries a FaultPlan that stalls its first
+    several SRV_POLL replies for seconds each — alive-but-slow, health
+    probes still green — while the router runs with hedged dispatch
+    (FLAGS_fleet_hedge_ms) and the progress watchdog armed.
+
+    degraded_p99_ttft_ms is the p99 time-to-first-token of a burst
+    through that degraded fleet (lower is better: without hedging it
+    would sit at the stall duration, with hedging the duplicate dispatch
+    to the healthy replica answers in ~hedge_ms + prefill).
+    hedge_win_rate is hedge_wins / hedges from router.stats() (higher
+    is better — hedges that lose were wasted work). Both land in the
+    acceptance summary for perf_gate.py."""
+    import socket as _socket
+    import subprocess
+
+    import paddle_tpu as fluid
+    from paddle_tpu.distributed import wire
+    from paddle_tpu.models import transformer as tfm
+    from paddle_tpu.serving import FleetRouter
+
+    n_requests = 16 if quick else 48
+    new_tokens = 4 if quick else 8
+    slots = 4 if quick else 8
+    stall_secs = 2.0
+    n_stalls = 8
+    here = os.path.dirname(os.path.abspath(__file__))
+    rng = np.random.RandomState(7)
+    procs = []
+    with tempfile.TemporaryDirectory() as tmp:
+        model_dir = os.path.join(tmp, 'model')
+        main_prog, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main_prog, startup):
+            tokens = fluid.layers.data(
+                'tokens', shape=[1, cfg.max_len, 1], dtype='int64',
+                append_batch_size=False)
+            logits = tfm.language_model_logits(tokens, cfg)
+        exe = fluid.Executor(fluid.TPUPlace())
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            fluid.io.save_inference_model(model_dir, ['tokens'],
+                                          [logits], exe,
+                                          main_program=main_prog)
+        eps = []
+        for _ in range(replicas):
+            s = _socket.socket()
+            s.bind(('127.0.0.1', 0))
+            eps.append('127.0.0.1:%d' % s.getsockname()[1])
+            s.close()
+        env = dict(os.environ)
+        env.pop('XLA_FLAGS', None)
+        # replica0: stall each of the first n_stalls SRV_POLL replies
+        # for stall_secs — the gray window the hedges must cover
+        plan = json.dumps({'rules': [
+            {'when': 'recv', 'type': 'SRV_POLL', 'nth': n,
+             'action': 'stall', 'secs': stall_secs}
+            for n in range(1, n_stalls + 1)]})
+        try:
+            for i, ep in enumerate(eps):
+                rep_env = dict(env, SERVE_MODEL_DIR=model_dir,
+                               SERVE_ENDPOINT=ep,
+                               SERVE_SLOTS=str(slots))
+                if i == 0:
+                    rep_env['FLAGS_fault_plan'] = plan
+                procs.append(subprocess.Popen(
+                    [sys.executable,
+                     os.path.join(here, 'serve_replica.py')],
+                    env=rep_env,
+                    stdout=subprocess.DEVNULL,
+                    stderr=subprocess.DEVNULL))
+            # warm over direct connections (no SRV_POLL, so the stall
+            # budget survives into the measured window), THEN arm the
+            # gray-failure machinery and construct the router
+            prompts = [rng.randint(1, cfg.vocab,
+                                   max(1, cfg.max_len // 2))
+                       for _ in range(n_requests)]
+            for ep in eps:
+                _warm_replica_direct(ep, prompts[0], new_tokens)
+            from paddle_tpu import flags
+            saved = {k: flags.get_flag(k)
+                     for k in ('fleet_hedge_ms',
+                               'fleet_progress_timeout_secs')}
+            flags.set_flags({'FLAGS_fleet_hedge_ms': 150.0,
+                             'FLAGS_fleet_progress_timeout_secs': 1.0})
+            try:
+                router = FleetRouter(eps, probe_secs=0.1).start()
+            finally:
+                flags.set_flags(
+                    {'FLAGS_' + k: v for k, v in saved.items()})
+            try:
+                router.wait_healthy(timeout=300.0)
+                t0 = time.perf_counter()
+                reqs = [router.submit(p, max_new_tokens=new_tokens)
+                        for p in prompts]
+                for r in reqs:
+                    r.wait(600.0)
+                wall = time.perf_counter() - t0
+                total = sum(len(r.tokens) for r in reqs)
+                ttfts = sorted(r.first_token_at - r.submitted_at
+                               for r in reqs if r.first_token_at)
+                p99 = ttfts[int(0.99 * (len(ttfts) - 1))]
+                stats = router.stats()
+            finally:
+                router.stop()
+            for ep in eps:
+                host, port = ep.rsplit(':', 1)
+                try:
+                    with _socket.create_connection(
+                            (host, int(port)), timeout=5.0) as s:
+                        wire.write_msg(s, wire.COMPLETE, {'seq': 0})
+                        wire.read_msg(s)
+                except (ConnectionError, OSError):
+                    pass
+            for p in procs:
+                try:
+                    p.wait(timeout=30)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+    return {'mode': 'hedge', 'replicas': replicas, 'slots': slots,
+            'requests': n_requests, 'stall_secs': stall_secs,
+            'degraded_tokens_per_sec': round(total / wall, 2),
+            'degraded_p99_ttft_ms': round(p99 * 1e3, 1),
+            'hedges': stats['hedges'],
+            'hedge_wins': stats['hedge_wins'],
+            'hedge_win_rate': round(
+                stats['hedge_wins'] / max(1, stats['hedges']), 4),
+            'gray_marks': stats['gray_marks'],
+            'failovers': stats['failovers'],
+            'completed': stats['completed']}
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument('--quick', action='store_true',
@@ -667,6 +841,12 @@ def main():
                          'over 2 replica subprocesses under burst '
                          'load (fleet_tokens_per_sec + '
                          'fleet_p99_ttft_ms in the summary)')
+    ap.add_argument('--hedge', action='store_true',
+                    help='add the gray-failure tail-tolerance leg: the '
+                         'fleet topology with one deliberately stalled '
+                         'replica, hedged dispatch + progress watchdog '
+                         'armed (degraded_p99_ttft_ms + hedge_win_rate '
+                         'in the summary)')
     ap.add_argument('--preempt', action='store_true',
                     help='add the preempt-first capacity leg: a '
                          'mixed-tier overload burst against a paged '
@@ -762,6 +942,14 @@ def main():
         summary['fleet_tokens_per_sec'] = \
             fleet_row['fleet_tokens_per_sec']
         summary['fleet_p99_ttft_ms'] = fleet_row['fleet_p99_ttft_ms']
+
+    if args.hedge:
+        hedge_row = _hedge_leg(cfg, args.quick)
+        hedge_row['config'] = label
+        print(json.dumps(hedge_row), flush=True)
+        summary['degraded_p99_ttft_ms'] = \
+            hedge_row['degraded_p99_ttft_ms']
+        summary['hedge_win_rate'] = hedge_row['hedge_win_rate']
 
     if args.preempt:
         pre_row = _preempt_leg(pred, cfg, args.quick)
