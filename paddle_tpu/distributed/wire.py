@@ -118,6 +118,25 @@ SRV_DRAIN = 24      # router -> replica: drain fence — meta['on'] stops
 SRV_REFRESH = 25    # router -> replica: pull + install the pservers'
                     # newest params NOW (ParamSubscriber.refresh_once);
                     # the rolling-deploy step after the drain completes
+SRV_PAGES = 26      # disaggregated serving (serving/disagg.py): a
+                    # first-class KV-page shipment. meta carries the
+                    # hash-chain keys ('keys', hex, in chain order),
+                    # how many leading chain pages the receiver already
+                    # held ('skip' — content-addressed dedup: a page
+                    # already present is acknowledged without
+                    # transfer), the prompt tokens and page geometry;
+                    # the value is one float32 array
+                    # [pools, pages, page_tokens, heads, dk] under the
+                    # usual CRC/bmeta discipline. Sent prefill ->
+                    # decode as the SRV_PAGE_FETCH reply, or pushed
+                    # directly at a replica, which installs via
+                    # PagePool.restore_pages + PrefixCache and acks
+                    # REPLY_OK {'installed', 'deduped'}
+SRV_PAGE_FETCH = 27  # decode replica -> prefill replica: prefill
+                    # meta-described prompt (value: token ids) if its
+                    # pages are not already cached, then reply with an
+                    # SRV_PAGES frame shipping every full prefix page
+                    # the requester's 'have' key list lacks
 REPLY_VAR = 7       # pserver -> trainer: a variable value
 REPLY_OK = 8        # pserver -> trainer: ack
 REPLY_ERR = 9       # pserver -> trainer: error (meta['error'])

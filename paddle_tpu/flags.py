@@ -225,6 +225,27 @@ Known flags:
                          failure is final — bounds the livelock when
                          the whole fleet is saturated; counted in
                          fleet.cache_sheds
+  fleet_prefill_endpoints  disaggregated serving (serving/disagg.py):
+                         comma-separated ReplicaServer endpoints that
+                         form the PREFILL tier. When set, the router
+                         routes each stream's prefill to this tier and
+                         the computed KV pages are shipped over the
+                         wire (SRV_PAGES) to the decode replica that
+                         owns the stream; '' (default) keeps today's
+                         colocated path
+  disagg_ship_timeout    seconds one page ship (SRV_PAGE_FETCH prefill
+                         + SRV_PAGES transfer + install) may take on
+                         the decode replica before it gives up and
+                         re-prefills locally (bit-exact by greedy
+                         determinism)
+  fleet_prefix_affinity  weight of the prefix-affinity term in the
+                         router's dispatch score: the fraction of a
+                         request's hash-chain prefix already resident
+                         on a replica (per the fleet-wide prefix
+                         directory) is subtracted from its load score
+                         scaled by this, so shared-prefix requests
+                         land where the pages live. 0 disables the
+                         term
   spec_k                 speculative decoding (serving/speculative.py):
                          draft proposals per verify pass (the CEILING —
                          the predictor adapts k per slot between 1 and
@@ -410,6 +431,13 @@ _DEFAULTS = {
     'fleet_admission_rules': '',
     'fleet_deploy_timeout': 120.0,
     'fleet_cache_shed_budget': 5,
+    # disaggregated prefill/decode serving (serving/disagg.py): the
+    # prefill-tier endpoints ('' = colocated), the per-ship wall budget
+    # on the decode side before local re-prefill, and the weight of the
+    # prefix-directory affinity term in dispatch scoring (0 = off)
+    'fleet_prefill_endpoints': '',
+    'disagg_ship_timeout': 15.0,
+    'fleet_prefix_affinity': 0.5,
     # gray-failure tolerance (serving/fleet.py): connect-step cap and
     # the dedicated probe-connection timeout (both seconds), the
     # no-progress watchdog horizon (0 = off), the hedged-dispatch

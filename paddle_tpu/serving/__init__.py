@@ -48,6 +48,11 @@ layered on the inference Predictor ABI:
               serving contract, re-shaped for token streams).
 - replica.py  ReplicaServer: one LMServer exposed on the wire (SRV_*
               message types) so a fleet router can address it.
+- disagg.py   Disaggregated prefill/decode: KV pages as first-class
+              wire objects (SRV_PAGES / SRV_PAGE_FETCH) — a prefill
+              tier computes pages once per unique prefix and ships
+              them content-addressed to decode replicas; every ship
+              failure falls back to bit-exact local re-prefill.
 - fleet.py    FleetRouter: health-checked dispatch over N replicas
               with session affinity, transparent mid-stream failover
               (greedy re-prefill from the accumulated prefix),
@@ -61,19 +66,22 @@ path (tests/test_serving.py); the same determinism makes fleet
 failover bit-exact (tests/test_fleet.py).
 """
 from .decode import DecodePredictor
-from .paging import CacheExhaustedError, PagePool, PageTable, PrefixCache
+from .paging import (CacheExhaustedError, PagePool, PageTable,
+                     PrefixCache, chain_keys)
 from .paged import PagedDecodePredictor
 from .speculative import DraftModel, SpeculativeDecodePredictor
 from .engine import ServingEngine, Request, DeadlineExceededError
 from .preempt import HostSwapBudget
 from .api import LMServer
 from .replica import ReplicaServer
+from .disagg import ShipError
 from .fleet import (FleetRouter, FleetAutoscaler, FleetRequest,
                     OverloadError, FleetDeployError)
 
 __all__ = ['DecodePredictor', 'PagedDecodePredictor',
            'DraftModel', 'SpeculativeDecodePredictor',
            'CacheExhaustedError', 'PagePool', 'PageTable', 'PrefixCache',
+           'chain_keys', 'ShipError',
            'ServingEngine', 'Request', 'DeadlineExceededError',
            'HostSwapBudget', 'LMServer',
            'ReplicaServer', 'FleetRouter', 'FleetAutoscaler',

@@ -148,6 +148,33 @@ class LMServer(object):
     def cancel(self, handle):
         self._engine.cancel(self._req(handle))
 
+    # -- disaggregated page shipping (serving/disagg.py) -------------------
+    @property
+    def paged(self):
+        """True when serving from the page-pool cache — the only mode
+        page shipping and the fleet prefix directory apply to."""
+        return bool(getattr(self._decode, 'paged', False))
+
+    def export_prefix(self, prompt):
+        """Longest resident full-page chain for `prompt` as host copies
+        (see ServingEngine.export_prefix); None when non-paged or cold."""
+        return self._engine.export_prefix(prompt)
+
+    def install_prefix(self, prompt, keys, data, skip=0):
+        """Install a shipped page run (see ServingEngine.install_prefix);
+        returns (installed, deduped) page counts."""
+        return self._engine.install_prefix(prompt, keys, data, skip=skip)
+
+    def resident_keys(self, prompt):
+        """Hex keys of the locally resident leading chain run for
+        `prompt` — the 'have' list a page fetch advertises."""
+        return self._engine.resident_keys(prompt)
+
+    def prefix_report(self):
+        """Drain {'new', 'evicted'} prefix-chain hex keys since the
+        last call — the SRV_HEALTH directory delta."""
+        return self._engine.prefix_report()
+
     # -- ops ---------------------------------------------------------------
     @property
     def max_len(self):

@@ -392,6 +392,59 @@ class ServingEngine(object):
             _weight_swaps.inc()
             return out
 
+    # -- disaggregated page shipping (serving/disagg.py) -------------------
+    def export_prefix(self, prompt):
+        """Gather the longest resident full-page chain for `prompt`
+        across workers into host copies (quiesced at a step boundary —
+        save_pages reads device pools). None on a non-paged engine or
+        a cold cache."""
+        if not getattr(self._predictors[0], 'paged', False):
+            return None
+
+        def _gather():
+            best = None
+            for p in self._predictors:
+                got = p.export_prefix(prompt)
+                if got and (best is None
+                            or len(got['keys']) > len(best['keys'])):
+                    best = got
+            return best
+        return self.request_swap(_gather, label='export_prefix')
+
+    def install_prefix(self, prompt, keys, data, skip=0):
+        """Install shipped pages into worker 0's pool + prefix cache
+        (quiesced — restore_pages functionally rewrites device pools).
+        Streams admitted by other workers simply re-prefill locally;
+        correctness never depends on the install. Returns (installed,
+        deduped)."""
+        if not getattr(self._predictors[0], 'paged', False):
+            raise ValueError('install_prefix needs a paged engine')
+        return self.request_swap(
+            lambda: self._predictors[0].install_prefix(prompt, keys,
+                                                       data, skip=skip),
+            label='install_prefix')
+
+    def resident_keys(self, prompt):
+        """Worker 0's resident leading chain run for `prompt` (hex) —
+        advisory, lock-free (see PagedDecodePredictor.resident_keys)."""
+        p0 = self._predictors[0]
+        if not getattr(p0, 'paged', False):
+            return []
+        return p0.resident_keys(prompt)
+
+    def prefix_report(self):
+        """Drain registered/evicted prefix-chain deltas from every
+        worker (merged) — the replica's SRV_HEALTH contribution to the
+        fleet prefix directory."""
+        new, gone = [], []
+        for p in self._predictors:
+            if not getattr(p, 'paged', False):
+                continue
+            got = p.prefix_report()
+            new.extend(got['new'])
+            gone.extend(got['evicted'])
+        return {'new': new, 'evicted': gone}
+
     def stats(self):
         with self._cond:
             depth = self._qsize_locked()
@@ -421,6 +474,7 @@ class ServingEngine(object):
                'jit': p0.jit_cache_stats()}
         if paged:
             kv = {'pages_in_use': 0, 'pages_free': 0, 'prefix_hits': 0,
+                  'prefix_misses': 0, 'prefix_pages': 0,
                   'prefix_tokens_reused': 0, 'prefix_entries': 0}
             for p in self._predictors:
                 for key in kv:

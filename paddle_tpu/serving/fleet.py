@@ -84,7 +84,9 @@ local counts for stats() and the admission snapshot):
   fleet.hedges / fleet.hedge_wins / fleet.gray_marks /
   fleet.deadline_expired                   counters;
   fleet.queue_depth / fleet.active_streams / fleet.replicas_healthy /
-  fleet.replicas_total / fleet.shedding    gauges;
+  fleet.replicas_total / fleet.shedding /
+  fleet.pages_shipped / fleet.ship_bytes / fleet.prefix_hit_rate
+                                           gauges;
   fleet.ttft / fleet.dispatch_wait / fleet.probe_latency  histograms;
   fleet.deploy / fleet.drain               spans.
 """
@@ -131,6 +133,12 @@ _hedge_wins = telemetry.counter('fleet.hedge_wins')
 _gray_marks = telemetry.counter('fleet.gray_marks')
 _deadline_expired = telemetry.counter('fleet.deadline_expired')
 _probe_latency = telemetry.histogram('fleet.probe_latency')
+# disaggregated prefill/decode (serving/disagg.py): fleet-wide totals
+# aggregated from SRV_HEALTH each control tick — gauges, because the
+# replicas own the counters and the router only mirrors their sum
+_pages_shipped_g = telemetry.gauge('fleet.pages_shipped')
+_ship_bytes_g = telemetry.gauge('fleet.ship_bytes')
+_prefix_hit_rate_g = telemetry.gauge('fleet.prefix_hit_rate')
 
 
 class OverloadError(RuntimeError):
@@ -220,6 +228,8 @@ class FleetRequest(object):
         self.last_progress_at = None
         self.hedge_ep = None          # endpoint holding the duplicate
         self.hedge_rid = None
+        self._ck_cache = None         # (page_tokens, chain keys) memo
+        #                               for the prefix-affinity score
         self.dispatched_at = None
         self.first_token_at = None
         self.done_at = None
@@ -336,9 +346,13 @@ class _Replica(object):
                  'gray', 'strikes', 'clean_probes', 'probe_ewma',
                  'cache_tokens', 'cache_capacity',
                  'effective_tokens_per_step', 'spec_accept_rate',
-                 'preemptions', 'preempted_streams')
+                 'preemptions', 'preempted_streams', 'role',
+                 'page_tokens', 'prefix_hits', 'prefix_misses',
+                 'prefix_entries', 'prefix_pages', 'pages_shipped',
+                 'ship_bytes', 'pages_installed', 'pages_deduped',
+                 'local_reprefills')
 
-    def __init__(self, endpoint, order, timeout):
+    def __init__(self, endpoint, order, timeout, role='serve'):
         self.endpoint = endpoint
         self.client = _ReplicaClient(endpoint, timeout=timeout)
         # health probes ride a DEDICATED connection: a gray replica
@@ -373,6 +387,21 @@ class _Replica(object):
         # currently swapped out awaiting resume (both from SRV_HEALTH)
         self.preemptions = 0
         self.preempted_streams = 0
+        # disaggregated serving: 'prefill' replicas answer
+        # SRV_PAGE_FETCH and never take decode streams; 'serve' (the
+        # default) is the decode/colocated tier. The prefix/ship
+        # numbers mirror the replica's SRV_HEALTH truth.
+        self.role = role
+        self.page_tokens = None
+        self.prefix_hits = 0
+        self.prefix_misses = 0
+        self.prefix_entries = 0
+        self.prefix_pages = 0
+        self.pages_shipped = 0
+        self.ship_bytes = 0
+        self.pages_installed = 0
+        self.pages_deduped = 0
+        self.local_reprefills = 0
 
 
 class FleetAutoscaler(object):
@@ -457,13 +486,17 @@ class FleetRouter(object):
     def __init__(self, replicas, pservers=None, poll_secs=None,
                  probe_secs=None, max_hold=None, admission_rules=None,
                  shed_consecutive=None, probe_fail_threshold=None,
-                 call_timeout=10.0, subscriber_id=900):
+                 call_timeout=10.0, subscriber_id=900,
+                 prefill_replicas=None):
         """replicas: ReplicaServer endpoints ('host:port'). pservers:
         the parameter-server fleet (only needed for published-version
         watching / enable_rolling_deploys). admission_rules: obs/slo.py
         rule list (objects, dicts, JSON, or @path — parse_rules) over
         the fleet.* snapshot; default is a fleet.queue_depth gauge_max
-        rule at max_hold/2."""
+        rule at max_hold/2. prefill_replicas: endpoints of the PREFILL
+        TIER (serving/disagg.py) — probed for health like any replica
+        but never dispatched decode streams; defaults from
+        FLAGS_fleet_prefill_endpoints ('' = colocated, no tier)."""
         from ..obs import slo as _slo
         self._poll_secs = float(poll_secs if poll_secs is not None
                                 else get_flag('fleet_poll_secs'))
@@ -524,8 +557,21 @@ class FleetRouter(object):
         self._autoscaler = None
         self._stop_evt = threading.Event()
         self._threads = []
+        # disaggregated serving: the fleet-wide prefix directory — hex
+        # chain key -> set of endpoints whose PrefixCache holds that
+        # page (reconciled from SRV_HEALTH new/evicted deltas,
+        # invalidated wholesale on death/gray-mark) — plus the
+        # prefix-affinity weight feeding _pick_locked
+        self._prefix_dir = {}
+        self._prefix_affinity = float(get_flag('fleet_prefix_affinity'))
         for ep in replicas:
             self.add_replica(ep)
+        if prefill_replicas is None:
+            raw = str(get_flag('fleet_prefill_endpoints') or '')
+            prefill_replicas = [e.strip() for e in raw.split(',')
+                                if e.strip()]
+        for ep in prefill_replicas:
+            self.add_replica(ep, role='prefill')
 
     # -- lifecycle ---------------------------------------------------------
     def start(self):
@@ -578,13 +624,14 @@ class FleetRouter(object):
         self.stop()
 
     # -- fleet membership --------------------------------------------------
-    def add_replica(self, endpoint):
+    def add_replica(self, endpoint, role='serve'):
         with self._mu:
             if endpoint in self._reps:
                 return
             self._reps[endpoint] = _Replica(endpoint,
                                             next(self._order),
-                                            self._call_timeout)
+                                            self._call_timeout,
+                                            role=role)
         _replicas_total.set(len(self._reps))
 
     def remove_replica(self, endpoint, drain=True, timeout=30.0):
@@ -618,6 +665,7 @@ class FleetRouter(object):
             for req in list(rep.hedges.values()):
                 self._drop_hedge_locked(req, cancel=False)
             self._reps.pop(endpoint, None)
+            self._dir_forget_locked(endpoint)
             for s, ep in list(self._sessions.items()):
                 if ep == endpoint:
                     del self._sessions[s]
@@ -634,7 +682,8 @@ class FleetRouter(object):
         victim), preferring the newest; None when all are busy."""
         with self._mu:
             idle = [r for r in self._reps.values()
-                    if r.healthy and not r.active and not r.draining]
+                    if r.healthy and not r.active and not r.draining
+                    and r.role != 'prefill']
             if not idle:
                 return None
             return max(idle, key=lambda r: r.order).endpoint
@@ -756,9 +805,38 @@ class FleetRouter(object):
                              r.effective_tokens_per_step,
                          'spec_accept_rate': r.spec_accept_rate,
                          'preemptions': r.preemptions,
-                         'preempted_streams': r.preempted_streams}
+                         'preempted_streams': r.preempted_streams,
+                         'role': r.role,
+                         'prefix_entries': r.prefix_entries,
+                         'prefix_hits': r.prefix_hits,
+                         'prefix_misses': r.prefix_misses,
+                         'pages_shipped': r.pages_shipped,
+                         'local_reprefills': r.local_reprefills}
                     for ep, r in self._reps.items()}
+            hits = sum(r.prefix_hits for r in self._reps.values()
+                       if r.role != 'prefill')
+            misses = sum(r.prefix_misses for r in self._reps.values()
+                         if r.role != 'prefill')
             return {'replicas': reps,
+                    'prefill_replicas': sum(
+                        1 for r in self._reps.values()
+                        if r.role == 'prefill'),
+                    'pages_shipped': sum(r.pages_shipped
+                                         for r in self._reps.values()),
+                    'ship_bytes': sum(r.ship_bytes
+                                      for r in self._reps.values()),
+                    'pages_installed': sum(
+                        r.pages_installed for r in self._reps.values()),
+                    'pages_deduped': sum(
+                        r.pages_deduped for r in self._reps.values()),
+                    'local_reprefills': sum(
+                        r.local_reprefills
+                        for r in self._reps.values()),
+                    'prefix_hits': hits,
+                    'prefix_misses': misses,
+                    'prefix_hit_rate': (hits / (hits + misses)
+                                        if hits + misses else 0.0),
+                    'prefix_dir_entries': len(self._prefix_dir),
                     'queue_depth': self._hold_len_locked(),
                     'active': sum(len(r.active)
                                   for r in self._reps.values()),
@@ -913,6 +991,13 @@ class FleetRouter(object):
                     meta['deadline_ms'] = max(
                         1.0, (req.deadline_at - req.last_progress_at)
                         * 1000.0)
+                # disaggregated dispatch: name a prefill peer so the
+                # decode replica pulls the prompt's pages instead of
+                # prefilling (serving/disagg.py). No healthy prefill
+                # tier -> key absent -> today's colocated path.
+                pf = self._pick_prefill_locked(req)
+                if pf is not None:
+                    meta['prefill_from'] = pf.endpoint
                 if rep.max_len is not None and len(prompt) > rep.max_len:
                     # a failover prefix past the context window cannot
                     # be re-prefilled bit-exactly (ring slide)
@@ -958,6 +1043,7 @@ class FleetRouter(object):
         now = time.monotonic()
         elig = [r for r in self._reps.values()
                 if r.healthy and not r.draining and not r.gray
+                and r.role != 'prefill'
                 and r.endpoint != exclude
                 and now >= r.hold_until
                 and len(r.active) < max(1, r.capacity)]
@@ -985,8 +1071,96 @@ class FleetRouter(object):
             # steps: divide the load score by the measured tokens per
             # step so a high-accept-rate replica absorbs more streams
             # (neutral 1.0 for plain replicas keeps the old ordering)
-            / max(1.0, r.effective_tokens_per_step),
+            / max(1.0, r.effective_tokens_per_step)
+            # prefix-affinity term (FLAGS_fleet_prefix_affinity): the
+            # directory says this replica already holds a leading run
+            # of the request's page chain — landing there turns the
+            # prefill into a PrefixCache hit (or a near-free dedup
+            # ship). Subtractive, so a stale directory entry only
+            # nudges the ordering and dispatch still falls back to any
+            # healthy replica.
+            - self._prefix_affinity * self._affinity_locked(req, r),
             r.order))
+
+    def _affinity_locked(self, req, rep):
+        """Fraction [0, 1] of the request's full-page hash chain the
+        directory believes `rep` holds as a LEADING run (only leading
+        pages are adoptable — the chain breaks at the first miss)."""
+        if self._prefix_affinity <= 0 or not self._prefix_dir:
+            return 0.0
+        pt = rep.page_tokens
+        if not pt:
+            return 0.0
+        cache = req._ck_cache
+        if cache is None or cache[0] != pt:
+            from .paging import chain_keys
+            prompt = req.prompt + req.tokens
+            req._ck_cache = cache = (
+                pt, chain_keys(prompt, pt, limit=len(prompt) - 1))
+        keys = cache[1]
+        if not keys:
+            return 0.0
+        matched = 0
+        for k in keys:
+            if rep.endpoint not in self._prefix_dir.get(k, ()):
+                break
+            matched += 1
+        return matched / len(keys)
+
+    def _pick_prefill_locked(self, req):
+        """The prefill-tier replica a dispatch names in
+        meta['prefill_from'] — prefix-affine first (the peer that
+        already computed this chain ships it from cache), then
+        least-loaded. None when no prefill tier is configured or none
+        of it is currently trustworthy (the decode replica then
+        prefills locally: today's colocated path)."""
+        now = time.monotonic()
+        elig = [r for r in self._reps.values()
+                if r.role == 'prefill' and r.healthy and not r.draining
+                and not r.gray and now >= r.hold_until]
+        if not elig:
+            return None
+        return min(elig, key=lambda r: (
+            -self._affinity_locked(req, r),
+            (len(r.active) + r.queue_depth) / max(1, r.capacity),
+            r.order))
+
+    # -- fleet prefix directory (serving/disagg.py) ------------------------
+    def _dir_apply_locked(self, rep, health):
+        """Fold one replica's SRV_HEALTH prefix/disagg fields into the
+        router's view: mirror the counters, then reconcile the
+        directory from the replica's own registered/evicted key deltas
+        — replica truth, not dispatch bookkeeping."""
+        rep.page_tokens = health.get('page_tokens') or rep.page_tokens
+        rep.prefix_hits = int(health.get('prefix_hits', 0) or 0)
+        rep.prefix_misses = int(health.get('prefix_misses', 0) or 0)
+        rep.prefix_entries = int(health.get('prefix_entries', 0) or 0)
+        rep.prefix_pages = int(health.get('prefix_pages', 0) or 0)
+        rep.pages_shipped = int(health.get('pages_shipped', 0) or 0)
+        rep.ship_bytes = int(health.get('ship_bytes', 0) or 0)
+        rep.pages_installed = int(health.get('pages_installed', 0) or 0)
+        rep.pages_deduped = int(health.get('pages_deduped', 0) or 0)
+        rep.local_reprefills = int(health.get('local_reprefills', 0)
+                                   or 0)
+        ep = rep.endpoint
+        for k in health.get('prefix_new') or ():
+            self._prefix_dir.setdefault(str(k), set()).add(ep)
+        for k in health.get('prefix_evicted') or ():
+            eps = self._prefix_dir.get(str(k))
+            if eps is not None:
+                eps.discard(ep)
+                if not eps:
+                    del self._prefix_dir[str(k)]
+
+    def _dir_forget_locked(self, endpoint):
+        """Drop every directory entry naming `endpoint` (replica death,
+        gray-mark, removal): its pages may be gone, and a stale entry
+        must only ever cost a dedup round trip, never a dispatch."""
+        for k in list(self._prefix_dir):
+            eps = self._prefix_dir[k]
+            eps.discard(endpoint)
+            if not eps:
+                del self._prefix_dir[k]
 
     def _poll_one(self, rep):
         with self._mu:
@@ -1153,6 +1327,7 @@ class FleetRouter(object):
                     del self._sessions[s]
             for req in victims:
                 self._requeue_locked(req)
+            self._dir_forget_locked(rep.endpoint)
         rep.client.close()
         if was_live:
             self._deaths_inc(rep, len(victims))
@@ -1211,7 +1386,22 @@ class FleetRouter(object):
                 rep.preemptions = int(h.get('preemptions', 0) or 0)
                 rep.preempted_streams = int(
                     h.get('preempted_streams', 0) or 0)
+                self._dir_apply_locked(rep, h)
                 rep.healthy = True
+        with self._mu:
+            shipped = sum(r.pages_shipped for r in self._reps.values())
+            sbytes = sum(r.ship_bytes for r in self._reps.values())
+            # hit rate over the DECODE tier only: the prefill tier's
+            # cache exists to feed ships, and counting its warm hits
+            # would flatter the number the bench gates on
+            hits = sum(r.prefix_hits for r in self._reps.values()
+                       if r.role != 'prefill')
+            misses = sum(r.prefix_misses for r in self._reps.values()
+                         if r.role != 'prefill')
+        _pages_shipped_g.set(shipped)
+        _ship_bytes_g.set(sbytes)
+        _prefix_hit_rate_g.set(hits / (hits + misses)
+                               if hits + misses else 0.0)
         self._watchdog_tick()
         self._hedge_tick()
         now = time.monotonic()
@@ -1287,6 +1477,7 @@ class FleetRouter(object):
                 del self._sessions[s]
         for req in victims:
             self._requeue_locked(req)
+        self._dir_forget_locked(rep.endpoint)
         if fresh:
             self._gray_marks_n += 1
             _gray_marks.inc()

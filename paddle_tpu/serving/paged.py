@@ -43,7 +43,8 @@ from ..executor import Executor, Scope
 from ..flags import get_flag
 from ..obs import telemetry
 from .decode import DecodePredictor
-from .paging import CacheExhaustedError, PagePool, PageTable, PrefixCache
+from .paging import (CacheExhaustedError, PagePool, PageTable, PrefixCache,
+                     chain_keys)
 
 __all__ = ['PagedDecodePredictor']
 
@@ -130,6 +131,8 @@ class PagedDecodePredictor(DecodePredictor):
                 'pages_free': self._pool.pages_free,
                 'prefix_entries': len(self._prefix),
                 'prefix_hits': self._prefix.hits,
+                'prefix_misses': self._prefix.misses,
+                'prefix_pages': self._prefix.resident_pages,
                 'prefix_tokens_reused': self._prefix.tokens_reused}
 
     def _update_gauges(self):
@@ -236,6 +239,90 @@ class PagedDecodePredictor(DecodePredictor):
         table.length = int(snapshot['length'])
         self._tables[slot] = table
         self._update_gauges()
+
+    # -- disaggregated page shipping (serving/disagg.py) -------------------
+    def export_prefix(self, prompt):
+        """Gather the full-page hash chain this cache holds for
+        `prompt` (capped at prompt[:-1], the sharing limit) into host
+        float32 copies — the prefill tier's half of a page ship.
+        Returns None when nothing is resident, else {'keys' (hex, in
+        chain order), 'tokens', 'data' (one [n, page_tokens, ...] array
+        per layer pool), 'nbytes'}. A pure read: refcounts, tables and
+        LRU stamps are untouched."""
+        prompt = [int(t) for t in np.asarray(prompt).reshape(-1)]
+        digests, pages = self._prefix.chain(prompt,
+                                            limit=len(prompt) - 1)
+        if not digests:
+            return None
+        pools = [self._scope.find_var(name)
+                 for name in self._pair.cache_names]
+        data = self._pool.save_pages(pools, pages)
+        return {'keys': [d.hex() for d in digests],
+                'tokens': len(digests) * self.page_tokens,
+                'data': data,
+                'nbytes': int(sum(d.nbytes for d in data))}
+
+    def resident_keys(self, prompt):
+        """Hex keys of the leading full-page chain run this cache holds
+        for `prompt` — the 'have' list a page fetch sends so the sender
+        skips pages already here. Advisory (no quiesce, no LRU touch):
+        install_prefix re-checks residency under the swap gate, so a
+        racing eviction only costs wire bytes, never correctness."""
+        prompt = [int(t) for t in np.asarray(prompt).reshape(-1)]
+        digests, _ = self._prefix.chain(prompt, limit=len(prompt) - 1)
+        return [d.hex() for d in digests]
+
+    def install_prefix(self, prompt, keys, data, skip=0):
+        """Install a shipped page run into the local pool + prefix
+        cache (the decode tier's half). `keys` is the FULL leading run
+        of the prompt's hash chain the sender holds; `data` carries
+        rows for keys[skip:] only (the sender omitted pages the
+        receiver reported having). The chain is recomputed here, so a
+        shipment with foreign pages, corrupt keys, or a different
+        page_tokens is refused with ValueError and the caller
+        re-prefills locally — as is a shipment whose skipped prefix is
+        no longer resident (evicted between report and install: the
+        graft would dangle). Rows already resident (a racing install)
+        are deduped without allocation. Returns (installed, deduped)
+        page counts; raises the retryable CacheExhaustedError with
+        nothing taken when the pool cannot fit the fresh rows."""
+        prompt = [int(t) for t in np.asarray(prompt).reshape(-1)]
+        keys = list(keys)
+        skip = int(skip)
+        n = len(keys)
+        if n == 0:
+            return 0, 0
+        expected = chain_keys(prompt, self.page_tokens,
+                              limit=len(prompt) - 1)
+        if keys != expected[:n]:
+            raise ValueError(
+                'shipped keys are not a leading run of the prompt '
+                'hash chain (%d keys, page_tokens=%d)'
+                % (n, self.page_tokens))
+        resident, _ = self._prefix.chain(prompt, limit=len(prompt) - 1)
+        have = min(len(resident), n)
+        if have >= n:
+            return 0, n
+        if have < skip:
+            raise ValueError(
+                'shipment skipped %d pages but only %d are still '
+                'resident — the graft parent was evicted' % (skip, have))
+        names = self._pair.cache_names
+        pools = [self._scope.find_var(name) for name in names]
+        ids, pools = self._pool.restore_pages(
+            pools, [rows[have - skip:n - skip] for rows in data])
+        for name, pool in zip(names, pools):
+            self._scope.set_var(name, pool)
+        parent = resident[have - 1] if have else b''
+        self._prefix.extend_chain(
+            parent, [bytes.fromhex(k) for k in keys[have:n]], ids)
+        self._update_gauges()
+        return n - have, have
+
+    def prefix_report(self):
+        """Drain the prefix cache's registered/evicted delta (the
+        SRV_HEALTH payload feeding the fleet prefix directory)."""
+        return self._prefix.drain_events()
 
     @staticmethod
     def _rollback(cows, grows):

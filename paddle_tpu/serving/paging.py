@@ -48,7 +48,8 @@ import hashlib
 
 import numpy as np
 
-__all__ = ['CacheExhaustedError', 'PagePool', 'PageTable', 'PrefixCache']
+__all__ = ['CacheExhaustedError', 'PagePool', 'PageTable', 'PrefixCache',
+           'chain_keys']
 
 NULL_PAGE = 0
 
@@ -276,6 +277,22 @@ def _digest(prev, tokens):
     return h.digest()
 
 
+def chain_keys(tokens, page_tokens, limit=None):
+    """Hex hash-chain keys over the FULL pages of tokens[:limit] — the
+    content address every disagg page ship and fleet prefix-directory
+    entry is keyed by. A pure function of the tokens and the page size,
+    so a receiver can recompute the chain and refuse a shipment whose
+    keys do not match its own hash of the prompt."""
+    pt = int(page_tokens)
+    toks = [int(t) for t in tokens]
+    limit = len(toks) if limit is None else min(int(limit), len(toks))
+    out, chain = [], b''
+    for k in range(limit // pt):
+        chain = _digest(chain, toks[k * pt:(k + 1) * pt])
+        out.append(chain.hex())
+    return out
+
+
 class _Node(object):
     __slots__ = ('page', 'parent', 'children', 'tails', 'stamp')
 
@@ -317,7 +334,15 @@ class PrefixCache(object):
         self._tails = {}          # chain digest -> {tokens: _Tail}
         self._clock = 0
         self.hits = 0
+        self.misses = 0
         self.tokens_reused = 0
+        # delta logs for the fleet prefix directory (drained through
+        # SRV_HEALTH): hex chain keys of full-page nodes registered /
+        # evicted since the last drain_events(). Bounded by cache
+        # churn between probes — tails are never logged (the directory
+        # tracks full pages only).
+        self._announced = []
+        self._evicted = []
 
     def _touch(self, entry):
         self._clock += 1
@@ -359,7 +384,59 @@ class PrefixCache(object):
         if tokens:
             self.hits += 1
             self.tokens_reused += tokens
+        elif limit > 0:
+            # a shareable prompt found nothing — the miss half of the
+            # fleet_prefix_hit_rate metric (a 1-token prompt, limit 0,
+            # can never share and counts as neither)
+            self.misses += 1
         return pages, tokens
+
+    def chain(self, prompt, limit=None):
+        """Walk the FULL-page hash chain registered for prompt[:limit]
+        (no hit/LRU accounting — a pure read for the disagg shipper
+        and directory). Returns (digests, pages): the longest resident
+        leading run. Because eviction is leaf-first, the resident part
+        of a chain is always a prefix of it."""
+        pt = self.pool.page_tokens
+        toks = [int(t) for t in prompt]
+        limit = len(toks) if limit is None else min(int(limit), len(toks))
+        digests, pages, chain = [], [], b''
+        for k in range(limit // pt):
+            nxt = _digest(chain, toks[k * pt:(k + 1) * pt])
+            node = self._nodes.get(nxt)
+            if node is None:
+                break
+            digests.append(nxt)
+            pages.append(node.page)
+            chain = nxt
+        return digests, pages
+
+    def extend_chain(self, parent, digests, pages):
+        """Graft externally prefilled full pages onto the chain at
+        `parent` (b'' = the root): digests[i] hangs off digests[i-1].
+        Each page arrives with the caller's fresh-alloc ref, which
+        BECOMES the cache's ref (no extra share). A digest already
+        present — a racing install — keeps the resident page and the
+        duplicate ref is returned to the pool. The disagg install path
+        (serving/disagg.py): shipped bytes were computed by the same
+        deterministic prefill on the sender, so the content address
+        guarantees byte-identical pages."""
+        chain = parent
+        for d, p in zip(digests, pages):
+            node = self._nodes.get(d)
+            if node is not None:
+                self.pool.unref(p)
+                self._touch(node)
+                chain = d
+                continue
+            node = _Node(p, chain)
+            self._nodes[d] = node
+            par = self._nodes.get(chain)
+            if par is not None:
+                par.children += 1
+            self._touch(node)
+            self._announced.append(d.hex())
+            chain = d
 
     # -- registration ------------------------------------------------------
     def register(self, prompt, table):
@@ -382,6 +459,7 @@ class PrefixCache(object):
                 if parent is not None:
                     parent.children += 1
                 newly_shared.append(k)
+                self._announced.append(nxt.hex())
             elif node.page == table.pages[k]:
                 newly_shared.append(k)       # already cache-shared
             self._touch(node)
@@ -426,6 +504,7 @@ class PrefixCache(object):
             parent = self._nodes.get(entry.parent)
             if parent is not None:
                 parent.children -= 1
+            self._evicted.append(key.hex())
         else:
             chain, tokens = key
             del self._tails[chain][tokens]
@@ -436,6 +515,20 @@ class PrefixCache(object):
                 node.tails -= 1
         self.pool.unref(entry.page)
         return True
+
+    def drain_events(self):
+        """Take (and clear) the registered/evicted delta since the last
+        drain — the replica's SRV_HEALTH reply carries these so the
+        router's prefix directory follows replica truth instead of
+        guessing from dispatch history."""
+        new, gone = self._announced, self._evicted
+        self._announced, self._evicted = [], []
+        return {'new': new, 'evicted': gone}
+
+    @property
+    def resident_pages(self):
+        """Pages the cache itself holds a ref on (nodes + tails)."""
+        return len(self)
 
     def __len__(self):
         return len(self._nodes) + sum(len(t) for t in self._tails.values())

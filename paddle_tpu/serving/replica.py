@@ -12,7 +12,19 @@ a local LMServer. A FleetRouter talks to N of these:
                param version, draining; optional param digests)
   SRV_DRAIN    admission fence on/off (rolling-deploy drain step)
   SRV_REFRESH  orchestrator-driven ParamSubscriber.refresh_once()
+  SRV_PAGES    install a pushed KV-page shipment (serving/disagg.py);
+               ack carries {installed, deduped}
+  SRV_PAGE_FETCH  prefill the meta-described prompt (cache hit = free)
+               and reply with an SRV_PAGES frame — the prefill tier's
+               serving surface
   COMPLETE     clean shutdown (the tools/serve_replica.py exit path)
+
+A SUBMIT whose meta names a prefill peer ('prefill_from') is acked
+immediately and a ship thread pulls the prompt's pages from that peer
+before the local submit (disagg.fetch_and_install) — the stream polls
+as QUEUED while shipping, and ANY ship failure falls back to local
+re-prefill with the remaining deadline budget (bit-exact by greedy
+determinism).
 
 Error classification crosses the wire like the pserver's: a reply
 REPLY_ERR with retryable=True (queue full, draining, a failed-but-
@@ -33,10 +45,33 @@ import time
 import numpy as np
 
 from ..distributed import wire
+from . import disagg
 
 __all__ = ['ReplicaServer']
 
 UNKNOWN = 'UNKNOWN'
+
+
+class _ShippingStream(object):
+    """Placeholder handle for a stream whose pages are still in flight
+    from the prefill tier: polls as QUEUED, flips to the real LMServer
+    handle (or a dead-letter FAILED) when the ship thread finishes.
+    Cancellation is a flag the ship thread honors before the local
+    submit."""
+
+    __slots__ = ('cancelled', 'error')
+
+    def __init__(self):
+        self.cancelled = False
+        self.error = None
+
+    def poll(self):
+        if self.error is not None:
+            return {'state': 'FAILED', 'tokens': [],
+                    'error': self.error}
+        if self.cancelled:
+            return {'state': 'CANCELLED', 'tokens': []}
+        return {'state': 'QUEUED', 'tokens': []}
 
 
 class ReplicaServer(object):
@@ -65,6 +100,13 @@ class ReplicaServer(object):
         self._lock = threading.Lock()
         self._streams = {}            # rid -> LMServer handle
         self._draining = False
+        # disaggregated-serving counters (SRV_HEALTH feeds these to the
+        # router's fleet.* aggregates)
+        self._pages_shipped_n = 0     # prefill side: rows sent
+        self._ship_bytes_n = 0
+        self._pages_installed_n = 0   # decode side: rows grafted
+        self._pages_deduped_n = 0
+        self._local_reprefills_n = 0  # ship failures eaten locally
 
     # -- lifecycle ---------------------------------------------------------
     def serve_forever(self):
@@ -125,9 +167,31 @@ class ReplicaServer(object):
         elif msg_type == wire.SRV_CANCEL:
             with self._lock:
                 handle = self._streams.get(meta['rid'])
-            if handle is not None:
+            if isinstance(handle, _ShippingStream):
+                handle.cancelled = True
+            elif handle is not None:
                 self._srv.cancel(handle)
             wire.write_msg(conn, wire.REPLY_OK, ack)
+        elif msg_type == wire.SRV_PAGES:
+            installed, deduped = disagg.install_shipment(self._srv,
+                                                         meta, value)
+            with self._lock:
+                self._pages_installed_n += installed
+                self._pages_deduped_n += deduped
+            reply = dict(ack)
+            reply.update({'installed': installed, 'deduped': deduped})
+            wire.write_msg(conn, wire.REPLY_OK, reply)
+        elif msg_type == wire.SRV_PAGE_FETCH:
+            rmeta, rvalue = disagg.serve_page_fetch(self._srv, meta,
+                                                    value)
+            with self._lock:
+                self._pages_shipped_n += (len(rmeta['keys'])
+                                          - rmeta['skip'])
+                if rvalue is not None:
+                    self._ship_bytes_n += int(rvalue.nbytes)
+            reply = dict(ack)
+            reply.update(rmeta)
+            wire.write_msg(conn, wire.SRV_PAGES, reply, rvalue)
         elif msg_type == wire.SRV_HEALTH:
             reply = dict(ack)
             reply.update(self._health(bool(meta.get('digests'))))
@@ -169,6 +233,26 @@ class ReplicaServer(object):
         # deadline_ms rides the meta only when the peer set one — an
         # old router's meta simply lacks the key and decodes to None
         ddl = meta.get('deadline_ms')
+        peer = meta.get('prefill_from')
+        if peer and getattr(self._srv, 'paged', False):
+            # disaggregated dispatch: ack now, ship pages off-thread,
+            # submit locally when they land (or when the ship fails —
+            # local re-prefill, bit-exact by greedy determinism). The
+            # deadline clock starts HERE so every downstream stage
+            # deducts elapsed time from one absolute budget.
+            deadline_at = (None if ddl is None
+                           else time.perf_counter() + float(ddl) / 1000.0)
+            sentinel = _ShippingStream()
+            with self._lock:
+                self._streams[rid] = sentinel
+            t = threading.Thread(
+                target=self._ship_and_submit,
+                args=(rid, sentinel, str(peer), prompt, meta,
+                      deadline_at),
+                daemon=True)
+            t.start()
+            wire.write_msg(conn, wire.REPLY_OK, ack)
+            return
         handle = self._srv.submit(prompt,
                                   max_new_tokens=int(meta['mnt']),
                                   eos_id=meta.get('eos'),
@@ -179,6 +263,41 @@ class ReplicaServer(object):
             self._streams[rid] = handle
         wire.write_msg(conn, wire.REPLY_OK, ack)
 
+    def _ship_and_submit(self, rid, sentinel, peer, prompt, meta,
+                         deadline_at):
+        """Ship-thread body: fetch + install the prompt's pages from
+        the prefill peer, then run the normal local submit with the
+        REMAINING deadline. A dead/gray/slow peer, a refused shipment,
+        or a spent budget all converge on the same fallback — submit
+        locally anyway; only a failure of the LOCAL submit dead-letters
+        the stream (the router sees FAILED with the error string)."""
+        try:
+            disagg.fetch_and_install(self._srv, peer, prompt,
+                                     deadline_at=deadline_at)
+        except Exception:  # noqa: BLE001 — every ship failure falls back
+            disagg.count_local_reprefill()
+            with self._lock:
+                self._local_reprefills_n += 1
+        if sentinel.cancelled:
+            return
+        remaining = (None if deadline_at is None
+                     else max(1.0, (deadline_at - time.perf_counter())
+                              * 1000.0))
+        try:
+            handle = self._srv.submit(prompt,
+                                      max_new_tokens=int(meta['mnt']),
+                                      eos_id=meta.get('eos'),
+                                      priority=int(meta.get('prio', 0)),
+                                      deadline_ms=remaining)
+        except Exception as e:  # noqa: BLE001 — dead-letter for the poll
+            sentinel.error = str(e)
+            return
+        with self._lock:
+            if sentinel.cancelled:
+                self._srv.cancel(handle)
+                return
+            self._streams[rid] = handle
+
     def _on_poll(self, conn, meta, ack):
         out = {}
         for rid in meta.get('rids', ()):
@@ -186,6 +305,8 @@ class ReplicaServer(object):
                 handle = self._streams.get(rid)
             if handle is None:
                 out[rid] = {'state': UNKNOWN, 'tokens': []}
+            elif isinstance(handle, _ShippingStream):
+                out[rid] = handle.poll()
             else:
                 out[rid] = self._srv.poll(handle)
         reply = dict(ack)
@@ -221,6 +342,26 @@ class ReplicaServer(object):
                'preemptions': stats.get('preemptions', 0),
                'preempted_streams': stats.get('preempted_streams', 0),
                'draining': self._draining}
+        with self._lock:
+            out['pages_shipped'] = self._pages_shipped_n
+            out['ship_bytes'] = self._ship_bytes_n
+            out['pages_installed'] = self._pages_installed_n
+            out['pages_deduped'] = self._pages_deduped_n
+            out['local_reprefills'] = self._local_reprefills_n
+        kv = stats.get('kv')
+        if kv:
+            # prefix-cache truth for the router's fleet directory: the
+            # counters seed fleet.prefix_hit_rate, the drained new/
+            # evicted key deltas reconcile the directory against what
+            # is ACTUALLY resident here (not router dispatch guesses)
+            out['page_tokens'] = kv.get('page_tokens')
+            out['prefix_entries'] = kv.get('prefix_entries', 0)
+            out['prefix_hits'] = kv.get('prefix_hits', 0)
+            out['prefix_misses'] = kv.get('prefix_misses', 0)
+            out['prefix_pages'] = kv.get('prefix_pages', 0)
+            report = self._srv.prefix_report()
+            out['prefix_new'] = report['new']
+            out['prefix_evicted'] = report['evicted']
         if with_digests:
             out['digests'] = self._srv.param_digests()
         return out

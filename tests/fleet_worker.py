@@ -29,6 +29,17 @@ run tools/serve_replica.py — this file covers what sits around them):
   (high_sheds / high_bad / low_failed / mismatches / preemptions)
   instead of raw streams.
 
+- disagg: the chaos_sweep --disagg driver — a FleetRouter over two
+  PAGED decode replicas plus a prefill tier (FLEET_PREFILL), running
+  a seeded mixed burst where every other stream carries one shared
+  8-token system prefix (two full 4-token pages — the shippable
+  chain). Long streams dispatch with meta['prefill_from'] and the
+  decode replicas pull pages over SRV_PAGE_FETCH; the sweep kills or
+  gray-stalls the prefill replica mid-ship, and acceptance is every
+  stream DONE and bit-exact (np.array_equal) against the in-process
+  solo reference with failovers + local_reprefills >= 1 — a dead or
+  frozen prefill tier must cost latency only, never tokens.
+
 - grayfail: the chaos_sweep --grayfail driver — replica 0 carries a
   seeded ``stall`` FaultPlan (alive-but-frozen: health keeps passing,
   its data connection stops mid-stream), and the router runs with the
@@ -91,6 +102,29 @@ def make_prompts(seed, n, budget):
         plen = int(rng.randint(2, 5))
         prompt = [int(t) for t in rng.randint(1, CFG.vocab, plen)]
         out.append((prompt, i % SESSIONS))
+    return out
+
+
+def make_disagg_prompts(seed, n, budget):
+    """The disagg workload: every EVEN stream is a long prompt built
+    from one shared 8-token system prefix (exactly two full 4-token
+    pages — the chain the prefill tier ships) plus a 2-4 token seeded
+    suffix; odd streams are short 2-3 token prompts whose chain has no
+    full page at all, so their dispatch must short-circuit the wire.
+    Returns (prompt, per-stream budget) pairs, budgets clipped so
+    prompt + budget always fits CFG.max_len."""
+    rng = np.random.RandomState(seed)
+    shared = [int(t) for t in rng.randint(1, CFG.vocab, 8)]
+    out = []
+    for i in range(n):
+        if i % 2 == 0:
+            extra = int(rng.randint(2, 5))
+            prompt = shared + [int(t)
+                               for t in rng.randint(1, CFG.vocab, extra)]
+        else:
+            plen = int(rng.randint(2, 4))
+            prompt = [int(t) for t in rng.randint(1, CFG.vocab, plen)]
+        out.append((prompt, min(budget, CFG.max_len - len(prompt))))
     return out
 
 
@@ -325,6 +359,71 @@ def run_grayfail_driver():
             complete_replica(ep)
 
 
+def run_disagg_driver():
+    # the bit-exact reference runs jax in THIS process — pin CPU first
+    import jax
+    jax.config.update('jax_platforms', 'cpu')
+    replicas = os.environ['FLEET_REPLICAS'].split(',')
+    prefill_eps = [e for e in
+                   os.environ.get('FLEET_PREFILL', '').split(',') if e]
+    seed = int(os.environ.get('FLEET_SEED', '0'))
+    n = int(os.environ.get('FLEET_STREAMS', '16'))
+    budget = int(os.environ.get('FLEET_BUDGET', '4'))
+    model_dir = os.environ['FLEET_MODEL_DIR']
+    work = make_disagg_prompts(seed, n, budget)
+    # warm EVERY tier over direct wire connections first: the prefill
+    # replica's cold jit compile must never race the decode tier's
+    # FLAGS_disagg_ship_timeout, and warmup must not consume the
+    # seeded fault rule (it is keyed to SRV_PAGE_FETCH, which warmup
+    # never sends)
+    for ep in replicas + prefill_eps:
+        _warm_replica(ep, [1, 2, 3], 2)
+    from paddle_tpu.serving import FleetRouter
+    router = FleetRouter(replicas, prefill_replicas=prefill_eps,
+                         poll_secs=0.005, probe_secs=0.1)
+    router.start()
+    try:
+        router.wait_healthy(timeout=120.0)
+        reqs = [router.submit(p, max_new_tokens=b) for p, b in work]
+        streams, states = [], []
+        for r in reqs:
+            r.wait(timeout=300.0)
+            streams.append([int(t) for t in r.tokens])
+            states.append(r.state)
+        # one probe period so the replicas' ship/reprefill counters
+        # (SRV_HEALTH truth) land in the router's aggregates
+        time.sleep(0.6)
+        stats = router.stats()
+    finally:
+        router.stop()
+    from paddle_tpu.inference import AnalysisConfig, AnalysisPredictor
+    ref = AnalysisPredictor(AnalysisConfig(model_dir)).prepare_decoding(
+        slots=1, prefill_batch=1)
+    mismatches = 0
+    for (p, b), st, toks in zip(work, states, streams):
+        want = np.asarray([int(t) for t in ref.generate(p, b)],
+                          np.int64)
+        if st != 'DONE' or not np.array_equal(
+                np.asarray(toks, np.int64), want):
+            mismatches += 1
+    print('RESULT ' + json.dumps({
+        'submitted': n,
+        'done': sum(1 for s in states if s == 'DONE'),
+        'states': states,
+        'streams': streams,
+        'mismatches': mismatches,
+        'failovers': stats['failovers'],
+        'local_reprefills': stats['local_reprefills'],
+        'pages_shipped': stats['pages_shipped'],
+        'ship_bytes': stats['ship_bytes'],
+        'prefix_hit_rate': stats['prefix_hit_rate'],
+        'prefix_dir_entries': stats['prefix_dir_entries']}),
+        flush=True)
+    if os.environ.get('FLEET_COMPLETE', '1') == '1':
+        for ep in replicas + prefill_eps:
+            complete_replica(ep)
+
+
 def main():
     role = os.environ['FLEET_ROLE']
     if role == 'build':
@@ -335,6 +434,8 @@ def main():
         run_overload_driver()
     elif role == 'grayfail':
         run_grayfail_driver()
+    elif role == 'disagg':
+        run_disagg_driver()
     else:
         raise SystemExit('unknown FLEET_ROLE %r' % role)
 

@@ -187,6 +187,11 @@ def run_one(model, mode, steps, full, quick=False):
             row['paged_max_streams'] = serving['paged_max_streams']
         if serving.get('prefix_hit_ttft_ms'):
             row['prefix_hit_ttft_ms'] = serving['prefix_hit_ttft_ms']
+        if serving.get('disagg_p99_ttft_ms'):
+            row['disagg_p99_ttft_ms'] = serving['disagg_p99_ttft_ms']
+        if serving.get('fleet_prefix_hit_rate'):
+            row['fleet_prefix_hit_rate'] = \
+                serving['fleet_prefix_hit_rate']
     return row
 
 
@@ -386,18 +391,20 @@ _SERVING_QUICK = [None]     # serve_bench --quick, measured at most once
 
 def _serving_quick():
     """Headline serving numbers (tools/serve_bench.py --quick
-    --refresh --fleet --paged --spec) stamped onto the transformer
-    local-mode row: the cached-vs-recompute decode speedup, the
-    online-refresh tail cost (refresh_p99_ratio — token p99 with a
-    live ParamSubscriber install loop over the undisturbed p99), the
-    fleet leg (fleet_tokens_per_sec / fleet_p99_ttft_ms through a
-    FleetRouter over 2 replica subprocesses — perf_gate infers the
-    direction from each suffix), the paged-cache A/B
+    --refresh --fleet --paged --spec --disagg) stamped onto the
+    transformer local-mode row: the cached-vs-recompute decode
+    speedup, the online-refresh tail cost (refresh_p99_ratio — token
+    p99 with a live ParamSubscriber install loop over the undisturbed
+    p99), the fleet leg (fleet_tokens_per_sec / fleet_p99_ttft_ms
+    through a FleetRouter over 2 replica subprocesses — perf_gate
+    infers the direction from each suffix), the paged-cache A/B
     (paged_tokens_per_sec / paged_max_streams at dense-equal HBM,
-    prefix_hit_ttft_ms), and the speculative-decoding A/B
+    prefix_hit_ttft_ms), the speculative-decoding A/B
     (spec_tokens_per_sec / spec_accept_rate vs plain paged decode at
-    equal HBM). One subprocess, cached across invocations;
-    {} on any failure."""
+    equal HBM), and the disaggregated prefill/decode A/B
+    (disagg_p99_ttft_ms / fleet_prefix_hit_rate — a shared-prefix
+    burst through a KV-page-shipping prefill tier vs colocated). One
+    subprocess, cached across invocations; {} on any failure."""
     if _SERVING_QUICK[0] is None:
         try:
             env = dict(os.environ, JAX_PLATFORMS='cpu')
@@ -405,8 +412,8 @@ def _serving_quick():
                 [sys.executable,
                  os.path.join(os.path.dirname(os.path.abspath(__file__)),
                               'serve_bench.py'), '--quick', '--refresh',
-                 '--fleet', '--paged', '--spec'],
-                capture_output=True, text=True, timeout=600, env=env)
+                 '--fleet', '--paged', '--spec', '--disagg'],
+                capture_output=True, text=True, timeout=900, env=env)
             line = [ln for ln in out.stdout.splitlines()
                     if ln.startswith('{') and '"summary"' in ln][-1]
             _SERVING_QUICK[0] = json.loads(line)

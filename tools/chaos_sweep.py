@@ -104,6 +104,25 @@ fired, caught, streams intact), `nokill` (the Nth poll was never
 reached), `diverged` (a stream changed or a tier-1 SLO broke), plus
 the usual `fatal`/`hung`.
 
+`--disagg` chaoses the disaggregated prefill/decode path (serving/
+disagg.py + the fleet prefix directory): two PAGED decode replicas,
+one PAGED prefill-tier replica, and the disagg driver
+(tests/fleet_worker.py) run a seeded mixed burst where every other
+stream shares one 8-token system prefix (two full shippable pages),
+so long streams dispatch with meta['prefill_from'] and the decode
+tier pulls pages over SRV_PAGE_FETCH. Each seed either kill-9's the
+prefill replica at a seeded SRV_PAGE_FETCH (the restarting
+Supervisor brings it back) or gray-stalls that fetch connection for
+20-40s while FLAGS_disagg_ship_timeout=2s forces the ship to give
+up. Acceptance: every stream DONE and bit-exact (in-driver
+np.array_equal against the solo reference), and once the fault
+demonstrably fired, fleet.failovers + local re-prefills >= 1 — a
+dead or frozen prefill tier may cost latency, never tokens.
+Verdicts: `recovered` (fault fired, ship fell back, streams intact),
+`nokill` (the Nth fetch was never reached), `diverged` (a stream
+changed or failed), `fatal` additionally when the fault fired but no
+fallback engaged, plus the usual `hung`.
+
 `--quick` is the CI smoke shape: 3 seeds by default, and the exit
 status is ALSO non-zero on any fatal/hung seed (a quick sweep exists
 to gate regressions, so every non-ok outcome fails it).
@@ -119,6 +138,7 @@ Usage:
     python tools/chaos_sweep.py --fleet --quick     # fleet replica/router kill
     python tools/chaos_sweep.py --overload --quick  # preempt-first capacity
     python tools/chaos_sweep.py --grayfail --quick  # gray-failure watchdog
+    python tools/chaos_sweep.py --disagg --quick    # prefill-tier kill/stall
 
 Exit status is non-zero iff any seed DIVERGED (or, under --quick, any
 seed was fatal/hung): fatal/hung seeds of the full sweep are
@@ -645,6 +665,100 @@ def _run_grayfail_seed(seed, budget, workdir, model_dir, n_replicas=2,
         sup.stop()
 
 
+def _run_disagg_seed(seed, budget, workdir, model_dir, streams=16,
+                     gen=4, obs_dir=None):
+    """One --disagg seed: two paged decode replicas + one paged
+    prefill-tier replica + the disagg driver (tests/fleet_worker.py)
+    under the Supervisor. The seeded fault lands on the prefill
+    replica's SRV_PAGE_FETCH recv side — either a kill-9 (`exit`, the
+    Supervisor restarts it on the same port) or a 20-40s `stall` of
+    the fetch connection, which the decode tier's 2s
+    FLAGS_disagg_ship_timeout turns into a ShipError and a local
+    re-prefill. The fault's nth is capped at 2 because at most one
+    fetch per decode replica ever reaches the wire (after the first
+    ship the pages are resident and dedup short-circuits), and a
+    restarted prefill replica re-counts from zero but sees no further
+    fetches. Acceptance comes from the driver's RESULT: every stream
+    DONE and bit-exact, and — once the fault demonstrably fired —
+    failovers + local_reprefills >= 1. Returns (verdict, result,
+    victim, plan_json, outs)."""
+    import random
+
+    from paddle_tpu.distributed.supervisor import Supervisor
+
+    ports = _free_ports(3)
+    eps = ['127.0.0.1:%d' % p for p in ports]
+    decode_eps, prefill_ep = eps[:2], eps[2]
+    rng = random.Random(('disagg', seed).__repr__())
+    mode = rng.choice(['kill', 'stall'])
+    victim = 'prefill0'
+    rule = {'when': 'recv', 'type': 'SRV_PAGE_FETCH',
+            'nth': rng.randint(1, 2)}
+    if mode == 'kill':
+        rule['action'] = 'exit'
+    else:
+        rule['action'] = 'stall'
+        rule['secs'] = round(20.0 + 20.0 * rng.random(), 1)
+    plan_json = json.dumps({'rules': [rule]})
+    base_env = dict(os.environ)
+    base_env.pop('JAX_PLATFORMS', None)
+    base_env.pop('XLA_FLAGS', None)
+    if obs_dir:
+        base_env['FLAGS_obs_flush_secs'] = '0.5'
+    paged_env = {'SERVE_MODEL_DIR': model_dir, 'SERVE_SLOTS': '4',
+                 'SERVE_WORKERS': '1', 'SERVE_PAGED': '1',
+                 'SERVE_PAGE_TOKENS': '4', 'SERVE_KV_PAGES': '64',
+                 'SERVE_PREFILL_CHUNK': '16'}
+    sup = Supervisor(max_restarts=2, backoff=0.5, log_dir=workdir,
+                     obs_dir=obs_dir)
+    for i, ep in enumerate(decode_eps):
+        # a short ship timeout so the stall flavor converts into a
+        # local re-prefill well inside the stream deadline — the flag
+        # is read at decode-replica import from env
+        env = dict(base_env, SERVE_ENDPOINT=ep,
+                   FLAGS_disagg_ship_timeout='2.0', **paged_env)
+        sup.add_role('replica%d' % i,
+                     [sys.executable, _SERVE_REPLICA], env=env)
+    # fixed port: a kill-9'd prefill replica rebinds the SAME endpoint
+    env = dict(base_env, SERVE_ENDPOINT=prefill_ep,
+               FLAGS_fault_plan=plan_json, **paged_env)
+    sup.add_role('prefill0', [sys.executable, _SERVE_REPLICA], env=env)
+    env = dict(base_env, FLEET_ROLE='disagg',
+               FLEET_MODEL_DIR=model_dir,
+               FLEET_REPLICAS=','.join(decode_eps),
+               FLEET_PREFILL=prefill_ep, FLEET_SEED='0',
+               FLEET_STREAMS=str(streams), FLEET_BUDGET=str(gen))
+    sup.add_role('driver', [sys.executable, _FLEET_WORKER], env=env)
+    sup.start()
+    states = sup.wait(timeout=budget)
+    outs = [sup.output(n) for n in sorted(states)]
+    try:
+        if any(s in ('running', 'backoff') for s in states.values()):
+            return 'hung', None, victim, plan_json, outs
+        if any(s == 'failed' for s in states.values()):
+            return 'fatal', None, victim, plan_json, outs
+        result = None
+        for ln in sup.output('driver').splitlines():
+            if ln.startswith('RESULT '):
+                result = json.loads(ln[len('RESULT '):])
+        if result is None:
+            return 'fatal', None, victim, plan_json, outs
+        if result['mismatches'] or result['done'] != result['submitted']:
+            return 'diverged', result, victim, plan_json, outs
+        fired = (sup.restarts[victim] >= 1 if mode == 'kill' else
+                 'fault injection: stall' in sup.output(victim))
+        if not fired:
+            # the workload never reached the Nth fetch: a clean run
+            return 'nokill', result, victim, plan_json, outs
+        if result['failovers'] + result['local_reprefills'] < 1:
+            # the fault fired but no fallback engaged — the machinery
+            # this sweep exists to gate did not show up
+            return 'fatal', result, victim, plan_json, outs
+        return 'recovered', result, victim, plan_json, outs
+    finally:
+        sup.stop()
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument('--seeds', type=int, default=None,
@@ -691,6 +805,11 @@ def main(argv=None):
                          'SRV_POLL; the progress watchdog must gray-mark '
                          'it, fail streams over bit-exactly, and honor '
                          'every high-tier deadline')
+    ap.add_argument('--disagg', action='store_true',
+                    help='disaggregated prefill/decode chaos: kill-9 or '
+                         'gray-stall the prefill-tier replica at a '
+                         'seeded SRV_PAGE_FETCH mid-ship; every stream '
+                         'must finish bit-exact via local re-prefill')
     ap.add_argument('--quick', action='store_true',
                     help='CI smoke: 3 seeds unless --seeds given, and '
                          'fatal/hung seeds fail the sweep too')
@@ -704,9 +823,11 @@ def main(argv=None):
                          '(default: a ./chaos_report.<pid> dir)')
     args = ap.parse_args(argv)
     if sum((args.kill, args.corrupt, args.mesh_kill, args.refresh,
-            args.fleet, args.overload, args.grayfail)) > 1:
+            args.fleet, args.overload, args.grayfail,
+            args.disagg)) > 1:
         ap.error('--kill, --corrupt, --mesh-kill, --refresh, --fleet, '
-                 '--overload and --grayfail are mutually exclusive')
+                 '--overload, --grayfail and --disagg are mutually '
+                 'exclusive')
     if args.seeds is None:
         args.seeds = 3 if args.quick else 20
 
@@ -722,12 +843,13 @@ def main(argv=None):
         # (printed by online_worker) are the acceptance reference, so
         # the comparison lives inside _run_refresh_seed
         local_w = {}
-    elif args.fleet or args.overload or args.grayfail:
+    elif args.fleet or args.overload or args.grayfail or args.disagg:
         # one model for the whole sweep (every replica and every seed
         # serves the identical bytes), then — for --fleet — a
         # fault-free fleet run for the bit-exact stream baseline
-        # (--overload and --grayfail need no external baseline: their
-        # drivers check every stream against an in-process reference)
+        # (--overload, --grayfail and --disagg need no external
+        # baseline: their drivers check every stream against an
+        # in-process reference)
         import atexit
         import shutil
         fleet_root = tempfile.mkdtemp(prefix='fleet_sweep.')
@@ -782,7 +904,7 @@ def main(argv=None):
     ok_verdicts = (('ok', 'recovered', 'nokill') if args.refresh
                    else ('recovered', 'nokill')
                    if (args.kill or args.mesh_kill or args.fleet or
-                       args.overload or args.grayfail)
+                       args.overload or args.grayfail or args.disagg)
                    else ('ok',))
     tally = {'ok': 0, 'recovered': 0, 'nokill': 0, 'diverged': 0,
              'fatal': 0, 'hung': 0}
@@ -820,6 +942,16 @@ def main(argv=None):
                 verdict, result, victim, plan_json, outs = \
                     _run_grayfail_seed(seed, args.budget, workdir,
                                        model_dir, obs_dir=obs_dir)
+            weights = {}
+            if result is not None:    # streams are bulky; counts only
+                result = {k: v for k, v in result.items()
+                          if k not in ('streams', 'states')}
+            label = '%s %s %s' % (victim, plan_json, json.dumps(result))
+        elif args.disagg:
+            with tempfile.TemporaryDirectory() as workdir:
+                verdict, result, victim, plan_json, outs = \
+                    _run_disagg_seed(seed, args.budget, workdir,
+                                     model_dir, obs_dir=obs_dir)
             weights = {}
             if result is not None:    # streams are bulky; counts only
                 result = {k: v for k, v in result.items()
@@ -894,6 +1026,7 @@ def main(argv=None):
                 else 'fleet' if args.fleet
                 else 'overload' if args.overload
                 else 'grayfail' if args.grayfail
+                else 'disagg' if args.disagg
                 else 'mesh-kill' if args.mesh_kill
                 else 'kill' if args.kill
                 else 'corrupt' if args.corrupt else 'fault')

@@ -51,7 +51,7 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 _HIGHER = ('_per_sec', 'mfu', 'value', 'tflops', 'speedup',
            'vs_baseline', 'samples_per_sec', 'efficiency', 'hits',
            '_max_streams', '_accept_rate', '_completion_rate',
-           '_win_rate')
+           '_win_rate', '_hit_rate')
 _LOWER = ('_ms', '_secs', 'compile_ms', 'hbm_peak', 'peak_hbm_gb',
           '_bytes', 'misses', 'latency')
 
@@ -233,6 +233,21 @@ def smoke():
     fails, _, _ = gate(traj_gray, {'hedge_win_rate': 0.88,
                                    'degraded_p99_ttft_ms': 200.0})
     expect(not fails, 'healthy gray-failure metrics flagged: %r' % fails)
+    # disagg leg metrics (serve_bench --disagg): fleet_prefix_hit_rate
+    # is higher-better, disagg_p99_ttft_ms rides the _ms ceiling
+    traj_dis = [{'fleet_prefix_hit_rate': 0.85,
+                 'disagg_p99_ttft_ms': 120.0}]
+    fails, _, _ = gate(traj_dis, {'fleet_prefix_hit_rate': 0.4,
+                                  'disagg_p99_ttft_ms': 115.0})
+    expect(any(f[0] == 'fleet_prefix_hit_rate' for f in fails),
+           'prefix hit-rate collapse missed')
+    fails, _, _ = gate(traj_dis, {'fleet_prefix_hit_rate': 0.9,
+                                  'disagg_p99_ttft_ms': 300.0})
+    expect(any(f[0] == 'disagg_p99_ttft_ms' for f in fails),
+           'disagg TTFT regression missed')
+    fails, _, _ = gate(traj_dis, {'fleet_prefix_hit_rate': 0.84,
+                                  'disagg_p99_ttft_ms': 110.0})
+    expect(not fails, 'healthy disagg metrics flagged: %r' % fails)
     # per-metric tolerance override: longcontext 11% swing passes
     traj2 = [{'longcontext_mfu': 0.46}]
     fails, _, _ = gate(traj2, {'longcontext_mfu': 0.41})

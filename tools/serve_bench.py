@@ -819,6 +819,146 @@ def _hedge_leg(cfg, quick, replicas=2):
             'completed': stats['completed']}
 
 
+def _disagg_leg(cfg, quick, replicas=2):
+    """Disaggregated prefill/decode A/B leg: the same shared-prefix
+    burst through two fleets over identical paged replicas — once
+    colocated (each decode replica prefills for itself) and once with
+    a prefill-tier replica shipping KV pages over SRV_PAGE_FETCH
+    (serving/disagg.py). Every request extends one page-aligned
+    system prefix, so the disagg fleet prefills that prefix ONCE
+    fleet-wide and the decode replicas adopt the shipped pages;
+    disagg_p99_ttft_ms vs colocated_p99_ttft_ms prices what the ship
+    path buys at burst concurrency, and fleet_prefix_hit_rate
+    (decode-tier prefix-cache hits / lookups, via the fleet prefix
+    directory's SRV_HEALTH feed) shows the sharing actually landing.
+    Both go in the acceptance summary for perf_gate.py."""
+    import socket as _socket
+    import subprocess
+
+    import paddle_tpu as fluid
+    from paddle_tpu.distributed import wire
+    from paddle_tpu.models import transformer as tfm
+    from paddle_tpu.serving import FleetRouter
+
+    n_requests = 16 if quick else 48
+    new_tokens = 4 if quick else 8
+    slots = 4
+    pt = max(2, cfg.max_len // 8)
+    kv_pages = 64 if quick else 256
+    here = os.path.dirname(os.path.abspath(__file__))
+    rng = np.random.RandomState(11)
+    # a page-aligned shared system prefix (4 full pages) + a 2-token
+    # per-request tail: the whole burst shares one shippable chain
+    sys_prefix = [int(t) for t in rng.randint(1, cfg.vocab, 4 * pt)]
+    prompts = [sys_prefix +
+               [int(t) for t in rng.randint(1, cfg.vocab, 2)]
+               for _ in range(n_requests)]
+
+    def one_fleet(model_dir, with_prefill):
+        eps = []
+        for _ in range(replicas + (1 if with_prefill else 0)):
+            s = _socket.socket()
+            s.bind(('127.0.0.1', 0))
+            eps.append('127.0.0.1:%d' % s.getsockname()[1])
+            s.close()
+        decode_eps, prefill_eps = eps[:replicas], eps[replicas:]
+        env = dict(os.environ)
+        env.pop('XLA_FLAGS', None)
+        procs = []
+        try:
+            for ep in eps:
+                procs.append(subprocess.Popen(
+                    [sys.executable,
+                     os.path.join(here, 'serve_replica.py')],
+                    env=dict(env, SERVE_MODEL_DIR=model_dir,
+                             SERVE_ENDPOINT=ep,
+                             SERVE_SLOTS=str(slots),
+                             SERVE_WORKERS='1', SERVE_PAGED='1',
+                             SERVE_PAGE_TOKENS=str(pt),
+                             SERVE_KV_PAGES=str(kv_pages),
+                             SERVE_PREFILL_CHUNK=str(cfg.max_len)),
+                    stdout=subprocess.DEVNULL,
+                    stderr=subprocess.DEVNULL))
+            # warm jit caches with a prompt OUTSIDE the shared prefix
+            # so the measured burst starts prefix-cold everywhere
+            for ep in eps:
+                _warm_replica_direct(ep, [1, 2, 3], 2)
+            router = FleetRouter(decode_eps,
+                                 prefill_replicas=prefill_eps,
+                                 probe_secs=0.1).start()
+            try:
+                router.wait_healthy(timeout=300.0)
+                t0 = time.perf_counter()
+                reqs = [router.submit(p, max_new_tokens=new_tokens)
+                        for p in prompts]
+                for r in reqs:
+                    r.wait(600.0)
+                wall = time.perf_counter() - t0
+                total = sum(len(r.tokens) for r in reqs)
+                ttfts = sorted(r.first_token_at - r.submitted_at
+                               for r in reqs if r.first_token_at)
+                p99 = ttfts[int(0.99 * (len(ttfts) - 1))]
+                # one probe period so the replicas' ship / prefix
+                # counters (SRV_HEALTH truth) land in router.stats()
+                time.sleep(0.3)
+                stats = router.stats()
+            finally:
+                router.stop()
+            for ep in eps:
+                host, port = ep.rsplit(':', 1)
+                try:
+                    with _socket.create_connection(
+                            (host, int(port)), timeout=5.0) as s:
+                        wire.write_msg(s, wire.COMPLETE, {'seq': 0})
+                        wire.read_msg(s)
+                except (ConnectionError, OSError):
+                    pass
+            for p in procs:
+                try:
+                    p.wait(timeout=30)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+        return {'p99': p99, 'tps': total / wall, 'stats': stats}
+
+    with tempfile.TemporaryDirectory() as tmp:
+        model_dir = os.path.join(tmp, 'model')
+        main_prog, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main_prog, startup):
+            tokens = fluid.layers.data(
+                'tokens', shape=[1, cfg.max_len, 1], dtype='int64',
+                append_batch_size=False)
+            logits = tfm.language_model_logits(tokens, cfg)
+        exe = fluid.Executor(fluid.TPUPlace())
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            fluid.io.save_inference_model(model_dir, ['tokens'],
+                                          [logits], exe,
+                                          main_program=main_prog)
+        colo = one_fleet(model_dir, with_prefill=False)
+        dis = one_fleet(model_dir, with_prefill=True)
+    return {'mode': 'disagg', 'replicas': replicas, 'slots': slots,
+            'page_tokens': pt, 'kv_pages': kv_pages,
+            'requests': n_requests, 'prefix_tokens': len(sys_prefix),
+            'colocated_p99_ttft_ms': round(colo['p99'] * 1e3, 1),
+            'disagg_p99_ttft_ms': round(dis['p99'] * 1e3, 1),
+            'colocated_tokens_per_sec': round(colo['tps'], 2),
+            'disagg_tokens_per_sec': round(dis['tps'], 2),
+            'fleet_prefix_hit_rate':
+                round(dis['stats']['prefix_hit_rate'], 4),
+            'colocated_prefix_hit_rate':
+                round(colo['stats']['prefix_hit_rate'], 4),
+            'pages_shipped': dis['stats']['pages_shipped'],
+            'ship_bytes': dis['stats']['ship_bytes'],
+            'pages_deduped': dis['stats']['pages_deduped'],
+            'local_reprefills': dis['stats']['local_reprefills'],
+            'prefix_dir_entries': dis['stats']['prefix_dir_entries']}
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument('--quick', action='store_true',
@@ -846,6 +986,13 @@ def main():
                          'fleet topology with one deliberately stalled '
                          'replica, hedged dispatch + progress watchdog '
                          'armed (degraded_p99_ttft_ms + hedge_win_rate '
+                         'in the summary)')
+    ap.add_argument('--disagg', action='store_true',
+                    help='add the disaggregated prefill/decode A/B '
+                         'leg: a shared-prefix burst through a '
+                         'colocated fleet vs the same replicas behind '
+                         'a KV-page-shipping prefill tier '
+                         '(disagg_p99_ttft_ms + fleet_prefix_hit_rate '
                          'in the summary)')
     ap.add_argument('--preempt', action='store_true',
                     help='add the preempt-first capacity leg: a '
@@ -950,6 +1097,15 @@ def main():
         summary['degraded_p99_ttft_ms'] = \
             hedge_row['degraded_p99_ttft_ms']
         summary['hedge_win_rate'] = hedge_row['hedge_win_rate']
+
+    if args.disagg:
+        dis_row = _disagg_leg(cfg, args.quick)
+        dis_row['config'] = label
+        print(json.dumps(dis_row), flush=True)
+        for key in ('disagg_p99_ttft_ms', 'colocated_p99_ttft_ms',
+                    'fleet_prefix_hit_rate', 'pages_shipped',
+                    'ship_bytes'):
+            summary[key] = dis_row[key]
 
     if args.preempt:
         pre_row = _preempt_leg(pred, cfg, args.quick)
