@@ -390,6 +390,12 @@ _DEFAULTS = {
     # shed), and the host-RAM budget (MiB) for swapped pages
     'serving_preempt_policy': 'swap',
     'serving_swap_host_mb': 64,
+    # mesh-sharded serving (serving/mesh.py): MeshConfig axis spec for
+    # the decode/prefill/verify programs ('tp=2', 'dp=1,tp=4'; '' =
+    # single-chip, the pre-mesh path). The page pool shards its heads
+    # axis over tp; axes that do not divide (heads % tp != 0) fall back
+    # to replicated via fit_spec, never error.
+    'serve_mesh_shape': '',
     # sharded checkpointing (paddle_tpu/checkpoint/): digest-verify the
     # legacy host save/load path, async writer pool size, and the
     # MeshConfig.from_flags axis spec ('dp=2,tp=2'; '' = pure dp)

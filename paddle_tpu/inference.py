@@ -161,7 +161,7 @@ class AnalysisPredictor(Predictor):
                          paged=False, page_tokens=None, kv_pages=None,
                          prefill_chunk=None, speculative=False,
                          spec_k=None, draft_layers=None,
-                         draft_predictor=None):
+                         draft_predictor=None, mesh=None):
         """Transpile the loaded LM into the KV-cached prefill + decode
         pair and return a serving.DecodePredictor over this predictor's
         weight scope (see paddle_tpu/serving/decode.py). paged=True
@@ -173,7 +173,11 @@ class AnalysisPredictor(Predictor):
         greedy speculation with bit-exact acceptance
         (serving/speculative.py; spec_k / draft_layers default from
         FLAGS_spec_*; draft_predictor supplies an explicit smaller
-        draft LM instead of the layer-truncated self-draft). Raises
+        draft LM instead of the layer-truncated self-draft). mesh makes
+        every decode/prefill/verify program ONE GSPMD SPMD program over
+        a device mesh ('tp=2' / MeshConfig / jax Mesh; None = read
+        FLAGS_serve_mesh_shape, '' = single-chip) — greedy decode stays
+        bit-exact vs single-chip (serving/mesh.py). Raises
         transpiler.DecodeTranspileError if the program is not a
         recognizable decoder-only LM."""
         if speculative:
@@ -183,16 +187,17 @@ class AnalysisPredictor(Predictor):
                 draft_layers=draft_layers,
                 draft_predictor=draft_predictor,
                 page_tokens=page_tokens, kv_pages=kv_pages,
-                prefill_chunk=prefill_chunk)
+                prefill_chunk=prefill_chunk, mesh=mesh)
         if paged:
             from .serving import PagedDecodePredictor
             return PagedDecodePredictor(self, slots=slots,
                                         page_tokens=page_tokens,
                                         kv_pages=kv_pages,
-                                        prefill_chunk=prefill_chunk)
+                                        prefill_chunk=prefill_chunk,
+                                        mesh=mesh)
         from .serving import DecodePredictor
         return DecodePredictor(self, slots=slots,
-                               prefill_batch=prefill_batch)
+                               prefill_batch=prefill_batch, mesh=mesh)
 
 
 def create_analysis_predictor(config):

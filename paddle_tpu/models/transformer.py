@@ -220,10 +220,17 @@ class DecodeSpec(object):
     qkv/proj/up/down -> (w_name, b_name); final_ln is (scale, bias);
     head is (w_name, b_name_or_None). pos_len is the positional TABLE
     length (>= max_len, the sequence length programs are built for).
+
+    param_specs maps weight name -> recovered training PartitionSpec in
+    tuple form (None = replicated); the transpiler fills it from
+    dist_attr / surviving sharding_constraint ops so mesh serving can
+    re-shard the same scope. mesh is the serving mesh spec string
+    ('tp=2'; '' = single-chip), stamped by prepare_decoding.
     """
 
     def __init__(self, vocab, dim, heads, layers, ffn, max_len, pos_len,
-                 emb_w, pos_w, blocks, final_ln, head, use_flash=False):
+                 emb_w, pos_w, blocks, final_ln, head, use_flash=False,
+                 param_specs=None, mesh=''):
         self.vocab, self.dim, self.heads = vocab, dim, heads
         self.layers, self.ffn = layers, ffn
         self.max_len, self.pos_len = max_len, pos_len
@@ -233,6 +240,8 @@ class DecodeSpec(object):
         self.final_ln = final_ln
         self.head = head
         self.use_flash = use_flash
+        self.param_specs = dict(param_specs or {})
+        self.mesh = mesh
 
     def cache_names(self, layer=None):
         """Ring-cache var names; shared by the prefill/decode pair."""
@@ -260,6 +269,32 @@ class DecodeSpec(object):
     def pool_shape(self, num_pages, page_tokens):
         return (num_pages, page_tokens, self.heads, self.dh)
 
+    def cache_spec(self):
+        """PartitionSpec (tuple form) for the K/V state: heads axis
+        sharded over tp. Dim 2 is H in BOTH layouts — ring caches
+        [slots, T, H, dh] and page pools [pages, pt, H, dh] — so one
+        spec covers dense and paged serving. Flash-attention specs
+        serve replicated: the Pallas kernel is opaque to GSPMD."""
+        return (None, None, _tp_ax(self), None)
+
+    def serve_param_specs(self):
+        """param_specs filtered to the shardings that keep greedy
+        decode BIT-EXACT vs single-chip: only column-style layouts
+        (last dim sharded, contraction dim whole) qualify — every
+        output element is then fully reduced on one device in the same
+        order as the single-chip dot, and the gathers GSPMD inserts
+        are pure data movement. Row-parallel weights (dim-0 sharded)
+        would shard the contraction -> a psum with a different
+        reduction order -> dropped here, i.e. served replicated."""
+        out = {}
+        for name, spec in self.param_specs.items():
+            if not spec or len(spec) < 2:
+                continue
+            if spec[-1] is not None and \
+                    all(s is None for s in spec[:-1]):
+                out[name] = tuple(spec)
+        return out
+
     def param_names(self):
         names = [self.emb_w, self.pos_w,
                  self.final_ln[0], self.final_ln[1], self.head[0]]
@@ -269,6 +304,12 @@ class DecodeSpec(object):
             for key in ('ln1', 'ln2', 'qkv', 'proj', 'up', 'down'):
                 names.extend(n for n in blk[key] if n)
         return names
+
+
+def _tp_ax(spec):
+    """The model axis the cached programs shard on — None (replicated)
+    for flash specs, whose Pallas kernel GSPMD cannot partition."""
+    return None if spec.use_flash else 'tp'
 
 
 def _named_attr(name):
@@ -321,13 +362,16 @@ def _create_cache_vars(spec, slots):
 def _qkv_parts(x, spec, blk, t):
     """qkv fc + per-part slice/reshape to [-1, t, H, dh] — the full
     path's heads() up to (not including) the transpose, which is the
-    cache's storage layout."""
+    cache's storage layout. On a mesh each part is pinned heads-sharded
+    (the cache/pool layout), a no-op single-chip; the qkv contraction
+    dim stays whole either way, so every element is bit-exact."""
     qkv = _named_fc(x, 3 * spec.dim, blk['qkv'])
     D = spec.dim
 
     def part(s, e):
         p = L.slice(qkv, axes=[2], starts=[s], ends=[e])
-        return L.reshape(p, shape=[-1, t, spec.heads, spec.dh])
+        p = L.reshape(p, shape=[-1, t, spec.heads, spec.dh])
+        return sharding_constraint(p, (None, None, _tp_ax(spec), None))
 
     return part(0, D), part(D, 2 * D), part(2 * D, 3 * D)
 
@@ -339,9 +383,13 @@ def _prefill_attention(x, spec, blk, cache, slot_idx):
                   inputs={'Cache': [cache_var], 'X': [new],
                           'Slots': [slot_idx]},
                   outputs={'Out': [cache_var]})
-    q = L.transpose(q4, perm=[0, 2, 1, 3])             # [pb, H, T, dh]
-    k = L.transpose(k4, perm=[0, 2, 1, 3])
-    v = L.transpose(v4, perm=[0, 2, 1, 3])
+    ax = _tp_ax(spec)
+    q = sharding_constraint(L.transpose(q4, perm=[0, 2, 1, 3]),
+                            (None, ax, None, None))    # [pb, H, T, dh]
+    k = sharding_constraint(L.transpose(k4, perm=[0, 2, 1, 3]),
+                            (None, ax, None, None))
+    v = sharding_constraint(L.transpose(v4, perm=[0, 2, 1, 3]),
+                            (None, ax, None, None))
     if spec.use_flash:
         ctx = L.flash_attention(q, k, v, causal=True)
     else:
@@ -351,6 +399,10 @@ def _prefill_attention(x, spec, blk, cache, slot_idx):
         ctx = L.matmul(probs, v)
     ctx = L.transpose(ctx, perm=[0, 2, 1, 3])
     ctx = L.reshape(ctx, shape=[-1, spec.max_len, spec.dim])
+    # replicate before the proj contraction: the all-gather of the
+    # per-head context is pure data movement, and the full-D dot then
+    # reduces in single-chip order — the bit-exactness invariant
+    ctx = sharding_constraint(ctx, (None, None, None))
     return _named_fc(ctx, spec.dim, blk['proj'])
 
 
@@ -361,9 +413,13 @@ def _decode_attention(x, spec, blk, cache, step_idx):
                   inputs={'Cache': [cache_var], 'X': [new],
                           'StepIdx': [step_idx]},
                   outputs={'Out': [cache_var]})
-    q = L.transpose(q1, perm=[0, 2, 1, 3])             # [S, H, 1, dh]
-    kt = L.transpose(cache[0], perm=[0, 2, 1, 3])      # [S, H, T, dh]
-    vt = L.transpose(cache[1], perm=[0, 2, 1, 3])
+    ax = _tp_ax(spec)
+    q = sharding_constraint(L.transpose(q1, perm=[0, 2, 1, 3]),
+                            (None, ax, None, None))    # [S, H, 1, dh]
+    kt = sharding_constraint(L.transpose(cache[0], perm=[0, 2, 1, 3]),
+                             (None, ax, None, None))   # [S, H, T, dh]
+    vt = sharding_constraint(L.transpose(cache[1], perm=[0, 2, 1, 3]),
+                             (None, ax, None, None))
     scores = L.matmul(q, kt, transpose_y=True,
                       alpha=1.0 / np.sqrt(spec.dh))    # [S, H, 1, T]
     masked = _tmp_var()
@@ -374,6 +430,7 @@ def _decode_attention(x, spec, blk, cache, step_idx):
     ctx = L.matmul(probs, vt)                          # [S, H, 1, dh]
     ctx = L.transpose(ctx, perm=[0, 2, 1, 3])
     ctx = L.reshape(ctx, shape=[-1, 1, spec.dim])
+    ctx = sharding_constraint(ctx, (None, None, None))
     return _named_fc(ctx, spec.dim, blk['proj'])
 
 
@@ -383,6 +440,10 @@ def _cached_block(x, spec, i, attention):
     x = L.elementwise_add(x, attn)
     ffn = _named_fc(_named_ln(x, blk['ln2']), spec.ffn, blk['up'],
                     act='gelu')
+    # a column-sharded up weight leaves the gelu output ffn-sharded;
+    # gather it whole BEFORE the down contraction so the down dot
+    # reduces in single-chip order (bit-exactness) instead of a psum
+    ffn = sharding_constraint(ffn, (None, None, None))
     ffn = _named_fc(ffn, spec.dim, blk['down'])
     return L.elementwise_add(x, ffn)
 
@@ -514,12 +575,13 @@ def _create_pool_vars(spec, num_pages, page_tokens):
     return pools
 
 
-def _paged_gather(pool_var, table):
+def _paged_gather(pool_var, table, spec):
     g = _tmp_var()
     _block_op('kv_page_gather',
               inputs={'Pool': [pool_var], 'Table': [table]},
               outputs={'Out': [g]})                    # [B, J, H, dh]
-    return L.transpose(g, perm=[0, 2, 1, 3])           # [B, H, J, dh]
+    return sharding_constraint(L.transpose(g, perm=[0, 2, 1, 3]),
+                               (None, _tp_ax(spec), None, None))
 
 
 def _paged_prefill_attention(x, spec, blk, pool, table, positions,
@@ -538,9 +600,10 @@ def _paged_prefill_attention(x, spec, blk, pool, table, positions,
                           'Table': [table], 'Positions': [positions],
                           'Len': [length]},
                   outputs={'Out': [pool_var]})
-    q = L.transpose(q4, perm=[0, 2, 1, 3])             # [1, H, C, dh]
-    kt = _paged_gather(pool[0], table)                 # [1, H, J, dh]
-    vt = _paged_gather(pool[1], table)
+    q = sharding_constraint(L.transpose(q4, perm=[0, 2, 1, 3]),
+                            (None, _tp_ax(spec), None, None))
+    kt = _paged_gather(pool[0], table, spec)           # [1, H, J, dh]
+    vt = _paged_gather(pool[1], table, spec)
     scores = L.matmul(q, kt, transpose_y=True,
                       alpha=1.0 / np.sqrt(spec.dh))    # [1, H, C, J]
     masked = _tmp_var()
@@ -551,6 +614,7 @@ def _paged_prefill_attention(x, spec, blk, pool, table, positions,
     ctx = L.matmul(probs, vt)                          # [1, H, C, dh]
     ctx = L.transpose(ctx, perm=[0, 2, 1, 3])
     ctx = L.reshape(ctx, shape=[-1, chunk, spec.dim])
+    ctx = sharding_constraint(ctx, (None, None, None))
     return _named_fc(ctx, spec.dim, blk['proj'])
 
 
@@ -566,9 +630,10 @@ def _paged_decode_attention(x, spec, blk, pool, table, positions,
                   inputs={'Pool': [pool_var], 'X': [new],
                           'Table': [table], 'Positions': [positions]},
                   outputs={'Out': [pool_var]})
-    q = L.transpose(q1, perm=[0, 2, 1, 3])             # [S, H, 1, dh]
-    kt = _paged_gather(pool[0], table)                 # [S, H, J, dh]
-    vt = _paged_gather(pool[1], table)
+    q = sharding_constraint(L.transpose(q1, perm=[0, 2, 1, 3]),
+                            (None, _tp_ax(spec), None, None))
+    kt = _paged_gather(pool[0], table, spec)           # [S, H, J, dh]
+    vt = _paged_gather(pool[1], table, spec)
     scores = L.matmul(q, kt, transpose_y=True,
                       alpha=1.0 / np.sqrt(spec.dh))    # [S, H, 1, J]
     masked = _tmp_var()
@@ -579,6 +644,7 @@ def _paged_decode_attention(x, spec, blk, pool, table, positions,
     ctx = L.matmul(probs, vt)                          # [S, H, 1, dh]
     ctx = L.transpose(ctx, perm=[0, 2, 1, 3])
     ctx = L.reshape(ctx, shape=[-1, 1, spec.dim])
+    ctx = sharding_constraint(ctx, (None, None, None))
     return _named_fc(ctx, spec.dim, blk['proj'])
 
 
@@ -600,9 +666,10 @@ def _paged_verify_attention(x, spec, blk, pool, table, positions,
                   inputs={'Pool': [pool_var], 'X': [new],
                           'Table': [table], 'Positions': [positions]},
                   outputs={'Out': [pool_var]})
-    q = L.transpose(q4, perm=[0, 2, 1, 3])             # [S, H, K1, dh]
-    kt = _paged_gather(pool[0], table)                 # [S, H, J, dh]
-    vt = _paged_gather(pool[1], table)
+    q = sharding_constraint(L.transpose(q4, perm=[0, 2, 1, 3]),
+                            (None, _tp_ax(spec), None, None))
+    kt = _paged_gather(pool[0], table, spec)           # [S, H, J, dh]
+    vt = _paged_gather(pool[1], table, spec)
     scores = L.matmul(q, kt, transpose_y=True,
                       alpha=1.0 / np.sqrt(spec.dh))    # [S, H, K1, J]
     masked = _tmp_var()
@@ -613,6 +680,7 @@ def _paged_verify_attention(x, spec, blk, pool, table, positions,
     ctx = L.matmul(probs, vt)                          # [S, H, K1, dh]
     ctx = L.transpose(ctx, perm=[0, 2, 1, 3])
     ctx = L.reshape(ctx, shape=[-1, k1, spec.dim])
+    ctx = sharding_constraint(ctx, (None, None, None))
     return _named_fc(ctx, spec.dim, blk['proj'])
 
 

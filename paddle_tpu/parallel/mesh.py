@@ -34,12 +34,10 @@ class MeshConfig(object):
         self.devices = devices
 
     @classmethod
-    def from_flags(cls, devices=None):
-        """Build from FLAGS_mesh_shape ('dp=2,tp=4'; '' = pure data
-        parallelism over every local device) so tools/tests construct
-        meshes without hand-wiring axis sizes."""
-        from .. import flags
-        shape = str(flags.get_flag('mesh_shape', '') or '').strip()
+    def from_spec(cls, shape, devices=None):
+        """Parse an axis-spec string ('dp=2,tp=4'; ''/None = pure data
+        parallelism over every local device) into a MeshConfig."""
+        shape = str(shape or '').strip()
         if not shape:
             n = len(devices) if devices is not None else len(jax.devices())
             return cls(devices=devices, dp=n)
@@ -50,10 +48,18 @@ class MeshConfig(object):
                 continue
             if '=' not in part:
                 raise ValueError(
-                    'FLAGS_mesh_shape entry %r is not axis=size' % part)
+                    'mesh shape entry %r is not axis=size' % part)
             ax, n = part.split('=', 1)
             sizes[ax.strip()] = int(n)
         return cls(devices=devices, **sizes)
+
+    @classmethod
+    def from_flags(cls, devices=None):
+        """Build from FLAGS_mesh_shape so tools/tests construct meshes
+        without hand-wiring axis sizes."""
+        from .. import flags
+        return cls.from_spec(flags.get_flag('mesh_shape', ''),
+                             devices=devices)
 
     @property
     def size(self):

@@ -24,7 +24,7 @@ class LMServer(object):
                  prefill_batch=None, workers=1, max_queue=None,
                  paged=False, page_tokens=None, kv_pages=None,
                  prefill_chunk=None, speculative=False, spec_k=None,
-                 draft_layers=None):
+                 draft_layers=None, mesh=None):
         """model_dir_or_predictor: a save_inference_model directory, an
         AnalysisPredictor, or an already-prepared DecodePredictor.
         paged=True serves from the page-pool cache (serving/paged.py):
@@ -32,7 +32,10 @@ class LMServer(object):
         page_tokens / kv_pages / prefill_chunk (each None defaults
         from FLAGS_serving_*). speculative=True (implies paged) serves
         through draft/verify speculation (serving/speculative.py);
-        spec_k / draft_layers default from FLAGS_spec_*."""
+        spec_k / draft_layers default from FLAGS_spec_*. mesh shards
+        the decode programs GSPMD over a device mesh ('tp=2'; None =
+        read FLAGS_serve_mesh_shape, '' = single-chip) with greedy
+        output bit-exact vs single-chip (serving/mesh.py)."""
         from .decode import DecodePredictor
         obj = model_dir_or_predictor
         if isinstance(obj, DecodePredictor):
@@ -47,15 +50,18 @@ class LMServer(object):
                                            draft_layers=draft_layers,
                                            page_tokens=page_tokens,
                                            kv_pages=kv_pages,
-                                           prefill_chunk=prefill_chunk)
+                                           prefill_chunk=prefill_chunk,
+                                           mesh=mesh)
             elif paged:
                 dec = obj.prepare_decoding(slots=slots, paged=True,
                                            page_tokens=page_tokens,
                                            kv_pages=kv_pages,
-                                           prefill_chunk=prefill_chunk)
+                                           prefill_chunk=prefill_chunk,
+                                           mesh=mesh)
             else:
                 dec = obj.prepare_decoding(slots=slots,
-                                           prefill_batch=prefill_batch)
+                                           prefill_batch=prefill_batch,
+                                           mesh=mesh)
         self._decode = dec
         self._engine = ServingEngine(dec, workers=workers,
                                      max_queue=max_queue)

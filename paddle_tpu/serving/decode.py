@@ -25,30 +25,92 @@ __all__ = ['DecodePredictor']
 
 class DecodePredictor(object):
     def __init__(self, predictor, slots=None, prefill_batch=None,
-                 _clone_of=None):
+                 _clone_of=None, mesh=None):
         """predictor: a (loaded) Predictor/AnalysisPredictor whose
         program is a decoder-only LM; prefer
         AnalysisPredictor.prepare_decoding() over calling this
         directly. slots / prefill_batch default to FLAGS_serving_slots
-        / FLAGS_serving_prefill_batch."""
+        / FLAGS_serving_prefill_batch. mesh (None = read
+        FLAGS_serve_mesh_shape; '' = single-chip) makes every program
+        ONE GSPMD SPMD program over the mesh — K/V state shards on
+        heads, weights per DecodeSpec.serve_param_specs, greedy decode
+        stays bit-exact vs single-chip (serving/mesh.py)."""
         self._base = predictor
         if _clone_of is not None:
             self._pair = _clone_of._pair
             self._weight_scope = _clone_of._weight_scope
+            self._mesh = _clone_of._mesh
+            self._mesh_shape = _clone_of._mesh_shape
         else:
-            from ..transpiler.decode_transpiler import DecodeTranspiler
+            from .mesh import serving_mesh
             slots = int(slots or get_flag('serving_slots'))
             prefill_batch = int(prefill_batch
                                 or get_flag('serving_prefill_batch'))
-            self._pair = DecodeTranspiler().transpile(
-                predictor._program, slots=slots,
-                prefill_batch=prefill_batch)
+            self._pair = self._transpile(predictor, slots, prefill_batch)
             self._weight_scope = predictor._scope
-        self._exe = Executor(predictor._place)
+            self._mesh, self._mesh_shape = serving_mesh(mesh)
+            self._pair.spec.mesh = self._mesh_shape
+        self._exe = self._make_executor(predictor._place)
         if _clone_of is None:
             self._pin_weights()
         self._scope = Scope(parent=self._weight_scope)
         self.reset()
+
+    def _transpile(self, predictor, slots, prefill_batch):
+        from ..transpiler.decode_transpiler import DecodeTranspiler
+        return DecodeTranspiler().transpile(
+            predictor._program, slots=slots,
+            prefill_batch=prefill_batch)
+
+    def _make_executor(self, place):
+        if self._mesh is None:
+            return Executor(place)
+        from .mesh import MeshDecodeExecutor
+        return MeshDecodeExecutor(place, self._mesh,
+                                  self._cache_shardings())
+
+    def _cache_shardings(self):
+        """{K/V state var name: NamedSharding} — heads axis over tp,
+        adapted by fit_spec (heads % tp != 0 falls back to replicated,
+        never errors). Shape dim 2 is H for both the dense ring and the
+        page pool, so one spec covers both pair kinds."""
+        if self._mesh is None:
+            return {}
+        from ..parallel.mesh import fit_spec, named_sharding
+        pair = self._pair
+        shape = (pair.pool_shape if pair.paged
+                 else pair.spec.cache_shape(pair.slots))
+        spec = fit_spec(pair.spec.cache_spec(), shape, self._mesh)
+        sh = named_sharding(self._mesh, spec)
+        return {n: sh for n in pair.cache_names}
+
+    def _param_shardings(self):
+        """{param name: NamedSharding} for the mesh: column-style specs
+        from serve_param_specs, replicated for everything else."""
+        from ..parallel.mesh import fit_spec, named_sharding
+        serve = self._pair.spec.serve_param_specs()
+        out = {}
+        for name in self._pair.spec.param_names():
+            spec = serve.get(name)
+            if spec is not None:
+                val = self._weight_scope.find_var(name)
+                shape = getattr(val, 'shape', None)
+                spec = fit_spec(spec, shape, self._mesh) \
+                    if shape is not None else None
+            out[name] = named_sharding(self._mesh, spec)
+        return out
+
+    # -- mesh introspection ------------------------------------------------
+    @property
+    def mesh_shape(self):
+        """'tp=2'-style axis spec ('' = single-chip) — surfaced through
+        ServingEngine.stats() and SRV_HEALTH."""
+        return self._mesh_shape
+
+    @property
+    def mesh_devices(self):
+        return int(self._mesh.devices.size) if self._mesh is not None \
+            else 1
 
     # -- introspection -----------------------------------------------------
     @property
@@ -75,9 +137,17 @@ class DecodePredictor(object):
         """Pin every referenced parameter to device in the PARENT scope
         before any child scope exists — otherwise the executor's lazy
         pin would write per-worker device copies into each child,
-        duplicating the model in HBM once per clone."""
+        duplicating the model in HBM once per clone.
+
+        On a mesh this also covers already-device-resident arrays (a
+        predictor that ran before prepare_decoding leaves params
+        committed to one chip): device_put reshards them onto their
+        serve NamedSharding, so the executor's single-device lazy-pin
+        path never fires for a mesh weight."""
         import jax
         block = self._pair.decode_program.global_block()
+        shardings = self._param_shardings() if self._mesh is not None \
+            else None
         for name in self._pair.spec.param_names():
             val = self._weight_scope.find_var(name)
             if val is None:
@@ -86,27 +156,41 @@ class DecodePredictor(object):
                     'in the predictor scope — was the model loaded with '
                     'load_params=True?' % name)
             if isinstance(val, np.ndarray) and \
-                    val.dtype not in (np.int64, np.uint64, np.float64):
-                var = block.vars.get(name)
-                if var is not None and var.persistable:
-                    self._weight_scope.set_var(
-                        name, jax.device_put(val, self._exe.device))
+                    val.dtype in (np.int64, np.uint64, np.float64):
+                continue
+            var = block.vars.get(name)
+            if var is None or not var.persistable:
+                continue
+            if shardings is not None:
+                self._weight_scope.set_var(
+                    name, jax.device_put(val, shardings[name]))
+            elif isinstance(val, np.ndarray):
+                self._weight_scope.set_var(
+                    name, jax.device_put(val, self._exe.device))
 
     def load_sharded(self, ckpt_dir, mesh=None):
         """Replace the weights from a sharded checkpoint root
         (checkpoint/sharded.py two-generation layout): each referenced
         param is assembled from the shard files of the last committed,
         digest-verified generation and resharded onto `mesh` (default:
-        pinned whole to this predictor's device) — serving can roll to
-        a checkpoint saved on ANY training topology. Cache vars are
-        runtime state, never checkpointed, never touched here. Raises
-        if no generation is loadable or a referenced param is absent."""
+        this predictor's serving mesh, else pinned whole to its
+        device) — serving can roll to a checkpoint saved on ANY
+        training topology; train-on-n/serve-on-m is a pure reshard. On
+        a mesh the params land under their SERVE specs (column-style
+        only; the checkpoint's recorded training spec is deliberately
+        overridden — a row-sharded restore would break the bit-exact
+        decode contract). Cache vars are runtime state, never
+        checkpointed, never touched here. Raises if no generation is
+        loadable or a referenced param is absent."""
         import jax
         from ..checkpoint import restore as restore_mod
         ckpt = restore_mod.load_checkpoint(ckpt_dir)
         if ckpt is None:
             raise RuntimeError(
                 'no committed checkpoint generation under %r' % ckpt_dir)
+        if mesh is None:
+            mesh = self._mesh
+        serve = self._pair.spec.serve_param_specs()
         cache_names = set(self._pair.cache_names)
         for name in self._pair.spec.param_names():
             if name in cache_names:
@@ -116,7 +200,11 @@ class DecodePredictor(object):
                     'sharded checkpoint %s (generation %d) is missing '
                     'param %r' % (ckpt.dirname, ckpt.generation, name))
             if mesh is not None:
-                val = ckpt.as_jax(name, mesh)
+                # spec=() (not None): None would fall back to the spec
+                # RECORDED at save — the training layout, not the
+                # bit-exact serve layout
+                val = ckpt.as_jax(name, mesh,
+                                  spec=serve.get(name, ()))
             else:
                 val = jax.device_put(ckpt.read(name), self._exe.device)
             self._weight_scope.set_var(name, val)
@@ -152,6 +240,8 @@ class DecodePredictor(object):
         mismatch."""
         import jax
         known = set(self.param_names())
+        shardings = self._param_shardings() if self._mesh is not None \
+            else None
         staged = {}
         for name, val in params.items():
             if name not in known:
@@ -165,7 +255,10 @@ class DecodePredictor(object):
                 raise ValueError(
                     'refresh shape mismatch for %r: got %r, serving %r'
                     % (name, arr.shape, tuple(cur_shape)))
-            staged[name] = jax.device_put(arr, self._exe.device)
+            if shardings is not None:
+                staged[name] = jax.device_put(arr, shardings[name])
+            else:
+                staged[name] = jax.device_put(arr, self._exe.device)
         return staged
 
     def install_weights(self, staged):
@@ -178,10 +271,20 @@ class DecodePredictor(object):
             self._weight_scope.set_var(name, val)
 
     def reset(self):
-        """Zero every ring cache (all slots forget everything)."""
+        """Zero every ring cache (all slots forget everything). On a
+        mesh the zeros are placed under the heads-sharded pin up front,
+        so the first step compiles against the steady-state layout."""
         shape = self._pair.spec.cache_shape(self.slots)
         for name in self._pair.cache_names:
-            self._scope.set_var(name, np.zeros(shape, np.float32))
+            self._scope.set_var(name, self._place_cache(
+                name, np.zeros(shape, np.float32)))
+
+    def _place_cache(self, name, value):
+        """Host K/V state -> the executor's pinned device layout (the
+        identity off-mesh: the executor lazy-pins on first run)."""
+        if self._mesh is None:
+            return value
+        return self._exe.place_state(name, value)
 
     def clone(self):
         """A worker sharing this one's weights and compiled-program

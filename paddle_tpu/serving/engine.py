@@ -465,6 +465,10 @@ class ServingEngine(object):
                'preempted_streams': preempted,
                'swap_host_bytes': self._swap_budget.used_bytes,
                'paged': paged,
+               # mesh-sharded serving (serving/mesh.py): '' and 1 on
+               # the single-chip path
+               'mesh_shape': getattr(p0, 'mesh_shape', ''),
+               'mesh_devices': getattr(p0, 'mesh_devices', 1),
                # per-worker {slot: tokens held} — actual cache pressure,
                # so the fleet router's least-loaded dispatch can weigh
                # a worker near its token capacity over one holding the

@@ -347,6 +347,7 @@ class _Replica(object):
                  'cache_tokens', 'cache_capacity',
                  'effective_tokens_per_step', 'spec_accept_rate',
                  'preemptions', 'preempted_streams', 'role',
+                 'mesh_shape', 'mesh_devices',
                  'page_tokens', 'prefix_hits', 'prefix_misses',
                  'prefix_entries', 'prefix_pages', 'pages_shipped',
                  'ship_bytes', 'pages_installed', 'pages_deduped',
@@ -392,6 +393,10 @@ class _Replica(object):
         # default) is the decode/colocated tier. The prefix/ship
         # numbers mirror the replica's SRV_HEALTH truth.
         self.role = role
+        # mesh-sharded replicas: axis spec + chip count their SPMD
+        # decode programs span (SRV_HEALTH; '' / 1 = single-chip)
+        self.mesh_shape = ''
+        self.mesh_devices = 1
         self.page_tokens = None
         self.prefix_hits = 0
         self.prefix_misses = 0
@@ -807,6 +812,8 @@ class FleetRouter(object):
                          'preemptions': r.preemptions,
                          'preempted_streams': r.preempted_streams,
                          'role': r.role,
+                         'mesh_shape': r.mesh_shape,
+                         'mesh_devices': r.mesh_devices,
                          'prefix_entries': r.prefix_entries,
                          'prefix_hits': r.prefix_hits,
                          'prefix_misses': r.prefix_misses,
@@ -1386,6 +1393,8 @@ class FleetRouter(object):
                 rep.preemptions = int(h.get('preemptions', 0) or 0)
                 rep.preempted_streams = int(
                     h.get('preempted_streams', 0) or 0)
+                rep.mesh_shape = h.get('mesh_shape', '') or ''
+                rep.mesh_devices = int(h.get('mesh_devices', 1) or 1)
                 self._dir_apply_locked(rep, h)
                 rep.healthy = True
         with self._mu:

@@ -39,7 +39,7 @@ from __future__ import annotations
 
 import numpy as np
 
-from ..executor import Executor, Scope
+from ..executor import Scope
 from ..flags import get_flag
 from ..obs import telemetry
 from .decode import DecodePredictor
@@ -72,26 +72,35 @@ class PagedDecodePredictor(DecodePredictor):
 
     def __init__(self, predictor, slots=None, page_tokens=None,
                  kv_pages=None, prefill_chunk=None, _clone_of=None,
-                 pair=None):
+                 pair=None, mesh=None):
         """With `pair` (an already-transpiled PagedDecodePair) the
         transpile is skipped — the speculative path builds its target
-        and draft pairs in one transpile_spec and hands them here."""
+        and draft pairs in one transpile_spec and hands them here.
+        mesh follows the DecodePredictor contract (None = read
+        FLAGS_serve_mesh_shape; '' = single-chip): the page pool shards
+        its heads axis over tp and every program runs as ONE SPMD
+        program over the mesh (serving/mesh.py)."""
         self._base = predictor
         if _clone_of is not None:
             self._pair = _clone_of._pair
             self._weight_scope = _clone_of._weight_scope
-        elif pair is not None:
-            self._pair = pair
-            self._weight_scope = predictor._scope
+            self._mesh = _clone_of._mesh
+            self._mesh_shape = _clone_of._mesh_shape
         else:
-            from ..transpiler.decode_transpiler import DecodeTranspiler
-            slots = int(slots or get_flag('serving_slots'))
-            self._pair = DecodeTranspiler().transpile(
-                predictor._program, slots=slots, paged=True,
-                page_tokens=page_tokens, kv_pages=kv_pages,
-                prefill_chunk=prefill_chunk)
+            from .mesh import serving_mesh
+            if pair is not None:
+                self._pair = pair
+            else:
+                from ..transpiler.decode_transpiler import DecodeTranspiler
+                slots = int(slots or get_flag('serving_slots'))
+                self._pair = DecodeTranspiler().transpile(
+                    predictor._program, slots=slots, paged=True,
+                    page_tokens=page_tokens, kv_pages=kv_pages,
+                    prefill_chunk=prefill_chunk)
             self._weight_scope = predictor._scope
-        self._exe = Executor(predictor._place)
+            self._mesh, self._mesh_shape = serving_mesh(mesh)
+            self._pair.spec.mesh = self._mesh_shape
+        self._exe = self._make_executor(predictor._place)
         if _clone_of is None:
             self._pin_weights()
         self._scope = Scope(parent=self._weight_scope)
@@ -142,10 +151,13 @@ class PagedDecodePredictor(DecodePredictor):
     # -- lifecycle ---------------------------------------------------------
     def reset(self):
         """Zero the page pools and forget every stream and cached
-        prefix (fresh allocator state)."""
+        prefix (fresh allocator state). On a mesh the zeroed pools land
+        under the heads-sharded pin up front (steady-state layout from
+        step one)."""
         shape = self._pair.pool_shape
         for name in self._pair.cache_names:
-            self._scope.set_var(name, np.zeros(shape, np.float32))
+            self._scope.set_var(name, self._place_cache(
+                name, np.zeros(shape, np.float32)))
         self._pool = PagePool(self.num_pages, self.page_tokens)
         self._prefix = PrefixCache(self._pool)
         self._pool.set_evict(self._prefix.evict_one)
@@ -233,7 +245,10 @@ class PagedDecodePredictor(DecodePredictor):
         pools = [self._scope.find_var(name) for name in names]
         ids, pools = self._pool.restore_pages(pools, snapshot['data'])
         for name, pool in zip(names, pools):
-            self._scope.set_var(name, pool)
+            # _place_cache: on a mesh the .at[].set result re-pins to the
+            # heads-sharded layout so the donated pool never flips
+            # sharding (which would recompile the decode step)
+            self._scope.set_var(name, self._place_cache(name, pool))
         table = PageTable(self._pool, self.pages_per_slot)
         table.pages = list(ids)
         table.length = int(snapshot['length'])
@@ -312,7 +327,7 @@ class PagedDecodePredictor(DecodePredictor):
         ids, pools = self._pool.restore_pages(
             pools, [rows[have - skip:n - skip] for rows in data])
         for name, pool in zip(names, pools):
-            self._scope.set_var(name, pool)
+            self._scope.set_var(name, self._place_cache(name, pool))
         parent = resident[have - 1] if have else b''
         self._prefix.extend_chain(
             parent, [bytes.fromhex(k) for k in keys[have:n]], ids)

@@ -341,6 +341,11 @@ class ReplicaServer(object):
                # treats waiting preempted streams as cache pressure
                'preemptions': stats.get('preemptions', 0),
                'preempted_streams': stats.get('preempted_streams', 0),
+               # mesh-sharded serving: the axis spec ('' = single-chip)
+               # and chip count this replica's SPMD programs span — the
+               # fleet surfaces both so per-chip throughput is auditable
+               'mesh_shape': stats.get('mesh_shape', ''),
+               'mesh_devices': stats.get('mesh_devices', 1),
                'draining': self._draining}
         with self._lock:
             out['pages_shipped'] = self._pages_shipped_n

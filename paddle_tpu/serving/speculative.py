@@ -74,9 +74,9 @@ class DraftModel(PagedDecodePredictor):
     draft's parameter names resolve to the target's own pinned
     weights."""
 
-    def __init__(self, predictor, pair=None, _clone_of=None):
+    def __init__(self, predictor, pair=None, _clone_of=None, mesh=None):
         PagedDecodePredictor.__init__(self, predictor, pair=pair,
-                                      _clone_of=_clone_of)
+                                      _clone_of=_clone_of, mesh=mesh)
 
     def clone(self):
         return DraftModel(self._base, _clone_of=self)
@@ -102,7 +102,7 @@ class SpeculativeDecodePredictor(PagedDecodePredictor):
     def __init__(self, predictor, slots=None, spec_k=None,
                  draft_layers=None, draft_predictor=None,
                  page_tokens=None, kv_pages=None, prefill_chunk=None,
-                 _clone_of=None):
+                 _clone_of=None, mesh=None):
         if _clone_of is not None:
             self._spair = _clone_of._spair
             self._draft = _clone_of._draft.clone()
@@ -119,9 +119,13 @@ class SpeculativeDecodePredictor(PagedDecodePredictor):
             page_tokens=page_tokens, kv_pages=kv_pages,
             prefill_chunk=prefill_chunk)
         self._spair = spair
+        # draft and target share one mesh: the self-draft runs the SAME
+        # pinned (possibly column-sharded) weights, so its programs
+        # must compile over the same device set
         self._draft = DraftModel(draft_predictor or predictor,
-                                 pair=spair.draft)
-        PagedDecodePredictor.__init__(self, predictor, pair=spair.target)
+                                 pair=spair.draft, mesh=mesh)
+        PagedDecodePredictor.__init__(self, predictor, pair=spair.target,
+                                      mesh=mesh)
 
     # -- introspection -----------------------------------------------------
     @property

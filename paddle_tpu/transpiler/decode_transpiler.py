@@ -9,14 +9,21 @@ attention builders for two fresh programs that bind those names. Both
 run against the Predictor's existing weight Scope, so transpilation
 moves zero bytes of weights.
 
-Recognized source shape: the non-TP decoder-only LM
-(`language_model_logits` / `language_model` with use_tp=False) —
-lookup_table, position_embedding, per block [layer_norm, qkv mul,
-proj mul, layer_norm, up mul, down mul] (+ flash_attention or the
+Recognized source shape: the decoder-only LM (`language_model_logits`
+/ `language_model`, TP-sharded or not) — lookup_table,
+position_embedding, per block [layer_norm, qkv mul, proj mul,
+layer_norm, up mul, down mul] (+ flash_attention or the
 matmul/causal_mask/softmax triple), final layer_norm, lm_head mul.
-Anything else (TP-sharded muls, MoE, no attention reshape) raises
-DecodeTranspileError naming what was missing — better a loud refusal
-at prepare time than a silently wrong cache layout at serve time.
+GSPMD-style TP keeps full LOGICAL weight shapes, so a use_tp=True
+program walks identically; its sharding is RECOVERED into
+DecodeSpec.param_specs — from dist_attr annotations when the program
+is still in memory, else from the sharding_constraint ops that survive
+save_inference_model (see _recover_param_specs). Genuinely
+unsupported layouts (MoE expert-sharded FFN, ring attention, a
+constraint on an axis the serving mesh cannot honor) still raise
+DecodeTranspileError naming the offending op/axis — better a loud
+refusal at prepare time than a silently wrong cache layout at serve
+time.
 """
 from __future__ import annotations
 
@@ -127,20 +134,104 @@ def _truncate_spec(spec, draft_layers):
         raise DecodeTranspileError(
             'spec_draft_layers %d outside [1, %d] (target layers)'
             % (draft_layers, spec.layers))
-    return DecodeSpec(vocab=spec.vocab, dim=spec.dim, heads=spec.heads,
-                      layers=draft_layers, ffn=spec.ffn,
-                      max_len=spec.max_len, pos_len=spec.pos_len,
-                      emb_w=spec.emb_w, pos_w=spec.pos_w,
-                      blocks=spec.blocks[:draft_layers],
-                      final_ln=spec.final_ln, head=spec.head,
-                      use_flash=spec.use_flash)
+    truncated = DecodeSpec(vocab=spec.vocab, dim=spec.dim,
+                           heads=spec.heads,
+                           layers=draft_layers, ffn=spec.ffn,
+                           max_len=spec.max_len, pos_len=spec.pos_len,
+                           emb_w=spec.emb_w, pos_w=spec.pos_w,
+                           blocks=spec.blocks[:draft_layers],
+                           final_ln=spec.final_ln, head=spec.head,
+                           use_flash=spec.use_flash)
+    # the draft's params are a SUBSET of the target's: carry their
+    # recovered shardings so the self-draft shards the same way
+    names = set(truncated.param_names())
+    truncated.param_specs = {n: s for n, s in spec.param_specs.items()
+                             if n in names}
+    return truncated
 
 
 def _fail(msg):
     raise DecodeTranspileError(
         'cannot transpile program for cached decoding: %s (expected a '
-        'non-TP decoder-only LM from models.transformer.language_model'
+        'decoder-only LM from models.transformer.language_model'
         '[_logits])' % msg)
+
+
+# sharding_constraint specs emitted by parallel/layers.py directly
+# after a parallel fc's bias add; the LAST-dim axis tells the weight
+# layout (column: output features sharded -> w (None, ax); row: output
+# replicated after the psum -> w (ax, None)).
+_SERVABLE_AXES = ('dp', 'tp', 'sp', 'ep', 'pp')
+
+
+def _recover_param_specs(block, spec, muls, add_out_of, act_out_of,
+                         constraints):
+    """Recover each weight's PartitionSpec (tuple form) for mesh
+    serving. Two sources, in preference order:
+
+    1. var.dist_attr — present while the trained program is still in
+       memory (shard_tensor wrote it), lost on save/load;
+    2. the sharding_constraint ops parallel/layers.py appends right
+       after each parallel fc's bias add — these SURVIVE
+       save_inference_model, so a loaded TP program is still
+       recoverable: a 2-tuple constraint (.., ax) right after a mul's
+       add means column-parallel (w sharded (None, ax)); (.., None)
+       means row-parallel (w sharded (ax, None), inferred from the
+       matching column fc's axis).
+
+    Unannotated weights map to None (replicated). An axis outside the
+    canonical mesh axes is a genuinely unsupported layout -> loud
+    DecodeTranspileError naming the weight and axis."""
+    specs = {}
+
+    def record(name, wspec):
+        if wspec is None:
+            specs[name] = None
+            return
+        wspec = tuple(wspec)
+        for ax in wspec:
+            for a in (ax if isinstance(ax, (tuple, list)) else (ax,)):
+                if a is not None and a not in _SERVABLE_AXES:
+                    _fail('weight %r is sharded on unknown mesh axis '
+                          '%r (valid: %s)' % (name, a, _SERVABLE_AXES))
+        specs[name] = wspec
+
+    def infer(name, out_name):
+        try:
+            var = block.var_recursive(name)
+        except KeyError:
+            var = None
+        dist = getattr(var, 'dist_attr', None)
+        if dist is not None:
+            record(name, dist)
+            return
+        out = add_out_of.get(out_name, out_name)
+        out = act_out_of.get(out, out)
+        cspec = constraints.get(out)
+        if cspec is None or len(cspec) < 2:
+            specs[name] = None
+            return
+        ax = cspec[-1]
+        if isinstance(ax, (tuple, list)):
+            ax = ax[0] if ax else None
+        if ax is not None:
+            record(name, (None, ax))        # column-parallel
+        else:
+            # a trailing-None activation constraint right after a mul
+            # is the row-parallel signature; the contraction dim was
+            # sharded over whichever model axis the net uses (tp)
+            record(name, ('tp', None))
+    for mul_w, mul_out in muls:
+        infer(mul_w, mul_out)
+    # embedding: dist_attr only (vocab_parallel_embedding emits no
+    # constraint); lost after save/load -> replicated, still correct
+    try:
+        emb_var = block.var_recursive(spec.emb_w)
+    except KeyError:
+        emb_var = None
+    dist = getattr(emb_var, 'dist_attr', None)
+    record(spec.emb_w, tuple(dist) if dist is not None else None)
+    spec.param_specs = {n: specs.get(n) for n in spec.param_names()}
 
 
 def extract_decode_spec(program):
@@ -150,6 +241,9 @@ def extract_decode_spec(program):
     lns = []          # (scale_name, bias_name) in op order
     muls = []         # (w_name, out_name) in op order
     bias_of = {}      # mul/intermediate out name -> persistable bias name
+    add_out_of = {}   # mul out name -> its bias add's out name
+    act_out_of = {}   # fc activation's in name -> out name (one hop)
+    constraints = {}  # constrained var name -> sharding spec tuple
     reshape4 = None
     use_flash = False
 
@@ -168,6 +262,21 @@ def extract_decode_spec(program):
             muls.append((op.single_input('Y'), op.single_output('Out')))
         elif t == 'flash_attention':
             use_flash = True
+        elif t == 'moe_ffn':
+            _fail('op moe_ffn: expert-sharded (ep) MoE FFN has no '
+                  'cached-decode equivalent')
+        elif t == 'ring_attention':
+            _fail('op ring_attention: sp-ring attention has no '
+                  'cached-decode equivalent (serve with the paged '
+                  'cache instead)')
+        elif t == 'sharding_constraint':
+            spec = op.attr('spec')
+            if spec is not None:
+                constraints[op.single_input('X')] = tuple(spec)
+        elif t in ('gelu', 'relu', 'tanh', 'sigmoid'):
+            # fc applies its act AFTER the bias add, so a parallel fc's
+            # constraint sits one hop past add_out — record the hop
+            act_out_of[op.single_input('X')] = op.single_output('Out')
         elif t == 'reshape2' and reshape4 is None:
             shp = op.attr('shape') or []
             if len(shp) == 4:
@@ -179,7 +288,9 @@ def extract_decode_spec(program):
             except KeyError:
                 continue
             if yv.persistable:
-                bias_of[op.single_input('X')] = y
+                x = op.single_input('X')
+                bias_of[x] = y
+                add_out_of[x] = op.single_output('Out')
 
     if emb_w is None:
         _fail('no lookup_table op (token embedding)')
@@ -218,16 +329,20 @@ def extract_decode_spec(program):
                'up': pair(base + 2), 'down': pair(base + 3)}
         qkv_shape = block.var_recursive(blk['qkv'][0]).shape
         if tuple(qkv_shape) != (dim, 3 * dim):
-            _fail('layer %d qkv weight %r is %r, want (%d, %d) — '
-                  'TP-sharded programs are not transpilable'
+            _fail('layer %d qkv weight %r is %r, want the full logical '
+                  '(%d, %d) — GSPMD keeps logical shapes, so this is '
+                  'not a recognizable attention block'
                   % (i, blk['qkv'][0], tuple(qkv_shape), dim, 3 * dim))
         blocks.append(blk)
 
-    return DecodeSpec(vocab=vocab, dim=dim, heads=heads, layers=layers,
+    spec = DecodeSpec(vocab=vocab, dim=dim, heads=heads, layers=layers,
                       ffn=ffn, max_len=max_len, pos_len=pos_len,
                       emb_w=emb_w, pos_w=pos_w, blocks=blocks,
                       final_ln=lns[-1], head=pair(len(muls) - 1),
                       use_flash=use_flash)
+    _recover_param_specs(block, spec, muls, add_out_of, act_out_of,
+                         constraints)
+    return spec
 
 
 class DecodeTranspiler(object):

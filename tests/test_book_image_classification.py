@@ -6,6 +6,7 @@ target, not to 'smaller than before'), then save/load inference model.
 The ResNet chapter feeds through py_reader + double_buffer — the
 reference book's reader stack — not direct feeds."""
 import numpy as np
+import pytest
 
 import paddle_tpu as fluid
 from paddle_tpu.framework import Program, program_guard
@@ -80,6 +81,7 @@ def test_resnet_cifar10_trains_to_threshold(tmp_path):
     np.testing.assert_allclose(out.sum(axis=1), 1.0, rtol=1e-4)
 
 
+@pytest.mark.slow
 def test_vgg_trains_to_threshold():
     def small_vgg(img):
         return vgg.vgg16(img, class_dim=10)

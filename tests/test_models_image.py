@@ -5,6 +5,7 @@ structural checks. The elementwise []-vs-[1] regression test pins the
 scalar-shape contract the GoogLeNet aux-head loss composition
 exposed."""
 import numpy as np
+import pytest
 
 import paddle_tpu as fluid
 from paddle_tpu.framework import Program, program_guard
@@ -35,6 +36,7 @@ def _train(build, hw, steps=15, lr=1e-3):
     return losses
 
 
+@pytest.mark.slow
 def test_alexnet_trains():
     # is_test=True drops the dropout noise so the 2-sample overfit is
     # monotone enough to assert on; every weight still trains
@@ -47,6 +49,7 @@ def test_alexnet_trains():
     assert min(losses[-3:]) < losses[0]
 
 
+@pytest.mark.slow
 def test_googlenet_aux_heads_train():
     losses = _train(
         lambda i, l: googlenet.train_network(i, l, class_dim=4),
@@ -54,6 +57,7 @@ def test_googlenet_aux_heads_train():
     assert min(losses[-3:]) < losses[0]
 
 
+@pytest.mark.slow
 def test_googlenet_no_aux_small_input():
     losses = _train(
         lambda i, l: googlenet.train_network(i, l, class_dim=4,
@@ -94,6 +98,7 @@ def test_elementwise_scalar_vs_unit_shape_grad():
     assert np.isfinite(np.asarray(out)).all()
 
 
+@pytest.mark.slow
 def test_vgg19_depth_groups_build_and_train():
     """VGG-19 (the published-rows depth: 2-2-4-4-4 conv groups,
     benchmark/IntelOptimizedPaddle.md) builds and trains; the graph

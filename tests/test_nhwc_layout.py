@@ -27,6 +27,7 @@ def _build(nhwc):
 
 
 class TestNHWCParity:
+    @pytest.mark.slow
     def test_resnet18_training_parity(self):
         """Same weights -> identical losses across 4 training steps in
         either layout (fwd, backward, and optimizer all agree)."""

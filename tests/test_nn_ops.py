@@ -204,8 +204,11 @@ class TestSigmoidCrossEntropyWithLogits(OpTest):
     op_type = 'sigmoid_cross_entropy_with_logits'
 
     def test_all(self):
-        x = (np.random.rand(4, 5).astype('float32') - 0.5) * 4
-        label = np.random.rand(4, 5).astype('float32')
+        # seeded: unseeded draws occasionally land a logit near 0 where
+        # the finite-difference grad check's 2% tolerance is marginal
+        rng = np.random.RandomState(11)
+        x = (rng.rand(4, 5).astype('float32') - 0.5) * 4
+        label = rng.rand(4, 5).astype('float32')
         expect = np.maximum(x, 0) - x * label + np.log1p(np.exp(-np.abs(x)))
         self.inputs = {'X': x, 'Label': label}
         self.outputs = {'Out': expect}

@@ -5,6 +5,7 @@ memory lever is buffer reuse (memory_optimize); remat is the XLA-native
 equivalent. Checks: exact training parity vs the unscoped build, both
 policies, and fwd/bwd RNG consistency for dropout inside the scope."""
 import numpy as np
+import pytest
 
 import paddle_tpu as fluid
 from paddle_tpu import unique_name
@@ -41,6 +42,7 @@ def _train(remat, steps=4, dropout=False):
     return losses
 
 
+@pytest.mark.slow
 def test_recompute_training_parity():
     base = _train(None)
     np.testing.assert_allclose(base, _train('nothing'), rtol=1e-5)

@@ -4,6 +4,7 @@ relation w'[o, c*4+di*2+dj, m, n] = w[o, c, 2m+di-1, 2n+dj-1] (zero
 outside the 7x7 support) must reproduce the original conv output
 EXACTLY — this is a retiling, not a numerics change."""
 import numpy as np
+import pytest
 
 import paddle_tpu as fluid
 from paddle_tpu import unique_name
@@ -65,6 +66,7 @@ def test_space_to_depth_stem_exact():
     np.testing.assert_allclose(s2d, base, rtol=1e-5, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_resnet_trains_with_s2d_stem():
     rng = np.random.RandomState(1)
     from paddle_tpu.models import resnet

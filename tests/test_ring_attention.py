@@ -145,6 +145,7 @@ def test_ring_attention_op_off_mesh_fallback():
                                rtol=1e-4, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_transformer_ring_attention_trains_on_dp_sp_mesh():
     """Program-built transformer with cfg.ring_attention under dp2 x sp4
     matches the serial (full-attention) transformer's losses."""

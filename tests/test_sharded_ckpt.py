@@ -556,12 +556,15 @@ def test_fit_spec_adapts_to_new_topology():
 # serving satellite: DecodePredictor.load_sharded serve-after-reshard
 # ---------------------------------------------------------------------------
 
-def test_serve_after_reshard_parity(tmp_path):
+@pytest.mark.parametrize('paged', [False, True],
+                         ids=['dense', 'paged'])
+def test_serve_after_reshard_parity(tmp_path, paged):
     """Weights saved SHARDED on a dp=2xtp=2 training mesh, loaded by a
-    single-device DecodePredictor: greedy decode is identical to the
-    predictor's original weights (the save/reshard/load round trip is
-    exact), caches are never part of the checkpoint, and a missing
-    param raises naming it."""
+    single-device predictor — both the dense-cache DecodePredictor and
+    the page-pool PagedDecodePredictor: greedy decode is identical to
+    the predictor's original weights (the save/reshard/load round trip
+    is exact), caches and page pools are never part of the checkpoint,
+    and a missing param raises naming it."""
     from paddle_tpu import unique_name
     from paddle_tpu.framework import Program, program_guard
     from paddle_tpu.models.transformer import (TransformerConfig,
@@ -585,7 +588,12 @@ def test_serve_after_reshard_parity(tmp_path):
                                       exe, main_program=prog)
     predictor = AnalysisPredictor(AnalysisConfig(model_dir,
                                                  place=fluid.CPUPlace()))
-    dec = predictor.prepare_decoding(slots=2, prefill_batch=1)
+    if paged:
+        dec = predictor.prepare_decoding(slots=2, paged=True,
+                                         page_tokens=4, kv_pages=8,
+                                         prefill_chunk=cfg.max_len)
+    else:
+        dec = predictor.prepare_decoding(slots=2, prefill_batch=1)
     prompt = [3, 1, 4]
     ref_tokens = dec.generate(prompt, 4)
 

@@ -169,6 +169,17 @@ def run_one(model, mode, steps, full, quick=False):
         row['hbm_peak'] = int(snap['gauges']['hbm.watermark_bytes'])
         telemetry.disable(final_flush=False)
         telemetry.reset()
+        if model == 'transformer':
+            # mesh-sharded serving leg (serve_bench --quick --mesh):
+            # stamps the SPMD decode throughput + per-chip numbers the
+            # perf gate tracks, and the mesh axis spec they ran under
+            mesh = _mesh_quick()
+            if mesh.get('mesh_tokens_per_sec'):
+                row['mesh_shape'] = mesh.get('mesh_shape', '')
+                for key in ('mesh_tokens_per_sec',
+                            'mesh_tokens_per_sec_per_chip',
+                            'mesh_hbm_per_chip_mb'):
+                    row[key] = mesh[key]
     elif model == 'transformer' and mode == 'local':
         # subprocess extra — skipped under --quick to keep the gate
         # feed fast
@@ -384,6 +395,35 @@ def _transport_quick():
         except Exception:   # noqa: BLE001 — a bench extra, never fatal
             _TRANSPORT_QUICK[0] = 0.0
     return _TRANSPORT_QUICK[0]
+
+
+_MESH_QUICK = [None]        # serve_bench --quick --mesh, at most once
+
+
+def _mesh_quick():
+    """Mesh-sharded serving headline (tools/serve_bench.py --quick
+    --mesh): one GSPMD SPMD decode program over a tp=2 mesh vs the
+    same paged pool single-chip, bit-exact checked in the bench
+    itself. Stamped onto the transformer --quick row so perf_gate
+    tracks mesh_tokens_per_sec / _per_chip / mesh_hbm_per_chip_mb.
+    One subprocess, cached across invocations; {} on any failure."""
+    if _MESH_QUICK[0] is None:
+        try:
+            env = dict(os.environ, JAX_PLATFORMS='cpu')
+            # let the child set its own multi-device host override —
+            # it must land before the child's jax backend initializes
+            env.pop('XLA_FLAGS', None)
+            out = subprocess.run(
+                [sys.executable,
+                 os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                              'serve_bench.py'), '--quick', '--mesh'],
+                capture_output=True, text=True, timeout=900, env=env)
+            line = [ln for ln in out.stdout.splitlines()
+                    if ln.startswith('{') and '"summary"' in ln][-1]
+            _MESH_QUICK[0] = json.loads(line)
+        except Exception:   # noqa: BLE001 — a bench extra, never fatal
+            _MESH_QUICK[0] = {}
+    return _MESH_QUICK[0]
 
 
 _SERVING_QUICK = [None]     # serve_bench --quick, measured at most once

@@ -123,6 +123,17 @@ Verdicts: `recovered` (fault fired, ship fell back, streams intact),
 changed or failed), `fatal` additionally when the fault fired but no
 fallback engaged, plus the usual `hung`.
 
+`--mesh-serve` is the GSPMD flavor of `--fleet`: the same two-replica
+topology, but every replica serves mesh-sharded (SERVE_MESH_SHAPE=tp=2
+over 8 virtual CPU devices — the XLA_FLAGS device-count override rides
+the role env so it lands before the child imports jax), while the
+fault-free baseline run stays SINGLE-chip. Each seed kill-9's the
+mesh-backed replica 0 at a seeded wire message under the restarting
+Supervisor, so acceptance gates two properties at once: failover off a
+dead sharded replica, and the recovered streams matching the
+single-chip baseline BIT-exactly (GSPMD decode must change no token).
+Same verdicts as `--fleet`.
+
 `--quick` is the CI smoke shape: 3 seeds by default, and the exit
 status is ALSO non-zero on any fatal/hung seed (a quick sweep exists
 to gate regressions, so every non-ok outcome fails it).
@@ -139,6 +150,7 @@ Usage:
     python tools/chaos_sweep.py --overload --quick  # preempt-first capacity
     python tools/chaos_sweep.py --grayfail --quick  # gray-failure watchdog
     python tools/chaos_sweep.py --disagg --quick    # prefill-tier kill/stall
+    python tools/chaos_sweep.py --mesh-serve --quick # mesh-replica kill
 
 Exit status is non-zero iff any seed DIVERGED (or, under --quick, any
 seed was fatal/hung): fatal/hung seeds of the full sweep are
@@ -449,7 +461,8 @@ def _run_refresh_seed(seed, steps, pservers, budget, workdir,
 
 
 def _run_fleet_seed(seed, budget, workdir, model_dir, baseline,
-                    n_replicas=2, streams=24, gen=10, obs_dir=None):
+                    n_replicas=2, streams=24, gen=10, obs_dir=None,
+                    mesh=''):
     """One --fleet seed: n serve_replica.py processes + a FleetRouter
     driver (tests/fleet_worker.py) under the Supervisor, with a seeded
     exit fault on either replica 0 (recv side) or the driver (send
@@ -458,17 +471,23 @@ def _run_fleet_seed(seed, budget, workdir, model_dir, baseline,
     driver re-runs the identical seeded workload from scratch — must
     match the baseline streams bit-exactly. The workload seed is FIXED
     (only the kill point varies per sweep seed) so every run is
-    comparable. Returns (verdict, streams, victim, plan_json, outs)."""
+    comparable. mesh='tp=2' (the --mesh-serve sweep) serves every
+    replica GSPMD-sharded over 8 virtual CPU devices; the victim is
+    then always the mesh-backed replica 0, and the single-chip
+    baseline makes bit-exactness a cross-sharding check too.
+    Returns (verdict, streams, victim, plan_json, outs)."""
     import random
 
     from paddle_tpu.distributed.supervisor import Supervisor
 
     ports = _free_ports(n_replicas)
     eps = ['127.0.0.1:%d' % p for p in ports]
-    rng = random.Random(('fleet', seed).__repr__())
+    rng = random.Random((('mesh-serve' if mesh else 'fleet'),
+                         seed).__repr__())
     victim, plan_json = None, ''
     if baseline is not None:
-        victim = rng.choice(['replica0', 'driver'])
+        victim = ('replica0' if mesh else
+                  rng.choice(['replica0', 'driver']))
         plan_json = json.dumps({'rules': [{
             'when': 'recv' if victim == 'replica0' else 'send',
             'type': '*', 'nth': rng.randint(15, 90),
@@ -486,6 +505,12 @@ def _run_fleet_seed(seed, budget, workdir, model_dir, baseline,
         env = dict(base_env, SERVE_MODEL_DIR=model_dir,
                    SERVE_ENDPOINT=ep, SERVE_SLOTS='4',
                    SERVE_WORKERS='1')
+        if mesh:
+            # the device-count override must ride the role env — it
+            # has to be in place before the replica process imports
+            # jax (see serve_replica.py's SERVE_MESH_SHAPE contract)
+            env['SERVE_MESH_SHAPE'] = mesh
+            env['XLA_FLAGS'] = '--xla_force_host_platform_device_count=8'
         if victim == 'replica0' and i == 0:
             env['FLAGS_fault_plan'] = plan_json
         sup.add_role('replica%d' % i,
@@ -810,6 +835,12 @@ def main(argv=None):
                          'gray-stall the prefill-tier replica at a '
                          'seeded SRV_PAGE_FETCH mid-ship; every stream '
                          'must finish bit-exact via local re-prefill')
+    ap.add_argument('--mesh-serve', action='store_true',
+                    help='mesh-sharded serving chaos: kill-9 a GSPMD '
+                         '(SERVE_MESH_SHAPE=tp=2) replica mid-stream at '
+                         'a seeded wire message; the recovered fleet '
+                         'must reproduce the fault-free SINGLE-chip '
+                         'stream baseline bit-exactly')
     ap.add_argument('--quick', action='store_true',
                     help='CI smoke: 3 seeds unless --seeds given, and '
                          'fatal/hung seeds fail the sweep too')
@@ -823,11 +854,11 @@ def main(argv=None):
                          '(default: a ./chaos_report.<pid> dir)')
     args = ap.parse_args(argv)
     if sum((args.kill, args.corrupt, args.mesh_kill, args.refresh,
-            args.fleet, args.overload, args.grayfail,
-            args.disagg)) > 1:
+            args.fleet, args.overload, args.grayfail, args.disagg,
+            args.mesh_serve)) > 1:
         ap.error('--kill, --corrupt, --mesh-kill, --refresh, --fleet, '
-                 '--overload, --grayfail and --disagg are mutually '
-                 'exclusive')
+                 '--overload, --grayfail, --disagg and --mesh-serve '
+                 'are mutually exclusive')
     if args.seeds is None:
         args.seeds = 3 if args.quick else 20
 
@@ -843,13 +874,14 @@ def main(argv=None):
         # (printed by online_worker) are the acceptance reference, so
         # the comparison lives inside _run_refresh_seed
         local_w = {}
-    elif args.fleet or args.overload or args.grayfail or args.disagg:
+    elif (args.fleet or args.overload or args.grayfail or args.disagg
+          or args.mesh_serve):
         # one model for the whole sweep (every replica and every seed
-        # serves the identical bytes), then — for --fleet — a
-        # fault-free fleet run for the bit-exact stream baseline
-        # (--overload, --grayfail and --disagg need no external
-        # baseline: their drivers check every stream against an
-        # in-process reference)
+        # serves the identical bytes), then — for --fleet and
+        # --mesh-serve — a fault-free SINGLE-chip fleet run for the
+        # bit-exact stream baseline (--overload, --grayfail and
+        # --disagg need no external baseline: their drivers check
+        # every stream against an in-process reference)
         import atexit
         import shutil
         fleet_root = tempfile.mkdtemp(prefix='fleet_sweep.')
@@ -860,8 +892,8 @@ def main(argv=None):
         build_env.pop('XLA_FLAGS', None)
         subprocess.run([sys.executable, _FLEET_WORKER], env=build_env,
                        check=True)
-        if args.fleet:
-            print('baseline: fault-free fleet ...')
+        if args.fleet or args.mesh_serve:
+            print('baseline: fault-free fleet (single-chip) ...')
             with tempfile.TemporaryDirectory() as workdir:
                 verdict, fleet_baseline, _, _, outs = _run_fleet_seed(
                     0, args.budget, workdir, model_dir, None)
@@ -904,7 +936,8 @@ def main(argv=None):
     ok_verdicts = (('ok', 'recovered', 'nokill') if args.refresh
                    else ('recovered', 'nokill')
                    if (args.kill or args.mesh_kill or args.fleet or
-                       args.overload or args.grayfail or args.disagg)
+                       args.overload or args.grayfail or args.disagg
+                       or args.mesh_serve)
                    else ('ok',))
     tally = {'ok': 0, 'recovered': 0, 'nokill': 0, 'diverged': 0,
              'fatal': 0, 'hung': 0}
@@ -930,6 +963,14 @@ def main(argv=None):
                                     obs_dir=obs_dir)
             weights = {}
             label = '%s %s' % (victim, plan_json)
+        elif args.mesh_serve:
+            with tempfile.TemporaryDirectory() as workdir:
+                verdict, _streams, victim, plan_json, outs = \
+                    _run_fleet_seed(seed, args.budget, workdir,
+                                    model_dir, fleet_baseline,
+                                    obs_dir=obs_dir, mesh='tp=2')
+            weights = {}
+            label = 'mesh(tp=2) %s %s' % (victim, plan_json)
         elif args.overload:
             with tempfile.TemporaryDirectory() as workdir:
                 verdict, result, victim, plan_json, outs = \
@@ -1023,6 +1064,7 @@ def main(argv=None):
              tally['diverged'], tally['fatal'], tally['hung']))
     if report_root:
         mode = ('refresh' if args.refresh
+                else 'mesh-serve' if args.mesh_serve
                 else 'fleet' if args.fleet
                 else 'overload' if args.overload
                 else 'grayfail' if args.grayfail

@@ -51,9 +51,9 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 _HIGHER = ('_per_sec', 'mfu', 'value', 'tflops', 'speedup',
            'vs_baseline', 'samples_per_sec', 'efficiency', 'hits',
            '_max_streams', '_accept_rate', '_completion_rate',
-           '_win_rate', '_hit_rate')
+           '_win_rate', '_hit_rate', '_per_chip')
 _LOWER = ('_ms', '_secs', 'compile_ms', 'hbm_peak', 'peak_hbm_gb',
-          '_bytes', 'misses', 'latency')
+          '_bytes', 'misses', 'latency', '_hbm_per_chip_mb')
 
 TOL_DEFAULT = 0.05
 # longcontext numbers move ~11% between identical runs depending on
@@ -248,6 +248,32 @@ def smoke():
     fails, _, _ = gate(traj_dis, {'fleet_prefix_hit_rate': 0.84,
                                   'disagg_p99_ttft_ms': 110.0})
     expect(not fails, 'healthy disagg metrics flagged: %r' % fails)
+    # mesh leg metrics (serve_bench --mesh): aggregate AND per-chip
+    # throughput gate as higher-better (a mesh that holds aggregate by
+    # burning N more chips must trip on _per_chip); the per-chip HBM
+    # footprint rides a lower-is-better ceiling
+    traj_mesh = [{'mesh_tokens_per_sec': 2000.0,
+                  'mesh_tokens_per_sec_per_chip': 1000.0,
+                  'mesh_hbm_per_chip_mb': 50.0}]
+    fails, _, _ = gate(traj_mesh, {'mesh_tokens_per_sec': 2100.0,
+                                   'mesh_tokens_per_sec_per_chip': 500.0,
+                                   'mesh_hbm_per_chip_mb': 49.0})
+    expect(any(f[0] == 'mesh_tokens_per_sec_per_chip' for f in fails),
+           'per-chip throughput collapse missed')
+    fails, _, _ = gate(traj_mesh, {'mesh_tokens_per_sec': 1500.0,
+                                   'mesh_tokens_per_sec_per_chip': 990.0,
+                                   'mesh_hbm_per_chip_mb': 50.0})
+    expect(any(f[0] == 'mesh_tokens_per_sec' for f in fails),
+           'mesh aggregate throughput regression missed')
+    fails, _, _ = gate(traj_mesh, {'mesh_tokens_per_sec': 2000.0,
+                                   'mesh_tokens_per_sec_per_chip': 1000.0,
+                                   'mesh_hbm_per_chip_mb': 90.0})
+    expect(any(f[0] == 'mesh_hbm_per_chip_mb' for f in fails),
+           'per-chip HBM growth missed')
+    fails, _, _ = gate(traj_mesh, {'mesh_tokens_per_sec': 1990.0,
+                                   'mesh_tokens_per_sec_per_chip': 996.0,
+                                   'mesh_hbm_per_chip_mb': 48.0})
+    expect(not fails, 'healthy mesh metrics flagged: %r' % fails)
     # per-metric tolerance override: longcontext 11% swing passes
     traj2 = [{'longcontext_mfu': 0.46}]
     fails, _, _ = gate(traj2, {'longcontext_mfu': 0.41})

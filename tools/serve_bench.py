@@ -959,6 +959,97 @@ def _disagg_leg(cfg, quick, replicas=2):
             'prefix_dir_entries': dis['stats']['prefix_dir_entries']}
 
 
+def _hbm_per_chip_mb(dec):
+    """Max bytes any one chip holds of this predictor's weights + KV
+    state (the serve-footprint-per-chip number the mesh leg compares).
+    Sharded jax arrays are charged per shard to the device that holds
+    it; host numpy state charges to chip 0 (the single-chip path)."""
+    per = {}
+    names = (set(dec._pair.spec.param_names())
+             | set(dec._pair.cache_names))
+    seen = set()
+    for name in names:
+        arr = dec._scope.find_var(name)
+        if arr is None or id(arr) in seen:
+            continue
+        seen.add(id(arr))
+        shards = getattr(arr, 'addressable_shards', None)
+        if shards is not None:
+            for sh in shards:
+                key = sh.device.id
+                per[key] = per.get(key, 0) + int(sh.data.nbytes)
+        else:
+            per[0] = per.get(0, 0) + int(getattr(arr, 'nbytes', 0))
+    return round(max(per.values()) / 1e6, 3) if per else 0.0
+
+
+def _mesh_leg(cfg, quick, iters, mesh_shape):
+    """Mesh-sharded serving A/B leg (serving/mesh.py): the same paged
+    decode pool single-chip vs GSPMD over `mesh_shape`, same weights.
+    mesh_tokens_per_sec is steady-state full-pool decode throughput of
+    the SPMD program (one compiled step across the mesh, device-side
+    argmax — only token ids leave); mesh_tokens_per_sec_per_chip
+    divides by the mesh size (the number that must not crater — a mesh
+    that serves N× the chips for the same aggregate is a regression).
+    single_hbm_per_chip_mb vs mesh_hbm_per_chip_mb shows the heads-
+    sharded page pool + column-sharded weights actually splitting
+    across chips. The leg asserts the mesh stream is BIT-EXACT vs the
+    single-chip stream before timing anything."""
+    slots = 4 if quick else 8
+    pt = max(2, cfg.max_len // 8)
+    chunk = max(1, cfg.max_len // 2)
+    steps = max(4, cfg.max_len - 4)
+    rng = np.random.RandomState(17)
+    prompts = [list(rng.randint(1, cfg.vocab, 2)) for _ in range(slots)]
+    probe = list(rng.randint(1, cfg.vocab, 3))
+    n_probe = min(8, cfg.max_len - len(probe) - 1)
+
+    # ONE predictor for both runs: the A/B (and the bit-exact check)
+    # is meaningful only over identical weights. Single-chip runs
+    # first; the mesh run then reshards the shared parent scope.
+    pred = _build_predictor(cfg)
+
+    def run(mesh):
+        dec = pred.prepare_decoding(slots=slots, paged=True,
+                                    page_tokens=pt,
+                                    prefill_chunk=chunk, mesh=mesh)
+        stream = dec.generate(probe, n_probe)
+        dec.reset()
+        ids = dec.prefill(prompts, list(range(slots)))
+        toks = np.asarray(ids, np.int64)
+        pos = np.array([len(p) for p in prompts], np.int32)
+        dec.decode_step(toks, pos)          # compile outside the window
+        dec.reset()
+        ids = dec.prefill(prompts, list(range(slots)))
+        toks = np.asarray(ids, np.int64)
+        pos = np.array([len(p) for p in prompts], np.int32)
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            toks = np.asarray(dec.decode_step(toks, pos), np.int64)
+            pos += 1
+        dt = time.perf_counter() - t0
+        jit = dec.jit_cache_stats()
+        return {'tps': slots * steps / dt, 'stream': stream,
+                'hbm_mb': _hbm_per_chip_mb(dec),
+                'devices': dec.mesh_devices, 'jit': jit}
+
+    single = run('')
+    mesh = run(mesh_shape)
+    assert mesh['stream'] == single['stream'], \
+        'mesh greedy stream diverged from single-chip'
+    return {'mode': 'mesh', 'mesh_shape': mesh_shape,
+            'mesh_devices': mesh['devices'], 'slots': slots,
+            'page_tokens': pt, 'decode_steps': steps,
+            'bit_exact': True,
+            'single_tokens_per_sec': round(single['tps'], 2),
+            'mesh_tokens_per_sec': round(mesh['tps'], 2),
+            'mesh_tokens_per_sec_per_chip':
+                round(mesh['tps'] / max(1, mesh['devices']), 2),
+            'single_hbm_per_chip_mb': single['hbm_mb'],
+            'mesh_hbm_per_chip_mb': mesh['hbm_mb'],
+            'mesh_compiled_segments': mesh['jit']['compiled_segments']}
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument('--quick', action='store_true',
@@ -1007,10 +1098,23 @@ def main():
                          'greedy decode at equal cache HBM '
                          '(spec_tokens_per_sec, spec_accept_rate, '
                          'spec_speedup in the summary)')
+    ap.add_argument('--mesh', action='store_true',
+                    help='add the mesh-sharded serving A/B leg: the '
+                         'same paged decode single-chip vs one GSPMD '
+                         'SPMD program over --mesh-shape, bit-exact '
+                         'checked (mesh_tokens_per_sec + per-chip '
+                         'HBM in the summary)')
+    ap.add_argument('--mesh-shape', default='tp=2',
+                    help="mesh axis spec for --mesh (default 'tp=2')")
     ap.add_argument('--iters', type=int, default=20)
     args = ap.parse_args()
     if not args.full:
         os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+        if args.mesh:
+            # must land before jax initializes its backend: the CPU
+            # mesh leg needs more than one (virtual) device
+            os.environ.setdefault(
+                'XLA_FLAGS', '--xla_force_host_platform_device_count=8')
 
     from paddle_tpu.models import transformer as tfm
     if args.full:
@@ -1121,6 +1225,17 @@ def main():
         for key in ('spec_tokens_per_sec', 'plain_paged_tokens_per_sec',
                     'spec_accept_rate', 'spec_speedup'):
             summary[key] = spec_row[key]
+
+    if args.mesh:
+        mesh_row = _mesh_leg(cfg, args.quick, args.iters,
+                             args.mesh_shape)
+        mesh_row['config'] = label
+        print(json.dumps(mesh_row), flush=True)
+        for key in ('mesh_tokens_per_sec', 'mesh_tokens_per_sec_per_chip',
+                    'single_tokens_per_sec', 'mesh_hbm_per_chip_mb',
+                    'single_hbm_per_chip_mb'):
+            summary[key] = mesh_row[key]
+        summary['mesh_shape'] = mesh_row['mesh_shape']
 
     print(json.dumps(summary), flush=True)
     return summary

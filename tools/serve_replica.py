@@ -19,6 +19,12 @@ Environment contract (everything a Supervisor role env can carry):
                         sharing + chunked prefill); sized by
                         SERVE_PAGE_TOKENS / SERVE_KV_PAGES /
                         SERVE_PREFILL_CHUNK   (defaults from flags)
+  SERVE_MESH_SHAPE      'tp=2'-style axis spec -> the decode programs
+                        run GSPMD over a device mesh (serving/mesh.py;
+                        '' / unset = single-chip). The LAUNCHER env
+                        must carry any XLA_FLAGS device-count override
+                        — it has to be set before this process imports
+                        jax, so exporting it here would be too late.
   SERVE_PS_ENDPOINTS    comma-separated pserver endpoints; attaches a
                         ParamSubscriber. Default posture is PAUSED —
                         staleness is measured but only an
@@ -55,13 +61,15 @@ def main():
     page_tokens = os.environ.get('SERVE_PAGE_TOKENS')
     kv_pages = os.environ.get('SERVE_KV_PAGES')
     chunk = os.environ.get('SERVE_PREFILL_CHUNK')
+    mesh = os.environ.get('SERVE_MESH_SHAPE', '')
     srv = LMServer(model_dir,
                    slots=int(slots) if slots else None,
                    prefill_batch=int(prefill) if prefill else None,
                    workers=workers, paged=paged,
                    page_tokens=int(page_tokens) if page_tokens else None,
                    kv_pages=int(kv_pages) if kv_pages else None,
-                   prefill_chunk=int(chunk) if chunk else None)
+                   prefill_chunk=int(chunk) if chunk else None,
+                   mesh=mesh)
     ps_eps = os.environ.get('SERVE_PS_ENDPOINTS')
     if ps_eps:
         srv.enable_refresh(
